@@ -1,0 +1,15 @@
+"""Test configuration: force CPU with 8 virtual devices.
+
+Tests run on a virtual 8-device CPU mesh so sharding/collective code paths are
+exercised without TPU hardware (the driver separately dry-runs the multi-chip
+path; bench.py uses the real chip). Must run before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,382 @@
+"""Oracle semantics tests, mirroring the reference's state-machine test DSL
+scenarios (src/state_machine.zig:1674+ table-driven tests)."""
+
+import pytest
+
+from tigerbeetle_tpu.testing.model import Account, ReferenceStateMachine, Transfer
+from tigerbeetle_tpu.types import (
+    AccountFlags,
+    CreateAccountResult as AR,
+    CreateTransferResult as TR,
+    TransferFlags as F,
+)
+
+U128_MAX = (1 << 128) - 1
+
+
+def machine_with_accounts(n=4, ledger=1, flags=None):
+    m = ReferenceStateMachine()
+    accs = [
+        Account(id=i + 1, ledger=ledger, code=10, flags=(flags or {}).get(i + 1, 0))
+        for i in range(n)
+    ]
+    res = m.create_accounts(accs, wall_clock_ns=1_000)
+    assert res == []
+    return m
+
+
+class TestCreateAccounts:
+    def test_ok_and_timestamps(self):
+        m = ReferenceStateMachine()
+        res = m.create_accounts(
+            [Account(id=1, ledger=1, code=1), Account(id=2, ledger=1, code=1)],
+            wall_clock_ns=100,
+        )
+        assert res == []
+        # timestamp = prepare_timestamp - len + index + 1 (state_machine.zig:1035)
+        assert m.accounts[1].timestamp == 101
+        assert m.accounts[2].timestamp == 102
+
+    def test_validation_precedence(self):
+        m = ReferenceStateMachine()
+        res = m.create_accounts(
+            [
+                Account(id=0, ledger=0, code=0),  # id wins over ledger/code
+                Account(id=U128_MAX, ledger=1, code=1),
+                Account(id=3, ledger=0, code=0, reserved=1),  # reserved first
+                Account(id=4, ledger=1, code=1, flags=0x8000),  # padding flag
+                Account(id=5, ledger=0, code=1),
+                Account(id=6, ledger=1, code=0),
+                Account(id=7, ledger=1, code=1, debits_posted=1),
+                Account(
+                    id=8, ledger=1, code=1,
+                    flags=AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+                    | AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS,
+                ),
+                Account(id=9, ledger=1, code=1, timestamp=77),
+            ],
+            wall_clock_ns=100,
+        )
+        assert dict(res) == {
+            0: AR.id_must_not_be_zero,
+            1: AR.id_must_not_be_int_max,
+            2: AR.reserved_field,
+            3: AR.reserved_flag,
+            4: AR.ledger_must_not_be_zero,
+            5: AR.code_must_not_be_zero,
+            6: AR.debits_posted_must_be_zero,
+            7: AR.flags_are_mutually_exclusive,
+            8: AR.timestamp_must_be_zero,
+        }
+
+    def test_exists_ladder(self):
+        m = ReferenceStateMachine()
+        m.create_accounts([Account(id=1, ledger=1, code=1, user_data_64=5)], 100)
+        res = m.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1, user_data_64=5),
+                Account(id=1, ledger=2, code=1, user_data_64=5),
+                Account(id=1, ledger=1, code=9, user_data_64=5),
+                Account(id=1, ledger=1, code=1, user_data_64=6),
+                Account(id=1, ledger=1, code=1, user_data_64=5, flags=AccountFlags.HISTORY),
+            ],
+        )
+        assert dict(res) == {
+            0: AR.exists,
+            1: AR.exists_with_different_ledger,
+            2: AR.exists_with_different_code,
+            3: AR.exists_with_different_user_data_64,
+            4: AR.exists_with_different_flags,
+        }
+
+    def test_linked_chain_rollback(self):
+        m = ReferenceStateMachine()
+        # Chain of 3 where the middle fails: all get rolled back, FIFO errors.
+        res = m.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1, flags=AccountFlags.LINKED),
+                Account(id=2, ledger=0, code=1, flags=AccountFlags.LINKED),
+                Account(id=3, ledger=1, code=1),
+                Account(id=4, ledger=1, code=1),
+            ],
+            wall_clock_ns=100,
+        )
+        assert res == [
+            (0, AR.linked_event_failed),
+            (1, AR.ledger_must_not_be_zero),
+            (2, AR.linked_event_failed),
+        ]
+        assert 1 not in m.accounts and 3 not in m.accounts
+        assert 4 in m.accounts
+
+    def test_linked_chain_open(self):
+        m = ReferenceStateMachine()
+        res = m.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1),
+                Account(id=2, ledger=1, code=1, flags=AccountFlags.LINKED),
+            ],
+            wall_clock_ns=100,
+        )
+        assert res == [(1, AR.linked_event_chain_open)]
+        assert 1 in m.accounts and 2 not in m.accounts
+
+
+class TestCreateTransfers:
+    def test_ok_balances(self):
+        m = machine_with_accounts()
+        res = m.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=10)]
+        )
+        assert res == []
+        assert m.accounts[1].debits_posted == 100
+        assert m.accounts[2].credits_posted == 100
+        assert m.accounts[1].credits_posted == 0
+
+    def test_validation_ladder(self):
+        m = machine_with_accounts()
+        cases = [
+            (Transfer(id=0), TR.id_must_not_be_zero),
+            (Transfer(id=U128_MAX), TR.id_must_not_be_int_max),
+            (Transfer(id=1, flags=0x8000), TR.reserved_flag),
+            (Transfer(id=1, debit_account_id=0), TR.debit_account_id_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=U128_MAX), TR.debit_account_id_must_not_be_int_max),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=0), TR.credit_account_id_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=1), TR.accounts_must_be_different),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, pending_id=5), TR.pending_id_must_be_zero),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, timeout=5), TR.timeout_reserved_for_pending_transfer),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=0), TR.amount_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1), TR.ledger_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1, ledger=1), TR.code_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=9, credit_account_id=2, amount=1, ledger=1, code=1), TR.debit_account_not_found),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=9, amount=1, ledger=1, code=1), TR.credit_account_not_found),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1, ledger=2, code=1), TR.transfer_must_have_the_same_ledger_as_accounts),
+        ]
+        for i, (ev, expected) in enumerate(cases):
+            res = m.create_transfers([ev])
+            assert res == [(0, expected)], f"case {i}: got {res}, want {expected}"
+
+    def test_exists_ladder(self):
+        m = machine_with_accounts()
+        t0 = Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                      ledger=1, code=10)
+        assert m.create_transfers([t0]) == []
+        import dataclasses
+        variants = [
+            (dataclasses.replace(t0), TR.exists),
+            (dataclasses.replace(t0, flags=F.PENDING), TR.exists_with_different_flags),
+            (dataclasses.replace(t0, debit_account_id=3), TR.exists_with_different_debit_account_id),
+            (dataclasses.replace(t0, credit_account_id=3), TR.exists_with_different_credit_account_id),
+            (dataclasses.replace(t0, amount=11), TR.exists_with_different_amount),
+            (dataclasses.replace(t0, user_data_128=7), TR.exists_with_different_user_data_128),
+            (dataclasses.replace(t0, user_data_64=7), TR.exists_with_different_user_data_64),
+            (dataclasses.replace(t0, user_data_32=7), TR.exists_with_different_user_data_32),
+            (dataclasses.replace(t0, code=11), TR.exists_with_different_code),
+        ]
+        for ev, expected in variants:
+            assert m.create_transfers([ev]) == [(0, expected)], expected
+
+    def test_balance_limits(self):
+        # debits_must_not_exceed_credits (tigerbeetle.zig:31-34).
+        m = machine_with_accounts(
+            flags={1: int(AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS)}
+        )
+        # Fund account 1 with 100 credits.
+        m.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1, amount=100,
+                      ledger=1, code=10)]
+        )
+        res = m.create_transfers(
+            [
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=60,
+                         ledger=1, code=10),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=60,
+                         ledger=1, code=10),  # 60+60 > 100 -> exceeds_credits
+                Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=40,
+                         ledger=1, code=10),  # 60+40 == 100 -> ok
+            ]
+        )
+        assert res == [(1, TR.exceeds_credits)]
+        assert m.accounts[1].debits_posted == 100
+
+    def test_balancing_debit(self):
+        # balancing_debit clamps to available credits (state_machine.zig:1294-1298).
+        m = machine_with_accounts()
+        m.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1, amount=70,
+                      ledger=1, code=10)]
+        )
+        res = m.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=3, amount=100,
+                      ledger=1, code=10, flags=F.BALANCING_DEBIT)]
+        )
+        assert res == []
+        assert m.transfers[2].amount == 70  # clamped
+        assert m.accounts[1].debits_posted == 70
+        # Nothing left: next balancing transfer fails.
+        res = m.create_transfers(
+            [Transfer(id=3, debit_account_id=1, credit_account_id=3, amount=0,
+                      ledger=1, code=10, flags=F.BALANCING_DEBIT)]
+        )
+        assert res == [(0, TR.exceeds_credits)]
+
+    def test_two_phase_post(self):
+        m = machine_with_accounts()
+        res = m.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=10, flags=F.PENDING)]
+        )
+        assert res == []
+        assert m.accounts[1].debits_pending == 100
+        assert m.accounts[1].debits_posted == 0
+        # Partial post (amount < pending amount).
+        res = m.create_transfers(
+            [Transfer(id=2, pending_id=1, amount=60, flags=F.POST_PENDING_TRANSFER)]
+        )
+        assert res == []
+        assert m.accounts[1].debits_pending == 0
+        assert m.accounts[1].debits_posted == 60
+        assert m.accounts[2].credits_posted == 60
+        # Double post -> exists ladder first checks flags/amount/pending_id.
+        res = m.create_transfers(
+            [Transfer(id=2, pending_id=1, amount=60, flags=F.POST_PENDING_TRANSFER)]
+        )
+        assert res == [(0, TR.exists)]
+        # Posting again under a new id -> already posted.
+        res = m.create_transfers(
+            [Transfer(id=3, pending_id=1, amount=60, flags=F.POST_PENDING_TRANSFER)]
+        )
+        assert res == [(0, TR.pending_transfer_already_posted)]
+
+    def test_two_phase_void(self):
+        m = machine_with_accounts()
+        m.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=10, flags=F.PENDING)]
+        )
+        # Void with a smaller amount -> pending_transfer_has_different_amount.
+        res = m.create_transfers(
+            [Transfer(id=2, pending_id=1, amount=50, flags=F.VOID_PENDING_TRANSFER)]
+        )
+        assert res == [(0, TR.pending_transfer_has_different_amount)]
+        res = m.create_transfers(
+            [Transfer(id=2, pending_id=1, flags=F.VOID_PENDING_TRANSFER)]
+        )
+        assert res == []
+        assert m.accounts[1].debits_pending == 0
+        assert m.accounts[1].debits_posted == 0
+        res = m.create_transfers(
+            [Transfer(id=3, pending_id=1, flags=F.POST_PENDING_TRANSFER)]
+        )
+        assert res == [(0, TR.pending_transfer_already_voided)]
+
+    def test_two_phase_validations(self):
+        m = machine_with_accounts()
+        m.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=10, flags=F.PENDING)]
+        )
+        cases = [
+            (Transfer(id=2, pending_id=1,
+                      flags=F.POST_PENDING_TRANSFER | F.VOID_PENDING_TRANSFER),
+             TR.flags_are_mutually_exclusive),
+            (Transfer(id=2, pending_id=1, flags=F.POST_PENDING_TRANSFER | F.PENDING),
+             TR.flags_are_mutually_exclusive),
+            (Transfer(id=2, pending_id=0, flags=F.POST_PENDING_TRANSFER),
+             TR.pending_id_must_not_be_zero),
+            (Transfer(id=2, pending_id=U128_MAX, flags=F.POST_PENDING_TRANSFER),
+             TR.pending_id_must_not_be_int_max),
+            (Transfer(id=2, pending_id=2, flags=F.POST_PENDING_TRANSFER),
+             TR.pending_id_must_be_different),
+            (Transfer(id=2, pending_id=1, timeout=5, flags=F.POST_PENDING_TRANSFER),
+             TR.timeout_reserved_for_pending_transfer),
+            (Transfer(id=2, pending_id=99, flags=F.POST_PENDING_TRANSFER),
+             TR.pending_transfer_not_found),
+            (Transfer(id=2, pending_id=1, debit_account_id=3,
+                      flags=F.POST_PENDING_TRANSFER),
+             TR.pending_transfer_has_different_debit_account_id),
+            (Transfer(id=2, pending_id=1, amount=101, flags=F.POST_PENDING_TRANSFER),
+             TR.exceeds_pending_transfer_amount),
+        ]
+        for ev, expected in cases:
+            assert m.create_transfers([ev]) == [(0, expected)], expected
+        # pending_transfer_not_pending: target a plain transfer.
+        m.create_transfers(
+            [Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=5,
+                      ledger=1, code=10)]
+        )
+        res = m.create_transfers(
+            [Transfer(id=11, pending_id=10, flags=F.POST_PENDING_TRANSFER)]
+        )
+        assert res == [(0, TR.pending_transfer_not_pending)]
+
+    def test_pending_expiry(self):
+        m = machine_with_accounts()
+        m.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=10, timeout=1, flags=F.PENDING)],
+            wall_clock_ns=10_000,
+        )
+        p_ts = m.transfers[1].timestamp
+        # Post after expiry (timeout=1s).
+        res = m.create_transfers(
+            [Transfer(id=2, pending_id=1, flags=F.POST_PENDING_TRANSFER)],
+            wall_clock_ns=p_ts + 1_000_000_000,
+        )
+        assert res == [(0, TR.pending_transfer_expired)]
+        # A pending balance remains (reference has no expiry sweep yet:
+        # state_machine.zig:1448-1453 TODO).
+        assert m.accounts[1].debits_pending == 100
+
+    def test_linked_chain_balance_rollback(self):
+        m = machine_with_accounts()
+        res = m.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=10, flags=F.LINKED),
+                Transfer(id=2, debit_account_id=9, credit_account_id=2, amount=10,
+                         ledger=1, code=10),
+            ]
+        )
+        assert res == [(0, TR.linked_event_failed), (1, TR.debit_account_not_found)]
+        assert m.accounts[1].debits_posted == 0
+        assert 1 not in m.transfers
+
+    def test_intra_batch_duplicate_id(self):
+        m = machine_with_accounts()
+        res = m.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=10),
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=10),
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=99,
+                         ledger=1, code=10),
+            ]
+        )
+        assert res == [(1, TR.exists), (2, TR.exists_with_different_amount)]
+        assert m.accounts[1].debits_posted == 10
+
+    def test_intra_batch_pending_post(self):
+        # Post a pending transfer created earlier in the same batch.
+        m = machine_with_accounts()
+        res = m.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                         ledger=1, code=10, flags=F.PENDING),
+                Transfer(id=2, pending_id=1, flags=F.POST_PENDING_TRANSFER),
+            ]
+        )
+        assert res == []
+        assert m.accounts[1].debits_pending == 0
+        assert m.accounts[1].debits_posted == 100
+
+    def test_overflow_timeout(self):
+        m = machine_with_accounts()
+        res = m.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1,
+                      ledger=1, code=10, timeout=(1 << 32) - 1, flags=F.PENDING)],
+            wall_clock_ns=(1 << 64) - 10,
+        )
+        assert res == [(0, TR.overflows_timeout)]

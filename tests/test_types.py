@@ -1,0 +1,93 @@
+"""Layout and enum parity for the core data model (vs src/tigerbeetle.zig)."""
+
+import numpy as np
+
+from tigerbeetle_tpu import types as t
+
+
+def test_struct_sizes():
+    # tigerbeetle.zig comptime asserts: @sizeOf(Account|Transfer|AccountBalance)==128.
+    assert t.ACCOUNT_DTYPE.itemsize == 128
+    assert t.TRANSFER_DTYPE.itemsize == 128
+    assert t.ACCOUNT_BALANCE_DTYPE.itemsize == 128
+    assert t.EVENT_RESULT_DTYPE.itemsize == 8
+    assert t.ACCOUNT_FILTER_DTYPE.itemsize == 64
+
+
+def test_account_field_offsets():
+    # Field offsets must match the Zig extern struct layout exactly.
+    f = t.ACCOUNT_DTYPE.fields
+    assert f["id_lo"][1] == 0
+    assert f["debits_pending_lo"][1] == 16
+    assert f["debits_posted_lo"][1] == 32
+    assert f["credits_pending_lo"][1] == 48
+    assert f["credits_posted_lo"][1] == 64
+    assert f["user_data_128_lo"][1] == 80
+    assert f["user_data_64"][1] == 96
+    assert f["user_data_32"][1] == 104
+    assert f["reserved"][1] == 108
+    assert f["ledger"][1] == 112
+    assert f["code"][1] == 116
+    assert f["flags"][1] == 118
+    assert f["timestamp"][1] == 120
+
+
+def test_transfer_field_offsets():
+    f = t.TRANSFER_DTYPE.fields
+    assert f["id_lo"][1] == 0
+    assert f["debit_account_id_lo"][1] == 16
+    assert f["credit_account_id_lo"][1] == 32
+    assert f["amount_lo"][1] == 48
+    assert f["pending_id_lo"][1] == 64
+    assert f["user_data_128_lo"][1] == 80
+    assert f["user_data_64"][1] == 96
+    assert f["user_data_32"][1] == 104
+    assert f["timeout"][1] == 108
+    assert f["ledger"][1] == 112
+    assert f["code"][1] == 116
+    assert f["flags"][1] == 118
+    assert f["timestamp"][1] == 120
+
+
+def test_u128_roundtrip():
+    for v in [0, 1, (1 << 64) - 1, 1 << 64, (1 << 128) - 1, 0xDEADBEEF << 77]:
+        lo, hi = t.u128_split(v)
+        assert t.u128_join(lo, hi) == v
+
+
+def test_wire_roundtrip():
+    row = t.transfer(
+        id=(7 << 64) | 9,
+        debit_account_id=1,
+        credit_account_id=2,
+        amount=(1 << 100) + 5,
+        ledger=700,
+        code=10,
+        flags=int(t.TransferFlags.PENDING),
+        timeout=3,
+    )
+    arr = t.transfers_array([row])
+    raw = arr.tobytes()
+    assert len(raw) == 128
+    back = np.frombuffer(raw, dtype=t.TRANSFER_DTYPE)[0]
+    assert back == row
+
+
+def test_result_enums_precedence_ordered():
+    # tigerbeetle.zig comptime asserts enum values equal their index.
+    for i, r in enumerate(t.CreateAccountResult):
+        assert r.value == i
+    for i, r in enumerate(t.CreateTransferResult):
+        assert r.value == i
+    assert t.CreateTransferResult.exceeds_debits.value == 55
+    assert t.CreateAccountResult.exists.value == 21
+
+
+def test_soa_roundtrip():
+    rows = t.transfers_array(
+        [t.transfer(id=i + 1, amount=i * (1 << 70), ledger=1, code=1) for i in range(5)]
+    )
+    soa = t.to_soa(rows)
+    assert soa["flags"].dtype == np.uint32
+    back = t.from_soa(soa, t.TRANSFER_DTYPE)
+    assert (back == rows).all()

@@ -1,0 +1,18 @@
+"""tigerbeetle-tpu: a TPU-native double-entry accounting framework.
+
+A ground-up JAX/XLA re-architecture of the capabilities of TigerBeetle
+(reference: /root/reference, Zig): the deterministic batch state machine
+(accounts, single/two-phase transfers, linked chains, balance limits, queries)
+executes as vectorized device kernels over a struct-of-arrays HBM ledger, behind
+the same pluggable state-machine seam the reference uses
+(state_machine.zig:34 StateMachineType), with VSR-style replication and a
+vmapped fault-injection simulator.
+
+u64 integer lanes require x64 mode; enable it before any array is created.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,98 @@
+"""Cluster/process configuration, mirroring the reference's two-level config.
+
+Reference: src/config.zig (ConfigCluster :130-185, ConfigProcess :73-121,
+presets :206-303) and src/constants.zig (derived constants :45-74, batch sizes
+:203-204).  Only the knobs that matter to the TPU build are carried over;
+format-affecting values keep the reference defaults so the wire protocol and
+batch math match exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Consensus/format-affecting constants (config.zig:130-185)."""
+
+    # Wire/WAL message size (config.zig: message_size_max default 1 MiB).
+    message_size_max: int = 1 << 20
+    # 256-byte message header (message_header.zig:17).
+    header_size: int = 256
+    # WAL slots (config.zig: journal_slot_count default 1024).
+    journal_slot_count: int = 1024
+    # Consensus pipeline depth (config.zig: pipeline_prepare_queue_max 8).
+    pipeline_prepare_queue_max: int = 8
+    clients_max: int = 32
+    replicas_max: int = 6
+    standbys_max: int = 6
+    lsm_batch_multiple: int = 32
+
+    @property
+    def message_body_size_max(self) -> int:
+        return self.message_size_max - self.header_size
+
+    @property
+    def batch_max_create_transfers(self) -> int:
+        # (1 MiB - 256 B) / 128 B = 8190 (state_machine.zig:70-75).
+        return self.message_body_size_max // 128
+
+    @property
+    def batch_max_create_accounts(self) -> int:
+        return self.message_body_size_max // 128
+
+    @property
+    def batch_max_lookups(self) -> int:
+        # lookup events are bare u128 ids but results are 128 B rows, and
+        # batch_max divides by max(event, result) size (state_machine.zig:70-75).
+        return self.message_body_size_max // 128
+
+    @property
+    def vsr_checkpoint_interval(self) -> int:
+        # constants.zig:45-74: journal_slot_count minus compaction+pipeline margin.
+        return self.journal_slot_count - self.lsm_batch_multiple - (
+            self.pipeline_prepare_queue_max + 1
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class LedgerConfig:
+    """Device ledger capacity knobs (the TPU analogue of ConfigProcess cache
+    sizing, config.zig:84-101). Capacities are power-of-two open-addressing
+    table sizes; load factor should stay under ~0.5 for short probe chains."""
+
+    accounts_capacity_log2: int = 16
+    transfers_capacity_log2: int = 18
+    posted_capacity_log2: int = 16
+    # Upper bound on linear-probe distance before the kernel reports the table
+    # as over-full (host must grow/rebuild; analogous to cache eviction limits).
+    max_probe: int = 64
+
+    @property
+    def accounts_capacity(self) -> int:
+        return 1 << self.accounts_capacity_log2
+
+    @property
+    def transfers_capacity(self) -> int:
+        return 1 << self.transfers_capacity_log2
+
+    @property
+    def posted_capacity(self) -> int:
+        return 1 << self.posted_capacity_log2
+
+
+# Presets, mirroring config.zig:206-303.
+PRODUCTION = ClusterConfig()
+TEST_MIN = ClusterConfig(message_size_max=8192, journal_slot_count=64)
+
+LEDGER_TEST = LedgerConfig(
+    accounts_capacity_log2=10, transfers_capacity_log2=12, posted_capacity_log2=10,
+    max_probe=1 << 10,
+)
+# Benchmark sizing: 10M+ accounts, tens of millions of transfers resident.
+LEDGER_BENCH = LedgerConfig(
+    accounts_capacity_log2=21, transfers_capacity_log2=25, posted_capacity_log2=21
+)
+
+NS_PER_S = 1_000_000_000
