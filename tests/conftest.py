@@ -7,7 +7,10 @@ path; bench.py uses the real chip). Must run before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The environment pre-sets JAX_PLATFORMS=axon (the real-TPU tunnel); tests must
+# override it, not setdefault — remote dispatch makes eager ops ~1000x slower
+# and tests need the virtual 8-device CPU mesh anyway.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

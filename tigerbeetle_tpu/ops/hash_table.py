@@ -1,0 +1,228 @@
+"""On-HBM open-addressing SoA hash table — the device analogue of an LSM Groove.
+
+The reference resolves object lookups through an LSM tree hierarchy with a
+set-associative cache in front (src/lsm/groove.zig:138+, cache_map.zig:10-25).
+On TPU the working set lives resident in HBM as one struct-of-arrays
+open-addressing table: lookups are a batched vectorized linear probe (a few
+gathers over 8k lanes), and inserts are a batched claim protocol — both O(1)
+expected per key at load factor < 0.5, fully inside jit, no host round trips.
+
+Design:
+- Capacity is a static power of two; slot = splitmix64(key) & (C-1).
+- Empty slot: key == 0 (valid ids are nonzero: id_must_not_be_zero).
+- Tombstones (from linked-chain rollback of inserts) keep ``tombstone=True``
+  with key cleared; probes continue past them, inserts may not reuse them
+  (wastes a slot per rolled-back insert; rollbacks are rare).
+- Batched insert resolves intra-batch slot collisions by lane order: among
+  unplaced lanes probing the same slot, the lowest batch index wins; losers
+  advance their probe. Deterministic (a pure function of the batch).
+
+All entry points are shape-stable and jit-traceable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..u128 import mix64
+
+
+@struct.dataclass
+class Table:
+    """SoA open-addressing table. ``cols`` holds the value columns."""
+
+    key_lo: jax.Array  # uint64[C]; 0 = empty/tombstone
+    key_hi: jax.Array  # uint64[C]
+    tombstone: jax.Array  # bool[C]
+    cols: Dict[str, jax.Array]
+    count: jax.Array  # uint64 scalar: live entries
+    probe_overflow: jax.Array  # bool scalar: a probe exceeded max_probe (host must grow)
+
+    @property
+    def capacity(self) -> int:
+        return self.key_lo.shape[0]
+
+
+def make_table(capacity: int, col_specs: Dict[str, jnp.dtype]) -> Table:
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    return Table(
+        key_lo=jnp.zeros((capacity,), jnp.uint64),
+        key_hi=jnp.zeros((capacity,), jnp.uint64),
+        tombstone=jnp.zeros((capacity,), jnp.bool_),
+        cols={name: jnp.zeros((capacity,), dt) for name, dt in col_specs.items()},
+        count=jnp.uint64(0),
+        probe_overflow=jnp.bool_(False),
+    )
+
+
+class LookupResult(NamedTuple):
+    found: jax.Array  # bool[N]
+    slot: jax.Array  # uint64[N] — valid where found
+    overflow: jax.Array  # bool scalar — some lane exhausted max_probe
+
+
+@functools.partial(jax.jit, static_argnames=("max_probe",))
+def lookup(
+    table: Table, key_lo: jax.Array, key_hi: jax.Array, max_probe: int
+) -> LookupResult:
+    """Batched linear probe: for each key, find its slot or prove absence."""
+    capacity = table.capacity
+    mask = jnp.uint64(capacity - 1)
+    home = mix64(key_lo, key_hi) & mask
+
+    # Lanes probing key 0 (invalid id / padding lanes) resolve immediately.
+    is_null = (key_lo == 0) & (key_hi == 0)
+
+    def cond(state):
+        i, done, _, _ = state
+        return jnp.any(~done) & (i < max_probe)
+
+    def body(state):
+        i, done, found, slot = state
+        cur = (home + jnp.uint64(i)) & mask
+        t_lo = table.key_lo[cur]
+        t_hi = table.key_hi[cur]
+        tomb = table.tombstone[cur]
+        match = ~done & (t_lo == key_lo) & (t_hi == key_hi) & ~tomb
+        empty = ~done & (t_lo == 0) & (t_hi == 0) & ~tomb
+        found = found | match
+        slot = jnp.where(match, cur, slot)
+        done = done | match | empty
+        return i + 1, done, found, slot
+
+    i0 = jnp.int32(0)
+    done0 = is_null
+    found0 = jnp.zeros_like(is_null)
+    slot0 = jnp.zeros_like(home)
+    i, done, found, slot = jax.lax.while_loop(cond, body, (i0, done0, found0, slot0))
+    return LookupResult(found=found, slot=slot, overflow=jnp.any(~done))
+
+
+@functools.partial(jax.jit, static_argnames=("max_probe",))
+def insert(
+    table: Table,
+    key_lo: jax.Array,
+    key_hi: jax.Array,
+    insert_mask: jax.Array,
+    rows: Dict[str, jax.Array],
+    max_probe: int,
+) -> Tuple[Table, jax.Array]:
+    """Batched insert of *new, distinct* keys where ``insert_mask`` is set.
+
+    Caller guarantees: masked keys are nonzero, not present in the table, and
+    pairwise distinct within the batch (the state-machine kernel's duplicate
+    resolution establishes this). Returns (table, claimed_slot[N]) where
+    claimed_slot is the row index each inserted key now occupies (undefined for
+    unmasked lanes).
+    """
+    capacity = table.capacity
+    n = key_lo.shape[0]
+    mask = jnp.uint64(capacity - 1)
+    home = mix64(key_lo, key_hi) & mask
+    sentinel = jnp.uint64(capacity)  # out-of-range: dropped by scatters
+
+    def cond(state):
+        _, _, unplaced, _, overflow = state
+        return jnp.any(unplaced) & ~overflow
+
+    def body(state):
+        occ, offset, unplaced, claimed, _ = state
+        cur = (home + offset) & mask
+        cand = jnp.where(unplaced, cur, sentinel)
+
+        occupied = occ[cur]
+
+        # Intra-batch collision resolution: sort candidate slots; within a run
+        # of equal slots the first (stable sort keeps lane order) wins.
+        order = jnp.argsort(cand, stable=True)
+        sorted_cand = cand[order]
+        first_of_run = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sorted_cand[1:] != sorted_cand[:-1]]
+        )
+        is_winner = jnp.zeros((n,), jnp.bool_).at[order].set(first_of_run)
+
+        win = unplaced & ~occupied & is_winner
+        claimed = jnp.where(win, cur, claimed)
+        # Mark claimed slots occupied so later iterations (and later lanes)
+        # probe past them. Only winners scatter; their slots are unique.
+        occ = occ.at[jnp.where(win, cur, sentinel)].set(True, mode="drop")
+
+        unplaced = unplaced & ~win
+        offset = jnp.where(unplaced, offset + jnp.uint64(1), offset)
+        overflow = jnp.any(offset >= jnp.uint64(max_probe))
+        return occ, offset, unplaced, claimed, overflow
+
+    occ0 = (table.key_lo != 0) | (table.key_hi != 0) | table.tombstone
+    offset0 = jnp.zeros((n,), jnp.uint64)
+    unplaced0 = insert_mask
+    claimed0 = jnp.full((n,), sentinel, jnp.uint64)
+    overflow0 = jnp.bool_(False)
+
+    _, _, _, claimed, overflow = jax.lax.while_loop(
+        cond, body, (occ0, offset0, unplaced0, claimed0, overflow0)
+    )
+
+    # Write keys + value columns + clear tombstone at the claimed slots
+    # (claimed slots are unique across the batch by construction).
+    scatter_idx = jnp.where(insert_mask & (claimed < sentinel), claimed, sentinel)
+    key_lo_new = table.key_lo.at[scatter_idx].set(key_lo, mode="drop")
+    key_hi_new = table.key_hi.at[scatter_idx].set(key_hi, mode="drop")
+    tomb_new = table.tombstone.at[scatter_idx].set(False, mode="drop")
+    cols_new = {
+        name: table.cols[name].at[scatter_idx].set(rows[name], mode="drop")
+        for name in table.cols
+    }
+    inserted = jnp.sum((scatter_idx < sentinel).astype(jnp.uint64))
+    return (
+        table.replace(
+            key_lo=key_lo_new,
+            key_hi=key_hi_new,
+            tombstone=tomb_new,
+            cols=cols_new,
+            count=table.count + inserted,
+            probe_overflow=table.probe_overflow | overflow,
+        ),
+        claimed,
+    )
+
+
+def gather_cols(table: Table, slot: jax.Array, valid: jax.Array) -> Dict[str, jax.Array]:
+    """Gather value columns at ``slot``, zeroed where ``valid`` is False."""
+    safe = jnp.where(valid, slot, jnp.uint64(0))
+    return {
+        name: jnp.where(valid, col[safe], jnp.zeros((), col.dtype))
+        for name, col in table.cols.items()
+    }
+
+
+def scatter_cols(
+    table: Table, slot: jax.Array, valid: jax.Array, updates: Dict[str, jax.Array]
+) -> Table:
+    """Scatter updated value columns back at ``slot`` where ``valid``.
+
+    Slots must be unique among valid lanes (callers pre-combine per-slot
+    updates — see the segment reduction in the commit kernel)."""
+    sentinel = jnp.uint64(table.capacity)
+    idx = jnp.where(valid, slot, sentinel)
+    cols = dict(table.cols)
+    for name, val in updates.items():
+        cols[name] = cols[name].at[idx].set(val, mode="drop")
+    return table.replace(cols=cols)
+
+
+def remove_to_tombstone(table: Table, slot: jax.Array, valid: jax.Array) -> Table:
+    """Clear keys at ``slot`` (rollback of inserts), leaving tombstones."""
+    sentinel = jnp.uint64(table.capacity)
+    idx = jnp.where(valid, slot, sentinel)
+    removed = jnp.sum(valid.astype(jnp.uint64))
+    return table.replace(
+        key_lo=table.key_lo.at[idx].set(jnp.uint64(0), mode="drop"),
+        key_hi=table.key_hi.at[idx].set(jnp.uint64(0), mode="drop"),
+        tombstone=table.tombstone.at[idx].set(True, mode="drop"),
+        count=table.count - removed,
+    )
