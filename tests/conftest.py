@@ -1,45 +1,22 @@
 """Test configuration: force a clean CPU JAX with 8 virtual devices.
 
-Two environment hazards are handled here:
+The image pre-sets ``JAX_PLATFORMS=axon`` (a remote-TPU tunnel) and its
+sitecustomize registers the remote PJRT plugin (with remote compilation) into
+every interpreter at startup, which makes test compiles/dispatches network
+round trips (5-20x slowdown) — and jax is already imported by the time conftest
+runs, so env vars are too late.  Instead: override the platform via jax.config
+and deregister the axon backend factory before any backend initializes.
 
-1. The image pre-sets ``JAX_PLATFORMS=axon`` (a remote-TPU tunnel) and injects
-   ``/root/.axon_site`` into PYTHONPATH, whose sitecustomize registers the
-   remote PJRT plugin (with remote compilation) into *every* interpreter at
-   startup — making test compiles/dispatches network round trips (5-20x
-   slowdown). Tests must run on the local CPU backend.
-2. Sharding tests need ``--xla_force_host_platform_device_count=8`` set before
-   JAX initializes its backends.
-
-Since sitecustomize has already run by the time conftest is imported, the only
-reliable fix is to re-exec the test process once with a scrubbed environment.
-bench.py and production entry points are unaffected (they want the real TPU).
+Tests get 8 virtual CPU devices so sharding/collective paths are exercised
+without TPU hardware (the driver separately dry-runs the multi-chip path;
+bench.py uses the real chip).
 """
 
-import os
-import sys
+import jax
 
-_AXON_MARKER = ".axon_site"
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
+from jax._src import xla_bridge  # noqa: E402
 
-def _needs_reexec() -> bool:
-    if os.environ.get("TB_TPU_TEST_REEXEC") == "1":
-        return False
-    return _AXON_MARKER in os.environ.get("PYTHONPATH", "")
-
-
-if _needs_reexec():
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        p
-        for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and _AXON_MARKER not in p
-    )
-    env["TB_TPU_TEST_REEXEC"] = "1"
-    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+xla_bridge._backend_factories.pop("axon", None)
