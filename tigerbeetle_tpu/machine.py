@@ -1,0 +1,262 @@
+"""TpuStateMachine: the host-side seam mirroring the reference's StateMachine.
+
+The reference makes the application state machine pluggable behind
+``StateMachineType(comptime Storage, comptime config)`` (state_machine.zig:34),
+with the contract prepare()/prefetch()/commit() driven by the replica
+(replica.zig:3102-3173 commit dispatch).  This class is the TPU-native
+implementation of that seam: it owns the device-resident ledger, assigns batch
+timestamps like prepare() does (state_machine.zig:503-512), dispatches each
+batch to the widest safe device kernel, and compresses dense device result
+codes into the wire's (index, result) pairs (only failures are emitted —
+state_machine.zig:1051-1073).
+
+Dispatch policy (see ops/state_machine.py preconditions P1-P4):
+- create_accounts: vectorized kernel, unless the batch combines linked chains
+  with intra-batch duplicate ids (P4) -> sequential path.
+- create_transfers: vectorized kernel when the batch has no balancing/post/void
+  flags (P2), no limit/history-flagged account exists anywhere (P1, tracked
+  conservatively on host), amounts fit u64 and cumulative balances are bounded
+  (P3), and not linked+duplicates (P4) -> otherwise sequential path.
+
+The sequential path (ops/scan_path.py) runs the full semantics on device as a
+lax.scan and is bit-identical but latency-bound; the benchmark workload always
+takes the vectorized path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .config import LedgerConfig
+from .ops import state_machine as sm
+
+U64_MAX = (1 << 64) - 1
+
+
+class TpuStateMachine:
+    def __init__(
+        self,
+        ledger_config: Optional[LedgerConfig] = None,
+        batch_lanes: int = 8192,
+    ) -> None:
+        cfg = ledger_config or LedgerConfig()
+        self.config = cfg
+        self.batch_lanes = batch_lanes
+        self.ledger = sm.make_ledger(
+            cfg.accounts_capacity, cfg.transfers_capacity, cfg.posted_capacity
+        )
+        self.prepare_timestamp = 0
+        self.commit_timestamp = 0
+        # Host-tracked conservative bits for fast-path preconditions.
+        self._any_limit_or_history_account = False
+        self._amount_bound = 0  # upper bound on any account balance
+
+    # -- prepare (state_machine.zig:503-512) --------------------------------
+
+    def prepare(self, operation: str, count: int, wall_clock_ns: int = 0) -> int:
+        if wall_clock_ns > self.prepare_timestamp:
+            self.prepare_timestamp = wall_clock_ns
+        if operation in ("create_accounts", "create_transfers"):
+            self.prepare_timestamp += count
+        return self.prepare_timestamp
+
+    # -- batch plumbing ------------------------------------------------------
+
+    def _pad_soa(self, batch: np.ndarray) -> dict:
+        n = len(batch)
+        assert n <= self.batch_lanes, "batch exceeds configured lanes"
+        padded = np.zeros(self.batch_lanes, dtype=batch.dtype)
+        padded[:n] = batch
+        return {k: jnp.asarray(v) for k, v in types.to_soa(padded).items()}
+
+    @staticmethod
+    def _compress(codes: np.ndarray, count: int) -> List[Tuple[int, int]]:
+        codes = codes[:count]
+        (idx,) = np.nonzero(codes)
+        return [(int(i), int(codes[i])) for i in idx]
+
+    @staticmethod
+    def _has_intra_batch_dup_ids(batch: np.ndarray) -> bool:
+        # id 0 lanes can never insert (id_must_not_be_zero), so repeats of 0
+        # are not order-dependent duplicates.
+        nonzero = (batch["id_lo"] != 0) | (batch["id_hi"] != 0)
+        ids = np.stack([batch["id_hi"][nonzero], batch["id_lo"][nonzero]], axis=1)
+        return len(np.unique(ids, axis=0)) < len(ids)
+
+    # -- create_accounts -----------------------------------------------------
+
+    def create_accounts(
+        self, batch: np.ndarray, wall_clock_ns: int = 0
+    ) -> List[Tuple[int, int]]:
+        count = len(batch)
+        timestamp = self.prepare("create_accounts", count, wall_clock_ns)
+        if count == 0:
+            return []
+
+        any_linked = bool((batch["flags"] & types.AccountFlags.LINKED).any())
+        if any_linked and self._has_intra_batch_dup_ids(batch):
+            return self._sequential("create_accounts", batch, timestamp)
+
+        # Conservative P1 tracking: any *requested* limit/history flag flips
+        # the bit, even if the event ultimately fails.
+        special = (
+            types.AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+            | types.AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS
+            | types.AccountFlags.HISTORY
+        )
+        if bool((batch["flags"] & special).any()):
+            self._any_limit_or_history_account = True
+
+        soa = self._pad_soa(batch)
+        self.ledger, codes = sm.create_accounts(
+            self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
+        )
+        codes = np.asarray(codes)
+        results = self._compress(codes, count)
+        self._update_commit_timestamp(codes, count, timestamp)
+        return results
+
+    # -- create_transfers ----------------------------------------------------
+
+    def create_transfers(
+        self, batch: np.ndarray, wall_clock_ns: int = 0
+    ) -> List[Tuple[int, int]]:
+        count = len(batch)
+        timestamp = self.prepare("create_transfers", count, wall_clock_ns)
+        if count == 0:
+            return []
+
+        if not self._fast_path_ok(batch):
+            return self._sequential("create_transfers", batch, timestamp)
+
+        soa = self._pad_soa(batch)
+        self.ledger, codes = sm.create_transfers_fast(
+            self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
+        )
+        codes = np.asarray(codes)
+        results = self._compress(codes, count)
+        self._update_commit_timestamp(codes, count, timestamp)
+        # P3 bound: accepted amounts can only add up to the batch total.
+        self._amount_bound += int(batch["amount_lo"].astype(object).sum())
+        return results
+
+    def _fast_path_ok(self, batch: np.ndarray) -> bool:
+        if self._any_limit_or_history_account:
+            return False  # P1
+        slow_flags = (
+            types.TransferFlags.POST_PENDING_TRANSFER
+            | types.TransferFlags.VOID_PENDING_TRANSFER
+            | types.TransferFlags.BALANCING_DEBIT
+            | types.TransferFlags.BALANCING_CREDIT
+        )
+        if bool((batch["flags"] & slow_flags).any()):
+            return False  # P2
+        if bool((batch["amount_hi"] != 0).any()):
+            return False  # P3: amounts must fit u64
+        batch_total = int(batch["amount_lo"].astype(object).sum())
+        if self._amount_bound + batch_total >= 1 << 126:
+            return False  # P3: balance headroom
+        any_linked = bool((batch["flags"] & types.TransferFlags.LINKED).any())
+        if any_linked and self._has_intra_batch_dup_ids(batch):
+            return False  # P4
+        return True
+
+    def _sequential(
+        self, operation: str, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
+        from .ops import scan_path
+
+        soa = self._pad_soa(batch)
+        count = len(batch)
+        kernel = (
+            scan_path.create_accounts_seq
+            if operation == "create_accounts"
+            else scan_path.create_transfers_seq
+        )
+        self.ledger, codes = kernel(
+            self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
+        )
+        codes = np.asarray(codes)
+        if operation == "create_accounts":
+            special = (
+                types.AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+                | types.AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS
+                | types.AccountFlags.HISTORY
+            )
+            if bool((batch["flags"] & special).any()):
+                self._any_limit_or_history_account = True
+        else:
+            self._amount_bound += int(batch["amount_lo"].astype(object).sum())
+        results = self._compress(codes, count)
+        self._update_commit_timestamp(codes, count, timestamp)
+        return results
+
+    def _update_commit_timestamp(
+        self, codes: np.ndarray, count: int, timestamp: int
+    ) -> None:
+        ok_lanes = np.nonzero(codes[:count] == 0)[0]
+        if len(ok_lanes):
+            self.commit_timestamp = timestamp - count + int(ok_lanes[-1]) + 1
+
+    # -- lookups -------------------------------------------------------------
+
+    def lookup_accounts(self, ids: List[int]) -> np.ndarray:
+        """Return found accounts as an ACCOUNT_DTYPE array (misses omitted,
+        state_machine.zig:1091-1107)."""
+        if not ids:
+            return np.zeros(0, dtype=types.ACCOUNT_DTYPE)
+        lo = jnp.asarray([i & U64_MAX for i in ids], jnp.uint64)
+        hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
+        found, cols = sm.lookup_accounts(self.ledger, lo, hi)
+        found = np.asarray(found)
+        host = {k: np.asarray(v) for k, v in cols.items()}
+        host["reserved"] = np.zeros(len(ids), np.uint32)
+        rows = types.from_soa(host, types.ACCOUNT_DTYPE)
+        return rows[found]
+
+    def lookup_transfers(self, ids: List[int]) -> np.ndarray:
+        if not ids:
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        lo = jnp.asarray([i & U64_MAX for i in ids], jnp.uint64)
+        hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
+        found, cols = sm.lookup_transfers(self.ledger, lo, hi)
+        found = np.asarray(found)
+        host = {k: np.asarray(v) for k, v in cols.items()}
+        rows = types.from_soa(host, types.TRANSFER_DTYPE)
+        return rows[found]
+
+    # -- parity surface ------------------------------------------------------
+
+    def balances_snapshot(self) -> List[Tuple[int, int, int, int, int, int]]:
+        """(id, dp, dpo, cp, cpo, ts) sorted by id — comparable with
+        ReferenceStateMachine.balances_snapshot()."""
+        a = self.ledger.accounts
+        key_lo = np.asarray(a.key_lo)
+        key_hi = np.asarray(a.key_hi)
+        live = (key_lo != 0) | (key_hi != 0)
+        cols = {k: np.asarray(v)[live] for k, v in a.cols.items()}
+        ids = (key_hi[live].astype(object) << 64) | key_lo[live].astype(object)
+
+        def u128_col(name):
+            return (cols[name + "_hi"].astype(object) << 64) | cols[
+                name + "_lo"
+            ].astype(object)
+
+        out = list(
+            zip(
+                ids,
+                u128_col("debits_pending"),
+                u128_col("debits_posted"),
+                u128_col("credits_pending"),
+                u128_col("credits_posted"),
+                (int(t) for t in cols["timestamp"]),
+            )
+        )
+        return sorted((int(a_), int(b), int(c), int(d), int(e), int(f)) for a_, b, c, d, e, f in out)
+
+    def digest(self) -> int:
+        return int(sm.ledger_digest(self.ledger))
