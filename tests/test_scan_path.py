@@ -1,0 +1,295 @@
+"""Differential tests for the fully-general sequential (lax.scan) path.
+
+Covers everything the fast path excludes: balancing transfers, two-phase
+post/void, balance limits, linked-chain rollback of two-phase effects, and
+mixed feature interactions — all against the scalar oracle."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LedgerConfig
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.testing import model as M
+from tigerbeetle_tpu.types import AccountFlags as AF, TransferFlags as F
+
+LANES = 64
+
+
+def make_pair(force_sequential=True):
+    cfg = LedgerConfig(
+        accounts_capacity_log2=10,
+        transfers_capacity_log2=11,
+        posted_capacity_log2=10,
+        max_probe=1 << 9,
+    )
+    return (
+        TpuStateMachine(cfg, batch_lanes=LANES, force_sequential=force_sequential),
+        M.ReferenceStateMachine(),
+    )
+
+
+def run_accounts(dev, ref, batch, wall=0):
+    got = dev.create_accounts(batch, wall_clock_ns=wall)
+    want = ref.execute(
+        "create_accounts",
+        ref.prepare("create_accounts", len(batch), wall),
+        [M.account_from_row(r) for r in batch],
+    )
+    assert got == want, f"accounts results differ: {got} vs {want}"
+
+
+def run_transfers(dev, ref, batch, wall=0):
+    got = dev.create_transfers(batch, wall_clock_ns=wall)
+    want = ref.execute(
+        "create_transfers",
+        ref.prepare("create_transfers", len(batch), wall),
+        [M.transfer_from_row(r) for r in batch],
+    )
+    assert got == want, f"transfer results differ: {got} vs {want}"
+
+
+def check_parity(dev, ref):
+    assert dev.balances_snapshot() == ref.balances_snapshot()
+
+
+def seed(dev, ref, n=6, flags=None, ledger=1):
+    rows = [
+        types.account(id=i + 1, ledger=ledger, code=10, flags=(flags or {}).get(i + 1, 0))
+        for i in range(n)
+    ]
+    run_accounts(dev, ref, types.accounts_array(rows), wall=1000)
+
+
+class TestSequentialAccounts:
+    def test_basic_and_chains(self):
+        dev, ref = make_pair()
+        L = int(AF.LINKED)
+        rows = [
+            types.account(id=1, ledger=1, code=1),
+            types.account(id=1, ledger=1, code=1),  # exists
+            types.account(id=2, ledger=1, code=1, flags=L),
+            types.account(id=2, ledger=1, code=1),  # exists breaks chain -> rollback
+            types.account(id=3, ledger=1, code=1, flags=L),
+            types.account(id=4, ledger=1, code=1),
+        ]
+        run_accounts(dev, ref, types.accounts_array(rows), wall=100)
+        check_parity(dev, ref)
+
+    def test_linked_with_duplicates(self):
+        # The P4 case the fast path cannot handle: a rolled-back chain insert
+        # followed by a retry of the same id later in the batch.
+        dev, ref = make_pair(force_sequential=False)
+        L = int(AF.LINKED)
+        rows = [
+            types.account(id=1, ledger=1, code=1, flags=L),
+            types.account(id=2, ledger=0, code=1),  # breaks chain; 1 rolled back
+            types.account(id=1, ledger=1, code=1),  # retry id 1 -> ok now
+            types.account(id=1, ledger=1, code=1),  # exists
+        ]
+        run_accounts(dev, ref, types.accounts_array(rows), wall=100)
+        check_parity(dev, ref)
+
+
+class TestSequentialTransfers:
+    def test_plain_matches_fast_semantics(self):
+        dev, ref = make_pair()
+        seed(dev, ref)
+        rows = [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                           ledger=1, code=10),
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                           ledger=1, code=10),  # exists
+            types.transfer(id=2, debit_account_id=1, credit_account_id=1, amount=5,
+                           ledger=1, code=10),  # accounts_must_be_different
+            types.transfer(id=3, debit_account_id=1, credit_account_id=9, amount=5,
+                           ledger=1, code=10),  # credit_account_not_found
+        ]
+        run_transfers(dev, ref, types.transfers_array(rows))
+        check_parity(dev, ref)
+
+    def test_balance_limits(self):
+        dev, ref = make_pair()
+        seed(dev, ref, flags={1: int(AF.DEBITS_MUST_NOT_EXCEED_CREDITS),
+                              2: int(AF.CREDITS_MUST_NOT_EXCEED_DEBITS)})
+        # Fund account 1 with 100 credits.
+        run_transfers(dev, ref, types.transfers_array([
+            types.transfer(id=1, debit_account_id=3, credit_account_id=1, amount=100,
+                           ledger=1, code=10)]))
+        rows = [
+            types.transfer(id=2, debit_account_id=1, credit_account_id=3, amount=60,
+                           ledger=1, code=10),
+            types.transfer(id=3, debit_account_id=1, credit_account_id=3, amount=60,
+                           ledger=1, code=10),  # exceeds_credits
+            types.transfer(id=4, debit_account_id=1, credit_account_id=3, amount=40,
+                           ledger=1, code=10),  # exactly at limit: ok
+            types.transfer(id=5, debit_account_id=3, credit_account_id=2, amount=10,
+                           ledger=1, code=10),  # credits limit: 10 > debits 0
+        ]
+        run_transfers(dev, ref, types.transfers_array(rows))
+        check_parity(dev, ref)
+
+    def test_balancing_transfers(self):
+        dev, ref = make_pair()
+        seed(dev, ref)
+        run_transfers(dev, ref, types.transfers_array([
+            types.transfer(id=1, debit_account_id=2, credit_account_id=1, amount=70,
+                           ledger=1, code=10)]))
+        rows = [
+            types.transfer(id=2, debit_account_id=1, credit_account_id=3, amount=100,
+                           ledger=1, code=10, flags=F.BALANCING_DEBIT),  # clamp to 70
+            types.transfer(id=3, debit_account_id=1, credit_account_id=3, amount=0,
+                           ledger=1, code=10, flags=F.BALANCING_DEBIT),  # exceeds_credits
+            types.transfer(id=4, debit_account_id=3, credit_account_id=2, amount=0,
+                           ledger=1, code=10, flags=F.BALANCING_CREDIT),  # clamp
+            types.transfer(id=5, debit_account_id=3, credit_account_id=2, amount=0,
+                           ledger=1, code=10, flags=F.BALANCING_CREDIT),  # exceeds_debits
+        ]
+        run_transfers(dev, ref, types.transfers_array(rows))
+        check_parity(dev, ref)
+        # Stored amounts must reflect the clamp.
+        got = dev.lookup_transfers([2, 4])
+        want = ref.lookup_transfers([2, 4])
+        assert [M.transfer_from_row(g) for g in got] == want
+
+    def test_two_phase_full_cycle(self):
+        dev, ref = make_pair()
+        seed(dev, ref)
+        run_transfers(dev, ref, types.transfers_array([
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                           ledger=1, code=10, flags=F.PENDING),
+            types.transfer(id=2, debit_account_id=1, credit_account_id=2, amount=50,
+                           ledger=1, code=10, flags=F.PENDING, timeout=1000),
+        ]))
+        rows = [
+            # Partial post of 1.
+            types.transfer(id=10, pending_id=1, amount=60, flags=F.POST_PENDING_TRANSFER),
+            # Exists (same id, same fields).
+            types.transfer(id=10, pending_id=1, amount=60, flags=F.POST_PENDING_TRANSFER),
+            # Already posted under a different id.
+            types.transfer(id=11, pending_id=1, amount=60, flags=F.POST_PENDING_TRANSFER),
+            # Void 2.
+            types.transfer(id=12, pending_id=2, flags=F.VOID_PENDING_TRANSFER),
+            # Already voided.
+            types.transfer(id=13, pending_id=2, flags=F.POST_PENDING_TRANSFER),
+            # Validation ladder.
+            types.transfer(id=14, pending_id=0, flags=F.POST_PENDING_TRANSFER),
+            types.transfer(id=15, pending_id=15, flags=F.POST_PENDING_TRANSFER),
+            types.transfer(id=16, pending_id=99, flags=F.POST_PENDING_TRANSFER),
+            types.transfer(id=17, pending_id=1, amount=101, flags=F.POST_PENDING_TRANSFER),
+            types.transfer(id=18, pending_id=1, flags=F.POST_PENDING_TRANSFER | F.VOID_PENDING_TRANSFER),
+        ]
+        run_transfers(dev, ref, types.transfers_array(rows))
+        check_parity(dev, ref)
+        got = dev.lookup_transfers([10, 12])
+        want = ref.lookup_transfers([10, 12])
+        assert [M.transfer_from_row(g) for g in got] == want
+
+    def test_intra_batch_pending_post(self):
+        dev, ref = make_pair()
+        seed(dev, ref)
+        rows = [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                           ledger=1, code=10, flags=F.PENDING),
+            types.transfer(id=2, pending_id=1, flags=F.POST_PENDING_TRANSFER),
+            types.transfer(id=3, pending_id=1, flags=F.VOID_PENDING_TRANSFER),  # already posted
+        ]
+        run_transfers(dev, ref, types.transfers_array(rows))
+        check_parity(dev, ref)
+
+    def test_pending_expiry(self):
+        dev, ref = make_pair()
+        seed(dev, ref)
+        run_transfers(dev, ref, types.transfers_array([
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                           ledger=1, code=10, flags=F.PENDING, timeout=1)]),
+            wall=10_000)
+        p_ts = ref.transfers[1].timestamp
+        run_transfers(dev, ref, types.transfers_array([
+            types.transfer(id=2, pending_id=1, flags=F.POST_PENDING_TRANSFER)]),
+            wall=p_ts + 1_000_000_000)
+        check_parity(dev, ref)
+
+    def test_linked_chain_rolls_back_two_phase(self):
+        dev, ref = make_pair()
+        seed(dev, ref)
+        run_transfers(dev, ref, types.transfers_array([
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                           ledger=1, code=10, flags=F.PENDING)]))
+        L = int(F.LINKED)
+        rows = [
+            # Chain: post + plain transfer + failing event -> all rolled back.
+            types.transfer(id=2, pending_id=1, flags=F.POST_PENDING_TRANSFER | L),
+            types.transfer(id=3, debit_account_id=2, credit_account_id=3, amount=5,
+                           ledger=1, code=10, flags=L),
+            types.transfer(id=4, debit_account_id=1, credit_account_id=99, amount=1,
+                           ledger=1, code=10),
+            # After rollback the pending transfer is still postable.
+            types.transfer(id=5, pending_id=1, flags=F.POST_PENDING_TRANSFER),
+        ]
+        run_transfers(dev, ref, types.transfers_array(rows))
+        check_parity(dev, ref)
+        assert ref.posted[ref.transfers[1].timestamp] == "posted"
+
+    def test_rollback_then_reuse_id(self):
+        dev, ref = make_pair()
+        seed(dev, ref)
+        L = int(F.LINKED)
+        rows = [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                           ledger=1, code=10, flags=L),
+            types.transfer(id=2, debit_account_id=1, credit_account_id=99, amount=1,
+                           ledger=1, code=10),  # breaks; id 1 rolled back
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=11,
+                           ledger=1, code=10),  # fresh insert, different amount
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                           ledger=1, code=10),  # exists_with_different_amount
+        ]
+        run_transfers(dev, ref, types.transfers_array(rows))
+        check_parity(dev, ref)
+
+    def test_random_differential_all_features(self):
+        dev, ref = make_pair(force_sequential=False)
+        rng = np.random.default_rng(99)
+        # Accounts: some with limits/history -> machine must auto-fallback.
+        rows = []
+        for i in range(8):
+            flags = 0
+            if i == 1:
+                flags = int(AF.DEBITS_MUST_NOT_EXCEED_CREDITS)
+            if i == 2:
+                flags = int(AF.CREDITS_MUST_NOT_EXCEED_DEBITS)
+            rows.append(types.account(id=i + 1, ledger=1, code=10, flags=flags))
+        run_accounts(dev, ref, types.accounts_array(rows), wall=1000)
+
+        pending_pool = []
+        next_id = 100
+        for b in range(4):
+            batch = []
+            for i in range(24):
+                r = rng.random()
+                next_id += 1
+                if r < 0.2 and pending_pool:
+                    pid = int(rng.choice(pending_pool))
+                    f = int(rng.choice([F.POST_PENDING_TRANSFER, F.VOID_PENDING_TRANSFER]))
+                    amt = int(rng.integers(0, 40)) if f == F.POST_PENDING_TRANSFER else 0
+                    batch.append(types.transfer(id=next_id, pending_id=pid,
+                                                amount=amt, flags=f))
+                elif r < 0.35:
+                    f = int(rng.choice([F.BALANCING_DEBIT, F.BALANCING_CREDIT]))
+                    dr, cr = rng.choice(range(1, 9), size=2, replace=False)
+                    batch.append(types.transfer(
+                        id=next_id, debit_account_id=int(dr), credit_account_id=int(cr),
+                        amount=int(rng.integers(0, 100)), ledger=1, code=10, flags=f))
+                else:
+                    dr, cr = rng.choice(range(1, 9), size=2, replace=False)
+                    f = int(F.PENDING) if rng.random() < 0.4 else 0
+                    t = types.transfer(
+                        id=next_id, debit_account_id=int(dr), credit_account_id=int(cr),
+                        amount=int(rng.integers(1, 60)), ledger=1, code=10, flags=f,
+                        timeout=int(rng.integers(0, 3)) if f else 0)
+                    if f:
+                        pending_pool.append(next_id)
+                    batch.append(t)
+            run_transfers(dev, ref, types.transfers_array(batch), wall=50_000 * (b + 1))
+            assert dev.balances_snapshot() == ref.balances_snapshot(), f"batch {b}"

@@ -42,10 +42,12 @@ class TpuStateMachine:
         self,
         ledger_config: Optional[LedgerConfig] = None,
         batch_lanes: int = 8192,
+        force_sequential: bool = False,
     ) -> None:
         cfg = ledger_config or LedgerConfig()
         self.config = cfg
         self.batch_lanes = batch_lanes
+        self.force_sequential = force_sequential
         self.ledger = sm.make_ledger(
             cfg.accounts_capacity, cfg.transfers_capacity, cfg.posted_capacity
         )
@@ -98,7 +100,9 @@ class TpuStateMachine:
             return []
 
         any_linked = bool((batch["flags"] & types.AccountFlags.LINKED).any())
-        if any_linked and self._has_intra_batch_dup_ids(batch):
+        if self.force_sequential or (
+            any_linked and self._has_intra_batch_dup_ids(batch)
+        ):
             return self._sequential("create_accounts", batch, timestamp)
 
         # Conservative P1 tracking: any *requested* limit/history flag flips
@@ -130,7 +134,7 @@ class TpuStateMachine:
         if count == 0:
             return []
 
-        if not self._fast_path_ok(batch):
+        if self.force_sequential or not self._fast_path_ok(batch):
             return self._sequential("create_transfers", batch, timestamp)
 
         soa = self._pad_soa(batch)
