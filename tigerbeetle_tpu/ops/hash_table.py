@@ -66,14 +66,22 @@ class LookupResult(NamedTuple):
     overflow: jax.Array  # bool scalar — some lane exhausted max_probe
 
 
-@functools.partial(jax.jit, static_argnames=("max_probe",))
+@functools.partial(jax.jit, static_argnames=("max_probe", "hash_shift"))
 def lookup(
-    table: Table, key_lo: jax.Array, key_hi: jax.Array, max_probe: int
+    table: Table,
+    key_lo: jax.Array,
+    key_hi: jax.Array,
+    max_probe: int,
+    hash_shift: int = 0,
 ) -> LookupResult:
-    """Batched linear probe: for each key, find its slot or prove absence."""
+    """Batched linear probe: for each key, find its slot or prove absence.
+
+    ``hash_shift`` discards low hash bits before slotting — sharded tables use
+    the low bits as the owner-shard index (parallel/sharded.py) and the rest
+    for the local slot, so shard-local probes never cross devices."""
     capacity = table.capacity
     mask = jnp.uint64(capacity - 1)
-    home = mix64(key_lo, key_hi) & mask
+    home = (mix64(key_lo, key_hi) >> jnp.uint64(hash_shift)) & mask
 
     # Lanes probing key 0 (invalid id / padding lanes) resolve immediately.
     is_null = (key_lo == 0) & (key_hi == 0)
@@ -103,7 +111,7 @@ def lookup(
     return LookupResult(found=found, slot=slot, overflow=jnp.any(~done))
 
 
-@functools.partial(jax.jit, static_argnames=("max_probe",))
+@functools.partial(jax.jit, static_argnames=("max_probe", "hash_shift"))
 def insert(
     table: Table,
     key_lo: jax.Array,
@@ -111,6 +119,7 @@ def insert(
     insert_mask: jax.Array,
     rows: Dict[str, jax.Array],
     max_probe: int,
+    hash_shift: int = 0,
 ) -> Tuple[Table, jax.Array]:
     """Batched insert of *new, distinct* keys where ``insert_mask`` is set.
 
@@ -123,7 +132,7 @@ def insert(
     capacity = table.capacity
     n = key_lo.shape[0]
     mask = jnp.uint64(capacity - 1)
-    home = mix64(key_lo, key_hi) & mask
+    home = (mix64(key_lo, key_hi) >> jnp.uint64(hash_shift)) & mask
     sentinel = jnp.uint64(capacity)  # out-of-range: dropped by scatters
 
     def cond(state):

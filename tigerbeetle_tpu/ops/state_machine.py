@@ -260,19 +260,15 @@ def _timestamps(count: jax.Array, timestamp: jax.Array, n: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, donate_argnames=("ledger",))
-def create_accounts(
-    ledger: Ledger,
+def account_codes(
     batch: Dict[str, jax.Array],
+    found: jax.Array,
+    e: Dict[str, jax.Array],
     count: jax.Array,
-    timestamp: jax.Array,
-) -> Tuple[Ledger, jax.Array]:
-    """Vectorized create_accounts (state_machine.zig:1198-1237).
-
-    ``batch`` is the SoA of ACCOUNT_DTYPE columns padded to a fixed lane count;
-    ``count`` is the true event count; ``timestamp`` the batch prepare
-    timestamp. Returns (ledger, result codes uint32[N]) — 0 is ok, and lanes
-    >= count are don't-care."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Pure create_accounts validation (state_machine.zig:1198-1237): returns
+    (codes, ok). ``found``/``e`` are the table-existence gather, however the
+    table is sharded — replicated compute."""
     n = batch["id_lo"].shape[0]
     lane = jnp.arange(n, dtype=jnp.int32)
     valid = lane < count.astype(jnp.int32)
@@ -285,11 +281,6 @@ def create_accounts(
     dpo = _u128_col(batch, "debits_posted")
     cp = _u128_col(batch, "credits_pending")
     cpo = _u128_col(batch, "credits_posted")
-
-    # Table existence + exists ladder (state_machine.zig:1218-1237).
-    look = ht.lookup(ledger.accounts, bid.lo, bid.hi, MAX_PROBE)
-    found = look.found & valid
-    e = ht.gather_cols(ledger.accounts, look.slot, found)
 
     exists_code = _exists_ladder_accounts(batch, e, n)
 
@@ -325,14 +316,51 @@ def create_accounts(
 
     codes = _chain_codes(linked, codes, count)
     ok = (codes == 0) & valid
+    return codes, ok
 
+
+def account_rows(
+    batch: Dict[str, jax.Array], count: jax.Array, timestamp: jax.Array
+) -> Dict[str, jax.Array]:
+    """Rows to insert for accepted create_accounts events (assigned timestamps)."""
+    n = batch["id_lo"].shape[0]
     ts = _timestamps(count, timestamp, n)
-    rows = {
+    return {
         name: (batch[name] if name != "timestamp" else ts).astype(dt)
         for name, dt in ACCOUNT_COLS.items()
     }
+
+
+def create_accounts_impl(
+    ledger: Ledger,
+    batch: Dict[str, jax.Array],
+    count: jax.Array,
+    timestamp: jax.Array,
+) -> Tuple[Ledger, jax.Array]:
+    """Vectorized create_accounts (state_machine.zig:1198-1237).
+
+    ``batch`` is the SoA of ACCOUNT_DTYPE columns padded to a fixed lane count;
+    ``count`` is the true event count; ``timestamp`` the batch prepare
+    timestamp. Returns (ledger, result codes uint32[N]) — 0 is ok, and lanes
+    >= count are don't-care."""
+    n = batch["id_lo"].shape[0]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    valid = lane < count.astype(jnp.int32)
+
+    bid = _u128_col(batch, "id")
+
+    # Table existence + exists ladder (state_machine.zig:1218-1237).
+    look = ht.lookup(ledger.accounts, bid.lo, bid.hi, MAX_PROBE)
+    found = look.found & valid
+    e = ht.gather_cols(ledger.accounts, look.slot, found)
+
+    codes, ok = account_codes(batch, found, e, count)
+    rows = account_rows(batch, count, timestamp)
     accounts, _ = ht.insert(ledger.accounts, bid.lo, bid.hi, ok, rows, MAX_PROBE)
     return ledger.replace(accounts=accounts), codes
+
+
+create_accounts = jax.jit(create_accounts_impl, donate_argnames=("ledger",))
 
 
 def _exists_ladder_accounts(
@@ -358,17 +386,28 @@ def _exists_ladder_accounts(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, donate_argnames=("ledger",))
-def create_transfers_fast(
-    ledger: Ledger,
+class TransferCtx(NamedTuple):
+    """Gathered context for transfer validation: everything the (replicated)
+    validation pass needs, independent of how the tables are sharded."""
+
+    dr_found: jax.Array
+    cr_found: jax.Array
+    dr_slot: jax.Array  # global slot ids (sharding-aware callers encode owner)
+    cr_slot: jax.Array
+    dr: Dict[str, jax.Array]
+    cr: Dict[str, jax.Array]
+    ex_found: jax.Array
+    e: Dict[str, jax.Array]
+
+
+def transfer_codes(
     batch: Dict[str, jax.Array],
+    ctx: TransferCtx,
     count: jax.Array,
     timestamp: jax.Array,
-) -> Tuple[Ledger, jax.Array]:
-    """Vectorized create_transfers under preconditions P1-P4 (module docstring).
-
-    Mirrors state_machine.zig:1239-1368 with the balancing/post-void/limit/
-    overflow branches statically excluded."""
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Pure validation pass: (codes, ok, ts, pending). Identical whether the
+    gathers came from a local table or a sharded one (replicated compute)."""
     n = batch["id_lo"].shape[0]
     lane = jnp.arange(n, dtype=jnp.int32)
     valid = lane < count.astype(jnp.int32)
@@ -383,21 +422,9 @@ def create_transfers_fast(
     pending = (flags & TF_PENDING).astype(jnp.bool_)
 
     ts = _timestamps(count, timestamp, n)
+    both = ctx.dr_found & ctx.cr_found
 
-    # Account gathers.
-    dr_look = ht.lookup(ledger.accounts, dr_id.lo, dr_id.hi, MAX_PROBE)
-    cr_look = ht.lookup(ledger.accounts, cr_id.lo, cr_id.hi, MAX_PROBE)
-    dr_found = dr_look.found & valid
-    cr_found = cr_look.found & valid
-    dr = ht.gather_cols(ledger.accounts, dr_look.slot, dr_found)
-    cr = ht.gather_cols(ledger.accounts, cr_look.slot, cr_found)
-    both = dr_found & cr_found
-
-    # Existing-transfer gather + exists ladder (state_machine.zig:1284,1370-1389).
-    ex_look = ht.lookup(ledger.transfers, tid.lo, tid.hi, MAX_PROBE)
-    ex_found = ex_look.found & valid
-    e = ht.gather_cols(ledger.transfers, ex_look.slot, ex_found)
-    exists_code = _exists_ladder_transfers(batch, e, n)
+    exists_code = _exists_ladder_transfers(batch, ctx.e, n)
 
     # overflows_timeout (state_machine.zig:1322): ts + timeout*1e9 > u64 max.
     timeout_ns = batch["timeout"].astype(jnp.uint64) * jnp.uint64(NS_PER_S)
@@ -420,13 +447,15 @@ def create_transfers_fast(
         (u128.is_zero(amt), 18),
         ((batch["ledger"] == 0), 19),
         ((batch["code"] == 0), 20),
-        (valid & ~dr_look.found, 21),
-        (valid & ~cr_look.found, 22),
-        (both & (dr["ledger"] != cr["ledger"]), 23),
-        (both & (batch["ledger"] != dr["ledger"]), 24),
+        (valid & ~ctx.dr_found, 21),
+        (valid & ~ctx.cr_found, 22),
+        (both & (ctx.dr["ledger"] != ctx.cr["ledger"]), 23),
+        (both & (batch["ledger"] != ctx.dr["ledger"]), 24),
         (timeout_overflow, 53),
     )
-    standalone = _merge_code(standalone, jnp.where(ex_found, exists_code, 0))
+    standalone = _merge_code(
+        standalone, jnp.where(ctx.ex_found, exists_code, 0)
+    )
 
     # Intra-batch duplicate ids.
     dup = _resolve_duplicates(tid.lo, tid.hi, standalone == 0, valid)
@@ -439,14 +468,40 @@ def create_transfers_fast(
 
     codes = _chain_codes(linked, codes, count)
     ok = (codes == 0) & valid
+    return codes, ok, ts, pending
 
-    # --- balance application: exact u128 segment sums via 32-bit limbs ---
-    cap = ledger.accounts.capacity
-    sent = jnp.uint64(cap)
+
+class BalancePlan(NamedTuple):
+    """Sorted, segment-summed balance deltas keyed by global account slot.
+
+    ``s_slot[i]`` is the sorted global slot for sorted-lane i; ``head`` marks
+    the first lane of each slot group; ``deltas[field] = (d_lo, d_hi)`` is the
+    u128 total delta for the lane's group."""
+
+    s_slot: jax.Array
+    head: jax.Array
+    deltas: Dict[str, Tuple[jax.Array, jax.Array]]
+
+
+def balance_plan(
+    dr_slot: jax.Array,
+    cr_slot: jax.Array,
+    ok: jax.Array,
+    amt_lo: jax.Array,
+    pending: jax.Array,
+    sentinel,
+) -> BalancePlan:
+    """Exact u128 per-account balance deltas via 32-bit limb segment sums.
+
+    Replaces the reference's two sequential balance updates per event
+    (state_machine.zig:1330-1338) with sort + segment-sum: limb partial sums of
+    <= 2*8190 u32 terms fit u64 exactly, so no carries are lost."""
+    n = ok.shape[0]
+    sent = jnp.uint64(sentinel)
     ok2 = jnp.concatenate([ok, ok])
-    slots2 = jnp.concatenate([dr_look.slot, cr_look.slot])
+    slots2 = jnp.concatenate([dr_slot, cr_slot])
     slots2 = jnp.where(ok2, slots2, sent)
-    amt2 = jnp.concatenate([amt.lo, amt.lo])  # P3: amount_hi == 0
+    amt2 = jnp.concatenate([amt_lo, amt_lo])  # P3: amount_hi == 0
     pending2 = jnp.concatenate([pending, pending])
     is_dr2 = jnp.concatenate(
         [jnp.ones((n,), jnp.bool_), jnp.zeros((n,), jnp.bool_)]
@@ -482,12 +537,7 @@ def create_transfers_fast(
         "credits_posted": limb_sums(~s_is_dr & ~s_pending),
     }
 
-    # Per-head-lane: delta = (a1_sum << 32) + a0_sum as u128, then old + delta.
-    head_slot = jnp.where(head, s_slot, sent)
-    head_valid = head
-    acc = ht.gather_cols(ledger.accounts, jnp.where(head_valid, s_slot, 0), head_valid)
-
-    updates = {}
+    deltas = {}
     for field, (sa0, sa1) in sums.items():
         sa0_l = sa0[gid]
         sa1_l = sa1[gid]
@@ -495,12 +545,69 @@ def create_transfers_fast(
         d_lo = sa0_l + low_part
         carry = (d_lo < low_part).astype(jnp.uint64)
         d_hi = (sa1_l >> jnp.uint64(32)) + carry
+        deltas[field] = (d_lo, d_hi)
+    return BalancePlan(s_slot=s_slot, head=head, deltas=deltas)
+
+
+def apply_balance_plan(accounts: ht.Table, plan: BalancePlan) -> ht.Table:
+    """Gather-old + add-delta + scatter at group heads (unique slots)."""
+    sent = jnp.uint64(accounts.capacity)
+    head_valid = plan.head & (plan.s_slot < sent)
+    acc = ht.gather_cols(
+        accounts, jnp.where(head_valid, plan.s_slot, 0), head_valid
+    )
+    updates = {}
+    for field, (d_lo, d_hi) in plan.deltas.items():
         old = U128(acc[field + "_lo"], acc[field + "_hi"])
         new, _ = u128.add(old, U128(d_lo, d_hi))  # P3: cannot overflow
         updates[field + "_lo"] = new.lo
         updates[field + "_hi"] = new.hi
+    return ht.scatter_cols(
+        accounts, jnp.where(head_valid, plan.s_slot, sent), head_valid, updates
+    )
 
-    accounts = ht.scatter_cols(ledger.accounts, head_slot, head_valid, updates)
+
+def create_transfers_impl(
+    ledger: Ledger,
+    batch: Dict[str, jax.Array],
+    count: jax.Array,
+    timestamp: jax.Array,
+) -> Tuple[Ledger, jax.Array]:
+    """Vectorized create_transfers under preconditions P1-P4 (module docstring).
+
+    Mirrors state_machine.zig:1239-1368 with the balancing/post-void/limit/
+    overflow branches statically excluded."""
+    tid = _u128_col(batch, "id")
+    dr_id = _u128_col(batch, "debit_account_id")
+    cr_id = _u128_col(batch, "credit_account_id")
+    amt = _u128_col(batch, "amount")
+    n = batch["id_lo"].shape[0]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    valid = lane < count.astype(jnp.int32)
+
+    dr_look = ht.lookup(ledger.accounts, dr_id.lo, dr_id.hi, MAX_PROBE)
+    cr_look = ht.lookup(ledger.accounts, cr_id.lo, cr_id.hi, MAX_PROBE)
+    ex_look = ht.lookup(ledger.transfers, tid.lo, tid.hi, MAX_PROBE)
+    dr_found = dr_look.found & valid
+    cr_found = cr_look.found & valid
+    ex_found = ex_look.found & valid
+    ctx = TransferCtx(
+        dr_found=dr_found,
+        cr_found=cr_found,
+        dr_slot=dr_look.slot,
+        cr_slot=cr_look.slot,
+        dr=ht.gather_cols(ledger.accounts, dr_look.slot, dr_found),
+        cr=ht.gather_cols(ledger.accounts, cr_look.slot, cr_found),
+        ex_found=ex_found,
+        e=ht.gather_cols(ledger.transfers, ex_look.slot, ex_found),
+    )
+
+    codes, ok, ts, pending = transfer_codes(batch, ctx, count, timestamp)
+
+    plan = balance_plan(
+        ctx.dr_slot, ctx.cr_slot, ok, amt.lo, pending, ledger.accounts.capacity
+    )
+    accounts = apply_balance_plan(ledger.accounts, plan)
 
     # --- transfer inserts ---
     rows = {
@@ -510,6 +617,21 @@ def create_transfers_fast(
     transfers, _ = ht.insert(ledger.transfers, tid.lo, tid.hi, ok, rows, MAX_PROBE)
 
     return ledger.replace(accounts=accounts, transfers=transfers), codes
+
+
+create_transfers_fast = jax.jit(create_transfers_impl, donate_argnames=("ledger",))
+
+
+def transfer_rows(
+    batch: Dict[str, jax.Array], count: jax.Array, timestamp: jax.Array
+) -> Dict[str, jax.Array]:
+    """Rows to insert for accepted create_transfers events."""
+    n = batch["id_lo"].shape[0]
+    ts = _timestamps(count, timestamp, n)
+    return {
+        name: (batch[name] if name != "timestamp" else ts).astype(dt)
+        for name, dt in TRANSFER_COLS.items()
+    }
 
 
 def _exists_ladder_transfers(

@@ -1,0 +1,232 @@
+"""Sharded (multi-chip) state machine: the ledger partitioned over a device mesh.
+
+The reference scales by *replicating* the whole state machine over a TCP bus
+(SURVEY §2.8-2.9; message_bus.zig) — every replica holds all state.  On a TPU
+slice we can additionally *shard* one state machine across chips, with XLA
+collectives over ICI doing the data movement:
+
+- Ownership: account/transfer keys are assigned to shards by the low bits of
+  their hash (owner = mix64(key) & (n_shards-1)); the remaining bits index an
+  open-addressing table local to the owner (hash_shift in ops/hash_table.py),
+  so probe chains never cross chips.
+- Gather phase: every shard probes its local table for the whole (replicated)
+  batch, masks to the keys it owns, and one ``psum`` per gathered quantity
+  combines the results — after which every shard holds the full gather context
+  (~1 MiB per table per batch riding ICI).
+- Validation: the pure passes (ops/state_machine.py transfer_codes /
+  account_codes) run *replicated* on every shard — deterministic, no
+  communication.
+- Apply phase: balance deltas are planned over global slot ids (replicated),
+  then each shard scatters only the slots it owns; inserts likewise. No
+  further communication.
+
+Determinism: every collective is a sum of disjoint (owner-masked) terms, and
+all apply-phase writes are owner-local — byte-identical to the single-chip
+kernels, which the tests check on a virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..u128 import mix64
+from ..ops import hash_table as ht
+from ..ops import state_machine as sm
+from ..ops.state_machine import (
+    ACCOUNT_COLS,
+    Ledger,
+    MAX_PROBE,
+    POSTED_COLS,
+    TRANSFER_COLS,
+    TransferCtx,
+)
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+AXIS = "shard"
+
+
+def make_sharded_ledger(
+    mesh: Mesh,
+    accounts_capacity: int,
+    transfers_capacity: int,
+    posted_capacity: int,
+) -> Ledger:
+    """Build a Ledger whose table arrays are sharded over ``mesh`` axis 0.
+
+    Capacities are *global* (power of two, divisible by the shard count).
+    Table ``count``/``probe_overflow`` become per-shard vectors of length
+    n_shards."""
+    n = mesh.devices.size
+    for cap in (accounts_capacity, transfers_capacity, posted_capacity):
+        assert cap % n == 0 and (cap & (cap - 1)) == 0
+
+    def table(capacity, col_specs):
+        return ht.Table(
+            key_lo=np.zeros((capacity,), np.uint64),
+            key_hi=np.zeros((capacity,), np.uint64),
+            tombstone=np.zeros((capacity,), np.bool_),
+            cols={k: np.zeros((capacity,), dt) for k, dt in col_specs.items()},
+            count=np.zeros((n,), np.uint64),
+            probe_overflow=np.zeros((n,), np.bool_),
+        )
+
+    ledger = Ledger(
+        accounts=table(accounts_capacity, ACCOUNT_COLS),
+        transfers=table(transfers_capacity, TRANSFER_COLS),
+        posted=table(posted_capacity, POSTED_COLS),
+    )
+    spec = NamedSharding(mesh, P(AXIS))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, spec), ledger)
+
+
+def _specs_like(tree):
+    return jax.tree_util.tree_map(lambda _: P(AXIS), tree)
+
+
+def _replicated_like(tree):
+    return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+class _ShardGather:
+    """Per-shard masked probe + psum combine for one key set."""
+
+    def __init__(self, table: ht.Table, lo, hi, n_shards: int, shift: int):
+        my = jax.lax.axis_index(AXIS).astype(jnp.uint64)
+        h = mix64(lo, hi)
+        self.owner_mask = (h & jnp.uint64(n_shards - 1)) == my
+        look = ht.lookup(table, lo, hi, MAX_PROBE, hash_shift=shift)
+        local_cap = table.capacity
+        self.found_l = look.found & self.owner_mask
+        self.slot_l = look.slot
+        gslot = my * jnp.uint64(local_cap) + look.slot
+        self.found = (
+            jax.lax.psum(self.found_l.astype(jnp.uint32), AXIS) > 0
+        )
+        self.gslot = jax.lax.psum(
+            jnp.where(self.found_l, gslot, jnp.uint64(0)), AXIS
+        )
+
+    def rows(self, table: ht.Table) -> Dict[str, jax.Array]:
+        local = ht.gather_cols(table, self.slot_l, self.found_l)
+        return {k: jax.lax.psum(v, AXIS) for k, v in local.items()}
+
+
+def sharded_create_transfers(mesh: Mesh):
+    """Build the jitted sharded create_transfers step for ``mesh``.
+
+    Returns fn(ledger, batch, count, timestamp) -> (ledger, codes), with the
+    ledger sharded per make_sharded_ledger and batch/count/timestamp
+    replicated."""
+    n_shards = mesh.devices.size
+    shift = n_shards.bit_length() - 1
+
+    def local_step(ledger: Ledger, batch, count, timestamp):
+        acc, tr = ledger.accounts, ledger.transfers
+        local_acc_cap = acc.capacity
+
+        dr_g = _ShardGather(
+            acc, batch["debit_account_id_lo"], batch["debit_account_id_hi"],
+            n_shards, shift,
+        )
+        cr_g = _ShardGather(
+            acc, batch["credit_account_id_lo"], batch["credit_account_id_hi"],
+            n_shards, shift,
+        )
+        ex_g = _ShardGather(tr, batch["id_lo"], batch["id_hi"], n_shards, shift)
+
+        lane = jnp.arange(batch["id_lo"].shape[0], dtype=jnp.int32)
+        valid = lane < count.astype(jnp.int32)
+        ctx = TransferCtx(
+            dr_found=dr_g.found & valid,
+            cr_found=cr_g.found & valid,
+            dr_slot=dr_g.gslot,
+            cr_slot=cr_g.gslot,
+            dr=dr_g.rows(acc),
+            cr=cr_g.rows(acc),
+            ex_found=ex_g.found & valid,
+            e=ex_g.rows(tr),
+        )
+
+        # Replicated validation (identical on every shard).
+        codes, ok, ts, pending = sm.transfer_codes(batch, ctx, count, timestamp)
+
+        # Balance plan over global slots, applied owner-locally.
+        global_cap = local_acc_cap * n_shards
+        plan = sm.balance_plan(
+            ctx.dr_slot, ctx.cr_slot, ok,
+            batch["amount_lo"], pending, global_cap,
+        )
+        my = jax.lax.axis_index(AXIS).astype(jnp.uint64)
+        base = my * jnp.uint64(local_acc_cap)
+        in_range = (plan.s_slot >= base) & (
+            plan.s_slot < base + jnp.uint64(local_acc_cap)
+        )
+        local_plan = sm.BalancePlan(
+            s_slot=jnp.where(in_range, plan.s_slot - base, jnp.uint64(local_acc_cap)),
+            head=plan.head & in_range,
+            deltas=plan.deltas,
+        )
+        accounts = sm.apply_balance_plan(acc, local_plan)
+
+        # Owner-local transfer inserts.
+        rows = sm.transfer_rows(batch, count, timestamp)
+        transfers, _ = ht.insert(
+            tr, batch["id_lo"], batch["id_hi"],
+            ok & ex_g.owner_mask, rows, MAX_PROBE, hash_shift=shift,
+        )
+
+        return ledger.replace(accounts=accounts, transfers=transfers), codes
+
+    def step(ledger, batch, count, timestamp):
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(_specs_like(ledger), _replicated_like(batch), P(), P()),
+            out_specs=(_specs_like(ledger), P()),
+            check_vma=False,
+        )(ledger, batch, count, timestamp)
+
+    return jax.jit(step, donate_argnames=("ledger",))
+
+
+def sharded_create_accounts(mesh: Mesh):
+    """Jitted sharded create_accounts step for ``mesh``."""
+    n_shards = mesh.devices.size
+    shift = n_shards.bit_length() - 1
+
+    def local_step(ledger: Ledger, batch, count, timestamp):
+        acc = ledger.accounts
+        g = _ShardGather(acc, batch["id_lo"], batch["id_hi"], n_shards, shift)
+        lane = jnp.arange(batch["id_lo"].shape[0], dtype=jnp.int32)
+        valid = lane < count.astype(jnp.int32)
+        codes, ok = sm.account_codes(
+            batch, g.found & valid, g.rows(acc), count
+        )
+        rows = sm.account_rows(batch, count, timestamp)
+        accounts, _ = ht.insert(
+            acc, batch["id_lo"], batch["id_hi"],
+            ok & g.owner_mask, rows, MAX_PROBE, hash_shift=shift,
+        )
+        return ledger.replace(accounts=accounts), codes
+
+    def step(ledger, batch, count, timestamp):
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(_specs_like(ledger), _replicated_like(batch), P(), P()),
+            out_specs=(_specs_like(ledger), P()),
+            check_vma=False,
+        )(ledger, batch, count, timestamp)
+
+    return jax.jit(step, donate_argnames=("ledger",))
