@@ -1,0 +1,76 @@
+"""Native (C++) runtime components, built lazily and loaded via ctypes.
+
+The reference's entire data plane is native Zig (SURVEY §2.7); here the
+non-JAX-traceable hot host paths — the AEGIS-128L wire/WAL checksum today,
+codec/IO helpers as they land — are C++ compiled on first use into
+``libtb.so`` next to this file.  Pure-Python fallbacks keep the framework
+functional without a toolchain (and cross-check the native code in tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["aegis.cpp"]
+_LIB_PATH = os.path.join(_DIR, "libtb.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime for s in _SOURCES
+    )
+
+
+def _build() -> None:
+    sources = [os.path.join(_DIR, s) for s in _SOURCES]
+    tmp = _LIB_PATH + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", tmp, *sources,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, OSError) as err:
+        # -march=native may be unavailable (cross/sandboxed); retry generic.
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, *sources]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(tmp, _LIB_PATH)
+
+
+def load():
+    """Return the loaded native library, building if needed; None on failure."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if _stale():
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.tb_checksum.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p
+            ]
+            lib.tb_checksum.restype = None
+            lib.tb_checksum_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.tb_checksum_batch.restype = None
+            lib.tb_aesni_enabled.restype = ctypes.c_int
+            _lib = lib
+        except Exception:
+            _build_failed = True
+    return _lib

@@ -609,11 +609,8 @@ def create_transfers_impl(
     )
     accounts = apply_balance_plan(ledger.accounts, plan)
 
-    # --- transfer inserts ---
-    rows = {
-        name: (batch[name] if name != "timestamp" else ts).astype(dt)
-        for name, dt in TRANSFER_COLS.items()
-    }
+    # --- transfer inserts (timestamps recomputed in transfer_rows CSE under jit) ---
+    rows = transfer_rows(batch, count, timestamp)
     transfers, _ = ht.insert(ledger.transfers, tid.lo, tid.hi, ok, rows, MAX_PROBE)
 
     return ledger.replace(accounts=accounts, transfers=transfers), codes

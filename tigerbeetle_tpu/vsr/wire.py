@@ -1,0 +1,286 @@
+"""Wire protocol: the 256-byte message header, command schemas, framing.
+
+Byte-compatible with the reference protocol (src/vsr/message_header.zig:17-99,
+src/vsr.zig:168-254) so existing clients and tooling interoperate: one 256-byte
+header serves as both network frame and WAL entry, with
+
+- ``checksum``       — AEGIS-128L over header bytes [16..256] (covers
+  ``checksum_body``, so it transitively covers the body),
+- ``checksum_body``  — AEGIS-128L over the body,
+- a per-command tail schema in the last 128 bytes.
+
+Headers are numpy structured scalars (one dtype per command, sharing the
+112-byte frame prefix), so ``tobytes()``/``frombuffer`` are the codec.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .checksum import checksum
+
+HEADER_SIZE = 256
+VERSION = 0
+
+
+class Command(enum.IntEnum):
+    """VSR protocol commands (vsr.zig:168-206)."""
+
+    reserved = 0
+    ping = 1
+    pong = 2
+    ping_client = 3
+    pong_client = 4
+    request = 5
+    prepare = 6
+    prepare_ok = 7
+    reply = 8
+    commit = 9
+    start_view_change = 10
+    do_view_change = 11
+    start_view = 12
+    request_start_view = 13
+    request_headers = 14
+    request_prepare = 15
+    request_reply = 16
+    headers = 17
+    eviction = 18
+    request_blocks = 19
+    block = 20
+    request_sync_checkpoint = 21
+    sync_checkpoint = 22
+
+
+VSR_OPERATIONS_RESERVED = 128
+
+
+class Operation(enum.IntEnum):
+    """Operation space: <128 VSR control plane, >=128 state machine
+    (vsr.zig:210-254, constants.zig:37-39, state_machine.zig:318-326)."""
+
+    reserved = 0
+    root = 1
+    register = 2
+    reconfigure = 3
+    create_accounts = VSR_OPERATIONS_RESERVED + 0
+    create_transfers = VSR_OPERATIONS_RESERVED + 1
+    lookup_accounts = VSR_OPERATIONS_RESERVED + 2
+    lookup_transfers = VSR_OPERATIONS_RESERVED + 3
+    get_account_transfers = VSR_OPERATIONS_RESERVED + 4
+    get_account_history = VSR_OPERATIONS_RESERVED + 5
+
+
+# The shared 112-byte frame prefix (message_header.zig:17-66).
+_FRAME = [
+    ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
+    ("checksum_padding", "V16"),
+    ("checksum_body_lo", "<u8"), ("checksum_body_hi", "<u8"),
+    ("checksum_body_padding", "V16"),
+    ("nonce_reserved", "V16"),
+    ("cluster_lo", "<u8"), ("cluster_hi", "<u8"),
+    ("size", "<u4"),
+    ("epoch", "<u4"),
+    ("view", "<u4"),
+    ("version", "<u2"),
+    ("command", "u1"),
+    ("replica", "u1"),
+    ("reserved_frame", "V16"),
+]
+
+
+def _dtype(tail) -> np.dtype:
+    dt = np.dtype(_FRAME + tail)
+    assert dt.itemsize == HEADER_SIZE, (dt.itemsize, tail)
+    return dt
+
+
+# Per-command tails (the final 128 bytes; message_header.zig per-command types).
+PREFIX_DTYPE = _dtype([("reserved_command", "V128")])
+
+REQUEST_DTYPE = _dtype([
+    ("parent_lo", "<u8"), ("parent_hi", "<u8"),
+    ("parent_padding", "V16"),
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("session", "<u8"),
+    ("timestamp", "<u8"),
+    ("request", "<u4"),
+    ("operation", "u1"),
+    ("reserved", "V59"),
+])
+
+PREPARE_DTYPE = _dtype([
+    ("parent_lo", "<u8"), ("parent_hi", "<u8"),
+    ("parent_padding", "V16"),
+    ("request_checksum_lo", "<u8"), ("request_checksum_hi", "<u8"),
+    ("request_checksum_padding", "V16"),
+    ("checkpoint_id_lo", "<u8"), ("checkpoint_id_hi", "<u8"),
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("op", "<u8"),
+    ("commit", "<u8"),
+    ("timestamp", "<u8"),
+    ("request", "<u4"),
+    ("operation", "u1"),
+    ("reserved", "V3"),
+])
+
+PREPARE_OK_DTYPE = _dtype([
+    ("parent_lo", "<u8"), ("parent_hi", "<u8"),
+    ("parent_padding", "V16"),
+    ("prepare_checksum_lo", "<u8"), ("prepare_checksum_hi", "<u8"),
+    ("prepare_checksum_padding", "V16"),
+    ("checkpoint_id_lo", "<u8"), ("checkpoint_id_hi", "<u8"),
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("op", "<u8"),
+    ("commit", "<u8"),
+    ("timestamp", "<u8"),
+    ("request", "<u4"),
+    ("operation", "u1"),
+    ("reserved", "V3"),
+])
+
+REPLY_DTYPE = _dtype([
+    ("request_checksum_lo", "<u8"), ("request_checksum_hi", "<u8"),
+    ("request_checksum_padding", "V16"),
+    ("context_lo", "<u8"), ("context_hi", "<u8"),
+    ("context_padding", "V16"),
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("op", "<u8"),
+    ("commit", "<u8"),
+    ("timestamp", "<u8"),
+    ("request", "<u4"),
+    ("operation", "u1"),
+    ("reserved", "V19"),
+])
+
+COMMIT_DTYPE = _dtype([
+    ("commit_checksum_lo", "<u8"), ("commit_checksum_hi", "<u8"),
+    ("commit_checksum_padding", "V16"),
+    ("checkpoint_id_lo", "<u8"), ("checkpoint_id_hi", "<u8"),
+    ("checkpoint_op", "<u8"),
+    ("commit", "<u8"),
+    ("timestamp_monotonic", "<u8"),
+    ("reserved", "V56"),
+])
+
+PING_DTYPE = _dtype([
+    ("checkpoint_id_lo", "<u8"), ("checkpoint_id_hi", "<u8"),
+    ("checkpoint_op", "<u8"),
+    ("ping_timestamp_monotonic", "<u8"),
+    ("reserved", "V96"),
+])
+
+PONG_DTYPE = _dtype([
+    ("ping_timestamp_monotonic", "<u8"),
+    ("pong_timestamp_wall", "<u8"),
+    ("reserved", "V112"),
+])
+
+PING_CLIENT_DTYPE = _dtype([
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("reserved", "V112"),
+])
+
+PONG_CLIENT_DTYPE = _dtype([("reserved", "V128")])
+
+EVICTION_DTYPE = _dtype([
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("reserved", "V112"),
+])
+
+COMMAND_DTYPES = {
+    Command.request: REQUEST_DTYPE,
+    Command.prepare: PREPARE_DTYPE,
+    Command.prepare_ok: PREPARE_OK_DTYPE,
+    Command.reply: REPLY_DTYPE,
+    Command.commit: COMMIT_DTYPE,
+    Command.ping: PING_DTYPE,
+    Command.pong: PONG_DTYPE,
+    Command.ping_client: PING_CLIENT_DTYPE,
+    Command.pong_client: PONG_CLIENT_DTYPE,
+    Command.eviction: EVICTION_DTYPE,
+}
+
+
+def new_header(command: Command, **fields) -> np.ndarray:
+    """Create a zeroed header record for ``command``; u128-valued fields may be
+    passed as Python ints (split into _lo/_hi lanes automatically)."""
+    dt = COMMAND_DTYPES.get(command, PREFIX_DTYPE)
+    h = np.zeros((), dtype=dt)
+    h["command"] = int(command)
+    h["version"] = VERSION
+    h["size"] = HEADER_SIZE
+    names = dt.names
+    for key, value in fields.items():
+        if key in names:
+            h[key] = value
+        elif key + "_lo" in names:
+            h[key + "_lo"] = value & 0xFFFF_FFFF_FFFF_FFFF
+            h[key + "_hi"] = value >> 64
+        else:
+            raise KeyError(f"{command.name} header has no field {key}")
+    return h
+
+
+def u128(h: np.ndarray, name: str) -> int:
+    return (int(h[name + "_hi"]) << 64) | int(h[name + "_lo"])
+
+
+def set_checksums(h: np.ndarray, body: bytes = b"") -> np.ndarray:
+    """set_checksum_body then set_checksum (message_header.zig:118-127)."""
+    h = h.copy()
+    h["size"] = HEADER_SIZE + len(body)
+    cb = checksum(body)
+    h["checksum_body_lo"] = cb & 0xFFFF_FFFF_FFFF_FFFF
+    h["checksum_body_hi"] = cb >> 64
+    c = checksum(h.tobytes()[16:])
+    h["checksum_lo"] = c & 0xFFFF_FFFF_FFFF_FFFF
+    h["checksum_hi"] = c >> 64
+    return h
+
+
+def header_checksum(h: np.ndarray) -> int:
+    return u128(h, "checksum")
+
+
+def encode(h: np.ndarray, body: bytes = b"") -> bytes:
+    """Frame a message: header (with checksums set) + body."""
+    h = set_checksums(h, body)
+    return h.tobytes() + body
+
+
+def decode_header(buf: bytes) -> Tuple[np.ndarray, Command]:
+    """Parse+verify the 256-byte header prefix. Raises ValueError on a bad
+    checksum/command — callers treat that as a corrupt/malicious frame."""
+    if len(buf) < HEADER_SIZE:
+        raise ValueError("short header")
+    prefix = np.frombuffer(buf[:HEADER_SIZE], dtype=PREFIX_DTYPE)[0]
+    expected = checksum(buf[16:HEADER_SIZE])
+    if u128(prefix, "checksum") != expected:
+        raise ValueError("header checksum mismatch")
+    try:
+        command = Command(int(prefix["command"]))
+    except ValueError as err:
+        raise ValueError(f"unknown command {int(prefix['command'])}") from err
+    dt = COMMAND_DTYPES.get(command, PREFIX_DTYPE)
+    h = np.frombuffer(buf[:HEADER_SIZE], dtype=dt)[0]
+    if int(h["size"]) < HEADER_SIZE:
+        raise ValueError("size < header size")
+    return h, command
+
+
+def verify_body(h: np.ndarray, body: bytes) -> None:
+    if len(body) != int(h["size"]) - HEADER_SIZE:
+        raise ValueError("body length != size")
+    if checksum(body) != u128(h, "checksum_body"):
+        raise ValueError("body checksum mismatch")
+
+
+def decode(buf: bytes) -> Tuple[np.ndarray, Command, bytes]:
+    """Parse+verify a full message (header + body)."""
+    h, command = decode_header(buf)
+    body = buf[HEADER_SIZE : int(h["size"])]
+    verify_body(h, body)
+    return h, command, body
