@@ -95,8 +95,6 @@ class TestEncodeDecode:
         h = np.zeros((), dtype=wire.PREFIX_DTYPE)
         h["command"] = 250
         h["size"] = 256
-        buf = wire.encode_raw(h) if hasattr(wire, "encode_raw") else None
-        # encode() sets checksums on any record:
         buf = wire.set_checksums(h).tobytes()
         with pytest.raises(ValueError, match="unknown command"):
             wire.decode_header(buf)

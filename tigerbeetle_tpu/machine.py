@@ -89,13 +89,34 @@ class TpuStateMachine:
         ids = np.stack([batch["id_hi"][nonzero], batch["id_lo"][nonzero]], axis=1)
         return len(np.unique(ids, axis=0)) < len(ids)
 
+    def commit_batch(
+        self, operation: str, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
+        """Commit a batch whose prepare timestamp was already assigned (by
+        this replica's prepare(), by the primary, or during WAL replay) —
+        the replica's StateMachine.commit() seam (state_machine.zig:894-928).
+        """
+        if operation not in ("create_accounts", "create_transfers"):
+            raise ValueError(f"unknown commit operation {operation}")
+        # Replay/backup path: keep the local prepare clock >= the primary's.
+        if timestamp > self.prepare_timestamp:
+            self.prepare_timestamp = timestamp
+        if operation == "create_accounts":
+            return self._commit_create_accounts(batch, timestamp)
+        return self._commit_create_transfers(batch, timestamp)
+
     # -- create_accounts -----------------------------------------------------
 
     def create_accounts(
         self, batch: np.ndarray, wall_clock_ns: int = 0
     ) -> List[Tuple[int, int]]:
+        timestamp = self.prepare("create_accounts", len(batch), wall_clock_ns)
+        return self._commit_create_accounts(batch, timestamp)
+
+    def _commit_create_accounts(
+        self, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
         count = len(batch)
-        timestamp = self.prepare("create_accounts", count, wall_clock_ns)
         if count == 0:
             return []
 
@@ -129,8 +150,13 @@ class TpuStateMachine:
     def create_transfers(
         self, batch: np.ndarray, wall_clock_ns: int = 0
     ) -> List[Tuple[int, int]]:
+        timestamp = self.prepare("create_transfers", len(batch), wall_clock_ns)
+        return self._commit_create_transfers(batch, timestamp)
+
+    def _commit_create_transfers(
+        self, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
         count = len(batch)
-        timestamp = self.prepare("create_transfers", count, wall_clock_ns)
         if count == 0:
             return []
 
@@ -232,6 +258,26 @@ class TpuStateMachine:
         host = {k: np.asarray(v) for k, v in cols.items()}
         rows = types.from_soa(host, types.TRANSFER_DTYPE)
         return rows[found]
+
+    # -- checkpoint surface --------------------------------------------------
+
+    def host_state(self) -> dict:
+        """Host-tracked state that must survive restarts (checkpointed
+        alongside the device ledger)."""
+        return {
+            "prepare_timestamp": self.prepare_timestamp,
+            "commit_timestamp": self.commit_timestamp,
+            "any_limit_or_history_account": self._any_limit_or_history_account,
+            "amount_bound": self._amount_bound,
+        }
+
+    def restore_host_state(self, state: dict) -> None:
+        self.prepare_timestamp = int(state["prepare_timestamp"])
+        self.commit_timestamp = int(state["commit_timestamp"])
+        self._any_limit_or_history_account = bool(
+            state["any_limit_or_history_account"]
+        )
+        self._amount_bound = int(state["amount_bound"])
 
     # -- parity surface ------------------------------------------------------
 

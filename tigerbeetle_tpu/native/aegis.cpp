@@ -96,14 +96,16 @@ static void checksum_impl(const uint8_t *data, size_t len, uint8_t out[16]) {
 
 #else  // portable fallback: table-based AES round
 
+#include <mutex>
+
 static uint8_t SBOX[256];
 static uint32_t T0[256], T1[256], T2[256], T3[256];
-static bool tables_ready = false;
+static std::once_flag tables_once;
 
 static uint8_t xtime(uint8_t x) { return (uint8_t)((x << 1) ^ ((x >> 7) * 0x1b)); }
 
-static void init_tables() {
-    if (tables_ready) return;
+// ctypes drops the GIL during foreign calls, so first use may race: call_once.
+static void init_tables_impl() {
     // Generate the AES S-box (multiplicative inverse in GF(2^8) + affine map).
     uint8_t p = 1, q = 1;
     SBOX[0] = 0x63;
@@ -128,8 +130,9 @@ static void init_tables() {
         T2[i] = (T1[i] << 8) | (T1[i] >> 24);
         T3[i] = (T2[i] << 8) | (T2[i] >> 24);
     }
-    tables_ready = true;
 }
+
+static void init_tables() { std::call_once(tables_once, init_tables_impl); }
 
 struct Block {
     uint32_t w[4];  // little-endian columns

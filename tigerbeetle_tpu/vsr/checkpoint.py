@@ -1,0 +1,127 @@
+"""Checkpoint snapshots: durable images of the device-resident ledger.
+
+The reference persists state-machine data through the LSM forest into grid
+blocks at every checkpoint (replica.zig:3153-3169).  Here the working set is
+the HBM ledger itself, so a checkpoint is: device→host transfer of the table
+arrays, one atomically-written compressed snapshot file per checkpoint op, and
+the snapshot's whole-file AEGIS checksum + state-machine digest recorded in
+the superblock (superblock.py).  Restart = load snapshot (verify checksum) +
+replay WAL ops beyond the checkpoint (journal.py).
+
+Snapshot files live next to the data file as ``<data>.checkpoint.<op>``;
+the previous snapshot is removed only after the superblock referencing the
+new one is durable.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .checksum import checksum
+from ..ops import hash_table as ht
+from ..ops import state_machine as sm
+
+TABLE_NAMES = ("accounts", "transfers", "posted")
+
+
+def _table_arrays(prefix: str, table: ht.Table, out: Dict[str, np.ndarray]) -> None:
+    out[f"{prefix}/key_lo"] = np.asarray(table.key_lo)
+    out[f"{prefix}/key_hi"] = np.asarray(table.key_hi)
+    out[f"{prefix}/tombstone"] = np.asarray(table.tombstone)
+    out[f"{prefix}/count"] = np.asarray(table.count)
+    out[f"{prefix}/probe_overflow"] = np.asarray(table.probe_overflow)
+    for name, col in table.cols.items():
+        out[f"{prefix}/cols/{name}"] = np.asarray(col)
+
+
+def _load_table(prefix: str, z) -> ht.Table:
+    cols = {}
+    cols_prefix = f"{prefix}/cols/"
+    for key in z.files:
+        if key.startswith(cols_prefix):
+            cols[key[len(cols_prefix):]] = jnp.asarray(z[key])
+    return ht.Table(
+        key_lo=jnp.asarray(z[f"{prefix}/key_lo"]),
+        key_hi=jnp.asarray(z[f"{prefix}/key_hi"]),
+        tombstone=jnp.asarray(z[f"{prefix}/tombstone"]),
+        cols=cols,
+        count=jnp.asarray(z[f"{prefix}/count"]),
+        probe_overflow=jnp.asarray(z[f"{prefix}/probe_overflow"]),
+    )
+
+
+def path_for(data_path: str, op: int) -> str:
+    return f"{data_path}.checkpoint.{op}"
+
+
+def save(
+    data_path: str, op: int, ledger: sm.Ledger, meta: Optional[dict] = None
+) -> Tuple[str, int]:
+    """Write the snapshot for checkpoint ``op`` atomically; returns
+    (path, file_checksum)."""
+    arrays: Dict[str, np.ndarray] = {}
+    for name in TABLE_NAMES:
+        _table_arrays(name, getattr(ledger, name), arrays)
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    ).copy()
+
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)  # uncompressed: snapshot speed over size
+    blob = buf.getvalue()
+    file_checksum = checksum(blob)
+
+    path = path_for(data_path, op)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return path, file_checksum
+
+
+def load(
+    data_path: str, op: int, expected_checksum: int
+) -> Tuple[sm.Ledger, dict]:
+    """Load + verify the snapshot for checkpoint ``op``."""
+    path = path_for(data_path, op)
+    with open(path, "rb") as f:
+        blob = f.read()
+    actual = checksum(blob)
+    if actual != expected_checksum:
+        raise RuntimeError(
+            f"checkpoint {path}: checksum mismatch "
+            f"(got {actual:#x}, superblock says {expected_checksum:#x})"
+        )
+    z = np.load(io.BytesIO(blob))
+    ledger = sm.Ledger(
+        accounts=_load_table("accounts", z),
+        transfers=_load_table("transfers", z),
+        posted=_load_table("posted", z),
+    )
+    meta = json.loads(bytes(z["meta"]).decode()) if "meta" in z.files else {}
+    return ledger, meta
+
+
+def remove_older_than(data_path: str, op: int) -> None:
+    """GC snapshots strictly older than ``op`` (called after the superblock
+    referencing ``op`` is durable)."""
+    directory = os.path.dirname(os.path.abspath(data_path)) or "."
+    base = os.path.basename(data_path) + ".checkpoint."
+    for entry in os.listdir(directory):
+        if entry.startswith(base):
+            tail = entry[len(base):]
+            if tail.isdigit() and int(tail) < op:
+                os.unlink(os.path.join(directory, entry))

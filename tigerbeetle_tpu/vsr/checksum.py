@@ -140,9 +140,11 @@ def checksum(data) -> int:
     data = bytes(data)
     if lib is None:
         return checksum_py(data)
-    out = bytes(16)
+    import ctypes
+
+    out = ctypes.create_string_buffer(16)
     lib.tb_checksum(data, len(data), out)
-    return int.from_bytes(out, "little")
+    return int.from_bytes(out.raw, "little")
 
 
 CHECKSUM_EMPTY = None  # filled lazily below (avoids native build at import)

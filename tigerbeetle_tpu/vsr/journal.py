@@ -1,0 +1,159 @@
+"""Journal (WAL): two on-disk rings — redundant headers + full prepares.
+
+Semantics from the reference (src/vsr/journal.zig:17-46): prepares are written
+to slot ``op % slot_count`` of the prepare ring; a redundant copy of each
+256-byte prepare header goes to the header ring.  The write order (prepare
+body first, fsync, then redundant header, fsync) plus dual checksums lets
+recovery disentangle torn writes from true corruption (Protocol-Aware
+Recovery):
+
+- header-ring entry valid + prepare valid + checksums match  -> entry ok
+- header-ring valid, prepare corrupt                          -> faulty slot
+  (torn prepare write or bitrot; repairable from peers, or truncatable if
+  the op was never acknowledged)
+- header-ring corrupt, prepare valid                          -> torn header
+  write; the prepare itself is authoritative, header is rewritten
+- both corrupt                                                -> empty/corrupt
+
+WAL entries are exactly wire-format prepare messages (header + body), so the
+wire codec is the journal codec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import wire
+from .storage import Storage
+
+
+@dataclasses.dataclass
+class RecoveredEntry:
+    op: int
+    header: np.ndarray          # PREPARE_DTYPE record
+    body: Optional[bytes]       # None => faulty (header known, body lost)
+
+
+@dataclasses.dataclass
+class Recovery:
+    entries: Dict[int, RecoveredEntry]
+    faulty_slots: List[int]
+    repaired_headers: int
+
+
+class Journal:
+    def __init__(self, storage: Storage) -> None:
+        self.storage = storage
+        self.config = storage.config
+        self.slot_count = self.config.journal_slot_count
+
+    def slot(self, op: int) -> int:
+        return op % self.slot_count
+
+    # -- writes --------------------------------------------------------------
+
+    def write_prepare(self, message: bytes, sync: bool = True) -> None:
+        """Durably journal a prepare message (header+body wire bytes)."""
+        h, command = wire.decode_header(message)
+        assert command == wire.Command.prepare
+        assert len(message) == int(h["size"]) <= self.config.message_size_max
+        slot = self.slot(int(h["op"]))
+        lay = self.storage.layout
+        self.storage.write(
+            lay.wal_prepares_offset + slot * self.config.message_size_max, message
+        )
+        if sync:
+            self.storage.sync()
+        self.storage.write(
+            lay.wal_headers_offset + slot * self.config.header_size,
+            message[: self.config.header_size],
+        )
+        if sync:
+            self.storage.sync()
+
+    def sync(self) -> None:
+        self.storage.sync()
+
+    # -- reads ---------------------------------------------------------------
+
+    def read_prepare(self, op: int) -> Optional[Tuple[np.ndarray, bytes]]:
+        """Read+verify the prepare at ``op``'s slot; None unless the slot
+        currently holds exactly ``op``."""
+        slot = self.slot(op)
+        lay = self.storage.layout
+        buf = self.storage.read(
+            lay.wal_prepares_offset + slot * self.config.message_size_max,
+            self.config.message_size_max,
+        )
+        try:
+            h, command = wire.decode_header(buf)
+            if command != wire.Command.prepare or int(h["op"]) != op:
+                return None
+            body = buf[wire.HEADER_SIZE : int(h["size"])]
+            wire.verify_body(h, body)
+            return h, body
+        except ValueError:
+            return None
+
+    def recover(self) -> Recovery:
+        """Scan both rings, disentangle torn writes, return surviving entries."""
+        lay = self.storage.layout
+        headers_buf = self.storage.read(lay.wal_headers_offset, lay.wal_headers_size)
+        entries: Dict[int, RecoveredEntry] = {}
+        faulty: List[int] = []
+        repaired = 0
+
+        for slot in range(self.slot_count):
+            ring_hdr = None
+            hbuf = headers_buf[
+                slot * self.config.header_size : (slot + 1) * self.config.header_size
+            ]
+            try:
+                h, command = wire.decode_header(hbuf)
+                if command == wire.Command.prepare:
+                    ring_hdr = h
+            except ValueError:
+                ring_hdr = None
+
+            pbuf = self.storage.read(
+                lay.wal_prepares_offset + slot * self.config.message_size_max,
+                self.config.message_size_max,
+            )
+            prepare = None
+            try:
+                ph, pcommand = wire.decode_header(pbuf)
+                if pcommand == wire.Command.prepare:
+                    body = pbuf[wire.HEADER_SIZE : int(ph["size"])]
+                    wire.verify_body(ph, body)
+                    prepare = (ph, body)
+            except ValueError:
+                prepare = None
+
+            if prepare is not None:
+                ph, body = prepare
+                op = int(ph["op"])
+                if self.slot(op) == slot:
+                    entries[op] = RecoveredEntry(op=op, header=ph, body=body)
+                    if ring_hdr is None or wire.header_checksum(
+                        ring_hdr
+                    ) != wire.header_checksum(ph):
+                        # Torn/stale header ring entry: prepare is authoritative.
+                        self.storage.write(
+                            lay.wal_headers_offset + slot * self.config.header_size,
+                            pbuf[: self.config.header_size],
+                        )
+                        repaired += 1
+            elif ring_hdr is not None:
+                # Header known but prepare lost: faulty (torn prepare write).
+                op = int(ring_hdr["op"])
+                if self.slot(op) == slot:
+                    entries[op] = RecoveredEntry(op=op, header=ring_hdr, body=None)
+                    faulty.append(slot)
+            # else: empty slot.
+
+        if repaired:
+            self.storage.sync()
+        return Recovery(entries=entries, faulty_slots=faulty, repaired_headers=repaired)

@@ -1,0 +1,443 @@
+"""Replica: the VSR participant owning journal, state machine, and sessions.
+
+Mirrors the reference replica's lifecycle and commit pipeline
+(src/vsr/replica.zig): requests become prepares (op assigned, batch timestamp
+from the clock, parent hash-chained — :1308-1337), prepares are journaled to
+the WAL before execution (:1364+), commit runs the state machine and builds a
+checksummed reply (:3678-3836), replies are stored per client session for
+retry idempotency (client_sessions.zig), and every ``vsr_checkpoint_interval``
+ops the ledger snapshot + superblock are made durable (:3153-3169).
+
+This module is transport-agnostic and synchronous: `on_request(header, body)`
+returns the messages to send.  The TCP message bus (net/) and the consensus
+message flow for multi-replica clusters layer on top; single-replica mode
+commits immediately after journaling (quorum of 1).
+
+Recovery (`open`): superblock quorum read -> checkpoint snapshot load ->
+journal scan -> replay the hash-chained suffix of the WAL beyond the
+checkpoint (§3.1 of SURVEY).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types
+from ..config import ClusterConfig, LedgerConfig
+from ..machine import TpuStateMachine
+from . import checkpoint as checkpoint_mod
+from . import wire
+from .journal import Journal
+from .storage import Storage
+from .superblock import SuperBlock, SuperBlockState
+
+U64_MASK = 0xFFFF_FFFF_FFFF_FFFF
+
+
+@dataclasses.dataclass
+class Session:
+    """One client's session (client_sessions.zig): session number is the
+    commit number of its register op; the last reply is retained for retry
+    idempotency."""
+
+    client: int
+    session: int           # commit number of the register prepare
+    request: int           # most recent request number seen
+    reply_bytes: bytes     # full wire reply (header+body) for that request
+    slot: int = 0          # stable client_replies zone slot (0..clients_max-1)
+
+
+class Eviction(Exception):
+    def __init__(self, client: int):
+        super().__init__(f"client {client:#x} evicted")
+        self.client = client
+
+
+class Replica:
+    def __init__(
+        self,
+        data_path: str,
+        cluster_config: Optional[ClusterConfig] = None,
+        ledger_config: Optional[LedgerConfig] = None,
+        batch_lanes: int = 8192,
+        time_ns=time.time_ns,
+    ) -> None:
+        self.data_path = data_path
+        self.config = cluster_config or ClusterConfig()
+        self.ledger_config = ledger_config or LedgerConfig()
+        self.batch_lanes = batch_lanes
+        self.time_ns = time_ns
+
+        self.storage = Storage(data_path, self.config)
+        self.superblock = SuperBlock(self.storage)
+        self.journal = Journal(self.storage)
+        self.machine = TpuStateMachine(self.ledger_config, batch_lanes=batch_lanes)
+
+        self.cluster = 0
+        self.replica = 0
+        self.replica_count = 1
+        self.view = 0
+        self.op = 0                 # latest journaled op
+        self.commit_min = 0         # latest committed (executed) op
+        self.op_checkpoint = 0
+        self.parent_checksum = 0    # checksum of prepare at self.op
+        self.sessions: Dict[int, Session] = {}
+
+    # -- format / open -------------------------------------------------------
+
+    @classmethod
+    def format(
+        cls,
+        data_path: str,
+        cluster: int,
+        replica: int = 0,
+        replica_count: int = 1,
+        cluster_config: Optional[ClusterConfig] = None,
+    ) -> None:
+        """Create + initialize a data file (main.zig format path; the root
+        prepare op=0 anchors the hash chain, message_header.zig Prepare.root)."""
+        config = cluster_config or ClusterConfig()
+        storage = Storage.format(data_path, config)
+        try:
+            superblock = SuperBlock(storage)
+            superblock.format(cluster, replica, replica_count)
+            root = wire.new_header(
+                wire.Command.prepare,
+                cluster=cluster,
+                op=0,
+                operation=int(wire.Operation.root),
+            )
+            journal = Journal(storage)
+            journal.write_prepare(wire.encode(root, b""))
+        finally:
+            storage.close()
+
+    def open(self) -> None:
+        """Recover durable state: superblock -> checkpoint -> WAL replay."""
+        sb = self.superblock.open()
+        self.cluster = sb.cluster
+        self.replica = sb.replica
+        self.replica_count = sb.replica_count
+        self.view = sb.view
+        self.op_checkpoint = sb.op_checkpoint
+        self.commit_min = sb.op_checkpoint
+
+        if sb.op_checkpoint > 0 or sb.checkpoint_file_checksum != 0:
+            ledger, meta = checkpoint_mod.load(
+                self.data_path, sb.op_checkpoint, sb.checkpoint_file_checksum
+            )
+            self.machine.ledger = ledger
+            self.machine.restore_host_state(meta["machine"])
+            digest = self.machine.digest()
+            if digest != sb.ledger_digest:
+                raise RuntimeError(
+                    f"checkpoint digest mismatch: ledger {digest:#x} != "
+                    f"superblock {sb.ledger_digest:#x}"
+                )
+            self.sessions = {
+                int(client_hex, 16): Session(
+                    client=int(client_hex, 16),
+                    session=s["session"],
+                    request=s["request"],
+                    reply_bytes=self._read_client_reply(s["slot"], s["reply_size"]),
+                    slot=s["slot"],
+                )
+                for client_hex, s in meta.get("sessions", {}).items()
+            }
+
+        recovery = self.journal.recover()
+        # Establish the head: the highest hash-chained op from the checkpoint.
+        self._replay(recovery)
+
+    def _replay(self, recovery) -> None:
+        """Replay the contiguous, hash-chained WAL suffix beyond commit_min."""
+        # Find the chain anchor: the entry at commit_min (or the root).
+        anchor = recovery.entries.get(self.commit_min)
+        if anchor is None:
+            if self.commit_min == 0:
+                raise RuntimeError("WAL: root prepare missing")
+            # The checkpoint op's slot was since overwritten by a newer op
+            # (ring wrapped): it must chain from the checkpoint regardless —
+            # the chain links below still verify each step.
+            self.parent_checksum = 0
+        else:
+            self.parent_checksum = wire.header_checksum(anchor.header)
+        self.op = self.commit_min
+
+        op = self.commit_min + 1
+        while op in recovery.entries:
+            entry = recovery.entries[op]
+            if entry.body is None:
+                break  # faulty slot: torn write of an unacknowledged op
+            parent = wire.u128(entry.header, "parent")
+            if self.parent_checksum and parent != self.parent_checksum:
+                break  # chain broken: stale entry from an older ring lap
+            self._commit_prepare(entry.header, entry.body, replay=True)
+            self.parent_checksum = wire.header_checksum(entry.header)
+            self.op = op
+            self.commit_min = op
+            op += 1
+
+    # -- request handling (the hot path, §3.2) -------------------------------
+
+    def on_request(self, header: np.ndarray, body: bytes) -> List[bytes]:
+        """Handle a verified client request; returns wire messages to send
+        back (replica.zig on_request :1308-1337 + commit_op :3678-3836)."""
+        client = wire.u128(header, "client")
+        operation = wire.Operation(int(header["operation"]))
+        request_n = int(header["request"])
+
+        session = self.sessions.get(client)
+        if operation != wire.Operation.register:
+            if session is None:
+                # Unknown session: evict so the client re-registers.
+                return [self._eviction(client)]
+            if int(header["session"]) != session.session:
+                return [self._eviction(client)]
+            if request_n == session.request and session.reply_bytes:
+                return [session.reply_bytes]  # duplicate: resend stored reply
+            if request_n < session.request:
+                return []  # stale: drop
+        elif session is not None:
+            # Duplicate register retry.
+            if session.reply_bytes:
+                return [session.reply_bytes]
+            return []
+
+        prepare_h, prepare_body = self._prepare(header, body, operation)
+        reply = self._commit_prepare(prepare_h, prepare_body, replay=False)
+        assert reply is not None
+        out = [reply]
+        if self._checkpoint_due():
+            self.checkpoint()
+        return out
+
+    def _prepare(
+        self, request_h: np.ndarray, body: bytes, operation: wire.Operation
+    ) -> Tuple[np.ndarray, bytes]:
+        """Assign op + timestamp, hash-chain, and journal the prepare."""
+        op = self.op + 1
+        count = self._event_count(operation, body)
+        timestamp = self.machine.prepare(
+            _OP_NAMES.get(operation, "other"), count, self.time_ns()
+        )
+        h = wire.new_header(
+            wire.Command.prepare,
+            cluster=self.cluster,
+            view=self.view,
+            parent=self.parent_checksum,
+            request_checksum=wire.header_checksum(request_h),
+            client=wire.u128(request_h, "client"),
+            op=op,
+            commit=self.commit_min,
+            timestamp=timestamp,
+            request=int(request_h["request"]),
+            operation=int(operation),
+        )
+        h["replica"] = self.replica
+        message = wire.encode(h, body)
+        self.journal.write_prepare(message)
+        decoded, _ = wire.decode_header(message)
+        self.op = op
+        self.parent_checksum = wire.header_checksum(decoded)
+        return decoded, body
+
+    def _commit_prepare(
+        self, header: np.ndarray, body: bytes, replay: bool
+    ) -> Optional[bytes]:
+        """Execute a journaled prepare; returns the reply message (stored in
+        the session table either way)."""
+        op = int(header["op"])
+        operation = wire.Operation(int(header["operation"]))
+        timestamp = int(header["timestamp"])
+        client = wire.u128(header, "client")
+
+        if operation == wire.Operation.root:
+            return None
+        if operation == wire.Operation.register:
+            result_body = b""
+            self.commit_min = op
+            session = Session(
+                client=client, session=op, request=0, reply_bytes=b""
+            )
+            self._admit_session(session)
+        else:
+            result_body = self._execute(operation, body, timestamp)
+            self.commit_min = op
+
+        reply_h = wire.new_header(
+            wire.Command.reply,
+            cluster=self.cluster,
+            view=self.view,
+            request_checksum=wire.u128(header, "request_checksum"),
+            context=wire.header_checksum(header),
+            client=client,
+            op=op,
+            commit=self.commit_min,
+            timestamp=timestamp,
+            request=int(header["request"]),
+            operation=int(operation),
+        )
+        reply_h["replica"] = self.replica
+        reply = wire.encode(reply_h, result_body)
+
+        session = self.sessions.get(client)
+        if session is not None:
+            if operation == wire.Operation.register:
+                session.session = op
+            session.request = int(header["request"])
+            session.reply_bytes = reply
+            self._store_client_reply(client, reply)
+        return reply
+
+    # -- state machine dispatch ----------------------------------------------
+
+    def _execute(
+        self, operation: wire.Operation, body: bytes, timestamp: int
+    ) -> bytes:
+        if operation == wire.Operation.create_accounts:
+            batch = np.frombuffer(body, dtype=types.ACCOUNT_DTYPE)
+            results = self.machine.commit_batch("create_accounts", batch, timestamp)
+            return _encode_results(results)
+        if operation == wire.Operation.create_transfers:
+            batch = np.frombuffer(body, dtype=types.TRANSFER_DTYPE)
+            results = self.machine.commit_batch("create_transfers", batch, timestamp)
+            return _encode_results(results)
+        if operation == wire.Operation.lookup_accounts:
+            ids = _decode_ids(body)
+            return self.machine.lookup_accounts(ids).tobytes()
+        if operation == wire.Operation.lookup_transfers:
+            ids = _decode_ids(body)
+            return self.machine.lookup_transfers(ids).tobytes()
+        raise ValueError(f"unimplemented operation {operation}")
+
+    def _event_count(self, operation: wire.Operation, body: bytes) -> int:
+        if operation in (
+            wire.Operation.create_accounts, wire.Operation.create_transfers
+        ):
+            return len(body) // 128
+        return 0
+
+    # -- sessions ------------------------------------------------------------
+
+    def _admit_session(self, session: Session) -> None:
+        if len(self.sessions) >= self.config.clients_max and (
+            session.client not in self.sessions
+        ):
+            # Evict the session with the lowest session number (oldest
+            # register commit) — client_sessions.zig eviction policy.
+            victim = min(self.sessions.values(), key=lambda s: s.session)
+            del self.sessions[victim.client]
+        existing = self.sessions.get(session.client)
+        if existing is not None:
+            session.slot = existing.slot
+        else:
+            used = {s.slot for s in self.sessions.values()}
+            session.slot = min(set(range(self.config.clients_max)) - used)
+        self.sessions[session.client] = session
+
+    def _eviction(self, client: int) -> bytes:
+        h = wire.new_header(
+            wire.Command.eviction,
+            cluster=self.cluster, view=self.view, client=client,
+        )
+        h["replica"] = self.replica
+        return wire.encode(h, b"")
+
+    def _store_client_reply(self, client: int, reply: bytes) -> None:
+        slot = self.sessions[client].slot
+        if len(reply) <= self.config.message_size_max:
+            off = (
+                self.storage.layout.client_replies_offset
+                + slot * self.config.message_size_max
+            )
+            self.storage.write(off, reply)
+
+    def _read_client_reply(self, slot: int, size: int) -> bytes:
+        if size == 0:
+            return b""
+        off = (
+            self.storage.layout.client_replies_offset
+            + slot * self.config.message_size_max
+        )
+        buf = self.storage.read(off, size)
+        try:
+            h, _, body = wire.decode(buf)
+            return buf[: int(h["size"])]
+        except ValueError:
+            return b""  # corrupt stored reply: client will retry
+
+    # -- checkpointing (replica.zig:3153-3169) --------------------------------
+
+    def _checkpoint_due(self) -> bool:
+        return (
+            self.commit_min - self.op_checkpoint
+            >= self.config.vsr_checkpoint_interval
+        )
+
+    def checkpoint(self) -> None:
+        """Durably snapshot ledger + sessions + superblock at commit_min."""
+        # Session replies live in the client_replies zone; make them durable
+        # before the superblock references their sizes.
+        self.storage.sync()
+        meta = {
+            "machine": self.machine.host_state(),
+            "sessions": {
+                f"{client:032x}": {
+                    "session": s.session,
+                    "request": s.request,
+                    "reply_size": len(s.reply_bytes),
+                    "slot": s.slot,
+                }
+                for client, s in self.sessions.items()
+            },
+        }
+        _, file_checksum = checkpoint_mod.save(
+            self.data_path, self.commit_min, self.machine.ledger, meta
+        )
+        state = SuperBlockState(
+            cluster=self.cluster,
+            replica=self.replica,
+            replica_count=self.replica_count,
+            view=self.view,
+            log_view=self.view,
+            commit_min=self.commit_min,
+            commit_max=self.op,
+            op_checkpoint=self.commit_min,
+            checkpoint_file_checksum=file_checksum,
+            ledger_digest=self.machine.digest(),
+            prepare_timestamp=self.machine.prepare_timestamp,
+            commit_timestamp=self.machine.commit_timestamp,
+        )
+        self.superblock.checkpoint(state)
+        self.op_checkpoint = self.commit_min
+        checkpoint_mod.remove_older_than(self.data_path, self.commit_min)
+
+    def close(self) -> None:
+        self.storage.close()
+
+
+_OP_NAMES = {
+    wire.Operation.create_accounts: "create_accounts",
+    wire.Operation.create_transfers: "create_transfers",
+}
+
+
+def _encode_results(results: List[Tuple[int, int]]) -> bytes:
+    arr = np.zeros(len(results), dtype=types.EVENT_RESULT_DTYPE)
+    for i, (index, result) in enumerate(results):
+        arr[i]["index"] = index
+        arr[i]["result"] = result
+    return arr.tobytes()
+
+
+def _decode_ids(body: bytes) -> List[int]:
+    lanes = np.frombuffer(body, dtype="<u8")
+    return [
+        int(lanes[2 * i]) | (int(lanes[2 * i + 1]) << 64)
+        for i in range(len(lanes) // 2)
+    ]

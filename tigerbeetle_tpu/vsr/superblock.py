@@ -1,0 +1,197 @@
+"""SuperBlock: the replica-local durable root, 4 checksummed copies.
+
+Semantics from the reference (src/vsr/superblock.zig:1-29 invariants,
+superblock_quorums.zig): the superblock stores the VSR state the replica must
+never lose — view/log_view, commit numbers, and the current checkpoint
+reference.  It is written as 4 sequential copies with fsync barriers between
+pairs, so that a crash mid-update always leaves at least two intact copies of
+either the old or the new state; open() reads all copies and picks the highest
+sequence with a working quorum.
+
+The checkpoint reference points at a snapshot file of the device ledger
+(checkpoint.py) — the TPU analogue of the reference's grid/manifest refs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .checksum import checksum
+from .storage import SUPERBLOCK_COPIES, SUPERBLOCK_COPY_SIZE, Storage
+
+MAGIC = 0x7462_7470_7573_6201  # "tbtpusb\x01"
+VERSION = 1
+
+# Quorum for reading: with 4 copies, require 2 matching (superblock_quorums).
+QUORUM_READ = 2
+
+SUPERBLOCK_DTYPE = np.dtype(
+    [
+        ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
+        ("copy", "u1"),
+        ("_pad0", "V7"),
+        ("magic", "<u8"),
+        ("version", "<u4"),
+        ("_pad1", "V4"),
+        ("cluster_lo", "<u8"), ("cluster_hi", "<u8"),
+        ("replica", "u1"),
+        ("replica_count", "u1"),
+        ("_pad2", "V6"),
+        ("sequence", "<u8"),
+        # -- VSRState (superblock.zig CheckpointState analogue) --
+        ("view", "<u4"),
+        ("log_view", "<u4"),
+        ("commit_min", "<u8"),           # == checkpoint op
+        ("commit_max", "<u8"),
+        ("op_checkpoint", "<u8"),
+        ("checkpoint_file_checksum_lo", "<u8"),
+        ("checkpoint_file_checksum_hi", "<u8"),
+        ("ledger_digest", "<u8"),        # state-machine parity digest
+        ("prepare_timestamp", "<u8"),
+        ("commit_timestamp", "<u8"),
+        ("reserved", "V3952"),
+    ]
+)
+assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE, SUPERBLOCK_DTYPE.itemsize
+
+
+@dataclasses.dataclass
+class SuperBlockState:
+    cluster: int = 0
+    replica: int = 0
+    replica_count: int = 1
+    sequence: int = 0
+    view: int = 0
+    log_view: int = 0
+    commit_min: int = 0
+    commit_max: int = 0
+    op_checkpoint: int = 0
+    checkpoint_file_checksum: int = 0
+    ledger_digest: int = 0
+    prepare_timestamp: int = 0
+    commit_timestamp: int = 0
+
+
+def _encode_copy(state: SuperBlockState, copy: int) -> bytes:
+    rec = np.zeros((), dtype=SUPERBLOCK_DTYPE)
+    rec["copy"] = copy
+    rec["magic"] = MAGIC
+    rec["version"] = VERSION
+    rec["cluster_lo"] = state.cluster & 0xFFFF_FFFF_FFFF_FFFF
+    rec["cluster_hi"] = state.cluster >> 64
+    rec["replica"] = state.replica
+    rec["replica_count"] = state.replica_count
+    rec["sequence"] = state.sequence
+    rec["view"] = state.view
+    rec["log_view"] = state.log_view
+    rec["commit_min"] = state.commit_min
+    rec["commit_max"] = state.commit_max
+    rec["op_checkpoint"] = state.op_checkpoint
+    rec["checkpoint_file_checksum_lo"] = (
+        state.checkpoint_file_checksum & 0xFFFF_FFFF_FFFF_FFFF
+    )
+    rec["checkpoint_file_checksum_hi"] = state.checkpoint_file_checksum >> 64
+    rec["ledger_digest"] = state.ledger_digest
+    rec["prepare_timestamp"] = state.prepare_timestamp
+    rec["commit_timestamp"] = state.commit_timestamp
+    buf = bytearray(rec.tobytes())
+    # checksum covers everything after the 16-byte checksum field, except the
+    # copy byte (so all copies share one checksum; a misdirected copy write is
+    # detected by the copy byte alone, like the reference's copy_index).
+    c = _copy_checksum(bytes(buf))
+    buf[0:8] = (c & 0xFFFF_FFFF_FFFF_FFFF).to_bytes(8, "little")
+    buf[8:16] = (c >> 64).to_bytes(8, "little")
+    return bytes(buf)
+
+
+def _copy_checksum(buf: bytes) -> int:
+    # zero out the copy byte for the checksum so copies are comparable.
+    body = bytearray(buf[16:])
+    body[0] = 0
+    return checksum(bytes(body))
+
+
+def _decode_copy(buf: bytes) -> Optional[Tuple[SuperBlockState, int]]:
+    rec = np.frombuffer(buf, dtype=SUPERBLOCK_DTYPE)[0]
+    stored = (int(rec["checksum_hi"]) << 64) | int(rec["checksum_lo"])
+    if stored != _copy_checksum(buf):
+        return None
+    if int(rec["magic"]) != MAGIC or int(rec["version"]) != VERSION:
+        return None
+    state = SuperBlockState(
+        cluster=(int(rec["cluster_hi"]) << 64) | int(rec["cluster_lo"]),
+        replica=int(rec["replica"]),
+        replica_count=int(rec["replica_count"]),
+        sequence=int(rec["sequence"]),
+        view=int(rec["view"]),
+        log_view=int(rec["log_view"]),
+        commit_min=int(rec["commit_min"]),
+        commit_max=int(rec["commit_max"]),
+        op_checkpoint=int(rec["op_checkpoint"]),
+        checkpoint_file_checksum=(
+            (int(rec["checkpoint_file_checksum_hi"]) << 64)
+            | int(rec["checkpoint_file_checksum_lo"])
+        ),
+        ledger_digest=int(rec["ledger_digest"]),
+        prepare_timestamp=int(rec["prepare_timestamp"]),
+        commit_timestamp=int(rec["commit_timestamp"]),
+    )
+    return state, int(rec["copy"])
+
+
+class SuperBlock:
+    def __init__(self, storage: Storage) -> None:
+        self.storage = storage
+        self.state = SuperBlockState()
+
+    def format(self, cluster: int, replica: int, replica_count: int = 1) -> None:
+        self.state = SuperBlockState(
+            cluster=cluster, replica=replica, replica_count=replica_count,
+            sequence=1,
+        )
+        self._write_all()
+
+    def checkpoint(self, state: SuperBlockState) -> None:
+        """Durably install a new superblock state (sequence bumped)."""
+        state.sequence = self.state.sequence + 1
+        self.state = state
+        self._write_all()
+
+    def _write_all(self) -> None:
+        off = self.storage.layout.superblock_offset
+        for copy in range(SUPERBLOCK_COPIES):
+            self.storage.write(
+                off + copy * SUPERBLOCK_COPY_SIZE, _encode_copy(self.state, copy)
+            )
+            # fsync after each pair: a crash leaves >=2 copies of old or new.
+            if copy % 2 == 1:
+                self.storage.sync()
+        self.storage.sync()
+
+    def open(self) -> SuperBlockState:
+        """Quorum-read the superblock (superblock_quorums.zig semantics)."""
+        off = self.storage.layout.superblock_offset
+        by_sequence: dict = {}
+        for copy in range(SUPERBLOCK_COPIES):
+            buf = self.storage.read(off + copy * SUPERBLOCK_COPY_SIZE,
+                                    SUPERBLOCK_COPY_SIZE)
+            decoded = _decode_copy(buf)
+            if decoded is None:
+                continue
+            state, _stored_copy = decoded
+            by_sequence.setdefault(state.sequence, []).append(state)
+        if not by_sequence:
+            raise RuntimeError("superblock: no valid copies (not formatted?)")
+        for sequence in sorted(by_sequence, reverse=True):
+            copies = by_sequence[sequence]
+            if len(copies) >= QUORUM_READ:
+                self.state = copies[0]
+                return self.state
+        # No sequence has a quorum: a torn first-ever write. Take the highest
+        # valid copy (the previous quorum, if any, is older by construction).
+        best = max(by_sequence)
+        self.state = by_sequence[best][0]
+        return self.state

@@ -73,7 +73,8 @@ class Operation(enum.IntEnum):
     get_account_history = VSR_OPERATIONS_RESERVED + 5
 
 
-# The shared 112-byte frame prefix (message_header.zig:17-66).
+# The shared 128-byte frame prefix (message_header.zig:17-66); per-command
+# tails fill the remaining 128 bytes.
 _FRAME = [
     ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
     ("checksum_padding", "V16"),
