@@ -1,0 +1,263 @@
+"""CLI: format / start / version / repl / benchmark subcommands.
+
+Mirrors the reference's command surface (src/tigerbeetle/main.zig:41-67,
+cli.zig:17-74): `format` initializes a data file, `start` serves it over TCP,
+`repl` talks to a running cluster, `benchmark` measures create_transfers
+throughput (spawning a temp single-replica cluster if no --addresses given,
+benchmark_driver.zig:50-64).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import List, Tuple
+
+import numpy as np
+
+
+def _parse_addresses(value: str) -> List[Tuple[str, int]]:
+    out = []
+    for part in value.split(","):
+        host, _, port = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tigerbeetle-tpu",
+        description="TPU-native accounting database (TigerBeetle-compatible wire protocol)",
+    )
+    sub = parser.add_subparsers(dest="subcommand", required=True)
+
+    p_format = sub.add_parser("format", help="initialize a replica data file")
+    p_format.add_argument("path")
+    p_format.add_argument("--cluster", type=lambda s: int(s, 0), required=True)
+    p_format.add_argument("--replica", type=int, default=0)
+    p_format.add_argument("--replica-count", type=int, default=1)
+
+    p_start = sub.add_parser("start", help="serve a formatted data file")
+    p_start.add_argument("path")
+    p_start.add_argument("--addresses", default="127.0.0.1:3000",
+                         help="host:port to listen on")
+    p_start.add_argument("--cache-accounts-log2", type=int, default=None,
+                         help="accounts table capacity (log2 slots)")
+    p_start.add_argument("--cache-transfers-log2", type=int, default=None)
+
+    p_version = sub.add_parser("version")
+    p_version.add_argument("--verbose", action="store_true")
+
+    p_repl = sub.add_parser("repl", help="interactive statement shell")
+    p_repl.add_argument("--addresses", default="127.0.0.1:3000")
+    p_repl.add_argument("--cluster", type=lambda s: int(s, 0), required=True)
+    p_repl.add_argument("--command", default=None,
+                        help="one-shot statement(s); omit for interactive")
+
+    p_bench = sub.add_parser("benchmark", help="client-driven load benchmark")
+    p_bench.add_argument("--addresses", default=None,
+                         help="existing cluster; omit to spawn a temp replica")
+    p_bench.add_argument("--cluster", type=lambda s: int(s, 0), default=0)
+    p_bench.add_argument("--account-count", type=int, default=10_000)
+    p_bench.add_argument("--transfer-count", type=int, default=1_000_000)
+    p_bench.add_argument("--transfer-batch-size", type=int, default=8190)
+
+    args = parser.parse_args(argv)
+    return {
+        "format": _cmd_format,
+        "start": _cmd_start,
+        "version": _cmd_version,
+        "repl": _cmd_repl,
+        "benchmark": _cmd_benchmark,
+    }[args.subcommand](args)
+
+
+def _cmd_format(args) -> int:
+    from .vsr.replica import Replica
+
+    Replica.format(
+        args.path, cluster=args.cluster, replica=args.replica,
+        replica_count=args.replica_count,
+    )
+    print(f"formatted {args.path} (cluster {args.cluster:#x}, "
+          f"replica {args.replica}/{args.replica_count})")
+    return 0
+
+
+def _cmd_start(args) -> int:
+    from .config import LedgerConfig
+    from .net.bus import run_server
+    from .vsr.replica import Replica
+
+    ledger_config = LedgerConfig()
+    if args.cache_accounts_log2 is not None:
+        ledger_config = LedgerConfig(
+            accounts_capacity_log2=args.cache_accounts_log2,
+            transfers_capacity_log2=(
+                args.cache_transfers_log2 or args.cache_accounts_log2 + 2
+            ),
+        )
+    replica = Replica(args.path, ledger_config=ledger_config)
+    replica.open()
+    (host, port), = _parse_addresses(args.addresses)
+
+    def ready(actual_port):
+        # Port-0 trick for tooling (reference main.zig:239-264): print the
+        # bound port on stdout so a parent process can parse it.
+        print(f"listening {host}:{actual_port}", flush=True)
+
+    run_server(replica, host, port, ready_callback=ready)
+    return 0
+
+
+def _cmd_version(args) -> int:
+    from .config import PRODUCTION
+
+    print("tigerbeetle-tpu 0.1.0")
+    if args.verbose:
+        import jax
+
+        for key, value in vars(PRODUCTION).items():
+            print(f"  config.{key}={value}")
+        print(f"  jax.devices={[str(d) for d in jax.devices()]}")
+    return 0
+
+
+def _cmd_repl(args) -> int:
+    from . import repl as repl_mod
+    from .client import Client
+
+    client = Client(_parse_addresses(args.addresses), cluster=args.cluster)
+    try:
+        repl_mod.run(client, args.command)
+    finally:
+        client.close()
+    return 0
+
+
+def _cmd_benchmark(args) -> int:
+    """Client-driven load (benchmark_load.zig:13-17: create accounts, stream
+    transfer batches, print accepted tx/s + batch latency percentiles)."""
+    from . import types
+    from .client import Client
+
+    stack = []
+    if args.addresses is None:
+        addresses, cleanup = _spawn_temp_replica(args.cluster)
+        stack.append(cleanup)
+    else:
+        addresses = _parse_addresses(args.addresses)
+
+    try:
+        client = Client(addresses, cluster=args.cluster)
+        rng = np.random.default_rng(42)
+
+        # Random id base: repeated runs against a used cluster don't collide.
+        import secrets
+
+        id_base = secrets.randbits(30) << 32
+
+        n = args.account_count
+        accounts = np.zeros(n, dtype=types.ACCOUNT_DTYPE)
+        accounts["id_lo"] = id_base + np.arange(1, n + 1, dtype=np.uint64)
+        accounts["ledger"] = 2
+        accounts["code"] = 1
+        for start in range(0, n, args.transfer_batch_size):
+            results = client.create_accounts(
+                accounts[start : start + args.transfer_batch_size]
+            )
+            assert not results, f"account failures: {results[:3]}"
+
+        total = args.transfer_count
+        batch_size = args.transfer_batch_size
+        latencies = []
+        accepted = 0
+        tid = secrets.randbits(30) << 33
+        t0 = time.monotonic()
+        sent = 0
+        while sent < total:
+            count = min(batch_size, total - sent)
+            batch = np.zeros(count, dtype=types.TRANSFER_DTYPE)
+            batch["id_lo"] = np.arange(tid, tid + count, dtype=np.uint64)
+            dr = rng.integers(1, n + 1, count, dtype=np.uint64)
+            off = rng.integers(1, n, count, dtype=np.uint64)
+            batch["debit_account_id_lo"] = id_base + dr
+            batch["credit_account_id_lo"] = id_base + (dr - 1 + off) % n + 1
+            batch["amount_lo"] = rng.integers(1, 1 << 16, count, dtype=np.uint64)
+            batch["ledger"] = 2
+            batch["code"] = 1
+            bt0 = time.monotonic()
+            results = client.create_transfers(batch)
+            latencies.append(time.monotonic() - bt0)
+            failures = len(results)
+            accepted += count - failures
+            sent += count
+            tid += count
+        elapsed = time.monotonic() - t0
+
+        lat_ms = sorted(1e3 * l for l in latencies)
+
+        def pct(p):
+            return lat_ms[min(len(lat_ms) - 1, int(p / 100 * len(lat_ms)))]
+
+        print(f"load accepted = {accepted / elapsed:,.0f} tx/s")
+        print(f"batch latency p50 = {pct(50):.2f} ms, p95 = {pct(95):.2f} ms, "
+              f"p99 = {pct(99):.2f} ms, max = {lat_ms[-1]:.2f} ms")
+        print(json.dumps({
+            "metric": "benchmark_load_accepted",
+            "value": round(accepted / elapsed, 1),
+            "unit": "tx/s",
+            "vs_baseline": round(accepted / elapsed / 1_000_000, 3),
+        }))
+        client.close()
+        return 0
+    finally:
+        for cleanup in stack:
+            cleanup()
+
+
+def _spawn_temp_replica(cluster: int):
+    """Format + serve a temp single replica in-process (benchmark_driver.zig
+    spawns a child; a daemon thread keeps this self-contained)."""
+    from .config import LedgerConfig
+    from .net.bus import run_server
+    from .vsr.replica import Replica
+
+    tmp = tempfile.mkdtemp(prefix="tb_bench_")
+    path = os.path.join(tmp, "bench.tb")
+    Replica.format(path, cluster=cluster)
+    replica = Replica(
+        path,
+        ledger_config=LedgerConfig(
+            accounts_capacity_log2=21, transfers_capacity_log2=23,
+            posted_capacity_log2=16,
+        ),
+    )
+    replica.open()
+
+    port_box = {}
+    ready = threading.Event()
+
+    def serve():
+        run_server(replica, "127.0.0.1", 0,
+                   ready_callback=lambda p: (port_box.update(port=p), ready.set()))
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    assert ready.wait(30), "temp replica failed to start"
+
+    def cleanup():
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return [("127.0.0.1", port_box["port"])], cleanup
+
+
+if __name__ == "__main__":
+    sys.exit(main())

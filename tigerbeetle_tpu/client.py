@@ -1,0 +1,188 @@
+"""Client library: session registration, hash-chained requests, retries.
+
+The reference client (src/vsr/client.zig) generates an ephemeral random u128
+client id, registers a session (its session number = the commit number of the
+register op), then sends at most one hash-chained request at a time —
+``parent`` is the checksum of the preceding request, which the cluster uses to
+verify linearizability (message_header.zig Request docs).  Replies are matched
+by request number; duplicate replies are discarded; an eviction message means
+the session was lost and the client must crash or re-register.
+
+This synchronous client is both the tb_client analogue and the substrate for
+the repl and the benchmark driver.  High-level batch helpers mirror the
+tb_client API surface (create_accounts/create_transfers/lookup_*).
+"""
+
+from __future__ import annotations
+
+import secrets
+import socket
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import types
+from .config import ClusterConfig
+from .vsr import wire
+
+
+class ClientEvicted(Exception):
+    pass
+
+
+class Client:
+    def __init__(
+        self,
+        addresses: Sequence[Tuple[str, int]],
+        cluster: int,
+        config: Optional[ClusterConfig] = None,
+        client_id: Optional[int] = None,
+        timeout_s: float = 30.0,
+    ) -> None:
+        self.addresses = list(addresses)
+        self.cluster = cluster
+        self.config = config or ClusterConfig()
+        self.client_id = client_id or (secrets.randbits(128) | 1)
+        self.timeout_s = timeout_s
+        self.session = 0
+        self.request_number = 0
+        self.parent = 0          # checksum of the previous request
+        self._sock: Optional[socket.socket] = None
+
+    # -- connection management ----------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        last_err: Optional[Exception] = None
+        for host, port in self.addresses:
+            try:
+                sock = socket.create_connection((host, port), timeout=self.timeout_s)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = sock
+                return sock
+            except OSError as err:
+                last_err = err
+        raise ConnectionError(f"no replica reachable: {last_err}")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _recv_exactly(self, sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            chunk = sock.recv(n - got)
+            if not chunk:
+                raise ConnectionError("connection closed mid-message")
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def _roundtrip(self, message: bytes, request_checksum: int) -> Tuple[np.ndarray, bytes]:
+        """Send; wait for the matching reply (retrying on reconnect)."""
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError("request timed out")
+            try:
+                sock = self._connect()
+                sock.sendall(message)
+                while True:
+                    head = self._recv_exactly(sock, wire.HEADER_SIZE)
+                    h, command = wire.decode_header(head)
+                    body = b""
+                    size = int(h["size"])
+                    if size > wire.HEADER_SIZE:
+                        body = self._recv_exactly(sock, size - wire.HEADER_SIZE)
+                        wire.verify_body(h, body)
+                    if command == wire.Command.eviction:
+                        raise ClientEvicted(
+                            f"session evicted for client {self.client_id:#x}"
+                        )
+                    if command != wire.Command.reply:
+                        continue  # e.g. pong
+                    if wire.u128(h, "request_checksum") != request_checksum:
+                        continue  # stale/duplicate reply
+                    return h, body
+            except (ConnectionError, OSError, ValueError):
+                self.close()
+                time.sleep(0.05)
+
+    # -- session protocol -----------------------------------------------------
+
+    def register(self) -> None:
+        h = wire.new_header(
+            wire.Command.request,
+            cluster=self.cluster,
+            client=self.client_id,
+            request=0,
+            parent=0,
+            session=0,
+            operation=int(wire.Operation.register),
+        )
+        message = wire.encode(h, b"")
+        request_checksum = wire.header_checksum(wire.decode_header(message)[0])
+        reply_h, _ = self._roundtrip(message, request_checksum)
+        self.session = int(reply_h["op"])
+        self.parent = request_checksum
+        self.request_number = 1
+
+    def request(self, operation: wire.Operation, body: bytes) -> bytes:
+        if self.session == 0:
+            self.register()
+        h = wire.new_header(
+            wire.Command.request,
+            cluster=self.cluster,
+            client=self.client_id,
+            request=self.request_number,
+            parent=self.parent,
+            session=self.session,
+            operation=int(operation),
+        )
+        message = wire.encode(h, body)
+        request_checksum = wire.header_checksum(wire.decode_header(message)[0])
+        _, reply_body = self._roundtrip(message, request_checksum)
+        self.parent = request_checksum
+        self.request_number += 1
+        return reply_body
+
+    # -- tb_client-style batch API -------------------------------------------
+
+    def create_accounts(self, accounts: np.ndarray) -> List[Tuple[int, int]]:
+        assert accounts.dtype == types.ACCOUNT_DTYPE
+        assert len(accounts) <= self.config.batch_max_create_accounts
+        body = self.request(wire.Operation.create_accounts, accounts.tobytes())
+        return _decode_results(body)
+
+    def create_transfers(self, transfers: np.ndarray) -> List[Tuple[int, int]]:
+        assert transfers.dtype == types.TRANSFER_DTYPE
+        assert len(transfers) <= self.config.batch_max_create_transfers
+        body = self.request(wire.Operation.create_transfers, transfers.tobytes())
+        return _decode_results(body)
+
+    def lookup_accounts(self, ids: Sequence[int]) -> np.ndarray:
+        body = self.request(wire.Operation.lookup_accounts, _encode_ids(ids))
+        return np.frombuffer(body, dtype=types.ACCOUNT_DTYPE)
+
+    def lookup_transfers(self, ids: Sequence[int]) -> np.ndarray:
+        body = self.request(wire.Operation.lookup_transfers, _encode_ids(ids))
+        return np.frombuffer(body, dtype=types.TRANSFER_DTYPE)
+
+
+def _encode_ids(ids: Sequence[int]) -> bytes:
+    arr = np.zeros(2 * len(ids), dtype="<u8")
+    for i, value in enumerate(ids):
+        arr[2 * i] = value & 0xFFFF_FFFF_FFFF_FFFF
+        arr[2 * i + 1] = value >> 64
+    return arr.tobytes()
+
+
+def _decode_results(body: bytes) -> List[Tuple[int, int]]:
+    arr = np.frombuffer(body, dtype=types.EVENT_RESULT_DTYPE)
+    return [(int(r["index"]), int(r["result"])) for r in arr]

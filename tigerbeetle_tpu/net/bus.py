@@ -1,0 +1,153 @@
+"""Message bus: TCP framing + the replica server event loop.
+
+The reference's MessageBus (src/message_bus.zig) is a TCP mesh over an
+io_uring event loop with per-connection receive buffers and bounded send
+queues; messages are framed as a 256-byte checksummed header + body.  This is
+the same wire discipline on asyncio: the frame codec is shared by server and
+client, bad frames drop the connection (checksum failure means corruption or
+a protocol mismatch — message_bus.zig terminates on invalid headers), and the
+replica executes on the loop thread (the reference replica is likewise
+single-threaded; SURVEY §2.8.5).
+
+Peer-to-peer replica connections (prepare/prepare_ok/commit flow) layer on
+the same framing; see vsr/cluster.py for the multi-replica message flow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import numpy as np
+
+from ..vsr import wire
+from ..vsr.replica import Replica
+
+log = logging.getLogger("tigerbeetle_tpu.net")
+
+
+class FrameError(Exception):
+    pass
+
+
+async def read_message(reader: asyncio.StreamReader, message_size_max: int):
+    """Read one framed message; returns (header, command, body) or None on
+    clean EOF. Raises FrameError on corruption (caller drops the connection)."""
+    try:
+        head = await reader.readexactly(wire.HEADER_SIZE)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    try:
+        h, command = wire.decode_header(head)
+    except ValueError as err:
+        raise FrameError(f"bad header: {err}") from err
+    size = int(h["size"])
+    if size > message_size_max:
+        raise FrameError(f"size {size} exceeds message_size_max")
+    body = b""
+    if size > wire.HEADER_SIZE:
+        try:
+            body = await reader.readexactly(size - wire.HEADER_SIZE)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return None
+        try:
+            wire.verify_body(h, body)
+        except ValueError as err:
+            raise FrameError(f"bad body: {err}") from err
+    return h, command, body
+
+
+class ReplicaServer:
+    """Serve one replica over TCP (the `tigerbeetle start` loop,
+    src/tigerbeetle/main.zig:133+266-269)."""
+
+    def __init__(self, replica: Replica, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.replica = replica
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("replica %d listening on %s:%d",
+                 self.replica.replica, self.host, self.port)
+        return self.port
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                msg = await read_message(
+                    reader, self.replica.config.message_size_max
+                )
+                if msg is None:
+                    break
+                h, command, body = msg
+                for out in self._dispatch(h, command, body):
+                    writer.write(out)
+                await writer.drain()
+        except FrameError as err:
+            log.warning("dropping connection %s: %s", peer, err)
+        except Exception:
+            # A dispatch failure must not take down the server loop; drop the
+            # connection like any other corrupt peer (message_bus.zig
+            # terminate-on-invalid discipline).
+            log.exception("dispatch error, dropping connection %s", peer)
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    def _dispatch(self, h: np.ndarray, command: wire.Command, body: bytes):
+        if wire.u128(h, "cluster") != self.replica.cluster:
+            log.warning("wrong cluster %x", wire.u128(h, "cluster"))
+            return []
+        if command == wire.Command.request:
+            return self.replica.on_request(h, body)
+        if command == wire.Command.ping_client:
+            pong = wire.new_header(
+                wire.Command.pong_client, cluster=self.replica.cluster,
+                view=self.replica.view,
+            )
+            pong["replica"] = self.replica.replica
+            return [wire.encode(pong, b"")]
+        log.warning("unhandled command %s", command.name)
+        return []
+
+
+def run_server(replica: Replica, host: str = "127.0.0.1", port: int = 0,
+               ready_callback=None) -> None:
+    """Blocking entry point: serve until cancelled."""
+
+    async def main():
+        server = ReplicaServer(replica, host, port)
+        actual_port = await server.start()
+        if ready_callback is not None:
+            ready_callback(actual_port)
+        await server.serve_forever()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass

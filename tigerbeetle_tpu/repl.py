@@ -1,0 +1,222 @@
+"""REPL: interactive/one-shot statement parser -> client requests.
+
+Statement grammar follows the reference repl (src/repl.zig): an operation name
+followed by ``field=value`` pairs, ``;``-terminated, with ``|``-combined flag
+names and multiple objects per statement separated by ``,``:
+
+    create_accounts id=1 code=10 ledger=700, id=2 code=10 ledger=700;
+    create_transfers id=1 debit_account_id=1 credit_account_id=2 amount=10
+                     ledger=700 code=10 flags=linked|pending;
+    lookup_accounts id=1;
+    get_account_transfers account_id=1 flags=debits|credits limit=10;
+"""
+
+from __future__ import annotations
+
+import shlex
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import types
+from .client import Client
+from .vsr import wire
+
+_ACCOUNT_FLAGS = {
+    "linked": types.AccountFlags.LINKED,
+    "debits_must_not_exceed_credits": types.AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS,
+    "credits_must_not_exceed_debits": types.AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS,
+    "history": types.AccountFlags.HISTORY,
+}
+
+_TRANSFER_FLAGS = {
+    "linked": types.TransferFlags.LINKED,
+    "pending": types.TransferFlags.PENDING,
+    "post_pending_transfer": types.TransferFlags.POST_PENDING_TRANSFER,
+    "void_pending_transfer": types.TransferFlags.VOID_PENDING_TRANSFER,
+    "balancing_debit": types.TransferFlags.BALANCING_DEBIT,
+    "balancing_credit": types.TransferFlags.BALANCING_CREDIT,
+}
+
+_FILTER_FLAGS = {
+    "debits": types.AccountFilterFlags.DEBITS,
+    "credits": types.AccountFilterFlags.CREDITS,
+    "reversed": types.AccountFilterFlags.REVERSED,
+}
+
+OPERATIONS = (
+    "create_accounts", "create_transfers", "lookup_accounts",
+    "lookup_transfers", "get_account_transfers", "get_account_history",
+)
+
+
+def _parse_flags(value: str, table: Dict[str, int]) -> int:
+    out = 0
+    for name in value.split("|"):
+        name = name.strip()
+        if name not in table:
+            raise ValueError(f"unknown flag {name!r} (expected {sorted(table)})")
+        out |= int(table[name])
+    return out
+
+
+def _parse_objects(tokens: List[str]) -> List[Dict[str, str]]:
+    """Split `k=v` tokens into objects at `,` boundaries."""
+    objects: List[Dict[str, str]] = [{}]
+    for token in tokens:
+        while token.endswith(","):
+            token = token[:-1]
+            if token:
+                objects[-1].update(_pair(token))
+            objects.append({})
+            token = ""
+        if token:
+            objects[-1].update(_pair(token))
+    return [obj for obj in objects if obj]
+
+
+def _pair(token: str) -> Dict[str, str]:
+    if "=" not in token:
+        raise ValueError(f"expected field=value, got {token!r}")
+    key, value = token.split("=", 1)
+    return {key.strip(): value.strip()}
+
+
+def parse_statement(statement: str):
+    """Parse one statement -> (operation, list-of-field-dicts)."""
+    statement = statement.strip().rstrip(";").strip()
+    if not statement:
+        return None
+    tokens = shlex.split(statement)
+    operation = tokens[0]
+    if operation not in OPERATIONS:
+        raise ValueError(
+            f"unknown operation {operation!r} (expected one of {OPERATIONS})"
+        )
+    return operation, _parse_objects(tokens[1:])
+
+
+def build_accounts(objects: List[Dict[str, str]]) -> np.ndarray:
+    rows = []
+    for obj in objects:
+        kwargs = {}
+        for key, value in obj.items():
+            if key == "flags":
+                kwargs["flags"] = _parse_flags(value, _ACCOUNT_FLAGS)
+            else:
+                kwargs[key] = int(value, 0)
+        rows.append(types.account(**kwargs))
+    return types.accounts_array(rows)
+
+
+def build_transfers(objects: List[Dict[str, str]]) -> np.ndarray:
+    rows = []
+    for obj in objects:
+        kwargs = {}
+        for key, value in obj.items():
+            if key == "flags":
+                kwargs["flags"] = _parse_flags(value, _TRANSFER_FLAGS)
+            else:
+                kwargs[key] = int(value, 0)
+        rows.append(types.transfer(**kwargs))
+    return types.transfers_array(rows)
+
+
+def build_filter(objects: List[Dict[str, str]]) -> np.ndarray:
+    assert len(objects) == 1, "account filters take exactly one object"
+    obj = objects[0]
+    rec = np.zeros((), dtype=types.ACCOUNT_FILTER_DTYPE)
+    for key, value in obj.items():
+        if key == "account_id":
+            rec["account_id_lo"] = int(value, 0) & ((1 << 64) - 1)
+            rec["account_id_hi"] = int(value, 0) >> 64
+        elif key == "flags":
+            rec["flags"] = _parse_flags(value, _FILTER_FLAGS)
+        else:
+            rec[key] = int(value, 0)
+    if int(rec["limit"]) == 0:
+        rec["limit"] = 8190
+    if int(rec["flags"]) == 0:
+        rec["flags"] = int(
+            types.AccountFilterFlags.DEBITS | types.AccountFilterFlags.CREDITS
+        )
+    return rec
+
+
+def _format_row(row: np.void, fields) -> str:
+    parts = []
+    for name in fields:
+        if name.endswith("_lo"):
+            base = name[:-3]
+            value = (int(row[base + "_hi"]) << 64) | int(row[name])
+            parts.append(f"{base}={value}")
+        elif name.endswith("_hi") or name == "reserved":
+            continue
+        else:
+            parts.append(f"{name}={int(row[name])}")
+    return "  " + " ".join(parts)
+
+
+def execute_statement(client: Client, statement: str, out=sys.stdout) -> None:
+    parsed = parse_statement(statement)
+    if parsed is None:
+        return
+    operation, objects = parsed
+    if operation == "create_accounts":
+        results = client.create_accounts(build_accounts(objects))
+        _print_results(results, types.CreateAccountResult, out)
+    elif operation == "create_transfers":
+        results = client.create_transfers(build_transfers(objects))
+        _print_results(results, types.CreateTransferResult, out)
+    elif operation == "lookup_accounts":
+        ids = [int(obj["id"], 0) for obj in objects]
+        rows = client.lookup_accounts(ids)
+        for row in rows:
+            print(_format_row(row, types.ACCOUNT_DTYPE.names), file=out)
+    elif operation == "lookup_transfers":
+        ids = [int(obj["id"], 0) for obj in objects]
+        rows = client.lookup_transfers(ids)
+        for row in rows:
+            print(_format_row(row, types.TRANSFER_DTYPE.names), file=out)
+    elif operation in ("get_account_transfers", "get_account_history"):
+        body = build_filter(objects).tobytes()
+        op = (wire.Operation.get_account_transfers
+              if operation == "get_account_transfers"
+              else wire.Operation.get_account_history)
+        reply = client.request(op, body)
+        dtype = (types.TRANSFER_DTYPE if operation == "get_account_transfers"
+                 else types.ACCOUNT_BALANCE_DTYPE)
+        for row in np.frombuffer(reply, dtype=dtype):
+            print(_format_row(row, dtype.names), file=out)
+
+
+def _print_results(results, enum_cls, out) -> None:
+    if not results:
+        print("  ok", file=out)
+    for index, result in results:
+        print(f"  [{index}]: {enum_cls(result).name}", file=out)
+
+
+def run(client: Client, command: Optional[str] = None) -> None:
+    """One-shot (--command) or interactive loop."""
+    if command is not None:
+        for statement in command.split(";"):
+            execute_statement(client, statement)
+        return
+    print("tigerbeetle-tpu repl (statements end with ';', ctrl-d to exit)")
+    buffer = ""
+    while True:
+        try:
+            prompt = "> " if not buffer else ". "
+            line = input(prompt)
+        except EOFError:
+            print()
+            return
+        buffer += " " + line
+        while ";" in buffer:
+            statement, buffer = buffer.split(";", 1)
+            try:
+                execute_statement(client, statement)
+            except (ValueError, KeyError, AssertionError) as err:
+                print(f"error: {err}")

@@ -51,10 +51,10 @@ class Session:
     slot: int = 0          # stable client_replies zone slot (0..clients_max-1)
 
 
-class Eviction(Exception):
-    def __init__(self, client: int):
-        super().__init__(f"client {client:#x} evicted")
-        self.client = client
+class InvalidRequest(Exception):
+    """Request rejected before journaling (malformed body / bad operation) —
+    the reference drops such requests at header validation
+    (message_header.zig Request.invalid_header)."""
 
 
 class Replica:
@@ -188,7 +188,13 @@ class Replica:
         """Handle a verified client request; returns wire messages to send
         back (replica.zig on_request :1308-1337 + commit_op :3678-3836)."""
         client = wire.u128(header, "client")
-        operation = wire.Operation(int(header["operation"]))
+        try:
+            operation = wire.Operation(int(header["operation"]))
+            self._validate_request(operation, body)
+        except (ValueError, InvalidRequest):
+            # Malformed request: drop it *before* journaling — a journaled
+            # prepare must always be executable, or replay would wedge.
+            return []
         request_n = int(header["request"])
 
         session = self.sessions.get(client)
@@ -315,6 +321,36 @@ class Replica:
             return self.machine.lookup_transfers(ids).tobytes()
         raise ValueError(f"unimplemented operation {operation}")
 
+    def _validate_request(self, operation: wire.Operation, body: bytes) -> None:
+        """Reject anything that could not commit cleanly. Every prepare that
+        reaches the WAL must be executable on replay."""
+        max_body = self.config.message_body_size_max
+        if len(body) > max_body:
+            raise InvalidRequest("body exceeds message_body_size_max")
+        if operation == wire.Operation.register:
+            if body:
+                raise InvalidRequest("register body must be empty")
+            return
+        if operation in (
+            wire.Operation.create_accounts, wire.Operation.create_transfers
+        ):
+            if len(body) % 128 != 0:
+                raise InvalidRequest("body not a multiple of event size")
+            if len(body) // 128 > self.batch_lanes:
+                raise InvalidRequest("batch exceeds configured lanes")
+            return
+        if operation in (
+            wire.Operation.lookup_accounts, wire.Operation.lookup_transfers
+        ):
+            if len(body) % 16 != 0:
+                raise InvalidRequest("body not a multiple of id size")
+            # Replies are 128 B/row vs 16 B/id: cap so the reply always fits
+            # in one message (state_machine.zig:70-75 batch_max semantics).
+            if len(body) // 16 > max_body // 128:
+                raise InvalidRequest("lookup batch exceeds reply capacity")
+            return
+        raise InvalidRequest(f"operation {operation!r} not accepted")
+
     def _event_count(self, operation: wire.Operation, body: bytes) -> int:
         if operation in (
             wire.Operation.create_accounts, wire.Operation.create_transfers
@@ -350,12 +386,13 @@ class Replica:
 
     def _store_client_reply(self, client: int, reply: bytes) -> None:
         slot = self.sessions[client].slot
-        if len(reply) <= self.config.message_size_max:
-            off = (
-                self.storage.layout.client_replies_offset
-                + slot * self.config.message_size_max
-            )
-            self.storage.write(off, reply)
+        # _validate_request guarantees replies fit one message slot.
+        assert len(reply) <= self.config.message_size_max, len(reply)
+        off = (
+            self.storage.layout.client_replies_offset
+            + slot * self.config.message_size_max
+        )
+        self.storage.write(off, reply)
 
     def _read_client_reply(self, slot: int, size: int) -> bytes:
         if size == 0:
