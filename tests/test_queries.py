@@ -1,0 +1,273 @@
+"""Differential tests for get_account_transfers / get_account_history.
+
+Device masked-scan queries (ops/query.py) vs the scalar oracle, covering
+filter validation, debit/credit side selection, timestamp windows, direction,
+limits, history recording on the sequential path, and linked-chain rollback of
+history appends (reference: state_machine.zig:693-892, 1128-1195)."""
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LedgerConfig
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.testing import model as M
+from tigerbeetle_tpu.types import AccountFlags as AF, TransferFlags as F
+
+LANES = 64
+DEBITS, CREDITS, REVERSED = 1, 2, 4
+U64_MAX = (1 << 64) - 1
+
+
+def make_pair():
+    cfg = LedgerConfig(
+        accounts_capacity_log2=10,
+        transfers_capacity_log2=11,
+        posted_capacity_log2=10,
+        history_capacity_log2=10,
+        max_probe=1 << 9,
+    )
+    return TpuStateMachine(cfg, batch_lanes=LANES), M.ReferenceStateMachine()
+
+
+def run_transfers(dev, ref, rows, wall=0):
+    batch = types.transfers_array(rows)
+    got = dev.create_transfers(batch, wall_clock_ns=wall)
+    want = ref.execute(
+        "create_transfers",
+        ref.prepare("create_transfers", len(batch), wall),
+        [M.transfer_from_row(r) for r in batch],
+    )
+    assert got == want, f"transfer results differ: {got} vs {want}"
+
+
+def seed(dev, ref, n=6, flags=None):
+    rows = [
+        types.account(id=i + 1, ledger=1, code=10, flags=(flags or {}).get(i + 1, 0))
+        for i in range(n)
+    ]
+    batch = types.accounts_array(rows)
+    got = dev.create_accounts(batch, wall_clock_ns=1000)
+    want = ref.execute(
+        "create_accounts",
+        ref.prepare("create_accounts", len(batch), 1000),
+        [M.account_from_row(r) for r in batch],
+    )
+    assert got == want
+
+
+def filt(account_id, ts_min=0, ts_max=0, limit=100, flags=DEBITS | CREDITS):
+    f = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
+    f["account_id_lo"] = account_id & U64_MAX
+    f["account_id_hi"] = account_id >> 64
+    f["timestamp_min"] = ts_min
+    f["timestamp_max"] = ts_max
+    f["limit"] = limit
+    f["flags"] = flags
+    return f
+
+
+def transfer_row_tuple(r):
+    j = types.u128_join
+    return (
+        j(r["id_lo"], r["id_hi"]),
+        j(r["debit_account_id_lo"], r["debit_account_id_hi"]),
+        j(r["credit_account_id_lo"], r["credit_account_id_hi"]),
+        j(r["amount_lo"], r["amount_hi"]),
+        j(r["pending_id_lo"], r["pending_id_hi"]),
+        int(r["ledger"]),
+        int(r["code"]),
+        int(r["flags"]),
+        int(r["timestamp"]),
+    )
+
+
+def oracle_transfer_tuple(t):
+    return (
+        t.id, t.debit_account_id, t.credit_account_id, t.amount,
+        t.pending_id, t.ledger, t.code, t.flags, t.timestamp,
+    )
+
+
+def check_transfers_query(dev, ref, f):
+    got = [transfer_row_tuple(r) for r in dev.get_account_transfers(f)]
+    want = [
+        oracle_transfer_tuple(t)
+        for t in ref.get_account_transfers(
+            types.u128_join(f["account_id_lo"], f["account_id_hi"]),
+            int(f["timestamp_min"]), int(f["timestamp_max"]),
+            int(f["limit"]), int(f["flags"]),
+        )
+    ]
+    assert got == want, f"query mismatch: {got} vs {want}"
+
+
+def check_history_query(dev, ref, f):
+    got = [
+        (
+            int(r["timestamp"]),
+            types.u128_join(r["debits_pending_lo"], r["debits_pending_hi"]),
+            types.u128_join(r["debits_posted_lo"], r["debits_posted_hi"]),
+            types.u128_join(r["credits_pending_lo"], r["credits_pending_hi"]),
+            types.u128_join(r["credits_posted_lo"], r["credits_posted_hi"]),
+        )
+        for r in dev.get_account_history(f)
+    ]
+    want = ref.get_account_history(
+        types.u128_join(f["account_id_lo"], f["account_id_hi"]),
+        int(f["timestamp_min"]), int(f["timestamp_max"]),
+        int(f["limit"]), int(f["flags"]),
+    )
+    assert got == want, f"history mismatch: {got} vs {want}"
+
+
+class TestGetAccountTransfers:
+    def _seed_transfers(self, dev, ref):
+        seed(dev, ref)
+        run_transfers(dev, ref, [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2,
+                           amount=10, ledger=1, code=10),
+            types.transfer(id=2, debit_account_id=2, credit_account_id=1,
+                           amount=20, ledger=1, code=10),
+            types.transfer(id=3, debit_account_id=1, credit_account_id=3,
+                           amount=30, ledger=1, code=10),
+            types.transfer(id=4, debit_account_id=3, credit_account_id=2,
+                           amount=40, ledger=1, code=10),
+        ])
+
+    def test_sides_direction_window_limit(self):
+        dev, ref = make_pair()
+        self._seed_transfers(dev, ref)
+        ts0 = ref.transfers[1].timestamp
+        for f in (
+            filt(1),
+            filt(1, flags=DEBITS),
+            filt(1, flags=CREDITS),
+            filt(1, flags=DEBITS | CREDITS | REVERSED),
+            filt(2, limit=1),
+            filt(2, limit=1, flags=DEBITS | CREDITS | REVERSED),
+            filt(1, ts_min=ts0 + 1),
+            filt(1, ts_max=ts0 + 1),
+            filt(1, ts_min=ts0 + 1, ts_max=ts0 + 2),
+            filt(3),
+            filt(5),   # no transfers
+            filt(9),   # nonexistent account: no matches
+        ):
+            check_transfers_query(dev, ref, f)
+
+    def test_invalid_filters_empty(self):
+        dev, ref = make_pair()
+        self._seed_transfers(dev, ref)
+        invalid = [
+            filt(0),
+            filt((1 << 128) - 1),
+            filt(1, limit=0),
+            filt(1, flags=0),                    # no side selected
+            filt(1, flags=8),                    # padding flag bits
+            filt(1, ts_min=U64_MAX),
+            filt(1, ts_max=U64_MAX),
+            filt(1, ts_min=5, ts_max=4),
+        ]
+        for f in invalid:
+            assert len(dev.get_account_transfers(f)) == 0
+            check_transfers_query(dev, ref, f)
+        bad = filt(1)
+        bad["reserved"] = b"\x01" + b"\x00" * 23
+        assert len(dev.get_account_transfers(bad)) == 0
+
+
+class TestGetAccountHistory:
+    def test_history_recorded_and_queried(self):
+        dev, ref = make_pair()
+        seed(dev, ref, flags={1: int(AF.HISTORY), 2: int(AF.HISTORY)})
+        run_transfers(dev, ref, [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2,
+                           amount=10, ledger=1, code=10),
+            types.transfer(id=2, debit_account_id=2, credit_account_id=3,
+                           amount=5, ledger=1, code=10),
+            types.transfer(id=3, debit_account_id=3, credit_account_id=4,
+                           amount=7, ledger=1, code=10),  # no history side
+            types.transfer(id=4, debit_account_id=1, credit_account_id=2,
+                           amount=3, ledger=1, code=10, flags=int(F.PENDING)),
+        ])
+        for f in (
+            filt(1), filt(2),
+            filt(1, flags=DEBITS),      # side selection: dr rows only
+            filt(1, flags=CREDITS),     # account 1 is never cr -> empty
+            filt(2, flags=DEBITS),
+            filt(2, flags=CREDITS),
+            filt(1, flags=DEBITS | CREDITS | REVERSED),
+            filt(2, limit=1),
+            filt(3),  # exists but not history-flagged -> empty
+            filt(9),  # missing account -> empty
+        ):
+            check_history_query(dev, ref, f)
+        assert len(dev.get_account_history(filt(3))) == 0
+
+    def test_two_phase_no_history_on_post(self):
+        # post/void inserts no history row (state_machine.zig:1391-1498 has
+        # no account_history insert); only the pending creation records one.
+        dev, ref = make_pair()
+        seed(dev, ref, flags={1: int(AF.HISTORY)})
+        run_transfers(dev, ref, [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2,
+                           amount=10, ledger=1, code=10, flags=int(F.PENDING)),
+        ])
+        run_transfers(dev, ref, [
+            types.transfer(id=2, pending_id=1, ledger=1, code=10,
+                           flags=int(F.POST_PENDING_TRANSFER)),
+        ])
+        check_history_query(dev, ref, filt(1))
+        assert len(dev.get_account_history(filt(1))) == 1
+
+    def test_chain_rollback_pops_history(self):
+        dev, ref = make_pair()
+        seed(dev, ref, flags={1: int(AF.HISTORY)})
+        L = int(F.LINKED)
+        run_transfers(dev, ref, [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2,
+                           amount=10, ledger=1, code=10, flags=L),
+            types.transfer(id=2, debit_account_id=1, credit_account_id=1,
+                           amount=5, ledger=1, code=10),  # fails -> chain rollback
+            types.transfer(id=3, debit_account_id=1, credit_account_id=2,
+                           amount=7, ledger=1, code=10),
+        ])
+        check_history_query(dev, ref, filt(1))
+        # Only the post-rollback transfer survives in history.
+        assert len(dev.get_account_history(filt(1))) == 1
+
+    def test_history_log_grows_past_capacity(self):
+        cfg = LedgerConfig(
+            accounts_capacity_log2=10,
+            transfers_capacity_log2=11,
+            posted_capacity_log2=10,
+            history_capacity_log2=0,  # capacity 1: every batch forces growth
+            max_probe=1 << 9,
+        )
+        dev, ref = TpuStateMachine(cfg, batch_lanes=LANES), M.ReferenceStateMachine()
+        seed(dev, ref, flags={1: int(AF.HISTORY)})
+        for start in (1, 6):
+            run_transfers(dev, ref, [
+                types.transfer(id=start + i, debit_account_id=1,
+                               credit_account_id=2, amount=1 + i,
+                               ledger=1, code=10)
+                for i in range(5)
+            ])
+        assert dev.ledger.history.capacity >= 10
+        check_history_query(dev, ref, filt(1, limit=1000))
+        assert len(dev.get_account_history(filt(1, limit=1000))) == 10
+
+    def test_history_survives_checkpoint_roundtrip(self, tmp_path):
+        from tigerbeetle_tpu.vsr import checkpoint as cp
+
+        dev, ref = make_pair()
+        seed(dev, ref, flags={1: int(AF.HISTORY)})
+        run_transfers(dev, ref, [
+            types.transfer(id=1, debit_account_id=1, credit_account_id=2,
+                           amount=10, ledger=1, code=10),
+        ])
+        data = str(tmp_path / "db")
+        _, csum = cp.save(data, 7, dev.ledger, {})
+        ledger2, _ = cp.load(data, 7, csum)
+        dev.ledger = ledger2
+        check_history_query(dev, ref, filt(1))
+        assert len(dev.get_account_history(filt(1))) == 1

@@ -65,6 +65,7 @@ class LedgerConfig:
     accounts_capacity_log2: int = 16
     transfers_capacity_log2: int = 18
     posted_capacity_log2: int = 16
+    history_capacity_log2: int = 16
     # Upper bound on linear-probe distance before the kernel reports the table
     # as over-full (host must grow/rebuild; analogous to cache eviction limits).
     max_probe: int = 64
@@ -81,6 +82,10 @@ class LedgerConfig:
     def posted_capacity(self) -> int:
         return 1 << self.posted_capacity_log2
 
+    @property
+    def history_capacity(self) -> int:
+        return 1 << self.history_capacity_log2
+
 
 # Presets, mirroring config.zig:206-303.
 PRODUCTION = ClusterConfig()
@@ -88,7 +93,7 @@ TEST_MIN = ClusterConfig(message_size_max=8192, journal_slot_count=64)
 
 LEDGER_TEST = LedgerConfig(
     accounts_capacity_log2=10, transfers_capacity_log2=12, posted_capacity_log2=10,
-    max_probe=1 << 10,
+    history_capacity_log2=10, max_probe=1 << 10,
 )
 # Benchmark sizing: 10M+ accounts, tens of millions of transfers resident.
 LEDGER_BENCH = LedgerConfig(

@@ -35,6 +35,9 @@ from .config import LedgerConfig
 from .ops import state_machine as sm
 
 U64_MAX = (1 << 64) - 1
+# Reply rows are 128 B; one 1 MiB message body holds at most this many
+# (constants.zig:203-204, state_machine.zig:70-75).
+QUERY_ROWS_MAX = ((1 << 20) - 256) // 128
 
 
 class TpuStateMachine:
@@ -49,7 +52,10 @@ class TpuStateMachine:
         self.batch_lanes = batch_lanes
         self.force_sequential = force_sequential
         self.ledger = sm.make_ledger(
-            cfg.accounts_capacity, cfg.transfers_capacity, cfg.posted_capacity
+            cfg.accounts_capacity,
+            cfg.transfers_capacity,
+            cfg.posted_capacity,
+            cfg.history_capacity,
         )
         self.prepare_timestamp = 0
         self.commit_timestamp = 0
@@ -200,6 +206,15 @@ class TpuStateMachine:
     ) -> List[Tuple[int, int]]:
         from .ops import scan_path
 
+        if operation == "create_transfers":
+            # Guarantee history headroom: each event appends at most one row
+            # (the log never wraps; see ops.state_machine.History).
+            needed = int(self.ledger.history.count) + len(batch)
+            if needed > self.ledger.history.capacity:
+                self.ledger = self.ledger.replace(
+                    history=sm.grow_history(self.ledger.history, needed)
+                )
+
         soa = self._pad_soa(batch)
         count = len(batch)
         kernel = (
@@ -258,6 +273,97 @@ class TpuStateMachine:
         host = {k: np.asarray(v) for k, v in cols.items()}
         rows = types.from_soa(host, types.TRANSFER_DTYPE)
         return rows[found]
+
+    # -- queries (state_machine.zig:693-892, 1128-1195) ----------------------
+
+    @staticmethod
+    def _filter_window(filt: np.void) -> Optional[Tuple[int, int, int, int, bool, int]]:
+        """Validate an AccountFilter and resolve its effective window.
+
+        Mirrors get_scan_from_filter (state_machine.zig:823-837): invalid
+        filters yield None -> empty results, not errors.  Returns
+        (acct_lo, acct_hi, ts_min, ts_max, descending, limit)."""
+        acct_lo = int(filt["account_id_lo"])
+        acct_hi = int(filt["account_id_hi"])
+        ts_min = int(filt["timestamp_min"])
+        ts_max = int(filt["timestamp_max"])
+        limit = int(filt["limit"])
+        flags = int(filt["flags"])
+        valid = (
+            (acct_lo, acct_hi) != (0, 0)
+            and (acct_lo, acct_hi) != (U64_MAX, U64_MAX)
+            and ts_min != U64_MAX
+            and ts_max != U64_MAX
+            and (ts_max == 0 or ts_min <= ts_max)
+            and limit != 0
+            and flags & (types.AccountFilterFlags.DEBITS | types.AccountFilterFlags.CREDITS)
+            and flags & ~0x7 == 0
+            and not bytes(filt["reserved"]).strip(b"\0")
+        )
+        if not valid:
+            return None
+        # TimestampRange defaults (lsm/timestamp_range.zig:4-5).
+        eff_min = ts_min if ts_min != 0 else 1
+        eff_max = ts_max if ts_max != 0 else U64_MAX - 1
+        descending = bool(flags & types.AccountFilterFlags.REVERSED)
+        return acct_lo, acct_hi, eff_min, eff_max, descending, limit
+
+    def get_account_transfers(self, filt: np.void) -> np.ndarray:
+        """Transfers on either side of the filtered account, timestamp-ordered
+        (prefetch_get_account_transfers, state_machine.zig:693-723)."""
+        from .ops import query
+
+        window = self._filter_window(filt)
+        if window is None:
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        acct_lo, acct_hi, ts_min, ts_max, descending, limit = window
+        flags = int(filt["flags"])
+        k = min(self.config.transfers_capacity, QUERY_ROWS_MAX)
+        valid, rows = query.scan_transfers(
+            self.ledger,
+            jnp.uint64(acct_lo), jnp.uint64(acct_hi),
+            jnp.uint64(ts_min), jnp.uint64(ts_max),
+            jnp.bool_(bool(flags & types.AccountFilterFlags.DEBITS)),
+            jnp.bool_(bool(flags & types.AccountFilterFlags.CREDITS)),
+            jnp.bool_(descending),
+            k,
+        )
+        valid = np.asarray(valid)
+        host = {name: np.asarray(col) for name, col in rows.items()}
+        out = types.from_soa(host, types.TRANSFER_DTYPE)
+        return out[valid][: min(limit, k)]
+
+    def get_account_history(self, filt: np.void) -> np.ndarray:
+        """Balance history of a HISTORY-flagged account
+        (prefetch_get_account_history, state_machine.zig:736-797): empty
+        unless the account exists and carries the flag."""
+        from .ops import query
+
+        window = self._filter_window(filt)
+        if window is None:
+            return np.zeros(0, dtype=types.ACCOUNT_BALANCE_DTYPE)
+        acct_lo, acct_hi, ts_min, ts_max, descending, limit = window
+        account = self.lookup_accounts([acct_lo | (acct_hi << 64)])
+        if len(account) == 0 or not (
+            int(account[0]["flags"]) & types.AccountFlags.HISTORY
+        ):
+            return np.zeros(0, dtype=types.ACCOUNT_BALANCE_DTYPE)
+        flags = int(filt["flags"])
+        k = min(self.ledger.history.capacity, QUERY_ROWS_MAX)
+        valid, rows = query.scan_history(
+            self.ledger,
+            jnp.uint64(acct_lo), jnp.uint64(acct_hi),
+            jnp.uint64(ts_min), jnp.uint64(ts_max),
+            jnp.bool_(bool(flags & types.AccountFilterFlags.DEBITS)),
+            jnp.bool_(bool(flags & types.AccountFilterFlags.CREDITS)),
+            jnp.bool_(descending),
+            k,
+        )
+        valid = np.asarray(valid)
+        host = {name: np.asarray(col) for name, col in rows.items()}
+        host["reserved"] = np.zeros(len(valid), dtype="V56")
+        out = types.from_soa(host, types.ACCOUNT_BALANCE_DTYPE)
+        return out[valid][: min(limit, k)]
 
     # -- checkpoint surface --------------------------------------------------
 

@@ -1,0 +1,125 @@
+"""Device query kernels: get_account_transfers / get_account_history.
+
+The reference answers these with LSM index scans — per-field CompositeKey
+trees walked through a ScanBuilder with union-merge of the debit/credit
+conditions, a timestamp range, direction, and limit
+(state_machine.zig:693-892, lsm/scan_builder.zig).
+
+On TPU the transfers groove is a flat HBM SoA table, so the idiomatic plan is
+a *masked full-table scan*: one vectorized predicate over every slot (a few
+fused elementwise ops over columns already resident in HBM), then an order-by
+key sort to pick the top-``k`` matches.  There is no tree to descend and no
+index to maintain on the write path — the "index" is the predicate itself.
+Timestamps are unique per object (strictly-increasing assignment), so the sort
+key never ties and the result order is total, matching the reference's
+ascending/descending scan directions exactly.
+
+Sort-key encoding: matches get key ``ts`` (descending scans) or ``~ts``
+(ascending scans — bitwise complement flips the order); non-matches get 0,
+which is below every valid key because object timestamps are >= 1
+(lsm/timestamp_range.zig:4-5).  ``argsort`` ascending + take-last-k yields the
+top-k in result order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import state_machine as sm
+
+
+def _top_k(key: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Indices of the k largest keys, largest first, plus their validity
+    (key != 0)."""
+    order = jnp.argsort(key)
+    top = order[-k:][::-1]
+    return top, key[top] != 0
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def scan_transfers(
+    ledger: sm.Ledger,
+    acct_lo: jax.Array,
+    acct_hi: jax.Array,
+    ts_min: jax.Array,
+    ts_max: jax.Array,
+    want_debits: jax.Array,
+    want_credits: jax.Array,
+    descending: jax.Array,
+    k: int,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Transfers where the account is on the filtered side(s), timestamp in
+    [ts_min, ts_max], ordered by timestamp, first ``k``.
+
+    Returns (valid[k], rows dict incl. id_lo/id_hi); rows beyond the match
+    count have valid=False.
+    """
+    t = ledger.transfers
+    live = ((t.key_lo != 0) | (t.key_hi != 0)) & ~t.tombstone
+    ts = t.cols["timestamp"]
+    on_debit = (
+        want_debits
+        & (t.cols["debit_account_id_lo"] == acct_lo)
+        & (t.cols["debit_account_id_hi"] == acct_hi)
+    )
+    on_credit = (
+        want_credits
+        & (t.cols["credit_account_id_lo"] == acct_lo)
+        & (t.cols["credit_account_id_hi"] == acct_hi)
+    )
+    match = live & (on_debit | on_credit) & (ts >= ts_min) & (ts <= ts_max)
+    key = jnp.where(match, jnp.where(descending, ts, ~ts), jnp.uint64(0))
+    top, valid = _top_k(key, k)
+    rows = {name: col[top] for name, col in t.cols.items()}
+    rows["id_lo"] = t.key_lo[top]
+    rows["id_hi"] = t.key_hi[top]
+    return valid, rows
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def scan_history(
+    ledger: sm.Ledger,
+    acct_lo: jax.Array,
+    acct_hi: jax.Array,
+    ts_min: jax.Array,
+    ts_max: jax.Array,
+    want_debits: jax.Array,
+    want_credits: jax.Array,
+    descending: jax.Array,
+    k: int,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """AccountBalance rows for one history-flagged account, side-selected the
+    way execute_get_account_history does (state_machine.zig:1149-1195).
+
+    The reference drives this query off the *transfers* debit/credit index
+    scans (get_scan_from_filter, :823-892), so the filter's DEBITS/CREDITS
+    flags select which side's rows appear — mirrored here by gating is_dr /
+    is_cr on the side flags."""
+    h = ledger.history
+    slot = jnp.arange(h.capacity, dtype=jnp.uint64)
+    live = slot < h.count
+    # A zeroed side id never matches: account_id 0 is filter-invalid upstream.
+    is_dr = want_debits & (h.cols["dr_id_lo"] == acct_lo) & (h.cols["dr_id_hi"] == acct_hi)
+    is_cr = want_credits & (h.cols["cr_id_lo"] == acct_lo) & (h.cols["cr_id_hi"] == acct_hi)
+    ts = h.cols["timestamp"]
+    match = live & (is_dr | is_cr) & (ts >= ts_min) & (ts <= ts_max)
+    key = jnp.where(match, jnp.where(descending, ts, ~ts), jnp.uint64(0))
+    top, valid = _top_k(key, k)
+
+    side_dr = is_dr[top]
+    rows = {"timestamp": ts[top]}
+    for field, short in (
+        ("debits_pending", "dp"), ("debits_posted", "dpo"),
+        ("credits_pending", "cp"), ("credits_posted", "cpo"),
+    ):
+        for half in ("lo", "hi"):
+            rows[f"{field}_{half}"] = jnp.where(
+                side_dr,
+                h.cols[f"dr_{short}_{half}"][top],
+                h.cols[f"cr_{short}_{half}"][top],
+            )
+    return valid, rows

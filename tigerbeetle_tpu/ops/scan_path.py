@@ -38,6 +38,7 @@ from .state_machine import (
     ACCOUNT_COLS,
     AF_CREDITS_MUST_NOT_EXCEED_DEBITS,
     AF_DEBITS_MUST_NOT_EXCEED_CREDITS,
+    AF_HISTORY,
     AF_PADDING,
     Ledger,
     MAX_PROBE,
@@ -200,6 +201,7 @@ def create_transfers_seq(
         "acc_vals": jnp.zeros((n, 2, 8), jnp.uint64),
         "tr_slot": jnp.full((n,), sent, jnp.uint64),
         "posted_slot": jnp.full((n,), sent, jnp.uint64),
+        "hist": jnp.zeros((n,), jnp.bool_),
     }
 
     def step(carry, x):
@@ -233,6 +235,7 @@ def create_transfers_seq(
             "acc_vals": undo["acc_vals"].at[i].set(undo_entry["acc_vals"]),
             "tr_slot": undo["tr_slot"].at[i].set(undo_entry["tr_slot"]),
             "posted_slot": undo["posted_slot"].at[i].set(undo_entry["posted_slot"]),
+            "hist": undo["hist"].at[i].set(undo_entry["hist"]),
         }
 
         # Chain break -> rollback chain_start..i-1 in reverse
@@ -265,6 +268,15 @@ def create_transfers_seq(
                 p_slot = undo["posted_slot"][idx]
                 led = led.replace(
                     posted=_tombstone(led.posted, p_slot, p_slot < sent)
+                )
+                # Pop the history append (the rolled-back row falls outside
+                # the live window; the groove scope_close analogue,
+                # state_machine.zig:981-996).
+                led = led.replace(
+                    history=led.history.replace(
+                        count=led.history.count
+                        - undo["hist"][idx].astype(jnp.uint64)
+                    )
                 )
                 return led
 
@@ -516,6 +528,12 @@ def _transfer_logic(ledger: Ledger, ev, ev_ts, batch_ts):
         "postvoid": postvoid,
         "posted_key": p_ts,
         "posted_val": jnp.where(post, jnp.uint32(1), jnp.uint32(2)),
+        # History recording inputs (state_machine.zig:1342-1364).
+        "dr_id": dr_id,
+        "cr_id": cr_id,
+        "dr_hist": (dr["flags"] & AF_HISTORY) != 0,
+        "cr_hist": (cr["flags"] & AF_HISTORY) != 0,
+        "ev_ts": ev_ts,
     }
     return code, effects
 
@@ -546,6 +564,37 @@ def _apply_transfer(ledger: Ledger, eff, ok):
         {"fulfillment": eff["posted_val"]},
     )
 
+    # History append (state_machine.zig:1342-1364): regular path only, when
+    # either account carries the history flag.  Sides without the flag stay
+    # zeroed (std.mem.zeroInit there).
+    h = ledger.history
+    do_hist = ok & ~eff["postvoid"] & (eff["dr_hist"] | eff["cr_hist"])
+    cap = jnp.uint64(h.capacity)
+    # Append at count; the host guarantees capacity headroom before the batch
+    # (machine.py grows the log), so count < cap whenever do_hist fires.
+    h_idx = jnp.where(do_hist, jnp.minimum(h.count, cap), cap)  # cap -> dropped
+    hist_row = {"timestamp": eff["ev_ts"]}
+    for prefix, on, id128, bal in (
+        ("dr", eff["dr_hist"], eff["dr_id"], eff["new_dr"]),
+        ("cr", eff["cr_hist"], eff["cr_id"], eff["new_cr"]),
+    ):
+        z = jnp.uint64(0)
+        hist_row[f"{prefix}_id_lo"] = jnp.where(on, id128.lo, z)
+        hist_row[f"{prefix}_id_hi"] = jnp.where(on, id128.hi, z)
+        for short, field in (
+            ("dp", "debits_pending"), ("dpo", "debits_posted"),
+            ("cp", "credits_pending"), ("cpo", "credits_posted"),
+        ):
+            hist_row[f"{prefix}_{short}_lo"] = jnp.where(on, bal[field + "_lo"], z)
+            hist_row[f"{prefix}_{short}_hi"] = jnp.where(on, bal[field + "_hi"], z)
+    history = h.replace(
+        cols={
+            name: h.cols[name].at[h_idx].set(hist_row[name], mode="drop")
+            for name in h.cols
+        },
+        count=h.count + do_hist.astype(jnp.uint64),
+    )
+
     undo_entry = {
         "acc_slot": jnp.stack(
             [
@@ -556,8 +605,14 @@ def _apply_transfer(ledger: Ledger, eff, ok):
         "acc_vals": jnp.stack([eff["old_dr"], eff["old_cr"]]),
         "tr_slot": jnp.where(ok, t_slot, sent),
         "posted_slot": jnp.where(do_posted, p_slot, sent),
+        "hist": do_hist,
     }
-    return ledger.replace(accounts=accounts, transfers=transfers, posted=posted), undo_entry
+    return (
+        ledger.replace(
+            accounts=accounts, transfers=transfers, posted=posted, history=history
+        ),
+        undo_entry,
+    )
 
 
 def _exists_transfer_scalar(t, e):

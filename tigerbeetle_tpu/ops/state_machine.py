@@ -109,6 +109,66 @@ TF_PADDING = 0xFFC0
 NS_PER_S = 1_000_000_000
 
 
+# History rows mirror the reference's AccountHistoryGrooveValue
+# (state_machine.zig:275-294): post-update balances of the debit and credit
+# accounts of one committed transfer (sides zeroed unless that account carries
+# the HISTORY flag), keyed by the transfer's timestamp.
+HISTORY_COLS = {
+    name: jnp.uint64
+    for name in (
+        "dr_id_lo", "dr_id_hi",
+        "dr_dp_lo", "dr_dp_hi", "dr_dpo_lo", "dr_dpo_hi",
+        "dr_cp_lo", "dr_cp_hi", "dr_cpo_lo", "dr_cpo_hi",
+        "cr_id_lo", "cr_id_hi",
+        "cr_dp_lo", "cr_dp_hi", "cr_dpo_lo", "cr_dpo_hi",
+        "cr_cp_lo", "cr_cp_hi", "cr_cpo_lo", "cr_cpo_hi",
+        "timestamp",
+    )
+}
+
+
+@struct.dataclass
+class History:
+    """Append-only device log of history rows (the account_history groove,
+    state_machine.zig:108,275-294).  Slots [0, count) are live; appends write
+    at ``count`` and linked-chain rollback pops by decrementing it.  The log
+    never wraps: the host grows the arrays before a batch could overflow them
+    (grow_history), the way the reference's LSM absorbs unbounded inserts."""
+
+    cols: Dict[str, jax.Array]
+    count: jax.Array  # uint64 scalar
+
+    @property
+    def capacity(self) -> int:
+        return self.cols["timestamp"].shape[0]
+
+
+def make_history(capacity: int) -> History:
+    assert capacity & (capacity - 1) == 0
+    return History(
+        cols={name: jnp.zeros((capacity,), dt) for name, dt in HISTORY_COLS.items()},
+        count=jnp.uint64(0),
+    )
+
+
+def grow_history(history: History, min_capacity: int) -> History:
+    """Host-side capacity doubling (keeps power-of-two sizing)."""
+    cap = history.capacity
+    while cap < min_capacity:
+        cap *= 2
+    if cap == history.capacity:
+        return history
+    return History(
+        cols={
+            name: jnp.concatenate(
+                [col, jnp.zeros((cap - history.capacity,), col.dtype)]
+            )
+            for name, col in history.cols.items()
+        },
+        count=history.count,
+    )
+
+
 @struct.dataclass
 class Ledger:
     """The full device-resident ledger state."""
@@ -116,15 +176,20 @@ class Ledger:
     accounts: ht.Table
     transfers: ht.Table
     posted: ht.Table
+    history: History
 
 
 def make_ledger(
-    accounts_capacity: int, transfers_capacity: int, posted_capacity: int
+    accounts_capacity: int,
+    transfers_capacity: int,
+    posted_capacity: int,
+    history_capacity: int = 1 << 16,
 ) -> Ledger:
     return Ledger(
         accounts=ht.make_table(accounts_capacity, ACCOUNT_COLS),
         transfers=ht.make_table(transfers_capacity, TRANSFER_COLS),
         posted=ht.make_table(posted_capacity, POSTED_COLS),
+        history=make_history(history_capacity),
     )
 
 

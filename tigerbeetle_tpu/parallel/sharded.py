@@ -81,10 +81,20 @@ def make_sharded_ledger(
             probe_overflow=np.zeros((n,), np.bool_),
         )
 
+    # History stays empty on the sharded fast path (history-flagged accounts
+    # are excluded by precondition P1); it exists so the Ledger pytree is
+    # uniform.  One row per shard keeps every leaf shardable over axis 0.
     ledger = Ledger(
         accounts=table(accounts_capacity, ACCOUNT_COLS),
         transfers=table(transfers_capacity, TRANSFER_COLS),
         posted=table(posted_capacity, POSTED_COLS),
+        history=sm.History(
+            cols={
+                name: np.zeros((n,), dt)
+                for name, dt in sm.HISTORY_COLS.items()
+            },
+            count=np.zeros((n,), np.uint64),
+        ),
     )
     spec = NamedSharding(mesh, P(AXIS))
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, spec), ledger)

@@ -608,6 +608,78 @@ class ReferenceStateMachine:
     def lookup_transfers(self, ids: List[int]) -> List[Transfer]:
         return [self.transfers[i].copy() for i in ids if i in self.transfers]
 
+    # -- queries (state_machine.zig:693-892, 1128-1195) ----------------------
+
+    @staticmethod
+    def _filter_window(
+        account_id: int, ts_min: int, ts_max: int, limit: int, flags: int
+    ) -> Optional[Tuple[int, int, bool]]:
+        """get_scan_from_filter validity + effective window
+        (state_machine.zig:823-837)."""
+        valid = (
+            account_id not in (0, U128_MAX)
+            and ts_min != U64_MAX
+            and ts_max != U64_MAX
+            and (ts_max == 0 or ts_min <= ts_max)
+            and limit != 0
+            and flags & 0x3
+            and flags & ~0x7 == 0
+        )
+        if not valid:
+            return None
+        return (ts_min or 1, ts_max or U64_MAX - 1, bool(flags & 0x4))
+
+    def get_account_transfers(
+        self, account_id: int, ts_min: int, ts_max: int, limit: int, flags: int
+    ) -> List[Transfer]:
+        window = self._filter_window(account_id, ts_min, ts_max, limit, flags)
+        if window is None:
+            return []
+        lo, hi, descending = window
+        matches = [
+            t.copy()
+            for t in self.transfers.values()
+            if lo <= t.timestamp <= hi
+            and (
+                (flags & 0x1 and t.debit_account_id == account_id)
+                or (flags & 0x2 and t.credit_account_id == account_id)
+            )
+        ]
+        matches.sort(key=lambda t: t.timestamp, reverse=descending)
+        return matches[:limit]
+
+    def get_account_history(
+        self, account_id: int, ts_min: int, ts_max: int, limit: int, flags: int
+    ) -> List[Tuple[int, int, int, int, int]]:
+        """(timestamp, dp, dpo, cp, cpo) rows, side-selected
+        (execute_get_account_history, state_machine.zig:1149-1195)."""
+        window = self._filter_window(account_id, ts_min, ts_max, limit, flags)
+        if window is None:
+            return []
+        acct = self.accounts.get(account_id)
+        if acct is None or not (acct.flags & AccountFlags.HISTORY):
+            return []
+        lo, hi, descending = window
+        rows = []
+        for ts in sorted(self.history, reverse=descending):
+            if not lo <= ts <= hi:
+                continue
+            h = self.history[ts]
+            # Side selection honors the DEBITS/CREDITS flags: the reference
+            # resolves history rows through the transfers debit/credit index
+            # scans (get_scan_from_filter, state_machine.zig:823-892).
+            if flags & 0x1 and h["dr_account_id"] == account_id:
+                rows.append((
+                    ts, h["dr_debits_pending"], h["dr_debits_posted"],
+                    h["dr_credits_pending"], h["dr_credits_posted"],
+                ))
+            elif flags & 0x2 and h["cr_account_id"] == account_id:
+                rows.append((
+                    ts, h["cr_debits_pending"], h["cr_debits_posted"],
+                    h["cr_credits_pending"], h["cr_credits_posted"],
+                ))
+        return rows[:limit]
+
     # -- convenience entry points -------------------------------------------
 
     def create_accounts(self, events: List[Account], wall_clock_ns: int = 0):

@@ -68,6 +68,9 @@ def save(
     arrays: Dict[str, np.ndarray] = {}
     for name in TABLE_NAMES:
         _table_arrays(name, getattr(ledger, name), arrays)
+    for name, col in ledger.history.cols.items():
+        arrays[f"history/cols/{name}"] = np.asarray(col)
+    arrays["history/count"] = np.asarray(ledger.history.count)
     arrays["meta"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8
     ).copy()
@@ -110,6 +113,18 @@ def load(
         accounts=_load_table("accounts", z),
         transfers=_load_table("transfers", z),
         posted=_load_table("posted", z),
+        # Snapshots written before the history groove existed load as an
+        # empty log (grown on demand by the machine).
+        history=sm.History(
+            cols={
+                key[len("history/cols/"):]: jnp.asarray(z[key])
+                for key in z.files
+                if key.startswith("history/cols/")
+            },
+            count=jnp.asarray(z["history/count"]),
+        )
+        if "history/count" in z.files
+        else sm.make_history(1),
     )
     meta = json.loads(bytes(z["meta"]).decode()) if "meta" in z.files else {}
     return ledger, meta

@@ -319,6 +319,19 @@ class Replica:
         if operation == wire.Operation.lookup_transfers:
             ids = _decode_ids(body)
             return self.machine.lookup_transfers(ids).tobytes()
+        if operation in (
+            wire.Operation.get_account_transfers,
+            wire.Operation.get_account_history,
+        ):
+            filt = _decode_filter(body)
+            rows = (
+                self.machine.get_account_transfers(filt)
+                if operation == wire.Operation.get_account_transfers
+                else self.machine.get_account_history(filt)
+            )
+            # Reply rows are 128 B each; cap to one message body
+            # (scan_buffer sizing, state_machine.zig:697-712).
+            return rows[: self.config.message_body_size_max // 128].tobytes()
         raise ValueError(f"unimplemented operation {operation}")
 
     def _validate_request(self, operation: wire.Operation, body: bytes) -> None:
@@ -348,6 +361,15 @@ class Replica:
             # in one message (state_machine.zig:70-75 batch_max semantics).
             if len(body) // 16 > max_body // 128:
                 raise InvalidRequest("lookup batch exceeds reply capacity")
+            return
+        if operation in (
+            wire.Operation.get_account_transfers,
+            wire.Operation.get_account_history,
+        ):
+            # Any size is accepted: a body that is not exactly one
+            # AccountFilter is treated as a zeroed (invalid) filter and
+            # yields an empty reply (parse_filter_from_input,
+            # state_machine.zig:810-820).
             return
         raise InvalidRequest(f"operation {operation!r} not accepted")
 
@@ -470,6 +492,14 @@ def _encode_results(results: List[Tuple[int, int]]) -> bytes:
         arr[i]["index"] = index
         arr[i]["result"] = result
     return arr.tobytes()
+
+
+def _decode_filter(body: bytes) -> np.void:
+    """AccountFilter from a request body; wrong-size bodies become a zeroed
+    (hence invalid -> empty-reply) filter (state_machine.zig:810-820)."""
+    if len(body) == types.ACCOUNT_FILTER_DTYPE.itemsize:
+        return np.frombuffer(body, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
+    return np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
 
 
 def _decode_ids(body: bytes) -> List[int]:
