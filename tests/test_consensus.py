@@ -1,0 +1,254 @@
+"""Multi-replica VSR consensus under the deterministic simulator.
+
+Scenario tests in the spirit of the reference's replica_test.zig: scripted
+clusters driving the production consensus code over the packet simulator.
+"""
+
+import pytest
+
+from tigerbeetle_tpu.sim import PacketSimulator, SimCluster
+from tigerbeetle_tpu.vsr.consensus import NORMAL, quorums
+
+
+def make_cluster(tmp_path, seed=1, n=3, clients=2, requests=6, **net_kw):
+    net = PacketSimulator(seed=seed + 1, **net_kw)
+    return SimCluster(
+        str(tmp_path),
+        n_replicas=n,
+        n_clients=clients,
+        seed=seed,
+        requests_per_client=requests,
+        net=net,
+    )
+
+
+def finish(cluster, max_ticks=30_000):
+    ok = cluster.run_until(
+        lambda: cluster.clients_done() and cluster.converged(),
+        max_ticks=max_ticks,
+    )
+    assert ok, (
+        f"no convergence: statuses="
+        f"{[(r.status, r.view, r.commit_min, r.op) if r else None for r in cluster.replicas]} "
+        f"clients={[(c.requests_done, c.inflight is not None) for c in cluster.clients.values()]}"
+    )
+    cluster.check_converged()
+    cluster.check_conservation()
+
+
+def test_quorums():
+    assert quorums(1) == (1, 1)
+    assert quorums(2) == (2, 2)
+    assert quorums(3) == (2, 2)
+    assert quorums(4) == (2, 3)
+    assert quorums(5) == (3, 3)
+    assert quorums(6) == (3, 4)
+
+
+def test_normal_operation_r3(tmp_path):
+    """Happy path: 3 replicas, 2 clients, no faults."""
+    cluster = make_cluster(tmp_path, seed=11)
+    finish(cluster)
+    assert all(c.requests_done == 6 for c in cluster.clients.values())
+    # Commits actually replicated: every live replica executed them.
+    assert cluster.replicas[0].commit_min > 0
+
+
+def test_normal_operation_r2(tmp_path):
+    cluster = make_cluster(tmp_path, seed=12, n=2, clients=1)
+    finish(cluster)
+
+
+def test_lossy_network(tmp_path):
+    """10% packet loss + replay: retransmits and repair must cover."""
+    cluster = make_cluster(
+        tmp_path, seed=13, loss_probability=0.10, replay_probability=0.05,
+    )
+    finish(cluster, max_ticks=60_000)
+
+
+def test_backup_crash_restart(tmp_path):
+    """A backup crashes mid-workload and restarts: must catch up via
+    repair/WAL and re-converge."""
+    cluster = make_cluster(tmp_path, seed=14, requests=8)
+    cluster.run(600)
+    backup = (cluster.replicas[0].view + 1) % 3 if cluster.replicas[0] else 1
+    # Crash whichever replica is not primary.
+    primary = cluster.replicas[0].primary_index()
+    backup = (primary + 1) % 3
+    cluster.crash(backup)
+    cluster.run(800)
+    cluster.restart(backup)
+    finish(cluster, max_ticks=60_000)
+
+
+def test_primary_crash_view_change(tmp_path):
+    """Primary crashes: backups view-change and continue; the old primary
+    restarts and rejoins the new view."""
+    cluster = make_cluster(tmp_path, seed=15, requests=8)
+    cluster.run(600)
+    primary = next(
+        r.primary_index() for r in cluster.replicas if r is not None
+    )
+    cluster.crash(primary)
+    # Backups must elect a new primary and keep serving.
+    ok = cluster.run_until(
+        lambda: any(
+            a and r.status == NORMAL and r.view > 0
+            for r, a in zip(cluster.replicas, cluster.alive)
+        ),
+        max_ticks=20_000,
+    )
+    assert ok, "view change did not complete"
+    cluster.run(500)
+    cluster.restart(primary)
+    finish(cluster, max_ticks=60_000)
+
+
+def test_partition_minority_primary(tmp_path):
+    """Partition the primary away: majority side elects a new primary;
+    after healing, the old primary adopts the new view."""
+    cluster = make_cluster(tmp_path, seed=16, requests=8)
+    cluster.run(600)
+    primary = next(
+        r.primary_index() for r in cluster.replicas if r is not None
+    )
+    others = [i for i in range(3) if i != primary]
+    cluster.partition([[primary], others])
+    ok = cluster.run_until(
+        lambda: any(
+            a and r.status == NORMAL and r.view % 3 != primary
+            for r, a in zip(cluster.replicas, cluster.alive)
+        ),
+        max_ticks=20_000,
+    )
+    assert ok, "majority did not elect a new primary"
+    cluster.heal()
+    finish(cluster, max_ticks=60_000)
+
+
+def test_wal_corruption_repair(tmp_path):
+    """Corrupt one backup's WAL prepare slot: repair fetches it from peers
+    (journal.zig Protocol-Aware Recovery + replica repair protocol)."""
+    cluster = make_cluster(tmp_path, seed=17, requests=6)
+    ok = cluster.run_until(cluster.clients_done, max_ticks=30_000)
+    assert ok
+    primary = next(
+        r.primary_index() for r in cluster.replicas if r is not None
+    )
+    victim = (primary + 1) % 3
+    # Corrupt a committed op's slot, then force a restart so recovery sees it.
+    op = max(1, cluster.replicas[victim].commit_min - 1)
+    slot = op % cluster.config.journal_slot_count
+    cluster.crash(victim)
+    cluster.storages[victim].corrupt_wal_slot(slot, "prepares")
+    cluster.restart(victim)
+    finish(cluster, max_ticks=60_000)
+
+
+def test_checkpoint_under_consensus(tmp_path):
+    """Enough commits to cross the checkpoint interval (23 in TEST_MIN):
+    every replica durably checkpoints and the cluster stays converged."""
+    cluster = make_cluster(tmp_path, seed=18, clients=2, requests=16)
+    finish(cluster, max_ticks=90_000)
+    assert all(
+        r.op_checkpoint > 0 for r, a in zip(cluster.replicas, cluster.alive) if a
+    ), "no replica checkpointed"
+
+
+def test_state_sync_lagging_replica(tmp_path):
+    """A backup down long enough that the cluster checkpoints beyond its WAL
+    head must catch up via state sync (vsr/sync.zig), not WAL repair."""
+    cluster = make_cluster(tmp_path, seed=19, clients=2, requests=24)
+    cluster.run(100)
+    primary = next(
+        r.primary_index() for r in cluster.replicas if r is not None
+    )
+    victim = (primary + 1) % 3
+    head_at_crash = cluster.replicas[victim].op
+    cluster.crash(victim)
+    # Let the rest of the cluster commit past a checkpoint interval.
+    ok = cluster.run_until(
+        lambda: any(
+            a and r.op_checkpoint > head_at_crash
+            for r, a in zip(cluster.replicas, cluster.alive)
+        ),
+        max_ticks=90_000,
+    )
+    assert ok, "cluster never checkpointed past the victim's head"
+    cluster.restart(victim)
+    finish(cluster, max_ticks=90_000)
+    assert cluster.replicas[victim].op_checkpoint > head_at_crash, (
+        "victim did not adopt a newer checkpoint"
+    )
+
+
+def test_wal_corruption_after_view_change(tmp_path):
+    """Repair responses carry the view the op was *prepared* in; a backup
+    repairing after a view change must accept those old-view prepares."""
+    cluster = make_cluster(tmp_path, seed=21, requests=8)
+    cluster.run(600)
+    primary = next(
+        r.primary_index() for r in cluster.replicas if r is not None
+    )
+    # Force a view change by crashing the primary.
+    cluster.crash(primary)
+    ok = cluster.run_until(
+        lambda: any(
+            a and r.status == NORMAL and r.view > 0
+            for r, a in zip(cluster.replicas, cluster.alive)
+        ),
+        max_ticks=20_000,
+    )
+    assert ok
+    cluster.restart(primary)
+    cluster.run(500)
+    # Now corrupt an old-view committed op on a backup and restart it.
+    new_primary = next(
+        r.primary_index()
+        for r, a in zip(cluster.replicas, cluster.alive)
+        if a and r.status == NORMAL
+    )
+    victim = next(i for i in range(3) if i != new_primary)
+    op = 2  # committed in view 0
+    slot = op % cluster.config.journal_slot_count
+    cluster.crash(victim)
+    cluster.storages[victim].corrupt_wal_slot(slot, "prepares")
+    cluster.restart(victim)
+    finish(cluster, max_ticks=90_000)
+
+
+def test_state_sync_beyond_wal_ring(tmp_path):
+    """A backup down while the cluster commits more than a full journal ring
+    (64 slots in TEST_MIN): peers no longer hold its missing ops, so only
+    state sync can bring it back."""
+    cluster = make_cluster(tmp_path, seed=22, clients=2, requests=40)
+    cluster.run(100)
+    primary = next(
+        r.primary_index() for r in cluster.replicas if r is not None
+    )
+    victim = (primary + 1) % 3
+    head_at_crash = cluster.replicas[victim].op
+    cluster.crash(victim)
+    slots = cluster.config.journal_slot_count
+    ok = cluster.run_until(
+        lambda: any(
+            a and r.commit_min > head_at_crash + slots
+            for r, a in zip(cluster.replicas, cluster.alive)
+        ),
+        max_ticks=120_000,
+    )
+    assert ok, "cluster never committed past a full WAL ring"
+    cluster.restart(victim)
+    finish(cluster, max_ticks=120_000)
+    assert cluster.replicas[victim].op_checkpoint > head_at_crash
+
+
+def test_determinism_same_seed(tmp_path):
+    """Same seed => byte-identical final state (VOPR reproducibility)."""
+    a = make_cluster(tmp_path / "a", seed=42)
+    b = make_cluster(tmp_path / "b", seed=42)
+    finish(a)
+    finish(b)
+    assert a.replicas[0].machine.digest() == b.replicas[0].machine.digest()
+    assert a.replicas[0].commit_min == b.replicas[0].commit_min

@@ -1,0 +1,13 @@
+"""Deterministic cluster simulation (the VOPR, SURVEY §3.4/§4.2)."""
+
+from .cluster import SimClient, SimCluster, TICK_NS
+from .network import PacketSimulator
+from .storage import SimStorage
+
+__all__ = [
+    "PacketSimulator",
+    "SimClient",
+    "SimCluster",
+    "SimStorage",
+    "TICK_NS",
+]

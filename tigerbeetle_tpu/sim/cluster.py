@@ -1,0 +1,385 @@
+"""VOPR-style deterministic cluster simulation.
+
+The analogue of the reference simulator (src/simulator.zig, SURVEY §3.4):
+a full multi-replica cluster — the *production* consensus code
+(vsr/consensus.py), not a model of it — runs in one process on virtual time,
+over a seeded packet simulator (delays/loss/partitions, sim/network.py) and
+in-memory crash-faulting storage (sim/storage.py).  Simulated clients drive a
+seeded workload; the cluster can crash/restart/partition replicas at any
+tick.
+
+Oracles (src/testing/cluster/state_checker.zig):
+- StateChecker: after faults stop, every replica's (commit_min, ledger
+  digest) must converge — byte-level state determinism across replicas.
+- Reply coherence: a client must never observe two different replies for
+  the same request number (linearizability of the session protocol).
+- Conservation: in every converged ledger, total debits == total credits
+  (double-entry invariant over the whole cluster history).
+
+Everything is derived from ``seed``: two runs with the same seed and the
+same fault schedule are byte-identical (VOPR reproducibility, vopr.zig).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types
+from ..config import ClusterConfig, LedgerConfig, LEDGER_TEST, TEST_MIN
+from ..testing.workload import WorkloadGen
+from ..vsr import wire
+from ..vsr.consensus import NORMAL, VsrReplica
+from .network import PacketSimulator
+from .storage import SimStorage
+
+TICK_NS = 10_000_000  # one simulated tick = 10 ms
+WALL_EPOCH_NS = 1_700_000_000 * 1_000_000_000  # virtual wall clock base
+
+
+class SimClient:
+    """A simulated client: register, then a finite stream of workload
+    requests with retry/failover (vsr/client.zig semantics on virtual time)."""
+
+    def __init__(
+        self,
+        client_id: int,
+        cluster_id: int,
+        n_replicas: int,
+        seed: int,
+        n_requests: int = 10,
+        batch: int = 8,
+        retry_ticks: int = 80,
+    ) -> None:
+        self.client_id = client_id
+        self.cluster_id = cluster_id
+        self.n_replicas = n_replicas
+        self.rng = random.Random(seed)
+        self.workload = WorkloadGen(seed)
+        self.n_requests = n_requests
+        self.batch = batch
+        self.retry_ticks = retry_ticks
+
+        self.session = 0
+        self.request_number = 0
+        self.parent = 0
+        self.target = self.rng.randrange(n_replicas)
+        self.inflight: Optional[dict] = None
+        self.requests_done = 0
+        self.evicted = False
+        # request number -> reply header checksum (coherence oracle).
+        self.reply_log: Dict[int, int] = {}
+        self.results: List[Tuple[int, bytes]] = []
+
+    @property
+    def done(self) -> bool:
+        return self.evicted or (
+            self.requests_done >= self.n_requests and self.inflight is None
+        )
+
+    # -- request generation ---------------------------------------------------
+
+    def _next_request(self) -> Optional[Tuple[wire.Operation, bytes]]:
+        if self.session == 0:
+            return wire.Operation.register, b""
+        if self.requests_done >= self.n_requests:
+            return None
+        k = self.requests_done
+        if k == 0:
+            return (
+                wire.Operation.create_accounts,
+                self.workload.accounts_batch(self.batch).tobytes(),
+            )
+        if k % 5 == 4 and self.workload.account_ids:
+            ids = self.rng.sample(
+                self.workload.account_ids,
+                min(4, len(self.workload.account_ids)),
+            )
+            arr = np.zeros(2 * len(ids), dtype="<u8")
+            for i, v in enumerate(ids):
+                arr[2 * i] = v & 0xFFFF_FFFF_FFFF_FFFF
+                arr[2 * i + 1] = v >> 64
+            return wire.Operation.lookup_accounts, arr.tobytes()
+        return (
+            wire.Operation.create_transfers,
+            self.workload.transfers_batch(
+                self.batch, invalid_rate=0.1, dup_rate=0.1, pending_rate=0.2
+            ).tobytes(),
+        )
+
+    def tick(self, now: int) -> List[Tuple[Tuple[str, int], bytes]]:
+        if self.evicted:
+            return []
+        if self.inflight is not None:
+            if now - self.inflight["sent"] >= self.retry_ticks:
+                # Failover: rotate target and resend (client.zig reconnect).
+                self.target = (self.target + 1) % self.n_replicas
+                self.inflight["sent"] = now
+                return [(("replica", self.target), self.inflight["message"])]
+            return []
+        nxt = self._next_request()
+        if nxt is None:
+            return []
+        operation, body = nxt
+        h = wire.new_header(
+            wire.Command.request,
+            cluster=self.cluster_id,
+            client=self.client_id,
+            request=self.request_number,
+            parent=self.parent,
+            session=self.session,
+            operation=int(operation),
+        )
+        message = wire.encode(h, body)
+        request_checksum = wire.header_checksum(wire.decode_header(message)[0])
+        self.inflight = {
+            "message": message,
+            "checksum": request_checksum,
+            "operation": operation,
+            "sent": now,
+        }
+        return [(("replica", self.target), message)]
+
+    def on_message(
+        self, h: np.ndarray, command: wire.Command, body: bytes, now: int
+    ) -> None:
+        if command == wire.Command.eviction:
+            self.evicted = True
+            self.inflight = None
+            return
+        if command != wire.Command.reply:
+            return
+        request_n = int(h["request"])
+        # Coherence oracle: one logical outcome per request number, ever.
+        # Identity is (op, body checksum) — a post-view-change primary
+        # legitimately re-sends the reply with new view/replica header
+        # fields, but the assigned op and result bytes must never differ.
+        reply_identity = (int(h["op"]), wire.u128(h, "checksum_body"))
+        seen = self.reply_log.get(request_n)
+        assert seen is None or seen == reply_identity, (
+            f"client {self.client_id:#x}: two different replies for request "
+            f"{request_n}: {seen} vs {reply_identity}"
+        )
+        self.reply_log[request_n] = reply_identity
+        if self.inflight is None:
+            return
+        if wire.u128(h, "request_checksum") != self.inflight["checksum"]:
+            return  # stale reply
+        if self.inflight["operation"] == wire.Operation.register:
+            self.session = int(h["op"])
+            self.request_number = 1
+        else:
+            self.results.append((request_n, body))
+            self.requests_done += 1
+            self.request_number += 1
+        self.parent = self.inflight["checksum"]
+        self.inflight = None
+
+
+class SimCluster:
+    """N replicas + M clients on virtual time with injectable faults."""
+
+    def __init__(
+        self,
+        workdir: str,
+        n_replicas: int = 3,
+        n_clients: int = 2,
+        seed: int = 0,
+        cluster_id: int = 7,
+        requests_per_client: int = 8,
+        config: Optional[ClusterConfig] = None,
+        ledger_config: Optional[LedgerConfig] = None,
+        batch_lanes: int = 64,
+        net: Optional[PacketSimulator] = None,
+    ) -> None:
+        self.workdir = workdir
+        self.n = n_replicas
+        self.seed = seed
+        self.cluster_id = cluster_id
+        self.config = config or TEST_MIN
+        self.ledger_config = ledger_config or LEDGER_TEST
+        self.batch_lanes = batch_lanes
+        self.rng = random.Random(seed)
+        self.net = net or PacketSimulator(seed=seed + 1)
+        self.t = 0
+
+        # Per-replica wall-clock offsets (exercise the Marzullo clock).
+        self.wall_offsets = [
+            self.rng.randrange(-40, 40) * 1_000_000 for _ in range(self.n)
+        ]
+        self.storages = [
+            SimStorage(self.config, seed=seed * 101 + i) for i in range(self.n)
+        ]
+        self.replicas: List[Optional[VsrReplica]] = [None] * self.n
+        self.alive = [False] * self.n
+        for i in range(self.n):
+            VsrReplica.format(
+                self._data_path(i),
+                cluster=cluster_id,
+                replica=i,
+                replica_count=self.n,
+                cluster_config=self.config,
+                storage=self.storages[i],
+            )
+            self.storages[i].sync()
+            self.start(i)
+
+        self.clients = {
+            (seed * 1000 + 17 * (j + 1)) | 1: SimClient(
+                client_id=(seed * 1000 + 17 * (j + 1)) | 1,
+                cluster_id=cluster_id,
+                n_replicas=self.n,
+                seed=seed * 77 + j,
+                n_requests=requests_per_client,
+            )
+            for j in range(n_clients)
+        }
+
+    def _data_path(self, i: int) -> str:
+        return os.path.join(self.workdir, f"replica_{i}.data")
+
+    # -- replica lifecycle ----------------------------------------------------
+
+    def _make_replica(self, i: int) -> VsrReplica:
+        def monotonic(i=i):
+            return (self.t + 1) * TICK_NS
+
+        def realtime(i=i):
+            return WALL_EPOCH_NS + (self.t + 1) * TICK_NS + self.wall_offsets[i]
+
+        return VsrReplica(
+            self._data_path(i),
+            cluster_config=self.config,
+            ledger_config=self.ledger_config,
+            batch_lanes=self.batch_lanes,
+            storage=self.storages[i],
+            monotonic=monotonic,
+            realtime=realtime,
+            seed=self.seed * 31 + i,
+        )
+
+    def start(self, i: int) -> None:
+        assert not self.alive[i]
+        self.replicas[i] = self._make_replica(i)
+        self.replicas[i].open()
+        self.alive[i] = True
+
+    def crash(self, i: int) -> None:
+        """Kill a replica: unsynced storage writes may tear
+        (simulator.zig replica_crash_probability)."""
+        assert self.alive[i]
+        self.alive[i] = False
+        self.storages[i].crash()
+        self.replicas[i] = None
+
+    def restart(self, i: int) -> None:
+        self.start(i)
+
+    def partition(self, groups: List[List[int]]) -> None:
+        self.net.partition([[("replica", r) for r in g] for g in groups])
+
+    def heal(self) -> None:
+        self.net.heal()
+
+    # -- the tick loop (simulator.zig main loop) ------------------------------
+
+    def step(self) -> None:
+        self.t += 1
+        for src, dst, message in self.net.deliver(self.t):
+            kind, ident = dst
+            if kind == "replica":
+                if not self.alive[ident]:
+                    continue
+                try:
+                    h, command, body = wire.decode(message)
+                except ValueError:
+                    continue  # corrupt frame: dropped like a bad TCP peer
+                out = self.replicas[ident].on_message(h, command, body)
+                self._route(dst, out)
+            else:
+                client = self.clients.get(ident)
+                if client is None:
+                    continue
+                try:
+                    h, command, body = wire.decode(message)
+                except ValueError:
+                    continue
+                client.on_message(h, command, body, self.t)
+        for i in range(self.n):
+            if self.alive[i]:
+                self._route(("replica", i), self.replicas[i].tick())
+        for cid, client in self.clients.items():
+            self._route(("client", cid), client.tick(self.t))
+
+    def _route(self, src, envelopes) -> None:
+        for dst, message in envelopes:
+            self.net.send(src, dst, message, self.t)
+
+    def run(self, ticks: int) -> None:
+        for _ in range(ticks):
+            self.step()
+
+    # -- oracles --------------------------------------------------------------
+
+    def clients_done(self) -> bool:
+        return all(c.done for c in self.clients.values())
+
+    def converged(self) -> bool:
+        live = [r for r, a in zip(self.replicas, self.alive) if a]
+        if not live:
+            return False
+        if any(r.status != NORMAL for r in live):
+            return False
+        commits = {r.commit_min for r in live}
+        if len(commits) != 1:
+            return False
+        digests = {r.machine.digest() for r in live}
+        return len(digests) == 1
+
+    def check_converged(self) -> None:
+        """StateChecker: all live replicas at identical (commit_min, digest)."""
+        live = [
+            (i, r) for i, (r, a) in enumerate(zip(self.replicas, self.alive)) if a
+        ]
+        assert live, "no live replicas"
+        states = {
+            i: (r.commit_min, r.status, r.machine.digest()) for i, r in live
+        }
+        values = set(states.values())
+        assert len(values) == 1, f"replicas diverged: {states}"
+
+    def check_conservation(self) -> None:
+        """Double-entry invariant: Σ debits_posted == Σ credits_posted and
+        Σ debits_pending == Σ credits_pending over all accounts."""
+        for i, (r, a) in enumerate(zip(self.replicas, self.alive)):
+            if not a:
+                continue
+            acc = r.machine.ledger.accounts
+            live = (~np.asarray(acc.tombstone)) & (
+                (np.asarray(acc.key_lo) != 0) | (np.asarray(acc.key_hi) != 0)
+            )
+
+            def total(col_lo, col_hi):
+                lo = np.asarray(acc.cols[col_lo], dtype=np.uint64)[live]
+                hi = np.asarray(acc.cols[col_hi], dtype=np.uint64)[live]
+                return int(lo.astype(object).sum()) + (
+                    int(hi.astype(object).sum()) << 64
+                )
+
+            assert total("debits_posted_lo", "debits_posted_hi") == total(
+                "credits_posted_lo", "credits_posted_hi"
+            ), f"replica {i}: posted debits != credits"
+            assert total("debits_pending_lo", "debits_pending_hi") == total(
+                "credits_pending_lo", "credits_pending_hi"
+            ), f"replica {i}: pending debits != credits"
+
+    def run_until(self, predicate, max_ticks: int = 20_000, step: int = 50) -> bool:
+        for _ in range(0, max_ticks, step):
+            self.run(step)
+            if predicate():
+                return True
+        return False

@@ -1,0 +1,109 @@
+"""Packet simulator: seeded delays, loss, duplication, and partitions.
+
+The analogue of the reference's packet simulator
+(src/testing/packet_simulator.zig:10-62): every path (src, dst) carries
+messages with a seeded delay distribution; packets may be dropped or
+replayed; two-way partitions isolate groups of processes.  Deterministic
+under a fixed seed and send order.
+
+Addresses are opaque hashable process ids — the cluster uses
+``("replica", i)`` and ``("client", client_id)``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Dict, Hashable, List, Optional, Set, Tuple
+
+Addr = Tuple[str, int]
+
+
+class PacketSimulator:
+    def __init__(
+        self,
+        seed: int = 0,
+        delay_min: int = 1,
+        delay_mean: int = 3,
+        delay_max: int = 30,
+        loss_probability: float = 0.0,
+        replay_probability: float = 0.0,
+    ) -> None:
+        self.rng = random.Random(seed)
+        self.delay_min = delay_min
+        self.delay_mean = delay_mean
+        self.delay_max = delay_max
+        self.loss_probability = loss_probability
+        self.replay_probability = replay_probability
+        self._queue: List[Tuple[int, int, Addr, Addr, bytes]] = []
+        self._seq = 0
+        # Partition: mapping addr -> group id; cross-group packets drop.
+        # None = fully connected.  Clients are unaffected unless listed.
+        self._groups: Optional[Dict[Addr, int]] = None
+        self.sent = 0
+        self.dropped = 0
+        self.delivered = 0
+
+    # -- faults ---------------------------------------------------------------
+
+    def partition(self, groups: List[List[Addr]]) -> None:
+        """Install a partition: each inner list is an isolated island
+        (packet_simulator.zig partition modes)."""
+        self._groups = {}
+        for gid, members in enumerate(groups):
+            for addr in members:
+                self._groups[addr] = gid
+
+    def heal(self) -> None:
+        self._groups = None
+
+    def _blocked(self, src: Addr, dst: Addr) -> bool:
+        if self._groups is None:
+            return False
+        gs, gd = self._groups.get(src), self._groups.get(dst)
+        if gs is None or gd is None:
+            return False  # unlisted processes see everyone
+        return gs != gd
+
+    # -- traffic --------------------------------------------------------------
+
+    def send(self, src: Addr, dst: Addr, message: bytes, now: int) -> None:
+        self.sent += 1
+        if self._blocked(src, dst):
+            self.dropped += 1
+            return
+        if self.rng.random() < self.loss_probability:
+            self.dropped += 1
+            return
+        self._push(src, dst, message, now)
+        if self.rng.random() < self.replay_probability:
+            self._push(src, dst, message, now)  # duplicate delivery
+
+    def _push(self, src: Addr, dst: Addr, message: bytes, now: int) -> None:
+        extra = (
+            int(self.rng.expovariate(1.0 / (self.delay_mean - self.delay_min)))
+            if self.delay_mean > self.delay_min
+            else 0
+        )
+        delay = self.delay_min + min(extra, self.delay_max - self.delay_min)
+        self._seq += 1
+        heapq.heappush(
+            self._queue, (now + delay, self._seq, src, dst, message)
+        )
+
+    def deliver(self, now: int) -> List[Tuple[Addr, Addr, bytes]]:
+        """Pop all packets due at or before ``now`` (partition is checked
+        again at delivery: packets in flight when a partition forms drop)."""
+        out = []
+        while self._queue and self._queue[0][0] <= now:
+            _, _, src, dst, message = heapq.heappop(self._queue)
+            if self._blocked(src, dst):
+                self.dropped += 1
+                continue
+            self.delivered += 1
+            out.append((src, dst, message))
+        return out
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._queue)

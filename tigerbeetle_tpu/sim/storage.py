@@ -1,0 +1,92 @@
+"""Simulated storage: in-memory data file with crash/corruption fault injection.
+
+The analogue of the reference's testing storage (src/testing/storage.zig:1-25):
+an in-memory "disk" that survives replica restarts, models torn writes at
+crash time (writes since the last fsync may be lost, partially applied, or
+bit-flipped), and supports targeted corruption of WAL slots so repair paths
+can be exercised.  All randomness is seeded — a (seed, schedule) pair replays
+identically (VOPR determinism, SURVEY §4.2).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Tuple
+
+from ..config import ClusterConfig
+from ..vsr.storage import Layout
+
+
+class SimStorage:
+    """Drop-in for vsr.storage.Storage (read/write/sync/close + layout)."""
+
+    def __init__(self, config: Optional[ClusterConfig] = None, seed: int = 0):
+        self.config = config or ClusterConfig()
+        self.layout = Layout(self.config)
+        self.buf = bytearray(self.layout.total_size)
+        self.rng = random.Random(seed)
+        # Writes since the last sync: (offset, old_bytes) for crash rollback.
+        self.pending: List[Tuple[int, bytes]] = []
+        self.reads = 0
+        self.writes = 0
+        self.syncs = 0
+
+    # -- Storage interface ----------------------------------------------------
+
+    def read(self, offset: int, size: int) -> bytes:
+        assert offset + size <= self.layout.total_size
+        self.reads += 1
+        return bytes(self.buf[offset : offset + size])
+
+    def write(self, offset: int, data: bytes) -> None:
+        assert offset + len(data) <= self.layout.total_size
+        self.writes += 1
+        self.pending.append((offset, bytes(self.buf[offset : offset + len(data)])))
+        self.buf[offset : offset + len(data)] = data
+
+    def sync(self) -> None:
+        self.syncs += 1
+        self.pending.clear()
+
+    def close(self) -> None:
+        pass  # the "disk" outlives the process
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- fault injection ------------------------------------------------------
+
+    def crash(self, torn_probability: float = 0.5) -> None:
+        """Model power loss: each unsynced write is independently lost
+        entirely, torn (suffix reverted), or survives
+        (testing/storage.zig crash-time semantics)."""
+        for offset, old in reversed(self.pending):
+            r = self.rng.random()
+            if r < torn_probability / 2:
+                # Lost entirely.
+                self.buf[offset : offset + len(old)] = old
+            elif r < torn_probability:
+                # Torn: only a prefix of the write reached the platter.
+                keep = self.rng.randrange(len(old) + 1)
+                self.buf[offset + keep : offset + len(old)] = old[keep:]
+        self.pending.clear()
+
+    def corrupt(self, offset: int, size: int, flips: int = 8) -> None:
+        """Flip bits in [offset, offset+size) — models latent sector errors.
+        Callers must target repairable regions (the fault-atlas discipline:
+        never corrupt the same slot on a quorum, testing/storage.zig:1-25)."""
+        for _ in range(max(1, flips)):
+            i = offset + self.rng.randrange(size)
+            self.buf[i] ^= 1 << self.rng.randrange(8)
+
+    def corrupt_wal_slot(self, slot: int, ring: str = "prepares") -> None:
+        lay = self.layout
+        if ring == "prepares":
+            off = lay.wal_prepares_offset + slot * self.config.message_size_max
+            self.corrupt(off, self.config.message_size_max)
+        else:
+            off = lay.wal_headers_offset + slot * self.config.header_size
+            self.corrupt(off, self.config.header_size)

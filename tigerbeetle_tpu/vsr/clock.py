@@ -1,0 +1,163 @@
+"""Fault-tolerant cluster clock: Marzullo agreement over ping/pong offsets.
+
+Mirrors the reference clock (src/vsr/clock.zig:15+, src/vsr/marzullo.zig):
+each replica samples every peer's wall clock via ping/pong round trips; a
+sample bounds the peer's offset relative to our monotonic clock by
+``[offset - rtt/2, offset + rtt/2]``.  Marzullo's algorithm intersects the
+interval sets to find the smallest interval agreed on by a majority of
+remotes; the midpoint corrects our wall clock.  The primary refuses to assign
+timestamps until its clock is synchronized with a replication quorum
+(replica.zig:1322-1325), bounding cross-view timestamp skew.
+
+Epochs: samples age; after ``epoch_max_ns`` the window is re-armed from fresh
+samples so a remote's drift cannot accumulate (clock.zig epoch rotation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    """One remote's clock-offset bound (marzullo.zig Tuple)."""
+
+    lower: int  # ns
+    upper: int  # ns
+
+    def __post_init__(self):
+        assert self.lower <= self.upper
+
+
+def marzullo_smallest_interval(intervals: List[Interval]) -> Tuple[Interval, int]:
+    """Find the smallest interval consistent with the largest number of
+    sources (marzullo.zig:1+ ``smallest_interval``).
+
+    Returns (interval, sources_true): the best interval and how many source
+    intervals contain it.  Empty input yields a zero interval with 0 sources.
+    """
+    if not intervals:
+        return Interval(0, 0), 0
+    # Sweep over interval endpoints: +1 entering an interval, -1 leaving.
+    edges: List[Tuple[int, int]] = []
+    for iv in intervals:
+        edges.append((iv.lower, -1))  # -1 sorts "start" before "end" at ties
+        edges.append((iv.upper, +1))
+    edges.sort()
+    best = 0
+    count = 0
+    best_lower = edges[0][0]
+    best_upper = edges[0][0]
+    lower = 0
+    for offset, kind in edges:
+        if kind == -1:
+            count += 1
+            lower = offset
+        else:
+            # Closing an interval: [lower, offset] had `count` sources.
+            if count > best:
+                best = count
+                best_lower, best_upper = lower, offset
+            count -= 1
+    return Interval(best_lower, best_upper), best
+
+
+class Clock:
+    """Per-replica clock state (clock.zig ClockType).
+
+    ``monotonic()`` and ``realtime()`` come from the injected time source so
+    the simulator can drive virtual time deterministically.
+    """
+
+    def __init__(
+        self,
+        replica_count: int,
+        replica: int,
+        monotonic,
+        realtime,
+        epoch_max_ns: int = 60 * 1_000_000_000,
+        offset_tolerance_ns: int = 10 * 1_000_000_000,
+    ) -> None:
+        self.replica_count = replica_count
+        self.replica = replica
+        self.monotonic = monotonic
+        self.realtime = realtime
+        self.epoch_max_ns = epoch_max_ns
+        self.offset_tolerance_ns = offset_tolerance_ns
+        # Latest sample per remote replica: (monotonic_at_sample, Interval).
+        self.samples: Dict[int, Tuple[int, Interval]] = {}
+        self.epoch_start_monotonic = monotonic()
+        # Learned offset: realtime ≈ monotonic + offset.
+        self.offset_ns: Optional[int] = None
+        self._synchronized = False
+
+    # -- sampling (ping/pong round trips) ------------------------------------
+
+    def ping_timestamp(self) -> int:
+        """Monotonic timestamp to stamp into an outgoing ping."""
+        return self.monotonic()
+
+    def learn(self, remote: int, ping_monotonic: int, remote_realtime: int) -> None:
+        """Learn from a pong: we sent ping at ``ping_monotonic`` (our
+        monotonic), remote replied with its wall clock ``remote_realtime``
+        (clock.zig learn: one sample per round trip, rtt bounds the error)."""
+        if remote == self.replica:
+            return
+        m_now = self.monotonic()
+        rtt = m_now - ping_monotonic
+        if rtt < 0:
+            return  # time source misbehaved; drop sample
+        # Remote's wall clock was sampled somewhere inside the round trip;
+        # express as bounds on (their_realtime - our_monotonic).
+        mid = remote_realtime - (ping_monotonic + rtt // 2)
+        self.samples[remote] = (
+            m_now, Interval(mid - rtt // 2 - 1, mid + rtt // 2 + 1)
+        )
+        self._synchronize()
+
+    def _window_intervals(self) -> List[Interval]:
+        cutoff = self.monotonic() - self.epoch_max_ns
+        return [iv for (m, iv) in self.samples.values() if m >= cutoff]
+
+    def _synchronize(self) -> None:
+        """Re-run Marzullo over the sample window (clock.zig synchronize)."""
+        intervals = self._window_intervals()
+        # Our own clock is a source too: we believe realtime-monotonic with
+        # perfect confidence in our own frame (interval of width 0).
+        own = self.realtime() - self.monotonic()
+        intervals.append(Interval(own, own))
+        interval, sources = marzullo_smallest_interval(intervals)
+        # Quorum: a majority of the cluster must agree (clock.zig
+        # window_tuples quorum = replica_count majority).
+        quorum = self.replica_count // 2 + 1
+        if sources >= quorum:
+            self.offset_ns = (interval.lower + interval.upper) // 2
+            self._synchronized = (
+                interval.upper - interval.lower <= self.offset_tolerance_ns
+            )
+        else:
+            self._synchronized = self.replica_count == 1
+
+    @property
+    def realtime_synchronized(self) -> Optional[int]:
+        """Cluster-agreed wall time in ns, or None if not synchronized —
+        the primary drops requests in that state (replica.zig:1322-1325)."""
+        if self.replica_count == 1:
+            return self.realtime()
+        if not self._synchronized or self.offset_ns is None:
+            return None
+        return self.monotonic() + self.offset_ns
+
+    def tick(self) -> None:
+        """Expire stale epochs (clock.zig tick)."""
+        m = self.monotonic()
+        if m - self.epoch_start_monotonic > self.epoch_max_ns:
+            self.epoch_start_monotonic = m
+            stale = [
+                r for r, (sampled, _) in self.samples.items()
+                if sampled < m - self.epoch_max_ns
+            ]
+            for r in stale:
+                del self.samples[r]
+            self._synchronize()

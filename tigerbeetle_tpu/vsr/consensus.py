@@ -1,0 +1,1208 @@
+"""VSR consensus: the multi-replica message-driven participant.
+
+Mirrors the reference replica's consensus protocol (src/vsr/replica.zig):
+
+- Normal operation: the primary (``view % replica_count``) turns requests
+  into prepares (op + timestamp assigned, hash-chained — :1308-1337),
+  journals locally, and **ring-replicates**: each replica forwards the
+  prepare to the next replica in the ring so primary egress stays 1:1
+  (:1339-1363).  Backups journal and send prepare_ok to the primary; commit
+  happens at a replication quorum (:1469+), in op order, and the primary
+  replies to the client (:3678-3836).  Backups learn the commit number from
+  prepare headers and periodic commit heartbeats and execute via
+  commit_journal (:1591, :3176).
+- View change: a backup that stops hearing from the primary broadcasts
+  start_view_change for view+1; at a view-change quorum of SVCs each replica
+  sends do_view_change (carrying its journal-suffix headers) to the new
+  primary, which selects the canonical log — max (log_view, op) — repairs
+  any prepares it lacks, and broadcasts start_view (:1702-2013).  Backups
+  install the canonical suffix, repair missing bodies, and re-ack the
+  uncommitted suffix so it can commit in the new view.
+- Repair: request_prepare/request_headers fetch lost WAL entries from peers
+  (:2048-2497); a replica whose WAL no longer overlaps the cluster's
+  (primary checkpoint beyond its head) state-syncs the latest checkpoint
+  snapshot in message-sized chunks (vsr/sync.zig).
+- Clock: ping/pong round trips feed the Marzullo-filtered cluster clock
+  (clock.py); the primary refuses to assign timestamps while unsynchronized
+  (:1322-1325).
+
+The class is transport-agnostic and deterministic: ``on_message`` and
+``tick`` return ``(destination, bytes)`` envelopes; time comes from injected
+monotonic/realtime sources.  The TCP bus (net/) and the VOPR simulator
+(sim/) both drive this same code — the simulator's whole point (SURVEY §4.2)
+is that the production consensus path is what gets fault-injected.
+
+Quorums are flexible (vsr.zig:910-986): replication and view-change quorums
+need only intersect, so e.g. a 6-replica cluster commits at 3 and
+view-changes at 4 (docs/deploy/hardware.md:29-40).
+
+Divergence from the reference, by design: view/log_view are persisted to the
+superblock on view change via a quorum write of the full superblock state
+(the reference journals view headers separately); and a replica recovering
+from restart re-joins via request_start_view instead of a dedicated
+recovering_head protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from . import checkpoint as checkpoint_mod
+from . import wire
+from .clock import Clock
+from .replica import InvalidRequest, Replica, Session
+from .superblock import SuperBlockState
+
+# An outbound envelope: (("replica", index) | ("client", client_id), bytes).
+Dst = Tuple[str, int]
+Msg = Tuple[Dst, bytes]
+
+NORMAL = "normal"
+VIEW_CHANGE = "view_change"
+RECOVERING = "recovering"
+SYNCING = "syncing"
+
+# Timeout cadences in ticks (a tick is ~10 ms wall / 1 step simulated;
+# values mirror the reference's relative cadences, vsr.zig:543-712).
+PING_INTERVAL = 25
+COMMIT_HEARTBEAT = 10
+PREPARE_RESEND = 15
+NORMAL_HEARTBEAT = 100       # backup: primary presumed dead after this
+VIEW_CHANGE_RESEND = 25      # SVC/DVC re-broadcast while in view change
+VIEW_CHANGE_ESCALATE = 200   # stuck view change: try the next view
+RECOVERING_RESEND = 30       # request_start_view cadence while recovering
+REPAIR_INTERVAL = 15
+SYNC_RESEND = 30
+
+
+def quorums(replica_count: int) -> Tuple[int, int]:
+    """(quorum_replication, quorum_view_change) — flexible quorums that
+    always intersect (vsr.zig:910-986): 1/1, 2/2, 2/2, 2/3, 3/3, 3/4."""
+    if replica_count == 1:
+        return 1, 1
+    majority = replica_count // 2 + 1
+    q_replication = max(2, replica_count + 1 - majority)
+    q_view_change = max(majority, replica_count + 1 - q_replication)
+    assert q_replication + q_view_change > replica_count
+    return q_replication, q_view_change
+
+
+@dataclasses.dataclass
+class PipelineEntry:
+    """One in-flight prepare at the primary (replica.zig PipelineQueue)."""
+
+    op: int
+    checksum: int
+    client: int                 # 0 for re-certified view-change suffix ops
+    ok_from: Set[int] = dataclasses.field(default_factory=set)
+
+
+class VsrReplica(Replica):
+    """A full consensus participant; see module docstring."""
+
+    def __init__(
+        self,
+        data_path: str,
+        *,
+        monotonic=None,
+        realtime=None,
+        seed: int = 0,
+        **kwargs,
+    ) -> None:
+        import time as _time
+
+        realtime = realtime or _time.time_ns
+        monotonic = monotonic or _time.monotonic_ns
+        super().__init__(data_path, time_ns=realtime, **kwargs)
+        self._monotonic = monotonic
+        self._realtime = realtime
+        self.status = RECOVERING
+        self.log_view = 0
+        self.commit_max = 0
+        self.prng = random.Random(seed)
+
+        # Journaled prepare headers by op for the live window (chain checks,
+        # repair responses, DVC/SV bodies).  Pruned at checkpoint.
+        self.headers: Dict[int, np.ndarray] = {}
+        # Out-of-order prepares waiting for the chain to catch up.
+        self.stash: Dict[int, Tuple[np.ndarray, bytes]] = {}
+        # Ops whose canonical header is installed but whose body is missing.
+        self.missing: Dict[int, int] = {}  # op -> expected header checksum
+
+        self.pipeline: Dict[int, PipelineEntry] = {}
+        self.svc_from: Dict[int, Set[int]] = {}
+        self.dvc_from: Dict[int, Dict[int, dict]] = {}
+        self._dvc_sent_for: Optional[int] = None
+        self._new_view_pending: Optional[int] = None
+        self._pending_finish: Optional[int] = None
+
+        # Sync state (lagging replica fetching a checkpoint snapshot).
+        self.sync_target: Optional[dict] = None
+        self.sync_buffer = bytearray()
+
+        # Tick counters.  First ping fires on the first tick so the cluster
+        # clock synchronizes before the first client request.
+        self._ticks = 0
+        self._last_ping = -PING_INTERVAL
+        self._last_commit_sent = 0
+        self._last_prepare_resend = 0
+        self._last_primary_word = 0
+        self._last_vc_resend = 0
+        self._vc_started = 0
+        self._last_rsv = 0
+        self._last_repair = 0
+        self._last_sync_req = 0
+        self._heartbeat_jitter = 0
+        self._recovering_since = 0
+
+        self.clock: Optional[Clock] = None
+
+    # -- identity ------------------------------------------------------------
+
+    def primary_index(self, view: Optional[int] = None) -> int:
+        return (self.view if view is None else view) % self.replica_count
+
+    @property
+    def is_primary(self) -> bool:
+        return self.status == NORMAL and self.primary_index() == self.replica
+
+    @property
+    def quorum_replication(self) -> int:
+        return quorums(self.replica_count)[0]
+
+    @property
+    def quorum_view_change(self) -> int:
+        return quorums(self.replica_count)[1]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def open(self) -> None:
+        """Recover durable state; do NOT execute journaled-but-uncommitted
+        ops — a restarted replica must first learn commit_max from the
+        cluster (a journaled op may have been discarded by a view change
+        while we were down)."""
+        recovery = self._open_durable_state()
+        self.commit_max = self.commit_min
+        self.log_view = getattr(self._sb_state, "log_view", self.view)
+        self._load_chain(recovery)
+        self.clock = Clock(
+            self.replica_count, self.replica, self._monotonic, self._realtime
+        )
+        self.time_ns = self._primary_now
+        self._heartbeat_jitter = self.prng.randrange(NORMAL_HEARTBEAT // 2)
+        if self.replica_count == 1:
+            # Sole replica: everything chained is committed by definition.
+            self._replay_solo()
+            self.status = NORMAL
+        elif (
+            self.op == 0 and self.commit_min == 0 and self.view == 0
+            and self.log_view == 0
+        ):
+            # Freshly formatted cluster: nothing to recover, start normal
+            # (the reference's format-then-start path).
+            self.status = NORMAL
+        else:
+            self.status = RECOVERING
+            self._recovering_since = self._ticks
+
+    def _load_chain(self, recovery) -> None:
+        """Rebuild the in-memory hash chain from the WAL without executing:
+        sets self.op/parent_checksum/headers to the contiguous chained
+        suffix anchored at the checkpoint (cf. Replica._replay)."""
+        anchor = recovery.entries.get(self.commit_min)
+        if anchor is not None:
+            self.parent_checksum = wire.header_checksum(anchor.header)
+            self.headers[self.commit_min] = anchor.header
+        elif self.commit_min == 0:
+            raise RuntimeError("WAL: root prepare missing")
+        else:
+            self.parent_checksum = 0
+        self.op = self.commit_min
+        op = self.commit_min + 1
+        parent = self.parent_checksum
+        while op in recovery.entries:
+            entry = recovery.entries[op]
+            if entry.body is None:
+                break
+            if parent and wire.u128(entry.header, "parent") != parent:
+                break
+            self.headers[op] = entry.header
+            parent = wire.header_checksum(entry.header)
+            self.op = op
+            op += 1
+        if self.op > self.commit_min:
+            self.parent_checksum = wire.header_checksum(self.headers[self.op])
+
+    def _replay_solo(self) -> None:
+        """Single-replica replay: execute the whole chained suffix."""
+        for op in range(self.commit_min + 1, self.op + 1):
+            read = self.journal.read_prepare(op)
+            assert read is not None, op
+            h, body = read
+            self._commit_prepare(h, body, replay=True)
+        self.commit_max = self.commit_min
+
+    def _persist_view(self) -> None:
+        """Quorum-write view/log_view into the superblock so a restarted
+        replica never regresses its view (replica.zig view durability)."""
+        if self._sb_state is None:
+            return
+        state = dataclasses.replace(
+            self._sb_state, view=self.view, log_view=self.log_view
+        )
+        self.superblock.checkpoint(state)
+        self._sb_state = state
+
+    # -- message dispatch ----------------------------------------------------
+
+    def on_message(
+        self, h: np.ndarray, command: wire.Command, body: bytes
+    ) -> List[Msg]:
+        if wire.u128(h, "cluster") != self.cluster:
+            return []
+        handler = {
+            wire.Command.request: self.on_request_msg,
+            wire.Command.prepare: self.on_prepare,
+            wire.Command.prepare_ok: self.on_prepare_ok,
+            wire.Command.commit: self.on_commit,
+            wire.Command.start_view_change: self.on_start_view_change,
+            wire.Command.do_view_change: self.on_do_view_change,
+            wire.Command.start_view: self.on_start_view,
+            wire.Command.request_start_view: self.on_request_start_view,
+            wire.Command.request_headers: self.on_request_headers,
+            wire.Command.request_prepare: self.on_request_prepare,
+            wire.Command.headers: self.on_headers,
+            wire.Command.ping: self.on_ping,
+            wire.Command.pong: self.on_pong,
+            wire.Command.request_sync_checkpoint: self.on_request_sync_checkpoint,
+            wire.Command.sync_checkpoint: self.on_sync_checkpoint,
+        }.get(command)
+        if handler is None:
+            return []
+        return handler(h, body)
+
+    def _hdr(self, command: wire.Command, **fields) -> np.ndarray:
+        h = wire.new_header(
+            command, cluster=self.cluster, view=self.view, **fields
+        )
+        h["replica"] = self.replica
+        return h
+
+    def _broadcast(self, message: bytes) -> List[Msg]:
+        return [
+            (("replica", r), message)
+            for r in range(self.replica_count)
+            if r != self.replica
+        ]
+
+    # -- normal operation: client requests ----------------------------------
+
+    def on_request_msg(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        """Client request: primary prepares + replicates; backups forward to
+        the primary (replica.zig on_request :1308-1337)."""
+        if self.status != NORMAL:
+            return []
+        if not self.is_primary:
+            return [(("replica", self.primary_index()), wire.encode(h, body))]
+        if self.clock.realtime_synchronized is None:
+            return []  # drop: cannot assign timestamps (replica.zig:1322)
+        if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
+            return []  # pipeline full: client will retry
+
+        client = wire.u128(h, "client")
+        try:
+            operation = wire.Operation(int(h["operation"]))
+            self._validate_request(operation, body)
+        except (ValueError, InvalidRequest):
+            return []
+        request_n = int(h["request"])
+
+        session = self.sessions.get(client)
+        if operation != wire.Operation.register:
+            if session is None or int(h["session"]) != session.session:
+                return [(("client", client), self._eviction(client))]
+            if request_n == session.request and session.reply_bytes:
+                return [(("client", client), session.reply_bytes)]
+            if request_n <= session.request:
+                return []
+        elif session is not None:
+            if session.reply_bytes:
+                return [(("client", client), session.reply_bytes)]
+            return []
+        # Drop duplicates already being prepared in the pipeline.
+        for entry in self.pipeline.values():
+            if entry.client == client:
+                return []
+
+        prepare_h, prepare_body = self._prepare(h, body, operation)
+        op = int(prepare_h["op"])
+        self.headers[op] = prepare_h
+        self.pipeline[op] = PipelineEntry(
+            op=op,
+            checksum=wire.header_checksum(prepare_h),
+            client=client,
+            ok_from={self.replica},
+        )
+        out: List[Msg] = []
+        message = wire.encode(prepare_h, prepare_body)
+        successor = self._ring_successor()
+        if successor is not None:
+            out.append((("replica", successor), message))
+        self._maybe_commit_pipeline(out)
+        return out
+
+    def _primary_now(self) -> int:
+        now = self.clock.realtime_synchronized
+        assert now is not None
+        return now
+
+    def _ring_successor(self) -> Optional[int]:
+        """Next replica in the replication ring (replica.zig:1339-1363);
+        None when the ring would return to the primary."""
+        if self.replica_count == 1:
+            return None
+        nxt = (self.replica + 1) % self.replica_count
+        if nxt == self.primary_index():
+            return None
+        return nxt
+
+    # -- normal operation: replication ---------------------------------------
+
+    def on_prepare(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        view = int(h["view"])
+        op = int(h["op"])
+        checksum = wire.header_checksum(h)
+        out: List[Msg] = []
+
+        # Repair fills are VIEW-AGNOSTIC: a stored prepare keeps the view it
+        # was originally prepared in; its identity is its checksum / position
+        # in the hash chain, so responses to request_prepare must be accepted
+        # even when their header view predates ours (and even mid
+        # view-change — the new primary repairs canonical bodies then).
+        if op in self.missing and self.missing[op] == checksum:
+            self._fill_missing(h, body)
+            if self.status == NORMAL:
+                out.append(self._send_prepare_ok(h))
+                self._commit_journal(out)
+            return out
+
+        if view < self.view:
+            if self.status == NORMAL and op <= self.op:
+                existing = self.headers.get(op)
+                if existing is not None and (
+                    wire.header_checksum(existing) == checksum
+                ):
+                    # Duplicate of an adopted prepare (e.g. the new primary's
+                    # resend of a re-certified old-view suffix): re-ack in
+                    # the CURRENT view.
+                    out.append(self._send_prepare_ok(h))
+                elif existing is None and op > self.commit_min:
+                    self.stash[op] = (h, body)
+                    self._fill_gaps(out)
+            return out
+        if view > self.view or self.status == RECOVERING:
+            # We're behind a view change (or freshly restarted): stash and
+            # ask the new primary for start_view.
+            self.stash[op] = (h, body)
+            return self._request_start_view(view)
+        if self.status != NORMAL:
+            self.stash[op] = (h, body)
+            return []
+
+        self._last_primary_word = self._ticks
+        self.commit_max = max(self.commit_max, int(h["commit"]))
+
+        if op <= self.op:
+            existing = self.headers.get(op)
+            if existing is not None and wire.header_checksum(existing) == checksum:
+                out.append(self._send_prepare_ok(h))
+            elif existing is None and op > self.commit_min:
+                # Header-gap fill (e.g. a start_view whose header window did
+                # not reach back to our commit_min): verify DOWNWARD via the
+                # parent link of the next header before adopting.
+                self.stash[op] = (h, body)
+                self._fill_gaps(out)
+            return out
+
+        if op == self.op + 1 and wire.u128(h, "parent") == self.parent_checksum:
+            self._journal_prepare(h, body)
+            out.append(self._send_prepare_ok(h))
+            successor = self._ring_successor()
+            if successor is not None and successor != int(h["replica"]):
+                out.append((("replica", successor), wire.encode(h, body)))
+            self._drain_stash(out)
+            self._commit_journal(out)
+        else:
+            # Gap (lost prepare) or fork: stash and repair.
+            self.stash[op] = (h, body)
+            out.extend(self._repair_gaps())
+        return out
+
+    def _journal_prepare(self, h: np.ndarray, body: bytes) -> None:
+        self.journal.write_prepare(wire.encode(h, body))
+        self.headers[int(h["op"])] = h
+        self.op = int(h["op"])
+        self.parent_checksum = wire.header_checksum(h)
+
+    def _send_prepare_ok(self, prepare_h: np.ndarray) -> Msg:
+        ok = self._hdr(
+            wire.Command.prepare_ok,
+            parent=wire.u128(prepare_h, "parent"),
+            prepare_checksum=wire.header_checksum(prepare_h),
+            client=wire.u128(prepare_h, "client"),
+            op=int(prepare_h["op"]),
+            commit=self.commit_min,
+            timestamp=int(prepare_h["timestamp"]),
+            request=int(prepare_h["request"]),
+            operation=int(prepare_h["operation"]),
+        )
+        return (("replica", self.primary_index()), wire.encode(ok, b""))
+
+    def _drain_stash(self, out: List[Msg]) -> None:
+        """Chain in any stashed prepares that now fit."""
+        while self.op + 1 in self.stash:
+            h, body = self.stash.pop(self.op + 1)
+            if wire.u128(h, "parent") != self.parent_checksum:
+                break
+            self._journal_prepare(h, body)
+            out.append(self._send_prepare_ok(h))
+        # Prune committed stash entries (gap fills for ops <= self.op with
+        # unknown headers stay until _fill_gaps verifies them).
+        for op in [o for o in self.stash if o <= self.commit_min]:
+            del self.stash[op]
+
+    def _fill_gaps(self, out: List[Msg]) -> None:
+        """Adopt stashed prepares for header-gap ops, verifying each against
+        the parent link of the header above it (downward hash-chain walk),
+        then commit as far as possible."""
+        changed = True
+        while changed:
+            changed = False
+            for op in sorted(self.stash, reverse=True):
+                if op > self.op or op <= self.commit_min:
+                    continue
+                if self.headers.get(op) is not None:
+                    continue
+                nxt = self.headers.get(op + 1)
+                if nxt is None:
+                    continue
+                h, body = self.stash[op]
+                if wire.u128(nxt, "parent") == wire.header_checksum(h):
+                    self.journal.write_prepare(wire.encode(h, body))
+                    self.headers[op] = h
+                    del self.stash[op]
+                    out.append(self._send_prepare_ok(h))
+                    changed = True
+        self._commit_journal(out)
+
+    def _header_gaps(self, limit: int = 8) -> List[int]:
+        """Ops above commit_min with no known header (unrepairable via
+        `missing`, which needs a checksum).  Returns the HIGHEST ops of the
+        gap: adoption verifies downward from the known header above, so the
+        top of the gap must fill first."""
+        gaps = [
+            op
+            for op in range(self.commit_min + 1, self.op + 1)
+            if op not in self.headers
+        ]
+        return gaps[-limit:]
+
+    def on_prepare_ok(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if self.status != NORMAL or not self.is_primary:
+            return []
+        if int(h["view"]) != self.view:
+            return []
+        op = int(h["op"])
+        entry = self.pipeline.get(op)
+        if entry is None or entry.checksum != wire.u128(h, "prepare_checksum"):
+            return []
+        entry.ok_from.add(int(h["replica"]))
+        out: List[Msg] = []
+        self._maybe_commit_pipeline(out)
+        return out
+
+    def _maybe_commit_pipeline(self, out: List[Msg]) -> None:
+        """Commit pipeline entries in op order as quorums arrive."""
+        while True:
+            op = self.commit_min + 1
+            entry = self.pipeline.get(op)
+            if entry is None or len(entry.ok_from) < self.quorum_replication:
+                break
+            self.commit_max = max(self.commit_max, op)
+            self._commit_journal(out)
+            if self.commit_min < op:
+                break  # body missing (shouldn't happen at the primary)
+            self.pipeline.pop(op, None)
+
+    def on_commit(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        """Commit-number heartbeat from the primary (replica.zig :1591)."""
+        view = int(h["view"])
+        if view < self.view:
+            return []
+        if self.status == SYNCING:
+            # Keep the sync target fresh: if the primary checkpointed again
+            # mid-fetch, restart against the new snapshot (the responder
+            # only serves its exact current checkpoint).
+            new_ckpt = int(h["checkpoint_op"])
+            if self.sync_target is not None and (
+                new_ckpt > self.sync_target["checkpoint_op"]
+            ):
+                self.sync_target = {"checkpoint_op": new_ckpt, "total": None}
+                self.sync_buffer = bytearray()
+                self._last_sync_req = self._ticks
+                return self._request_sync_chunk()
+            return []
+        if view > self.view or self.status == RECOVERING:
+            return self._request_start_view(view)
+        if self.status != NORMAL or self.is_primary:
+            return []
+        self._last_primary_word = self._ticks
+        self.commit_max = max(self.commit_max, int(h["commit"]))
+        out: List[Msg] = []
+        self._commit_journal(out)
+        out.extend(self._maybe_start_sync(int(h["checkpoint_op"])))
+        return out
+
+    def _commit_journal(self, out: List[Msg]) -> None:
+        """Execute journaled ops up to min(commit_max, op), in order
+        (replica.zig commit_journal :3176)."""
+        while self.commit_min < min(self.commit_max, self.op):
+            op = self.commit_min + 1
+            h = self.headers.get(op)
+            if h is None:
+                break
+            read = self.journal.read_prepare(op)
+            if read is None or wire.header_checksum(read[0]) != (
+                wire.header_checksum(h)
+            ):
+                self.missing[op] = wire.header_checksum(h)
+                break
+            reply = self._commit_prepare(read[0], read[1], replay=False)
+            entry = self.pipeline.pop(op, None)
+            if self.is_primary and reply is not None:
+                client = wire.u128(read[0], "client")
+                if client:
+                    out.append((("client", client), reply))
+        if self._checkpoint_due():
+            self.checkpoint()
+            self._prune_headers()
+
+    def _prune_headers(self) -> None:
+        floor = self.op_checkpoint - 1
+        for op in [o for o in self.headers if o < floor]:
+            del self.headers[op]
+
+    # -- view change ---------------------------------------------------------
+
+    def _begin_view_change(self, new_view: int) -> List[Msg]:
+        """Move to view_change status for new_view and broadcast SVC
+        (replica.zig on view-change timeout)."""
+        assert new_view > self.view or (
+            new_view == self.view and self.status != NORMAL
+        )
+        self.view = new_view
+        self.status = VIEW_CHANGE
+        self._vc_started = self._ticks
+        self._last_vc_resend = self._ticks
+        self._dvc_sent_for = None
+        self.pipeline.clear()
+        self._persist_view()
+        self.svc_from.setdefault(new_view, set()).add(self.replica)
+        svc = self._hdr(wire.Command.start_view_change)
+        out = self._broadcast(wire.encode(svc, b""))
+        out.extend(self._maybe_send_dvc())
+        return out
+
+    def on_start_view_change(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        view = int(h["view"])
+        if view < self.view or self.replica_count == 1:
+            return []
+        out: List[Msg] = []
+        if view > self.view:
+            out.extend(self._begin_view_change(view))
+        elif self.status == NORMAL:
+            # Current view is live; ignore stragglers.
+            return []
+        self.svc_from.setdefault(view, set()).add(int(h["replica"]))
+        out.extend(self._maybe_send_dvc())
+        return out
+
+    def _maybe_send_dvc(self) -> List[Msg]:
+        """At an SVC quorum, send do_view_change to the new primary
+        (replica.zig send_do_view_change)."""
+        if self.status != VIEW_CHANGE:
+            return []
+        if len(self.svc_from.get(self.view, ())) < self.quorum_view_change:
+            return []
+        return self._send_dvc()
+
+    def _send_dvc(self) -> List[Msg]:
+        self._dvc_sent_for = self.view
+        dvc = self._hdr(
+            wire.Command.do_view_change,
+            op=self.op,
+            commit=self.commit_min,
+            checkpoint_op=self.op_checkpoint,
+            log_view=self.log_view,
+        )
+        body = wire.pack_headers(self._suffix_headers())
+        message = wire.encode(dvc, body)
+        new_primary = self.primary_index()
+        if new_primary == self.replica:
+            decoded, _, dbody = wire.decode(message)
+            return self.on_do_view_change(decoded, dbody)
+        return [(("replica", new_primary), message)]
+
+    def _suffix_headers(self) -> List[np.ndarray]:
+        """The journal-suffix headers that fit one message body (newest
+        last); covers at least a full checkpoint interval by config."""
+        k_max = self.config.message_body_size_max // wire.HEADER_SIZE
+        ops = sorted(o for o in self.headers if o <= self.op)[-k_max:]
+        return [self.headers[o] for o in ops]
+
+    def on_do_view_change(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        view = int(h["view"])
+        if view < self.view:
+            return []
+        out: List[Msg] = []
+        if view > self.view:
+            out.extend(self._begin_view_change(view))
+        if self.primary_index(view) != self.replica or self.status == NORMAL:
+            return out
+        try:
+            headers = wire.unpack_headers(body)
+        except ValueError:
+            return out
+        self.dvc_from.setdefault(view, {})[int(h["replica"])] = {
+            "log_view": int(h["log_view"]),
+            "op": int(h["op"]),
+            "commit": int(h["commit"]),
+            "headers": headers,
+        }
+        # Our own state counts toward the DVC quorum.
+        self.dvc_from[view][self.replica] = {
+            "log_view": self.log_view,
+            "op": self.op,
+            "commit": self.commit_min,
+            "headers": self._suffix_headers(),
+        }
+        if len(self.dvc_from[view]) >= self.quorum_view_change:
+            out.extend(self._install_canonical_log(view))
+        return out
+
+    def _install_canonical_log(self, view: int) -> List[Msg]:
+        """New primary: adopt the log of the DVC with max (log_view, op)
+        (replica.zig primary_set_log_from_do_view_change_messages)."""
+        dvcs = self.dvc_from[view]
+        canonical = max(dvcs.values(), key=lambda d: (d["log_view"], d["op"]))
+        self.commit_max = max(
+            [d["commit"] for d in dvcs.values()] + [self.commit_max]
+        )
+        out: List[Msg] = []
+        target_op = canonical["op"]
+        by_op = {int(ch["op"]): ch for ch in canonical["headers"]}
+        self._install_headers(target_op, by_op)
+
+        if self.missing:
+            # Stay in view_change; repair bodies then finish (tick retries).
+            self._new_view_pending = view
+            out.extend(self._request_missing(dvcs))
+            return out
+        return out + self._finish_view_change(view)
+
+    def journal_has(self, op: int, checksum: int) -> bool:
+        read = self.journal.read_prepare(op)
+        return read is not None and wire.header_checksum(read[0]) == checksum
+
+    def _install_headers(self, target_op: int, by_op: Dict[int, np.ndarray]) -> None:
+        """Adopt a canonical log suffix (shared by the new primary's DVC
+        install and the backup's start_view install): truncate uncommitted
+        forks beyond ``target_op``, install the canonical headers, journal
+        any matching stashed bodies, and record missing bodies for repair."""
+        if self.op > target_op:
+            for op in [o for o in self.headers if o > target_op]:
+                del self.headers[op]
+                self.stash.pop(op, None)
+            self.op = target_op
+        self.missing = {
+            op: cs for op, cs in self.missing.items() if op <= target_op
+        }
+        for op in sorted(by_op):
+            if op <= self.commit_min:
+                continue
+            ch = by_op[op]
+            checksum = wire.header_checksum(ch)
+            mine = self.headers.get(op)
+            if mine is not None and wire.header_checksum(mine) == checksum:
+                continue
+            self.headers[op] = ch
+            self.missing.pop(op, None)
+            stashed = self.stash.get(op)
+            if stashed is not None and (
+                wire.header_checksum(stashed[0]) == checksum
+            ):
+                self.journal.write_prepare(wire.encode(*stashed))
+                self.stash.pop(op, None)
+                continue
+            if not self.journal_has(op, checksum):
+                self.missing[op] = checksum
+        self.op = max(self.op, target_op)
+        head = self.headers.get(self.op)
+        if head is not None:
+            self.parent_checksum = wire.header_checksum(head)
+
+    def _request_missing(self, dvcs=None) -> List[Msg]:
+        """request_prepare for every missing body, spread over peers."""
+        out: List[Msg] = []
+        peers = [r for r in range(self.replica_count) if r != self.replica]
+        if not peers:
+            return out
+        for i, (op, checksum) in enumerate(sorted(self.missing.items())):
+            req = self._hdr(
+                wire.Command.request_prepare,
+                prepare_op=op,
+                prepare_checksum=checksum,
+            )
+            out.append((("replica", peers[i % len(peers)]), wire.encode(req)))
+        return out
+
+    def _finish_view_change(self, view: int) -> List[Msg]:
+        """All canonical bodies journaled: become primary of the new view
+        (replica.zig primary_start_view_as_the_new_primary)."""
+        assert self.primary_index(view) == self.replica
+        self.status = NORMAL
+        self.view = view
+        self.log_view = view
+        self._new_view_pending = None
+        self._persist_view()
+        self.svc_from.pop(view, None)
+        self.dvc_from.pop(view, None)
+        # Re-certify the uncommitted suffix in the new view: pipeline entries
+        # that commit once backups ack them after start_view.
+        self.pipeline.clear()
+        for op in range(self.commit_min + 1, self.op + 1):
+            h = self.headers[op]
+            self.pipeline[op] = PipelineEntry(
+                op=op,
+                checksum=wire.header_checksum(h),
+                client=wire.u128(h, "client"),
+                ok_from={self.replica},
+            )
+        sv = self._hdr(
+            wire.Command.start_view,
+            op=self.op,
+            commit=self.commit_min,
+            checkpoint_op=self.op_checkpoint,
+        )
+        body = wire.pack_headers(self._suffix_headers())
+        out = self._broadcast(wire.encode(sv, body))
+        self._maybe_commit_pipeline(out)
+        return out
+
+    def on_start_view(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        """Backup installs the new view's canonical log
+        (replica.zig on_start_view :1702+)."""
+        view = int(h["view"])
+        if view < self.view or (view == self.view and self.status == NORMAL):
+            return []
+        if self.status == SYNCING:
+            # Keep fetching; a view change only moves where chunks come from.
+            if view > self.view:
+                self.view = view
+            return []
+        try:
+            headers = wire.unpack_headers(body)
+        except ValueError:
+            return []
+        out: List[Msg] = []
+        target_op = int(h["op"])
+        by_op = {int(ch["op"]): ch for ch in headers}
+
+        self.view = view
+        self.log_view = view
+        self.commit_max = max(self.commit_max, int(h["commit"]))
+        self._last_primary_word = self._ticks
+        self.pipeline.clear()
+        self._dvc_sent_for = None
+        self.svc_from = {v: s for v, s in self.svc_from.items() if v > view}
+        self._persist_view()
+
+        # If the cluster's checkpoint is beyond our journal head, peers no
+        # longer hold the WAL range we'd need — adopting the canonical head
+        # first would falsify the sync trigger and wedge us with
+        # unrepairable gaps.  State-sync the snapshot instead.
+        sv_checkpoint = int(h["checkpoint_op"])
+        if sv_checkpoint > self.op:
+            self.status = NORMAL  # transitional; _maybe_start_sync -> SYNCING
+            sync = self._maybe_start_sync(sv_checkpoint)
+            if sync:
+                return sync
+
+        self.status = NORMAL
+        self._install_headers(target_op, by_op)
+
+        # Ack the uncommitted suffix so the new primary can commit it.
+        for op in range(self.commit_min + 1, self.op + 1):
+            hh = self.headers.get(op)
+            if hh is not None and op not in self.missing:
+                out.append(self._send_prepare_ok(hh))
+        out.extend(self._request_missing())
+        self._commit_journal(out)
+        return out
+
+    def _request_start_view(self, view: int) -> List[Msg]:
+        req = wire.new_header(
+            wire.Command.request_start_view,
+            cluster=self.cluster,
+            view=view,
+            nonce=self.prng.getrandbits(64),
+        )
+        req["replica"] = self.replica
+        return [(("replica", view % self.replica_count), wire.encode(req))]
+
+    def on_request_start_view(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if self.status != NORMAL or not self.is_primary:
+            return []
+        if int(h["view"]) > self.view:
+            return []
+        sv = self._hdr(
+            wire.Command.start_view,
+            op=self.op,
+            commit=self.commit_min,
+            checkpoint_op=self.op_checkpoint,
+        )
+        body_out = wire.pack_headers(self._suffix_headers())
+        return [(("replica", int(h["replica"])), wire.encode(sv, body_out))]
+
+    # -- repair (replica.zig :2048-2497) --------------------------------------
+
+    def _repair_gaps(self) -> List[Msg]:
+        """Request prepares between our head and the lowest stashed op."""
+        if not self.stash:
+            return []
+        out: List[Msg] = []
+        lowest = min(self.stash)
+        primary = self.primary_index()
+        for op in range(self.op + 1, min(lowest, self.op + 1 + 8)):
+            req = self._hdr(
+                wire.Command.request_prepare, prepare_op=op, prepare_checksum=0
+            )
+            out.append((("replica", primary), wire.encode(req)))
+        return out
+
+    def on_request_prepare(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        op = int(h["op"]) if "op" in h.dtype.names else int(h["prepare_op"])
+        checksum = wire.u128(h, "prepare_checksum")
+        read = self.journal.read_prepare(op)
+        if read is None:
+            return []
+        ph, pbody = read
+        if checksum and wire.header_checksum(ph) != checksum:
+            return []
+        return [(("replica", int(h["replica"])), wire.encode(ph, pbody))]
+
+    def on_request_headers(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        op_min, op_max = int(h["op_min"]), int(h["op_max"])
+        selected = [
+            self.headers[o]
+            for o in sorted(self.headers)
+            if op_min <= o <= op_max
+        ]
+        k_max = self.config.message_body_size_max // wire.HEADER_SIZE
+        selected = selected[:k_max]
+        if not selected:
+            return []
+        reply = self._hdr(wire.Command.headers)
+        return [
+            (("replica", int(h["replica"])),
+             wire.encode(reply, wire.pack_headers(selected)))
+        ]
+
+    def on_headers(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        """Merge repair headers: adopt chained extensions of our log."""
+        try:
+            headers = wire.unpack_headers(body)
+        except ValueError:
+            return []
+        out: List[Msg] = []
+        for ch in sorted(headers, key=lambda x: int(x["op"])):
+            op = int(ch["op"])
+            if op == self.op + 1 and wire.u128(ch, "parent") == (
+                self.parent_checksum
+            ):
+                self.headers[op] = ch
+                self.missing[op] = wire.header_checksum(ch)
+                self.op = op
+                self.parent_checksum = wire.header_checksum(ch)
+        out.extend(self._request_missing())
+        return out
+
+    def _fill_missing(self, h: np.ndarray, body: bytes) -> None:
+        op = int(h["op"])
+        self.journal.write_prepare(wire.encode(h, body))
+        del self.missing[op]
+        if getattr(self, "_new_view_pending", None) is not None and (
+            not self.missing
+        ):
+            # All repairs done: finish becoming primary.
+            pending = self._new_view_pending
+            self._pending_finish = pending
+
+    # -- state sync (vsr/sync.zig) --------------------------------------------
+
+    def _maybe_start_sync(self, primary_checkpoint_op: int) -> List[Msg]:
+        """If the primary's checkpoint is beyond our journal *head*, our WAL
+        no longer overlaps the cluster's and ordinary repair cannot catch us
+        up: fetch the checkpoint snapshot.  (A backup merely lagging in
+        commits — head >= the checkpoint — repairs via the WAL instead.)"""
+        if primary_checkpoint_op <= self.op:
+            return []
+        if self.sync_target is not None:
+            return []
+        self.status = SYNCING
+        self.sync_target = {"checkpoint_op": primary_checkpoint_op, "total": None}
+        self.sync_buffer = bytearray()
+        self._last_sync_req = self._ticks
+        return self._request_sync_chunk()
+
+    def _request_sync_chunk(self) -> List[Msg]:
+        req = self._hdr(
+            wire.Command.request_sync_checkpoint,
+            checkpoint_op=self.sync_target["checkpoint_op"],
+            offset=len(self.sync_buffer),
+        )
+        return [(("replica", self.primary_index()), wire.encode(req))]
+
+    def on_request_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        checkpoint_op = int(h["checkpoint_op"])
+        offset = int(h["offset"])
+        if checkpoint_op != self.op_checkpoint or self.op_checkpoint == 0:
+            return []
+        path = checkpoint_mod.path_for(self.data_path, self.op_checkpoint)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return []
+        if offset >= len(blob):
+            return []
+        chunk = blob[offset : offset + self.config.message_body_size_max]
+        resp = self._hdr(
+            wire.Command.sync_checkpoint,
+            checkpoint_op=self.op_checkpoint,
+            offset=offset,
+            total=len(blob),
+            file_checksum=self._sb_state.checkpoint_file_checksum,
+            commit_max=self.commit_min,
+        )
+        return [(("replica", int(h["replica"])), wire.encode(resp, chunk))]
+
+    def on_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if self.status != SYNCING or self.sync_target is None:
+            return []
+        checkpoint_op = int(h["checkpoint_op"])
+        if checkpoint_op != self.sync_target["checkpoint_op"]:
+            return []
+        if int(h["offset"]) != len(self.sync_buffer):
+            return self._request_sync_chunk()
+        self.sync_buffer.extend(body)
+        self.sync_target["total"] = int(h["total"])
+        self.sync_target["file_checksum"] = wire.u128(h, "file_checksum")
+        self.sync_target["commit_max"] = int(h["commit_max"])
+        if len(self.sync_buffer) < self.sync_target["total"]:
+            self._last_sync_req = self._ticks
+            return self._request_sync_chunk()
+        return self._install_sync_checkpoint()
+
+    def _install_sync_checkpoint(self) -> List[Msg]:
+        """Install a fully-fetched checkpoint snapshot and rejoin."""
+        target = self.sync_target
+        op = target["checkpoint_op"]
+        path = checkpoint_mod.path_for(self.data_path, op)
+        with open(path, "wb") as f:
+            f.write(bytes(self.sync_buffer))
+            f.flush()
+        try:
+            ledger, meta = checkpoint_mod.load(
+                self.data_path, op, target["file_checksum"]
+            )
+        except RuntimeError:
+            # Corrupt/raced snapshot: restart the fetch from scratch.
+            self.sync_buffer = bytearray()
+            self._last_sync_req = self._ticks
+            return self._request_sync_chunk()
+        self.machine.ledger = ledger
+        self.machine.restore_host_state(meta["machine"])
+        self.sessions = {
+            int(client_hex, 16): Session(
+                client=int(client_hex, 16),
+                session=s["session"],
+                request=s["request"],
+                reply_bytes=b"",
+                slot=s["slot"],
+            )
+            for client_hex, s in meta.get("sessions", {}).items()
+        }
+        self.op_checkpoint = op
+        self.commit_min = op
+        self.commit_max = max(self.commit_max, target.get("commit_max", op))
+        self.op = op
+        self.headers = {}
+        self.stash.clear()
+        self.missing.clear()
+        self.parent_checksum = 0
+        state = SuperBlockState(
+            cluster=self.cluster,
+            replica=self.replica,
+            replica_count=self.replica_count,
+            view=self.view,
+            log_view=self.log_view,
+            commit_min=self.commit_min,
+            commit_max=self.commit_max,
+            op_checkpoint=op,
+            checkpoint_file_checksum=target["file_checksum"],
+            ledger_digest=self.machine.digest(),
+            prepare_timestamp=self.machine.prepare_timestamp,
+            commit_timestamp=self.machine.commit_timestamp,
+        )
+        self.superblock.checkpoint(state)
+        self._sb_state = state
+        checkpoint_mod.remove_older_than(self.data_path, op)
+        self.sync_target = None
+        self.sync_buffer = bytearray()
+        self.status = RECOVERING
+        self._recovering_since = self._ticks
+        return self._request_start_view(self.view)
+
+    # -- clock ----------------------------------------------------------------
+
+    def on_ping(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        pong = self._hdr(
+            wire.Command.pong,
+            ping_timestamp_monotonic=int(h["ping_timestamp_monotonic"]),
+            pong_timestamp_wall=self._realtime(),
+        )
+        return [(("replica", int(h["replica"])), wire.encode(pong))]
+
+    def on_pong(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        self.clock.learn(
+            int(h["replica"]),
+            int(h["ping_timestamp_monotonic"]),
+            int(h["pong_timestamp_wall"]),
+        )
+        return []
+
+    # -- tick (timeouts; vsr.zig:543-712) -------------------------------------
+
+    def tick(self) -> List[Msg]:
+        self._ticks += 1
+        out: List[Msg] = []
+        if self.clock is not None:
+            self.clock.tick()
+        if self.replica_count == 1:
+            return out
+
+        # Deferred view-change completion after repairs.
+        if getattr(self, "_pending_finish", None) is not None:
+            view = self._pending_finish
+            self._pending_finish = None
+            if self.status == VIEW_CHANGE and not self.missing:
+                out.extend(self._finish_view_change(view))
+
+        if self._ticks - self._last_ping >= PING_INTERVAL:
+            self._last_ping = self._ticks
+            ping = self._hdr(
+                wire.Command.ping,
+                checkpoint_op=self.op_checkpoint,
+                ping_timestamp_monotonic=self.clock.ping_timestamp(),
+            )
+            out.extend(self._broadcast(wire.encode(ping)))
+
+        if self.status == NORMAL and self.is_primary:
+            if self._ticks - self._last_commit_sent >= COMMIT_HEARTBEAT:
+                self._last_commit_sent = self._ticks
+                commit = self._hdr(
+                    wire.Command.commit,
+                    commit=self.commit_min,
+                    checkpoint_op=self.op_checkpoint,
+                    timestamp_monotonic=self.clock.ping_timestamp(),
+                )
+                out.extend(self._broadcast(wire.encode(commit)))
+            if self.pipeline and (
+                self._ticks - self._last_prepare_resend >= PREPARE_RESEND
+            ):
+                self._last_prepare_resend = self._ticks
+                # Timeout fallback: re-broadcast unquorumed prepares to all
+                # backups (the ring is the fast path, this is the safety net).
+                for entry in self.pipeline.values():
+                    if len(entry.ok_from) >= self.quorum_replication:
+                        continue
+                    read = self.journal.read_prepare(entry.op)
+                    if read is None:
+                        continue
+                    message = wire.encode(read[0], read[1])
+                    for r in range(self.replica_count):
+                        if r != self.replica and r not in entry.ok_from:
+                            out.append((("replica", r), message))
+
+        elif self.status == NORMAL:
+            # Backup: watch for a dead primary.
+            if self._ticks - max(self._last_primary_word, 0) >= (
+                NORMAL_HEARTBEAT + self._heartbeat_jitter
+            ):
+                self._last_primary_word = self._ticks
+                out.extend(self._begin_view_change(self.view + 1))
+            elif self._ticks - self._last_repair >= REPAIR_INTERVAL and (
+                self.missing or self.stash or self._header_gaps()
+            ):
+                self._last_repair = self._ticks
+                out.extend(self._request_missing())
+                out.extend(self._repair_gaps())
+                # Header gaps: request by op with checksum 0 ("whatever you
+                # have chained there"); adoption verifies the parent chain.
+                primary = self.primary_index()
+                for op in self._header_gaps():
+                    req = self._hdr(
+                        wire.Command.request_prepare,
+                        prepare_op=op,
+                        prepare_checksum=0,
+                    )
+                    out.append((("replica", primary), wire.encode(req)))
+
+        elif self.status == VIEW_CHANGE:
+            if self._ticks - self._vc_started >= VIEW_CHANGE_ESCALATE:
+                out.extend(self._begin_view_change(self.view + 1))
+            elif self._ticks - self._last_vc_resend >= VIEW_CHANGE_RESEND:
+                self._last_vc_resend = self._ticks
+                svc = self._hdr(wire.Command.start_view_change)
+                out.extend(self._broadcast(wire.encode(svc)))
+                if self._dvc_sent_for == self.view and (
+                    self.primary_index() != self.replica
+                ):
+                    out.extend(self._send_dvc())
+                if self.missing:
+                    out.extend(self._request_missing())
+
+        elif self.status == RECOVERING:
+            if self._ticks - self._last_rsv >= RECOVERING_RESEND:
+                self._last_rsv = self._ticks
+                out.extend(self._request_start_view(self.view))
+                # If nobody answers (total cluster restart), force a view
+                # change so the cluster re-certifies its log.  Time base is
+                # entry into RECOVERING, not process age — a replica that
+                # re-enters late (post-sync) must give the live primary a
+                # chance to answer first.
+                if self._ticks - self._recovering_since >= (
+                    NORMAL_HEARTBEAT + self._heartbeat_jitter
+                ):
+                    out.extend(self._begin_view_change(self.view + 1))
+
+        elif self.status == SYNCING:
+            if self._ticks - self._last_sync_req >= SYNC_RESEND:
+                self._last_sync_req = self._ticks
+                out.extend(self._request_sync_chunk())
+        return out

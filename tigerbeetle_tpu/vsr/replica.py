@@ -65,6 +65,7 @@ class Replica:
         ledger_config: Optional[LedgerConfig] = None,
         batch_lanes: int = 8192,
         time_ns=time.time_ns,
+        storage: Optional[Storage] = None,
     ) -> None:
         self.data_path = data_path
         self.config = cluster_config or ClusterConfig()
@@ -72,7 +73,11 @@ class Replica:
         self.batch_lanes = batch_lanes
         self.time_ns = time_ns
 
-        self.storage = Storage(data_path, self.config)
+        # Injectable storage lets the VOPR simulator substitute an in-memory
+        # fault-injecting backend (testing/storage.zig's role).
+        self.storage = storage if storage is not None else Storage(
+            data_path, self.config
+        )
         self.superblock = SuperBlock(self.storage)
         self.journal = Journal(self.storage)
         self.machine = TpuStateMachine(self.ledger_config, batch_lanes=batch_lanes)
@@ -86,6 +91,7 @@ class Replica:
         self.op_checkpoint = 0
         self.parent_checksum = 0    # checksum of prepare at self.op
         self.sessions: Dict[int, Session] = {}
+        self._sb_state: Optional[SuperBlockState] = None
 
     # -- format / open -------------------------------------------------------
 
@@ -97,11 +103,13 @@ class Replica:
         replica: int = 0,
         replica_count: int = 1,
         cluster_config: Optional[ClusterConfig] = None,
+        storage: Optional[Storage] = None,
     ) -> None:
         """Create + initialize a data file (main.zig format path; the root
         prepare op=0 anchors the hash chain, message_header.zig Prepare.root)."""
         config = cluster_config or ClusterConfig()
-        storage = Storage.format(data_path, config)
+        if storage is None:
+            storage = Storage.format(data_path, config)
         try:
             superblock = SuperBlock(storage)
             superblock.format(cluster, replica, replica_count)
@@ -118,7 +126,16 @@ class Replica:
 
     def open(self) -> None:
         """Recover durable state: superblock -> checkpoint -> WAL replay."""
+        recovery = self._open_durable_state()
+        # Establish the head: the highest hash-chained op from the checkpoint.
+        self._replay(recovery)
+
+    def _open_durable_state(self):
+        """Superblock quorum read + checkpoint snapshot load + journal scan
+        (everything except WAL replay, which consensus defers until the
+        replica knows how far the cluster committed)."""
         sb = self.superblock.open()
+        self._sb_state = sb
         self.cluster = sb.cluster
         self.replica = sb.replica
         self.replica_count = sb.replica_count
@@ -149,9 +166,7 @@ class Replica:
                 for client_hex, s in meta.get("sessions", {}).items()
             }
 
-        recovery = self.journal.recover()
-        # Establish the head: the highest hash-chained op from the checkpoint.
-        self._replay(recovery)
+        return self.journal.recover()
 
     def _replay(self, recovery) -> None:
         """Replay the contiguous, hash-chained WAL suffix beyond commit_min."""
@@ -463,7 +478,7 @@ class Replica:
             replica=self.replica,
             replica_count=self.replica_count,
             view=self.view,
-            log_view=self.view,
+            log_view=getattr(self, "log_view", self.view),
             commit_min=self.commit_min,
             commit_max=self.op,
             op_checkpoint=self.commit_min,
@@ -473,6 +488,7 @@ class Replica:
             commit_timestamp=self.machine.commit_timestamp,
         )
         self.superblock.checkpoint(state)
+        self._sb_state = state
         self.op_checkpoint = self.commit_min
         checkpoint_mod.remove_older_than(self.data_path, self.commit_min)
 

@@ -191,6 +191,69 @@ EVICTION_DTYPE = _dtype([
     ("reserved", "V112"),
 ])
 
+# View change messages (message_header.zig StartViewChange/DoViewChange/
+# StartView).  DVC/SV bodies carry the journal-suffix prepare headers
+# (256 B each) — the new primary selects the canonical log from them.
+START_VIEW_CHANGE_DTYPE = _dtype([("reserved", "V128")])
+
+DO_VIEW_CHANGE_DTYPE = _dtype([
+    ("op", "<u8"),               # sender's journal head
+    ("commit", "<u8"),           # sender's commit_min
+    ("checkpoint_op", "<u8"),
+    ("log_view", "<u4"),         # view in which the sender's log was current
+    ("reserved", "V100"),
+])
+
+START_VIEW_DTYPE = _dtype([
+    ("op", "<u8"),               # canonical head of the new view
+    ("commit", "<u8"),           # new primary's commit_min
+    ("checkpoint_op", "<u8"),
+    ("reserved", "V104"),
+])
+
+REQUEST_START_VIEW_DTYPE = _dtype([
+    ("nonce_lo", "<u8"), ("nonce_hi", "<u8"),
+    ("reserved", "V112"),
+])
+
+# Repair protocol (message_header.zig RequestHeaders/RequestPrepare/Headers).
+REQUEST_HEADERS_DTYPE = _dtype([
+    ("op_min", "<u8"),           # inclusive range of requested headers
+    ("op_max", "<u8"),
+    ("reserved", "V112"),
+])
+
+REQUEST_PREPARE_DTYPE = _dtype([
+    ("prepare_checksum_lo", "<u8"), ("prepare_checksum_hi", "<u8"),
+    ("prepare_op", "<u8"),
+    ("reserved", "V104"),
+])
+
+HEADERS_DTYPE = _dtype([("reserved", "V128")])  # body = prepare headers
+
+REQUEST_REPLY_DTYPE = _dtype([
+    ("reply_checksum_lo", "<u8"), ("reply_checksum_hi", "<u8"),
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("reserved", "V96"),
+])
+
+# State sync (vsr/sync.zig): a lagging replica fetches the primary's latest
+# checkpoint snapshot in message-sized chunks.
+REQUEST_SYNC_CHECKPOINT_DTYPE = _dtype([
+    ("checkpoint_op", "<u8"),    # 0 = whatever is latest
+    ("offset", "<u8"),           # byte offset into the checkpoint blob
+    ("reserved", "V112"),
+])
+
+SYNC_CHECKPOINT_DTYPE = _dtype([
+    ("checkpoint_op", "<u8"),
+    ("offset", "<u8"),
+    ("total", "<u8"),            # total checkpoint blob size
+    ("file_checksum_lo", "<u8"), ("file_checksum_hi", "<u8"),
+    ("commit_max", "<u8"),
+    ("reserved", "V80"),
+])
+
 COMMAND_DTYPES = {
     Command.request: REQUEST_DTYPE,
     Command.prepare: PREPARE_DTYPE,
@@ -202,7 +265,38 @@ COMMAND_DTYPES = {
     Command.ping_client: PING_CLIENT_DTYPE,
     Command.pong_client: PONG_CLIENT_DTYPE,
     Command.eviction: EVICTION_DTYPE,
+    Command.start_view_change: START_VIEW_CHANGE_DTYPE,
+    Command.do_view_change: DO_VIEW_CHANGE_DTYPE,
+    Command.start_view: START_VIEW_DTYPE,
+    Command.request_start_view: REQUEST_START_VIEW_DTYPE,
+    Command.request_headers: REQUEST_HEADERS_DTYPE,
+    Command.request_prepare: REQUEST_PREPARE_DTYPE,
+    Command.headers: HEADERS_DTYPE,
+    Command.request_reply: REQUEST_REPLY_DTYPE,
+    Command.request_sync_checkpoint: REQUEST_SYNC_CHECKPOINT_DTYPE,
+    Command.sync_checkpoint: SYNC_CHECKPOINT_DTYPE,
 }
+
+
+def pack_headers(headers) -> bytes:
+    """Concatenate prepare headers into a DVC/SV/headers message body."""
+    return b"".join(h.tobytes() for h in headers)
+
+
+def unpack_headers(body: bytes):
+    """Split a DVC/SV/headers body back into verified prepare headers.
+    Raises ValueError on a malformed body (misaligned length or any
+    embedded header failing its checksum)."""
+    if len(body) % HEADER_SIZE != 0:
+        raise ValueError(f"headers body length {len(body)} not a multiple "
+                         f"of {HEADER_SIZE}")
+    out = []
+    for i in range(0, len(body), HEADER_SIZE):
+        h, command = decode_header(body[i : i + HEADER_SIZE])
+        if command != Command.prepare:
+            raise ValueError(f"embedded header is {command.name}, not prepare")
+        out.append(h)
+    return out
 
 
 def new_header(command: Command, **fields) -> np.ndarray:
