@@ -102,9 +102,37 @@ def _cmd_start(args) -> int:
                 args.cache_transfers_log2 or args.cache_accounts_log2 + 2
             ),
         )
+    addresses = _parse_addresses(args.addresses)
+    if len(addresses) > 1:
+        # Multi-replica cluster: full VSR consensus over the TCP bus.  The
+        # replica's own address is addresses[replica_index] (cli.zig
+        # --addresses semantics).
+        from .net.cluster_bus import run_cluster_server
+        from .vsr.consensus import VsrReplica
+
+        replica = VsrReplica(args.path, ledger_config=ledger_config)
+        replica.open()
+        host = addresses[replica.replica][0]
+
+        def ready(actual_port):
+            print(f"listening {host}:{actual_port}", flush=True)
+
+        run_cluster_server(replica, addresses, ready_callback=ready)
+        return 0
+
     replica = Replica(args.path, ledger_config=ledger_config)
     replica.open()
-    (host, port), = _parse_addresses(args.addresses)
+    if replica.replica_count != 1:
+        # A multi-replica data file must never be served solo: commits
+        # without the quorum would fork the cluster's log (split brain).
+        print(
+            f"error: data file is replica {replica.replica} of a "
+            f"{replica.replica_count}-replica cluster; pass all "
+            f"{replica.replica_count} --addresses",
+            file=sys.stderr,
+        )
+        return 1
+    (host, port), = addresses
 
     def ready(actual_port):
         # Port-0 trick for tooling (reference main.zig:239-264): print the

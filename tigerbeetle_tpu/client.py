@@ -49,6 +49,7 @@ class Client:
         self.request_number = 0
         self.parent = 0          # checksum of the previous request
         self._sock: Optional[socket.socket] = None
+        self._addr_index = 0     # preferred replica (rotates on failure)
 
     # -- connection management ----------------------------------------------
 
@@ -56,15 +57,66 @@ class Client:
         if self._sock is not None:
             return self._sock
         last_err: Optional[Exception] = None
-        for host, port in self.addresses:
+        n = len(self.addresses)
+        for k in range(n):
+            i = (self._addr_index + k) % n
+            host, port = self.addresses[i]
             try:
-                sock = socket.create_connection((host, port), timeout=self.timeout_s)
+                sock = socket.create_connection(
+                    (host, port), timeout=self.timeout_s
+                )
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # Multi-replica only: bounded receive wait so a silent
+                # replica (e.g. a backup whose forwarded reply went to the
+                # primary) triggers failover instead of a full-timeout hang.
+                # Single-replica waits the full timeout (slow first commits
+                # must not cause reconnect storms).
+                if n > 1:
+                    sock.settimeout(min(2.0, self.timeout_s))
+                else:
+                    sock.settimeout(self.timeout_s)
+                self._addr_index = i
                 self._sock = sock
-                return sock
+                self._discover_primary(sock)
+                return self._sock
             except OSError as err:
                 last_err = err
         raise ConnectionError(f"no replica reachable: {last_err}")
+
+    def _discover_primary(self, sock: socket.socket) -> None:
+        """Learn the current view via ping_client/pong_client and re-dial
+        the primary (view % replica_count) if we're on a backup — the
+        primary is the replica that sends replies (vsr/client.zig view
+        tracking)."""
+        if len(self.addresses) <= 1:
+            return
+        try:
+            ping = wire.new_header(
+                wire.Command.ping_client,
+                cluster=self.cluster,
+                client=self.client_id,
+            )
+            sock.sendall(wire.encode(ping))
+            head = self._recv_exactly(sock, wire.HEADER_SIZE)
+            h, command = wire.decode_header(head)
+            if command != wire.Command.pong_client:
+                return
+            primary = int(h["view"]) % len(self.addresses)
+            if primary != self._addr_index:
+                host, port = self.addresses[primary]
+                try:
+                    new = socket.create_connection(
+                        (host, port), timeout=self.timeout_s
+                    )
+                except OSError:
+                    return  # keep the current (backup) connection
+                new.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                new.settimeout(min(2.0, self.timeout_s))
+                sock.close()
+                self._addr_index = primary
+                self._sock = new
+        except (OSError, ValueError):
+            pass  # keep the current connection; failover handles the rest
 
     def close(self) -> None:
         if self._sock is not None:
@@ -112,6 +164,8 @@ class Client:
                     return h, body
             except (ConnectionError, OSError, ValueError):
                 self.close()
+                # Rotate the preferred replica before retrying (failover).
+                self._addr_index = (self._addr_index + 1) % len(self.addresses)
                 time.sleep(0.05)
 
     # -- session protocol -----------------------------------------------------

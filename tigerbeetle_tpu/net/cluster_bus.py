@@ -1,0 +1,236 @@
+"""Cluster message bus: multi-replica VSR over real TCP.
+
+The reference's replica-side MessageBus (src/message_bus.zig:24+): replicas
+dial higher-indexed replicas (one connection per pair, traffic both ways),
+clients dial any replica; connections carry 256-byte-header framed messages;
+invalid frames drop the connection; reconnects use exponential backoff.
+
+This asyncio implementation drives a ``VsrReplica`` (vsr/consensus.py): a
+tick task fires every ``tick_interval`` (the reference's
+``replica.tick(); io.run_for_ns()`` loop, main.zig:266-269) and every
+inbound message dispatches through ``on_message``; outbound envelopes route
+to peer or client connections.  Peer identity on accepted connections is
+learned from the ``replica`` field of the first valid message (replica
+messages), client identity from request/ping_client headers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..vsr import wire
+from ..vsr.consensus import VsrReplica
+from .bus import FrameError, read_message
+
+log = logging.getLogger("tigerbeetle_tpu.net.cluster")
+
+CLIENT_COMMANDS = {
+    wire.Command.request,
+    wire.Command.ping_client,
+}
+
+
+class ClusterServer:
+    def __init__(
+        self,
+        replica: VsrReplica,
+        addresses: List[Tuple[str, int]],
+        tick_interval: float = 0.01,
+    ) -> None:
+        assert replica.replica_count == len(addresses), (
+            replica.replica_count, addresses
+        )
+        self.replica = replica
+        self.addresses = addresses
+        self.index = replica.replica
+        self.tick_interval = tick_interval
+        self.peer_writers: Dict[int, asyncio.StreamWriter] = {}
+        self.client_writers: Dict[int, asyncio.StreamWriter] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._tasks: List[asyncio.Task] = []
+        self.port: Optional[int] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> int:
+        host, port = self.addresses[self.index]
+        self._server = await asyncio.start_server(
+            self._on_accept, host, port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("replica %d listening on %s:%d", self.index, host, self.port)
+        # Dial higher-indexed replicas (message_bus.zig connection rule).
+        for j in range(self.index + 1, self.replica.replica_count):
+            self._tasks.append(asyncio.ensure_future(self._dial_loop(j)))
+        self._tasks.append(asyncio.ensure_future(self._tick_loop()))
+        return self.port
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in list(self.peer_writers.values()) + list(
+            self.client_writers.values()
+        ):
+            w.close()
+
+    # -- peer connections -----------------------------------------------------
+
+    async def _dial_loop(self, j: int) -> None:
+        """Keep one outbound connection to replica j alive, with
+        exponential backoff (message_bus.zig reconnect discipline)."""
+        backoff = 0.05
+        loop = asyncio.get_event_loop()
+        while True:
+            host, port = self.addresses[j]
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            self.peer_writers[j] = writer
+            connected_at = loop.time()
+            try:
+                await self._read_loop(reader, writer, peer=j)
+            finally:
+                if self.peer_writers.get(j) is writer:
+                    del self.peer_writers[j]
+                writer.close()
+            # Reset backoff only after a connection that actually lived —
+            # an accept-then-drop listener must still back off exponentially.
+            if loop.time() - connected_at > 1.0:
+                backoff = 0.05
+            else:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    async def _on_accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """Accepted connection: replica j<i, or a client — identified by
+        the first valid message."""
+        try:
+            await self._read_loop(reader, writer, peer=None)
+        finally:
+            for table in (self.peer_writers, self.client_writers):
+                for key, w in list(table.items()):
+                    if w is writer:
+                        del table[key]
+            writer.close()
+
+    async def _read_loop(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        peer: Optional[int],
+    ) -> None:
+        # Connection kind: a dialed connection is a peer by construction; an
+        # accepted one is classified by its FIRST valid message (a client
+        # request forwarded over a replica link must NOT register the peer
+        # writer as that client — the reply would be misrouted).
+        is_peer = peer is not None
+        is_client = False
+        try:
+            while True:
+                msg = await read_message(
+                    reader, self.replica.config.message_size_max
+                )
+                if msg is None:
+                    return
+                h, command, body = msg
+                if wire.u128(h, "cluster") != self.replica.cluster:
+                    log.warning("wrong cluster %x", wire.u128(h, "cluster"))
+                    return
+                if not is_peer and not is_client:
+                    if command in CLIENT_COMMANDS:
+                        is_client = True
+                    else:
+                        is_peer = True
+                        sender = int(h["replica"])
+                        if 0 <= sender < self.replica.replica_count:
+                            self.peer_writers.setdefault(sender, writer)
+                if is_client and command in CLIENT_COMMANDS:
+                    client = wire.u128(h, "client")
+                    if client:
+                        self.client_writers[client] = writer
+                if command == wire.Command.ping_client:
+                    pong = wire.new_header(
+                        wire.Command.pong_client,
+                        cluster=self.replica.cluster,
+                        view=self.replica.view,
+                    )
+                    pong["replica"] = self.index
+                    writer.write(wire.encode(pong))
+                    await writer.drain()
+                    continue
+                out = self.replica.on_message(h, command, body)
+                await self._route(out)
+                await writer.drain()
+        except FrameError as err:
+            log.warning("dropping connection: %s", err)
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("dispatch error, dropping connection")
+
+    # -- outbound routing -----------------------------------------------------
+
+    # Bounded send queue per connection (message_pool.zig's static budget):
+    # a peer that stops reading is disconnected, not buffered without limit.
+    SEND_BUFFER_MAX = 8 * (1 << 20)
+
+    async def _route(self, envelopes) -> None:
+        for (kind, ident), message in envelopes:
+            if kind == "replica":
+                w = self.peer_writers.get(ident)
+            else:
+                w = self.client_writers.get(ident)
+            if w is None:
+                continue  # not connected: timeouts re-send
+            if w.transport.get_write_buffer_size() > self.SEND_BUFFER_MAX:
+                log.warning("send queue overflow, dropping connection")
+                w.close()
+                continue
+            w.write(message)
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_interval)
+            try:
+                await self._route(self.replica.tick())
+            except Exception:
+                log.exception("tick failure")
+
+
+def run_cluster_server(
+    replica: VsrReplica,
+    addresses: List[Tuple[str, int]],
+    ready_callback=None,
+) -> None:
+    """Blocking entry point: serve one cluster replica until cancelled."""
+
+    async def main():
+        server = ClusterServer(replica, addresses)
+        port = await server.start()
+        if ready_callback is not None:
+            ready_callback(port)
+        await server.serve_forever()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
