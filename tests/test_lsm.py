@@ -1,0 +1,182 @@
+"""LSM forest (base + delta runs + manifest + compaction) and EWAH tests."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LEDGER_TEST
+from tigerbeetle_tpu.lsm import Forest
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.utils import ewah
+
+
+# -- EWAH (reference src/ewah.zig; fuzzer ring §4.5) --------------------------
+
+def test_ewah_roundtrip_uniform():
+    for value in (0, 0xFFFF_FFFF_FFFF_FFFF):
+        w = np.full(300, value, dtype=np.uint64)
+        enc = ewah.encode(w)
+        assert len(enc) < 10  # long runs compress to markers
+        assert np.array_equal(ewah.decode(enc, 300), w)
+
+
+def test_ewah_roundtrip_random():
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        n = int(rng.integers(0, 400))
+        w = rng.integers(0, 1 << 63, size=n).astype(np.uint64)
+        # Sprinkle runs.
+        for _ in range(5):
+            if n > 10:
+                s = int(rng.integers(0, n - 5))
+                w[s : s + 5] = rng.choice(
+                    np.array([0, 0xFFFF_FFFF_FFFF_FFFF], dtype=np.uint64)
+                )
+        assert np.array_equal(ewah.decode(ewah.encode(w), n), w)
+
+
+def test_ewah_bits_roundtrip():
+    rng = np.random.default_rng(8)
+    for n in (0, 1, 63, 64, 65, 1000):
+        bits = rng.random(n) < 0.1
+        enc, cnt = ewah.encode_bits(bits)
+        assert cnt == n
+        assert np.array_equal(ewah.decode_bits(enc, cnt), bits)
+
+
+def test_ewah_rejects_malformed():
+    w = np.full(64, 5, dtype=np.uint64)
+    enc = ewah.encode(w)
+    with pytest.raises(ValueError):
+        ewah.decode(enc, 32)  # wrong expected size
+    with pytest.raises(ValueError):
+        ewah.decode(enc[:-1], 64)  # truncated literals
+
+
+# -- Forest -------------------------------------------------------------------
+
+def _machine():
+    return TpuStateMachine(LEDGER_TEST, batch_lanes=64)
+
+
+def _accounts(first, n):
+    return types.accounts_array(
+        [types.account(id=first + i, ledger=1, code=10) for i in range(n)]
+    )
+
+
+def _transfers(first, n, n_accounts=8):
+    return types.transfers_array(
+        [
+            types.transfer(
+                id=first + i,
+                debit_account_id=1 + i % n_accounts,
+                credit_account_id=1 + (i + 1) % n_accounts,
+                amount=1 + i,
+                ledger=1,
+                code=10,
+            )
+            for i in range(n)
+        ]
+    )
+
+
+def _digest(ledger):
+    m = _machine()
+    m.ledger = ledger
+    return m.digest()
+
+
+def test_forest_base_then_delta_runs(tmp_path):
+    path = str(tmp_path / "x.data")
+    m = _machine()
+    assert m.create_accounts(_accounts(1, 8), wall_clock_ns=1) == []
+    forest = Forest(path, major_ratio=100.0)  # force delta runs at tiny scale
+
+    base_cs, man_cs = forest.checkpoint(m.ledger, {"k": 1}, op=1)
+    assert forest.manifest.runs == []  # first checkpoint = base
+
+    assert m.create_transfers(_transfers(100, 16)) == []
+    base_cs2, man_cs2 = forest.checkpoint(m.ledger, {"k": 2}, op=2)
+    assert base_cs2 == base_cs  # unchanged base
+    assert len(forest.manifest.runs) == 1  # delta run
+
+    # Reopen from disk: base + run must reproduce the exact ledger.
+    forest2 = Forest(path)
+    ledger2, meta2 = forest2.open(2, man_cs2)
+    assert meta2 == {"k": 2}
+    assert _digest(ledger2) == m.digest()
+
+
+def test_forest_compaction(tmp_path):
+    path = str(tmp_path / "x.data")
+    m = _machine()
+    assert m.create_accounts(_accounts(1, 8), wall_clock_ns=1) == []
+    forest = Forest(path, compact_runs_max=3, major_ratio=100.0)
+
+    man_cs = None
+    op = 1
+    forest.checkpoint(m.ledger, {}, op=op)
+    for batch in range(6):
+        assert m.create_transfers(_transfers(1000 + 50 * batch, 8)) == []
+        op += 1
+        _, man_cs = forest.checkpoint(m.ledger, {"batch": batch}, op=op)
+    # Compaction kept the run list bounded.
+    assert len(forest.manifest.runs) <= 4
+
+    forest2 = Forest(path)
+    ledger2, meta2 = forest2.open(op, man_cs)
+    assert _digest(ledger2) == m.digest()
+    assert meta2 == {"batch": 5}
+
+
+def test_forest_major_compaction_rewrites_base(tmp_path):
+    path = str(tmp_path / "x.data")
+    m = _machine()
+    assert m.create_accounts(_accounts(1, 8), wall_clock_ns=1) == []
+    # major_ratio tiny => every delta triggers a base rewrite.
+    forest = Forest(path, major_ratio=0.0)
+    base1, _ = forest.checkpoint(m.ledger, {}, op=1)
+    assert m.create_transfers(_transfers(100, 8)) == []
+    base2, man2 = forest.checkpoint(m.ledger, {}, op=2)
+    assert base2 != base1  # base rewritten (major)
+    assert forest.manifest.runs == []
+
+    ledger2, _ = Forest(path).open(2, man2)
+    assert _digest(ledger2) == m.digest()
+
+
+def test_forest_gc_removes_stale_files(tmp_path):
+    path = str(tmp_path / "x.data")
+    m = _machine()
+    assert m.create_accounts(_accounts(1, 8), wall_clock_ns=1) == []
+    forest = Forest(path, compact_runs_max=2, major_ratio=100.0)
+    op = 1
+    forest.checkpoint(m.ledger, {}, op=op)
+    for batch in range(5):
+        assert m.create_transfers(_transfers(2000 + 40 * batch, 6)) == []
+        op += 1
+        forest.checkpoint(m.ledger, {}, op=op)
+        forest.gc()
+    names = sorted(p.name for p in tmp_path.iterdir())
+    live = {f"x.data.run.{r.seq}" for r in forest.manifest.runs}
+    live.add(f"x.data.checkpoint.{forest.manifest.base_op}")
+    live.add(f"x.data.manifest.{op}")
+    assert set(names) == live, names
+
+
+def test_forest_detects_corrupt_run(tmp_path):
+    path = str(tmp_path / "x.data")
+    m = _machine()
+    assert m.create_accounts(_accounts(1, 8), wall_clock_ns=1) == []
+    forest = Forest(path, major_ratio=100.0)
+    forest.checkpoint(m.ledger, {}, op=1)
+    assert m.create_transfers(_transfers(100, 8)) == []
+    _, man_cs = forest.checkpoint(m.ledger, {}, op=2)
+
+    run_file = tmp_path / f"x.data.run.{forest.manifest.runs[0].seq}"
+    blob = bytearray(run_file.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    run_file.write_bytes(bytes(blob))
+    with pytest.raises(RuntimeError, match="checksum"):
+        Forest(path).open(2, man_cs)

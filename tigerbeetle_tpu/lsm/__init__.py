@@ -1,0 +1,5 @@
+"""LSM-equivalent durable storage (SURVEY §2.4 TPU mapping)."""
+
+from .forest import Forest, Manifest, RunRef
+
+__all__ = ["Forest", "Manifest", "RunRef"]

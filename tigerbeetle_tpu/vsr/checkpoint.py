@@ -26,6 +26,7 @@ import numpy as np
 from .checksum import checksum
 from ..ops import hash_table as ht
 from ..ops import state_machine as sm
+from ..utils.fs import atomic_write
 
 TABLE_NAMES = ("accounts", "transfers", "posted")
 
@@ -40,10 +41,10 @@ def _table_arrays(prefix: str, table: ht.Table, out: Dict[str, np.ndarray]) -> N
         out[f"{prefix}/cols/{name}"] = np.asarray(col)
 
 
-def _load_table(prefix: str, z) -> ht.Table:
+def _load_table(prefix: str, z, keys=None) -> ht.Table:
     cols = {}
     cols_prefix = f"{prefix}/cols/"
-    for key in z.files:
+    for key in keys if keys is not None else z.files:
         if key.startswith(cols_prefix):
             cols[key[len(cols_prefix):]] = jnp.asarray(z[key])
     return ht.Table(
@@ -60,17 +61,45 @@ def path_for(data_path: str, op: int) -> str:
     return f"{data_path}.checkpoint.{op}"
 
 
-def save(
-    data_path: str, op: int, ledger: sm.Ledger, meta: Optional[dict] = None
-) -> Tuple[str, int]:
-    """Write the snapshot for checkpoint ``op`` atomically; returns
-    (path, file_checksum)."""
+def ledger_to_arrays(ledger: sm.Ledger) -> Dict[str, np.ndarray]:
+    """Flatten a ledger into the snapshot's flat key->array dict (the same
+    keys the npz uses); shared with the LSM forest's delta computation."""
     arrays: Dict[str, np.ndarray] = {}
     for name in TABLE_NAMES:
         _table_arrays(name, getattr(ledger, name), arrays)
     for name, col in ledger.history.cols.items():
         arrays[f"history/cols/{name}"] = np.asarray(col)
     arrays["history/count"] = np.asarray(ledger.history.count)
+    return arrays
+
+
+def arrays_to_ledger(arrays) -> sm.Ledger:
+    """Inverse of ledger_to_arrays; accepts any mapping with npz-style keys
+    (an NpzFile or a plain dict)."""
+    keys = arrays.files if hasattr(arrays, "files") else arrays.keys()
+    return sm.Ledger(
+        accounts=_load_table("accounts", arrays, keys),
+        transfers=_load_table("transfers", arrays, keys),
+        posted=_load_table("posted", arrays, keys),
+        history=sm.History(
+            cols={
+                key[len("history/cols/"):]: jnp.asarray(arrays[key])
+                for key in keys
+                if key.startswith("history/cols/")
+            },
+            count=jnp.asarray(arrays["history/count"]),
+        )
+        if "history/count" in keys
+        else sm.make_history(1),
+    )
+
+
+def save(
+    data_path: str, op: int, ledger: sm.Ledger, meta: Optional[dict] = None
+) -> Tuple[str, int]:
+    """Write the snapshot for checkpoint ``op`` atomically; returns
+    (path, file_checksum)."""
+    arrays = ledger_to_arrays(ledger)
     arrays["meta"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8
     ).copy()
@@ -81,17 +110,7 @@ def save(
     file_checksum = checksum(blob)
 
     path = path_for(data_path, op)
-    tmp = path + f".tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
-    try:
-        os.fsync(dfd)
-    finally:
-        os.close(dfd)
+    atomic_write(path, blob)
     return path, file_checksum
 
 
@@ -109,23 +128,7 @@ def load(
             f"(got {actual:#x}, superblock says {expected_checksum:#x})"
         )
     z = np.load(io.BytesIO(blob))
-    ledger = sm.Ledger(
-        accounts=_load_table("accounts", z),
-        transfers=_load_table("transfers", z),
-        posted=_load_table("posted", z),
-        # Snapshots written before the history groove existed load as an
-        # empty log (grown on demand by the machine).
-        history=sm.History(
-            cols={
-                key[len("history/cols/"):]: jnp.asarray(z[key])
-                for key in z.files
-                if key.startswith("history/cols/")
-            },
-            count=jnp.asarray(z["history/count"]),
-        )
-        if "history/count" in z.files
-        else sm.make_history(1),
-    )
+    ledger = arrays_to_ledger(z)
     meta = json.loads(bytes(z["meta"]).decode()) if "meta" in z.files else {}
     return ledger, meta
 

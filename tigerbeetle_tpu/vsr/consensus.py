@@ -982,11 +982,15 @@ class VsrReplica(Replica):
         offset = int(h["offset"])
         if checkpoint_op != self.op_checkpoint or self.op_checkpoint == 0:
             return []
-        path = checkpoint_mod.path_for(self.data_path, self.op_checkpoint)
         try:
+            # One full blob even when the checkpoint is base+delta-runs
+            # (forest materializes and caches it per checkpoint op).
+            path, file_checksum = self.forest.materialize_file(
+                self.op_checkpoint
+            )
             with open(path, "rb") as f:
                 blob = f.read()
-        except OSError:
+        except (OSError, AssertionError):
             return []
         if offset >= len(blob):
             return []
@@ -996,7 +1000,7 @@ class VsrReplica(Replica):
             checkpoint_op=self.op_checkpoint,
             offset=offset,
             total=len(blob),
-            file_checksum=self._sb_state.checkpoint_file_checksum,
+            file_checksum=file_checksum,
             commit_max=self.commit_min,
         )
         return [(("replica", int(h["replica"])), wire.encode(resp, chunk))]
@@ -1020,12 +1024,14 @@ class VsrReplica(Replica):
 
     def _install_sync_checkpoint(self) -> List[Msg]:
         """Install a fully-fetched checkpoint snapshot and rejoin."""
+        from ..utils.fs import atomic_write
+
         target = self.sync_target
         op = target["checkpoint_op"]
         path = checkpoint_mod.path_for(self.data_path, op)
-        with open(path, "wb") as f:
-            f.write(bytes(self.sync_buffer))
-            f.flush()
+        # Durably in place BEFORE the superblock/manifest reference its
+        # checksum — a crash in between must find the full blob on disk.
+        atomic_write(path, bytes(self.sync_buffer))
         try:
             ledger, meta = checkpoint_mod.load(
                 self.data_path, op, target["file_checksum"]
@@ -1055,6 +1061,9 @@ class VsrReplica(Replica):
         self.stash.clear()
         self.missing.clear()
         self.parent_checksum = 0
+        manifest_checksum = self.forest.adopt_base(
+            ledger, meta, op, target["file_checksum"]
+        )
         state = SuperBlockState(
             cluster=self.cluster,
             replica=self.replica,
@@ -1068,10 +1077,11 @@ class VsrReplica(Replica):
             ledger_digest=self.machine.digest(),
             prepare_timestamp=self.machine.prepare_timestamp,
             commit_timestamp=self.machine.commit_timestamp,
+            manifest_checksum=manifest_checksum,
         )
         self.superblock.checkpoint(state)
         self._sb_state = state
-        checkpoint_mod.remove_older_than(self.data_path, op)
+        self.forest.gc()
         self.sync_target = None
         self.sync_buffer = bytearray()
         self.status = RECOVERING

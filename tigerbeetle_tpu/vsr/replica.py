@@ -78,6 +78,11 @@ class Replica:
         self.storage = storage if storage is not None else Storage(
             data_path, self.config
         )
+        # LSM-equivalent durable layer: base snapshot + delta runs + manifest
+        # (lsm/forest.py); full snapshots only at majors/capacity changes.
+        from ..lsm.forest import Forest
+
+        self.forest = Forest(data_path)
         self.superblock = SuperBlock(self.storage)
         self.journal = Journal(self.storage)
         self.machine = TpuStateMachine(self.ledger_config, batch_lanes=batch_lanes)
@@ -144,9 +149,19 @@ class Replica:
         self.commit_min = sb.op_checkpoint
 
         if sb.op_checkpoint > 0 or sb.checkpoint_file_checksum != 0:
-            ledger, meta = checkpoint_mod.load(
-                self.data_path, sb.op_checkpoint, sb.checkpoint_file_checksum
-            )
+            if sb.manifest_checksum:
+                ledger, meta = self.forest.open(
+                    sb.op_checkpoint, sb.manifest_checksum
+                )
+            else:  # legacy full-snapshot checkpoint (no manifest)
+                ledger, meta = checkpoint_mod.load(
+                    self.data_path, sb.op_checkpoint, sb.checkpoint_file_checksum
+                )
+                # Seed the forest so state-sync can materialize this
+                # checkpoint and the next checkpoint goes delta.
+                self.forest.seed_base(
+                    ledger, sb.op_checkpoint, sb.checkpoint_file_checksum
+                )
             self.machine.ledger = ledger
             self.machine.restore_host_state(meta["machine"])
             digest = self.machine.digest()
@@ -470,8 +485,8 @@ class Replica:
                 for client, s in self.sessions.items()
             },
         }
-        _, file_checksum = checkpoint_mod.save(
-            self.data_path, self.commit_min, self.machine.ledger, meta
+        file_checksum, manifest_checksum = self.forest.checkpoint(
+            self.machine.ledger, meta, self.commit_min
         )
         state = SuperBlockState(
             cluster=self.cluster,
@@ -486,11 +501,14 @@ class Replica:
             ledger_digest=self.machine.digest(),
             prepare_timestamp=self.machine.prepare_timestamp,
             commit_timestamp=self.machine.commit_timestamp,
+            manifest_checksum=manifest_checksum,
         )
         self.superblock.checkpoint(state)
         self._sb_state = state
         self.op_checkpoint = self.commit_min
-        checkpoint_mod.remove_older_than(self.data_path, self.commit_min)
+        # GC only after the superblock referencing the new manifest is
+        # durable (crash before this point must find the old files intact).
+        self.forest.gc()
 
     def close(self) -> None:
         self.storage.close()

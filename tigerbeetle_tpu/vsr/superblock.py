@@ -52,7 +52,11 @@ SUPERBLOCK_DTYPE = np.dtype(
         ("ledger_digest", "<u8"),        # state-machine parity digest
         ("prepare_timestamp", "<u8"),
         ("commit_timestamp", "<u8"),
-        ("reserved", "V3952"),
+        # LSM manifest reference (forest.py; manifest_log.zig's superblock
+        # manifest refs).  Zero => legacy full-snapshot checkpoint.
+        ("manifest_checksum_lo", "<u8"),
+        ("manifest_checksum_hi", "<u8"),
+        ("reserved", "V3936"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE, SUPERBLOCK_DTYPE.itemsize
@@ -73,6 +77,7 @@ class SuperBlockState:
     ledger_digest: int = 0
     prepare_timestamp: int = 0
     commit_timestamp: int = 0
+    manifest_checksum: int = 0
 
 
 def _encode_copy(state: SuperBlockState, copy: int) -> bytes:
@@ -97,6 +102,8 @@ def _encode_copy(state: SuperBlockState, copy: int) -> bytes:
     rec["ledger_digest"] = state.ledger_digest
     rec["prepare_timestamp"] = state.prepare_timestamp
     rec["commit_timestamp"] = state.commit_timestamp
+    rec["manifest_checksum_lo"] = state.manifest_checksum & 0xFFFF_FFFF_FFFF_FFFF
+    rec["manifest_checksum_hi"] = state.manifest_checksum >> 64
     buf = bytearray(rec.tobytes())
     # checksum covers everything after the 16-byte checksum field, except the
     # copy byte (so all copies share one checksum; a misdirected copy write is
@@ -138,6 +145,10 @@ def _decode_copy(buf: bytes) -> Optional[Tuple[SuperBlockState, int]]:
         ledger_digest=int(rec["ledger_digest"]),
         prepare_timestamp=int(rec["prepare_timestamp"]),
         commit_timestamp=int(rec["commit_timestamp"]),
+        manifest_checksum=(
+            (int(rec["manifest_checksum_hi"]) << 64)
+            | int(rec["manifest_checksum_lo"])
+        ),
     )
     return state, int(rec["copy"])
 
