@@ -1,0 +1,60 @@
+"""VOPR tests: random-schedule runs of the real cluster + the vectorized
+protocol-model VOPR (oracle must be clean on the correct model and catch
+injected bugs)."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.sim import vopr_tpu
+from tigerbeetle_tpu.sim.vopr import EXIT_PASSED, run_seed
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_vopr_random_schedule_passes(tmp_path, seed):
+    result = run_seed(seed, workdir=str(tmp_path), ticks=3_000)
+    assert result.exit_code == EXIT_PASSED, result
+    assert result.commits > 0
+
+
+def test_vopr_tpu_correct_model_is_safe():
+    v = vopr_tpu.run(seed=5, n_clusters=256, n_steps=250)
+    assert v.sum() == 0, f"{v.sum()} false-positive violations"
+    # Harsh fault schedule too.
+    v = vopr_tpu.run(
+        seed=5, n_clusters=256, n_steps=250,
+        p_crash=0.08, p_restart=0.3, p_view_change=0.5, p_link=0.5,
+    )
+    assert v.sum() == 0
+
+
+def test_vopr_tpu_flexible_quorums_r5():
+    v = vopr_tpu.run(
+        seed=6, n_clusters=128, n_steps=200, n_replicas=5,
+        p_crash=0.08, p_restart=0.3, p_view_change=0.5, p_link=0.5,
+    )
+    assert v.sum() == 0
+
+
+@pytest.mark.parametrize(
+    "bug", ["commit_quorum", "canonical_by_op", "no_truncate"]
+)
+def test_vopr_tpu_catches_injected_bugs(bug):
+    v = vopr_tpu.run(
+        seed=1, n_clusters=512, n_steps=400, bug=bug,
+        p_crash=0.08, p_restart=0.3, p_view_change=0.5, p_link=0.5,
+    )
+    assert v.sum() > 0, f"oracle missed injected bug {bug}"
+
+
+def test_vopr_tpu_deterministic():
+    a = vopr_tpu.run(seed=9, n_clusters=64, n_steps=100, bug="commit_quorum",
+                     p_crash=0.08)
+    b = vopr_tpu.run(seed=9, n_clusters=64, n_steps=100, bug="commit_quorum",
+                     p_crash=0.08)
+    assert np.array_equal(a, b)
+
+
+def test_vopr_tpu_sharded_over_mesh():
+    v = vopr_tpu.run_sharded(seed=2, n_clusters=512, n_steps=150)
+    assert len(v) >= 512
+    assert v.sum() == 0

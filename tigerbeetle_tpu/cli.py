@@ -59,6 +59,26 @@ def main(argv=None) -> int:
     p_repl.add_argument("--command", default=None,
                         help="one-shot statement(s); omit for interactive")
 
+    p_vopr = sub.add_parser(
+        "vopr", help="deterministic fault-injection simulator (the VOPR)"
+    )
+    p_vopr.add_argument("--seed", type=int, default=None,
+                        help="single seed; omit for a random one")
+    p_vopr.add_argument("--count", type=int, default=1,
+                        help="number of consecutive seeds to run")
+    p_vopr.add_argument("--ticks", type=int, default=6_000)
+    p_vopr.add_argument("--tpu", action="store_true",
+                        help="run the vectorized protocol-model VOPR on "
+                             "the available accelerator mesh instead")
+    p_vopr.add_argument("--clusters", type=int, default=4096,
+                        help="(--tpu) simulated clusters in the batch")
+    p_vopr.add_argument("--steps", type=int, default=400)
+    p_vopr.add_argument("--bug", default=None,
+                        choices=["commit_quorum", "canonical_by_op",
+                                 "no_truncate"],
+                        help="(--tpu) inject a known consensus bug to "
+                             "validate the oracle")
+
     p_bench = sub.add_parser("benchmark", help="client-driven load benchmark")
     p_bench.add_argument("--addresses", default=None,
                          help="existing cluster; omit to spawn a temp replica")
@@ -74,7 +94,55 @@ def main(argv=None) -> int:
         "version": _cmd_version,
         "repl": _cmd_repl,
         "benchmark": _cmd_benchmark,
+        "vopr": _cmd_vopr,
     }[args.subcommand](args)
+
+
+def _cmd_vopr(args) -> int:
+    import secrets
+
+    from .sim.vopr import EXIT_CORRECTNESS
+
+    if args.tpu:
+        from .sim import vopr_tpu
+
+        if args.count != 1 or args.ticks != 6_000:
+            print("error: --count/--ticks apply only without --tpu",
+                  file=sys.stderr)
+            return 2
+        violations = vopr_tpu.run_sharded(
+            seed=args.seed if args.seed is not None else secrets.randbits(31),
+            n_clusters=args.clusters,
+            n_steps=args.steps,
+            bug=args.bug,
+        )
+        n = int(violations.sum())
+        print(
+            f"vopr-tpu: {len(violations)} clusters x {args.steps} steps, "
+            f"{n} safety violations"
+            + (f" (bug={args.bug} injected)" if args.bug else "")
+        )
+        if args.bug:
+            return 0 if n > 0 else 1  # the oracle must catch a known bug
+        return EXIT_CORRECTNESS if n > 0 else 0
+
+    from .sim.vopr import run_seed
+
+    if args.bug is not None or args.clusters != 4096 or args.steps != 400:
+        print("error: --clusters/--steps/--bug apply only with --tpu",
+              file=sys.stderr)
+        return 2
+    first = args.seed if args.seed is not None else secrets.randbits(31)
+    worst = 0
+    for seed in range(first, first + args.count):
+        result = run_seed(seed, ticks=args.ticks)
+        print(
+            f"seed={result.seed} exit={result.exit_code} "
+            f"commits={result.commits} faults={result.faults} "
+            f"ticks={result.ticks}: {result.reason}"
+        )
+        worst = max(worst, result.exit_code)
+    return worst
 
 
 def _cmd_format(args) -> int:

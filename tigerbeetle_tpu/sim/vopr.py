@@ -1,0 +1,130 @@
+"""VOPR driver: seeded random fault schedules against the real cluster.
+
+The analogue of src/simulator.zig's main loop + src/vopr.zig's exit-code
+protocol: derive a random topology and fault schedule from one seed, run the
+REAL consensus code (sim/cluster.py) through it, then heal everything and
+require convergence.  Exit codes match the reference
+(testing/cluster.zig:35-41): 0 = passed, 128 = liveness (no convergence
+after healing), 129 = correctness (oracle violation).
+
+Usage: ``python -m tigerbeetle_tpu vopr --seed 42`` (see cli.py), or
+``run_seed`` from tests.  A failing seed replays identically — print it,
+fix the bug, re-run the seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import tempfile
+from typing import Optional
+
+from .cluster import SimCluster
+from .network import PacketSimulator
+
+EXIT_PASSED = 0
+EXIT_LIVENESS = 128
+EXIT_CORRECTNESS = 129
+
+
+@dataclasses.dataclass
+class VoprResult:
+    seed: int
+    exit_code: int
+    reason: str
+    ticks: int
+    commits: int
+    faults: int
+
+
+def run_seed(
+    seed: int,
+    workdir: Optional[str] = None,
+    ticks: int = 6_000,
+    settle_ticks: int = 60_000,
+) -> VoprResult:
+    """One VOPR run: random topology + faults from ``seed``."""
+    rng = random.Random(seed)
+    n_replicas = rng.choice([2, 3, 3, 3, 5])  # simulator.zig random topology
+    n_clients = rng.randint(1, 3)
+    requests = rng.randint(8, 20)
+    net = PacketSimulator(
+        seed=seed + 1,
+        delay_mean=rng.randint(2, 5),
+        delay_max=rng.randint(10, 40),
+        loss_probability=rng.choice([0.0, 0.02, 0.1]),
+        replay_probability=rng.choice([0.0, 0.02]),
+    )
+
+    def go(workdir: str) -> VoprResult:
+        cluster = SimCluster(
+            workdir,
+            n_replicas=n_replicas,
+            n_clients=n_clients,
+            seed=seed,
+            requests_per_client=requests,
+            net=net,
+        )
+        faults = 0
+        down: set = set()
+        partitioned = False
+        try:
+            for t in range(ticks):
+                cluster.step()
+                # Random fault events (simulator.zig crash/partition probs).
+                r = rng.random()
+                if r < 0.002 and len(down) + 1 < n_replicas:
+                    victim = rng.randrange(n_replicas)
+                    if victim not in down:
+                        cluster.crash(victim)
+                        down.add(victim)
+                        faults += 1
+                elif r < 0.004 and down:
+                    back = rng.choice(sorted(down))
+                    cluster.restart(back)
+                    down.discard(back)
+                elif r < 0.0055 and not partitioned and n_replicas >= 3:
+                    lone = rng.randrange(n_replicas)
+                    cluster.partition(
+                        [[lone], [i for i in range(n_replicas) if i != lone]]
+                    )
+                    partitioned = True
+                    faults += 1
+                elif r < 0.007 and partitioned:
+                    cluster.heal()
+                    partitioned = False
+            # Heal everything; the cluster must converge.
+            cluster.heal()
+            for i in sorted(down):
+                cluster.restart(i)
+            down.clear()
+            ok = cluster.run_until(
+                lambda: cluster.clients_done() and cluster.converged(),
+                max_ticks=settle_ticks,
+            )
+            commits = max(
+                (r.commit_min for r in cluster.replicas if r is not None),
+                default=0,
+            )
+            if not ok:
+                return VoprResult(
+                    seed, EXIT_LIVENESS,
+                    f"no convergence after {settle_ticks} settle ticks: "
+                    f"{[(r.status, r.view, r.commit_min, r.op) if r else None for r in cluster.replicas]}",
+                    cluster.t, commits, faults,
+                )
+            cluster.check_converged()
+            cluster.check_conservation()
+            return VoprResult(
+                seed, EXIT_PASSED, "passed", cluster.t, commits, faults
+            )
+        except AssertionError as err:
+            return VoprResult(
+                seed, EXIT_CORRECTNESS, f"oracle violation: {err}",
+                cluster.t, 0, faults,
+            )
+
+    if workdir is not None:
+        return go(workdir)
+    with tempfile.TemporaryDirectory() as d:
+        return go(d)

@@ -1,0 +1,303 @@
+"""Pmapped VOPR: massively-parallel consensus fault search on TPU.
+
+The reference's VOPR (src/simulator.zig) runs ONE seeded cluster per process
+and farms seeds out to a fleet (src/vopr_hub).  The TPU-native equivalent
+runs THOUSANDS of simulated clusters as one batched, jitted computation:
+each cluster is a pure state tensor, each step applies a seeded random fault
+schedule (crashes/restarts, message loss, view changes) to a vectorized
+model of the VSR protocol, and the safety oracle — committed log prefixes
+must agree across replicas (state_checker.zig's invariant) — is evaluated
+on-device every step.  vmap batches clusters; shard_map spreads batches over
+the chip mesh, so a v5e slice explores millions of schedules per minute.
+
+Two layers of testing share the oracle (SURVEY §4):
+- sim/cluster.py runs the REAL consensus code on one schedule at a time
+  (fidelity); this module runs the protocol MODEL at device scale (search).
+- ``bug`` injects classic consensus bugs (commit quorum too small, canonical
+  log chosen by op instead of (log_view, op), missing truncation) to prove
+  the oracle catches them — the fuzzer's fuzzer (vopr.zig's -Dbug builds).
+
+Protocol model (per cluster, R replicas, S log slots):
+- state: status (alive/crashed), view, log_view, op, commit, log[R,S]
+  (entry = unique nonzero hash of (view, op) — divergence is detectable).
+- step: crash/restart flips; primary of the max alive view appends entries;
+  backups chain-replicate slot-by-slot with per-link loss; the primary
+  commits at a replication quorum of matching entries in its view; a
+  crashed primary triggers a view change at a view-change quorum which
+  adopts the canonical log by max (log_view, op) — vsr.zig:910-986 flexible
+  quorums, replica.zig DVC selection.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..vsr.consensus import quorums
+
+
+class ClusterState(NamedTuple):
+    status: jnp.ndarray     # (R,) i32: 0 alive, 1 crashed
+    view: jnp.ndarray       # (R,) i32
+    log_view: jnp.ndarray   # (R,) i32: view whose log this replica carries
+    op: jnp.ndarray         # (R,) i32 journal head
+    commit: jnp.ndarray     # (R,) i32
+    log: jnp.ndarray        # (R, S) u32 entry ids (0 = empty)
+    violated: jnp.ndarray   # () bool: safety violation detected
+
+
+def _entry(view: jnp.ndarray, op: jnp.ndarray) -> jnp.ndarray:
+    """Unique nonzero id for the prepare created at (view, op)."""
+    h = (view.astype(jnp.uint32) * jnp.uint32(2654435761)) ^ (
+        op.astype(jnp.uint32) * jnp.uint32(40503)
+    )
+    return h | jnp.uint32(1)
+
+
+def make_state(n_replicas: int, slots: int) -> ClusterState:
+    return ClusterState(
+        status=jnp.zeros(n_replicas, jnp.int32),
+        view=jnp.zeros(n_replicas, jnp.int32),
+        log_view=jnp.zeros(n_replicas, jnp.int32),
+        op=jnp.zeros(n_replicas, jnp.int32),
+        commit=jnp.zeros(n_replicas, jnp.int32),
+        log=jnp.zeros((n_replicas, slots), jnp.uint32),
+        violated=jnp.zeros((), bool),
+    )
+
+
+def step(
+    state: ClusterState,
+    key: jax.Array,
+    *,
+    n_replicas: int,
+    slots: int,
+    p_crash: float = 0.01,
+    p_restart: float = 0.2,
+    p_append: float = 0.6,
+    p_link: float = 0.7,
+    p_view_change: float = 0.3,
+    bug: Optional[str] = None,
+) -> ClusterState:
+    """One simulation step for one cluster (vmapped over clusters)."""
+    R, S = n_replicas, slots
+    q_repl, q_view = quorums(R)
+    if bug == "commit_quorum":
+        q_repl = max(1, q_repl - 1)   # classic: commit below quorum
+    k_crash, k_restart, k_append, k_link, k_vc = jax.random.split(key, 5)
+    rids = jnp.arange(R)
+
+    status, view, log_view, op, commit, log, violated = state
+
+    # 1. Crashes and restarts (WAL persists: op/commit/log survive).
+    crash = jax.random.bernoulli(k_crash, p_crash, (R,)) & (status == 0)
+    restart = jax.random.bernoulli(k_restart, p_restart, (R,)) & (status == 1)
+    status = jnp.where(crash, 1, jnp.where(restart, 0, status))
+    alive = status == 0
+
+    # 2. The cluster's working view and primary.
+    cluster_view = jnp.max(jnp.where(alive, view, 0))
+    primary = cluster_view % R
+    p_alive = alive[primary]
+    p_current = p_alive & (log_view[primary] == cluster_view)
+
+    # Replicas whose log predates the cluster view install it (start_view):
+    # truncate to the primary's head and mark the log as current.  A replica
+    # may NOT ack or commit in a view before installing — prepare_ok implies
+    # the sender's log is the view's log (replica.zig on_start_view).
+    joiner = alive & (log_view < cluster_view) & p_current
+    view = jnp.where(joiner, cluster_view, view)
+    if bug != "no_truncate":
+        # SV replaces the joiner's log with the canonical headers (truncating
+        # any fork) — retaining an old-view prefix unverified while marking
+        # the log current is exactly the bug the oracle caught in an earlier
+        # draft of this model.
+        slot_idx = jnp.arange(S)[None, :]
+        canonical_log = jnp.where(
+            slot_idx <= op[primary], log[primary][None, :], jnp.uint32(0)
+        )
+        log = jnp.where(joiner[:, None], canonical_log, log)
+        op = jnp.where(joiner, op[primary], op)
+    log_view = jnp.where(joiner, cluster_view, log_view)
+
+    # 3. Primary appends a new entry (client request -> prepare).
+    can_append = p_current & (op[primary] + 1 < S) & jax.random.bernoulli(
+        k_append, p_append
+    )
+    new_op = op[primary] + 1
+    append_entry = _entry(cluster_view, new_op)
+    one_hot_p = rids == primary
+    log = jnp.where(
+        (one_hot_p[:, None] & (jnp.arange(S)[None, :] == new_op) & can_append),
+        append_entry,
+        log,
+    )
+    op = jnp.where(one_hot_p & can_append, new_op, op)
+
+    # 4. Chain replication: each current backup syncs its first divergent or
+    # missing slot from the primary (repair + ring replication collapsed
+    # into one slot/step/replica; per-link delivery is lossy).
+    link_up = jax.random.bernoulli(k_link, p_link, (R,))
+    is_backup = (
+        alive & (log_view == cluster_view) & (~one_hot_p) & p_current
+    )
+    slot_idx = jnp.arange(S)[None, :]
+    in_primary = slot_idx <= op[primary][None]
+    mismatch = (log != log[primary][None, :]) & in_primary
+    first_bad = jnp.where(
+        mismatch.any(axis=1), jnp.argmax(mismatch, axis=1), op[primary] + 1
+    )
+    target = jnp.minimum(first_bad, jnp.minimum(op, op[primary]) + 1)
+    can_sync = is_backup & link_up & (target <= op[primary])
+    log = jnp.where(
+        (can_sync[:, None] & (slot_idx == target[:, None])),
+        log[primary][None, :].repeat(R, 0),
+        log,
+    )
+    op = jnp.where(can_sync, jnp.maximum(op, target), op)
+
+    # 5. Commit: the primary advances when a replication quorum holds the
+    # matching entry at commit+1 in the current view.
+    k = commit[primary] + 1
+    entry_k = log[primary, k % S]
+    # A prepare_ok refers to the op *number* in this view; a replica whose
+    # slot k matches the primary's log acks.  Under the no_truncate bug the
+    # backup skipped SV truncation, so its slot may hold a stale prepare
+    # while it still acks by number — the failure truncation prevents.
+    acks = alive & (log_view == cluster_view) & (op >= k)
+    if bug != "no_truncate":
+        acks = acks & (log[:, k % S] == entry_k)
+    can_commit = p_current & (k <= op[primary]) & (jnp.sum(acks) >= q_repl) & (
+        entry_k != 0
+    )
+    commit = jnp.where(one_hot_p & can_commit, k, commit)
+    # Backups learn the commit number (heartbeats), bounded by their own
+    # matching prefix.
+    safe_prefix = jnp.where(
+        mismatch.any(axis=1), first_bad - 1, jnp.minimum(op, op[primary])
+    )
+    commit = jnp.where(
+        is_backup & link_up,
+        jnp.maximum(commit, jnp.minimum(commit[primary], safe_prefix)),
+        commit,
+    )
+
+    # 6. View change on a dead primary at a view-change quorum: the new
+    # primary adopts the canonical log = max (log_view, op) among alive
+    # participants (replica.zig DVC selection).
+    do_vc = (
+        (~p_alive)
+        & (jnp.sum(alive) >= q_view)
+        & jax.random.bernoulli(k_vc, p_view_change)
+    )
+    new_view = cluster_view + 1
+    if bug == "canonical_by_op":
+        rank = op - jnp.where(alive, 0, 1 << 20)
+    else:
+        rank = log_view * (S + 1) + op - jnp.where(alive, 0, 1 << 20)
+    canonical = jnp.argmax(rank)
+    new_primary = new_view % R
+    np_alive = alive[new_primary]
+    install = do_vc & np_alive
+    one_hot_np = rids == new_primary
+    log = jnp.where(
+        (install & one_hot_np)[:, None], log[canonical][None, :], log
+    )
+    op = jnp.where(install & one_hot_np, op[canonical], op)
+    commit = jnp.where(
+        install & one_hot_np, jnp.maximum(commit, commit[canonical]), commit
+    )
+    log_view = jnp.where(install & one_hot_np, new_view, log_view)
+    view = jnp.where(do_vc & alive, new_view, view)
+
+    # 7. Safety oracle (state_checker.zig): committed prefixes must agree.
+    pair_commit = jnp.minimum(commit[:, None], commit[None, :])
+    slot_ge = jnp.arange(S)[None, None, :]
+    both = (slot_ge <= pair_commit[:, :, None]) & (slot_ge >= 1)
+    differ = log[:, None, :] != log[None, :, :]
+    violated = violated | (both & differ).any()
+
+    # Pin carry dtypes (the package enables x64; mixed-int arithmetic would
+    # otherwise promote and break the fori_loop carry contract).
+    return ClusterState(
+        status.astype(jnp.int32),
+        view.astype(jnp.int32),
+        log_view.astype(jnp.int32),
+        op.astype(jnp.int32),
+        commit.astype(jnp.int32),
+        log.astype(jnp.uint32),
+        violated,
+    )
+
+
+def _one_cluster_fn(n_steps: int, n_replicas: int, slots: int, bug, probs):
+    """Build the per-cluster schedule function (shared by run/run_sharded)."""
+    step_fn = functools.partial(
+        step, n_replicas=n_replicas, slots=slots, bug=bug, **probs
+    )
+
+    def one_cluster(key):
+        state = make_state(n_replicas, slots)
+
+        def body(i, carry):
+            state, key = carry
+            key, sub = jax.random.split(key)
+            return step_fn(state, sub), key
+
+        state, _ = jax.lax.fori_loop(0, n_steps, body, (state, key))
+        return state.violated
+
+    return one_cluster
+
+
+def run(
+    seed: int,
+    n_clusters: int,
+    n_steps: int,
+    n_replicas: int = 3,
+    slots: int = 32,
+    bug: Optional[str] = None,
+    **probs,
+) -> np.ndarray:
+    """Simulate ``n_clusters`` independent fault schedules for ``n_steps``;
+    returns the per-cluster violation flags (expected all-False unless a
+    ``bug`` is injected)."""
+    one_cluster = _one_cluster_fn(n_steps, n_replicas, slots, bug, probs)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_clusters)
+    return np.asarray(jax.jit(jax.vmap(one_cluster))(keys))
+
+
+def run_sharded(
+    seed: int,
+    n_clusters: int,
+    n_steps: int,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    **kwargs,
+) -> np.ndarray:
+    """Shard the cluster batch over the device mesh (one vmapped VOPR per
+    chip, embarrassingly parallel over ICI — BASELINE config 5)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs, ("vopr",))
+    n_dev = mesh.devices.size
+    n_clusters = (n_clusters + n_dev - 1) // n_dev * n_dev
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_clusters)
+    keys = jax.device_put(keys, NamedSharding(mesh, P("vopr", None)))
+
+    step_kwargs = dict(kwargs)
+    n_replicas = step_kwargs.pop("n_replicas", 3)
+    slots = step_kwargs.pop("slots", 32)
+    bug = step_kwargs.pop("bug", None)
+    one_cluster = _one_cluster_fn(n_steps, n_replicas, slots, bug, step_kwargs)
+
+    fn = jax.jit(
+        jax.vmap(one_cluster),
+        out_shardings=NamedSharding(mesh, P("vopr")),
+    )
+    return np.asarray(fn(keys))
