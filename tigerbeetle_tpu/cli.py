@@ -49,6 +49,8 @@ def main(argv=None) -> int:
     p_start.add_argument("--cache-accounts-log2", type=int, default=None,
                          help="accounts table capacity (log2 slots)")
     p_start.add_argument("--cache-transfers-log2", type=int, default=None)
+    p_start.add_argument("--aof", default=None, metavar="PATH",
+                         help="append-only audit log of committed prepares")
 
     p_version = sub.add_parser("version")
     p_version.add_argument("--verbose", action="store_true")
@@ -110,16 +112,17 @@ def _cmd_vopr(args) -> int:
             print("error: --count/--ticks apply only without --tpu",
                   file=sys.stderr)
             return 2
+        seed = args.seed if args.seed is not None else secrets.randbits(31)
         violations = vopr_tpu.run_sharded(
-            seed=args.seed if args.seed is not None else secrets.randbits(31),
+            seed=seed,
             n_clusters=args.clusters,
             n_steps=args.steps,
             bug=args.bug,
         )
         n = int(violations.sum())
         print(
-            f"vopr-tpu: {len(violations)} clusters x {args.steps} steps, "
-            f"{n} safety violations"
+            f"vopr-tpu: seed={seed} {len(violations)} clusters x "
+            f"{args.steps} steps, {n} safety violations"
             + (f" (bug={args.bug} injected)" if args.bug else "")
         )
         if args.bug:
@@ -178,7 +181,9 @@ def _cmd_start(args) -> int:
         from .net.cluster_bus import run_cluster_server
         from .vsr.consensus import VsrReplica
 
-        replica = VsrReplica(args.path, ledger_config=ledger_config)
+        replica = VsrReplica(
+            args.path, ledger_config=ledger_config, aof_path=args.aof
+        )
         replica.open()
         host = addresses[replica.replica][0]
 
@@ -188,7 +193,7 @@ def _cmd_start(args) -> int:
         run_cluster_server(replica, addresses, ready_callback=ready)
         return 0
 
-    replica = Replica(args.path, ledger_config=ledger_config)
+    replica = Replica(args.path, ledger_config=ledger_config, aof_path=args.aof)
     replica.open()
     if replica.replica_count != 1:
         # A multi-replica data file must never be served solo: commits

@@ -1,0 +1,116 @@
+"""flags: dataclass-driven CLI argument parsing (src/flags.zig, 998 LoC).
+
+The reference parses CLI flags straight into comptime structs with a
+fatal-error policy (flags.zig:1-38: unknown flags abort, values are
+validated eagerly, ``--flag=value`` syntax).  The Python analogue parses
+into dataclasses: field names map to ``--kebab-case`` flags, types drive
+parsing (bool flags need no value; ints accept 0x/0o prefixes; Optional
+unwraps), defaults mark flags optional, and any error is fatal with a
+one-line message — no partial parses.
+
+    @dataclasses.dataclass
+    class StartArgs:
+        path: str                  # positional (no default, non-flag)
+        addresses: str = "127.0.0.1:3000"
+        cache_accounts_log2: Optional[int] = None
+        verbose: bool = False
+
+    args = parse(StartArgs, argv)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import typing
+from typing import List, Optional, Sequence, Type, TypeVar
+
+T = TypeVar("T")
+
+
+class FlagsError(SystemExit):
+    def __init__(self, message: str) -> None:
+        print(f"error: {message}", file=sys.stderr)
+        super().__init__(2)
+
+
+def _flag_name(field_name: str) -> str:
+    return "--" + field_name.replace("_", "-")
+
+
+def _unwrap_optional(tp):
+    if typing.get_origin(tp) is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _parse_value(tp, raw: str, flag: str):
+    tp = _unwrap_optional(tp)
+    if tp is int:
+        try:
+            return int(raw, 0)  # accepts 0x.., 0o.., decimal
+        except ValueError:
+            raise FlagsError(f"{flag}: expected an integer, got {raw!r}")
+    if tp is float:
+        try:
+            return float(raw)
+        except ValueError:
+            raise FlagsError(f"{flag}: expected a float, got {raw!r}")
+    if tp is bool:
+        if raw in ("true", "1"):
+            return True
+        if raw in ("false", "0"):
+            return False
+        raise FlagsError(f"{flag}: expected true/false, got {raw!r}")
+    if tp is str:
+        return raw
+    raise FlagsError(f"{flag}: unsupported flag type {tp!r}")
+
+
+def parse(cls: Type[T], argv: Sequence[str]) -> T:
+    """Parse argv into an instance of dataclass ``cls`` (fatal on error)."""
+    assert dataclasses.is_dataclass(cls)
+    fields = dataclasses.fields(cls)
+    by_flag = {_flag_name(f.name): f for f in fields}
+    positionals = [
+        f for f in fields
+        if f.default is dataclasses.MISSING
+        and f.default_factory is dataclasses.MISSING
+    ]
+    values: dict = {}
+    pos_index = 0
+    i = 0
+    argv = list(argv)
+    while i < len(argv):
+        arg = argv[i]
+        if arg.startswith("--"):
+            name, eq, raw = arg.partition("=")
+            field = by_flag.get(name)
+            if field is None:
+                raise FlagsError(f"unknown flag {name}")
+            tp = _unwrap_optional(field.type if not isinstance(field.type, str)
+                                  else typing.get_type_hints(cls)[field.name])
+            if tp is bool and not eq:
+                values[field.name] = True
+            else:
+                if not eq:
+                    i += 1
+                    if i >= len(argv):
+                        raise FlagsError(f"{name}: missing value")
+                    raw = argv[i]
+                values[field.name] = _parse_value(tp, raw, name)
+        else:
+            if pos_index >= len(positionals):
+                raise FlagsError(f"unexpected positional argument {arg!r}")
+            field = positionals[pos_index]
+            tp = (field.type if not isinstance(field.type, str)
+                  else typing.get_type_hints(cls)[field.name])
+            values[field.name] = _parse_value(tp, arg, field.name)
+            pos_index += 1
+        i += 1
+    missing = [f.name for f in positionals if f.name not in values]
+    if missing:
+        raise FlagsError(f"missing required argument(s): {', '.join(missing)}")
+    return cls(**values)

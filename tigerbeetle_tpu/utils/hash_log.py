@@ -1,0 +1,73 @@
+"""hash_log: record/check execution digests to pinpoint divergence.
+
+The reference's determinism debugger (src/testing/hash_log.zig:1-5 +
+``-Dhash-log-mode``): run once in ``record`` mode writing a hash at every
+chosen point; run the supposedly-identical execution in ``check`` mode and
+it asserts at the FIRST diverging point — turning "the final states differ"
+into "they diverged at commit 17".  This is the tool for TPU-vs-oracle and
+replica-vs-replica parity hunts (SURVEY §4.7: directly reusable for
+Zig-vs-JAX parity checking).
+
+Usage::
+
+    log = HashLog("run.hashlog", mode="record")   # first run
+    log.log(machine.digest(), note=f"commit {op}")
+    ...
+    log = HashLog("run.hashlog", mode="check")    # second run
+    log.log(machine.digest(), note=f"commit {op}")  # raises on divergence
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class HashDivergence(AssertionError):
+    pass
+
+
+class HashLog:
+    def __init__(self, path: str, mode: str) -> None:
+        assert mode in ("record", "check", "off")
+        self.path = path
+        self.mode = mode
+        self.position = 0
+        self._recorded: List[int] = []
+        self._expected: List[tuple] = []
+        if mode == "check":
+            with open(path) as f:
+                for line in f:
+                    digest_hex, _, note = line.rstrip("\n").partition(" ")
+                    self._expected.append((int(digest_hex, 16), note))
+
+    def log(self, digest: int, note: str = "") -> None:
+        if self.mode == "off":
+            return
+        if self.mode == "record":
+            self._recorded.append(digest)
+            with open(self.path, "a" if self.position else "w") as f:
+                f.write(f"{digest:032x} {note}\n")
+            self.position += 1
+            return
+        # check mode
+        if self.position >= len(self._expected):
+            raise HashDivergence(
+                f"hash_log: check run is longer than the recording "
+                f"({len(self._expected)} entries) at {note!r}"
+            )
+        want, want_note = self._expected[self.position]
+        if digest != want:
+            raise HashDivergence(
+                f"hash_log: FIRST divergence at position {self.position} "
+                f"({note!r} vs recorded {want_note!r}): "
+                f"{digest:#x} != {want:#x}"
+            )
+        self.position += 1
+
+    def finish(self) -> None:
+        """In check mode, assert the recording was fully consumed."""
+        if self.mode == "check" and self.position != len(self._expected):
+            raise HashDivergence(
+                f"hash_log: check run is shorter than the recording "
+                f"({self.position}/{len(self._expected)})"
+            )

@@ -1,0 +1,48 @@
+"""StatsD metrics: non-blocking UDP emission (src/statsd.zig, 97 LoC).
+
+The reference emits counters/gauges/timings over UDP from the benchmark
+(benchmark_load.zig:120-129) without ever blocking the hot path.  Same
+discipline here: a connected non-blocking datagram socket; EAGAIN/any
+socket error drops the sample (metrics are best-effort by definition).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+
+class StatsD:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "tigerbeetle_tpu") -> None:
+        self.prefix = prefix
+        self._sock: Optional[socket.socket] = None
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.setblocking(False)
+            sock.connect((host, port))
+            self._sock = sock
+        except OSError:
+            self._sock = None  # metrics disabled; never fail the caller
+
+    def _send(self, payload: str) -> None:
+        if self._sock is None:
+            return
+        try:
+            self._sock.send(payload.encode())
+        except OSError:
+            pass  # full buffer / unreachable: drop the sample
+
+    def count(self, name: str, value: int = 1) -> None:
+        self._send(f"{self.prefix}.{name}:{value}|c")
+
+    def gauge(self, name: str, value: float) -> None:
+        self._send(f"{self.prefix}.{name}:{value}|g")
+
+    def timing(self, name: str, ms: float) -> None:
+        self._send(f"{self.prefix}.{name}:{ms}|ms")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

@@ -1,0 +1,102 @@
+"""AOF: append-only file of committed prepares (src/aof.zig).
+
+The reference optionally appends every prepare to a flat file with a
+synchronous write before executing it (replica.zig:3741-3746) — an
+independent, portable audit log that can rebuild or cross-check the cluster
+(e.g. migrate to different hardware, or diff two clusters' histories).
+
+Entries are exact wire-format prepare messages (self-framing: the 256-byte
+header carries the size and both checksums), so the wire codec is the AOF
+codec and `iterate` can validate every entry standalone.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from . import wire
+
+
+class AOF:
+    def __init__(self, path: str, fsync_each: bool = True) -> None:
+        self.path = path
+        self.fsync_each = fsync_each
+        self.fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        # Repair a torn tail from a prior crash: truncate to the last valid
+        # entry boundary so appended entries stay frameable.
+        valid = valid_length(path)
+        if valid < os.fstat(self.fd).st_size:
+            os.ftruncate(self.fd, valid)
+            os.fsync(self.fd)
+
+    def append(self, message: bytes) -> None:
+        """Append one prepare (wire bytes), durably (aof.zig O_SYNC)."""
+        written = os.write(self.fd, message)
+        assert written == len(message)
+        if self.fsync_each:
+            os.fsync(self.fd)
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            os.close(self.fd)
+            self.fd = -1
+
+
+def valid_length(path: str) -> int:
+    """Byte length of the valid entry prefix (the tear point, if any)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    offset = 0
+    while offset + wire.HEADER_SIZE <= len(blob):
+        try:
+            h, command = wire.decode_header(
+                blob[offset : offset + wire.HEADER_SIZE]
+            )
+        except ValueError:
+            break
+        if command != wire.Command.prepare:
+            break
+        size = int(h["size"])
+        if offset + size > len(blob):
+            break
+        try:
+            wire.verify_body(h, blob[offset + wire.HEADER_SIZE : offset + size])
+        except ValueError:
+            break
+        offset += size
+    return offset
+
+
+def iterate(path: str) -> Iterator[Tuple[np.ndarray, bytes]]:
+    """Yield (header, body) for every valid prepare, deduplicated by
+    checksum (crash-replay re-appends exact copies); stops at the first
+    corrupt/torn entry (a torn tail is expected after a crash)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    seen = set()
+    offset = 0
+    while offset + wire.HEADER_SIZE <= len(blob):
+        try:
+            h, command = wire.decode_header(
+                blob[offset : offset + wire.HEADER_SIZE]
+            )
+        except ValueError:
+            return
+        if command != wire.Command.prepare:
+            return
+        size = int(h["size"])
+        if offset + size > len(blob):
+            return  # torn tail
+        body = blob[offset + wire.HEADER_SIZE : offset + size]
+        try:
+            wire.verify_body(h, body)
+        except ValueError:
+            return
+        checksum = wire.header_checksum(h)
+        if checksum not in seen:
+            seen.add(checksum)
+            yield h, body
+        offset += size

@@ -28,6 +28,7 @@ import numpy as np
 
 from . import wire
 from .storage import Storage
+from ..utils.tracer import tracer
 
 
 @dataclasses.dataclass
@@ -57,6 +58,10 @@ class Journal:
 
     def write_prepare(self, message: bytes, sync: bool = True) -> None:
         """Durably journal a prepare message (header+body wire bytes)."""
+        with tracer.span("journal_write", size=len(message)):
+            self._write_prepare(message, sync)
+
+    def _write_prepare(self, message: bytes, sync: bool) -> None:
         h, command = wire.decode_header(message)
         assert command == wire.Command.prepare
         assert len(message) == int(h["size"]) <= self.config.message_size_max

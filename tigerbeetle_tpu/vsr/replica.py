@@ -29,6 +29,7 @@ import numpy as np
 from .. import types
 from ..config import ClusterConfig, LedgerConfig
 from ..machine import TpuStateMachine
+from ..utils.tracer import tracer
 from . import checkpoint as checkpoint_mod
 from . import wire
 from .journal import Journal
@@ -66,6 +67,7 @@ class Replica:
         batch_lanes: int = 8192,
         time_ns=time.time_ns,
         storage: Optional[Storage] = None,
+        aof_path: Optional[str] = None,
     ) -> None:
         self.data_path = data_path
         self.config = cluster_config or ClusterConfig()
@@ -83,6 +85,12 @@ class Replica:
         from ..lsm.forest import Forest
 
         self.forest = Forest(data_path)
+        # Optional append-only audit log of committed prepares (aof.zig).
+        self.aof = None
+        if aof_path:
+            from .aof import AOF
+
+            self.aof = AOF(aof_path)
         self.superblock = SuperBlock(self.storage)
         self.journal = Journal(self.storage)
         self.machine = TpuStateMachine(self.ledger_config, batch_lanes=batch_lanes)
@@ -294,6 +302,13 @@ class Replica:
 
         if operation == wire.Operation.root:
             return None
+        if self.aof is not None:
+            # Audit append BEFORE execution (replica.zig:3741-3746) — also
+            # during replay, so a crash between journaling and appending
+            # can't leave a committed op missing from the audit log.  The
+            # resulting crash-replay duplicates are exact byte copies and
+            # aof.iterate() dedupes them by checksum.
+            self.aof.append(wire.encode(header, body))
         if operation == wire.Operation.register:
             result_body = b""
             self.commit_min = op
@@ -302,7 +317,9 @@ class Replica:
             )
             self._admit_session(session)
         else:
-            result_body = self._execute(operation, body, timestamp)
+            with tracer.span("state_machine_commit", op=op,
+                             operation=operation.name):
+                result_body = self._execute(operation, body, timestamp)
             self.commit_min = op
 
         reply_h = wire.new_header(
@@ -470,6 +487,10 @@ class Replica:
 
     def checkpoint(self) -> None:
         """Durably snapshot ledger + sessions + superblock at commit_min."""
+        with tracer.span("checkpoint", op=self.commit_min):
+            self._checkpoint_inner()
+
+    def _checkpoint_inner(self) -> None:
         # Session replies live in the client_replies zone; make them durable
         # before the superblock references their sizes.
         self.storage.sync()
@@ -511,6 +532,8 @@ class Replica:
         self.forest.gc()
 
     def close(self) -> None:
+        if self.aof is not None:
+            self.aof.close()
         self.storage.close()
 
 
