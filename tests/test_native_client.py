@@ -1,0 +1,122 @@
+"""Native tb_client (C ABI, native/tb_client.cpp) against a live replica."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import native, types
+from tigerbeetle_tpu.config import ClusterConfig, LedgerConfig
+from tigerbeetle_tpu.net.bus import run_server
+from tigerbeetle_tpu.vsr.replica import Replica
+
+TEST_CONFIG = ClusterConfig(message_size_max=1 << 20, journal_slot_count=64)
+TEST_LEDGER = LedgerConfig(
+    accounts_capacity_log2=10, transfers_capacity_log2=12,
+    posted_capacity_log2=10, max_probe=1 << 10,
+)
+CLUSTER = 0xD2
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native toolchain unavailable"
+)
+
+
+def test_generated_header_up_to_date():
+    """The checked-in C header must match regeneration from types.py (the
+    reference's bindings are likewise generated from one canonical source)."""
+    import os
+
+    from tigerbeetle_tpu import bindings
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(bindings.__file__)),
+        "native", "tb_types.h",
+    )
+    with open(path) as f:
+        assert f.read() == bindings.generate_c_header(), (
+            "tb_types.h is stale: re-run python -m tigerbeetle_tpu.bindings"
+        )
+
+
+@pytest.fixture
+def server(tmp_path):
+    path = str(tmp_path / "native.tb")
+    Replica.format(path, cluster=CLUSTER, cluster_config=TEST_CONFIG)
+    replica = Replica(path, cluster_config=TEST_CONFIG,
+                      ledger_config=TEST_LEDGER, batch_lanes=64)
+    replica.open()
+    box = {}
+    ready = threading.Event()
+    thread = threading.Thread(
+        target=run_server,
+        args=(replica, "127.0.0.1", 0),
+        kwargs=dict(ready_callback=lambda p: (box.update(port=p), ready.set())),
+        daemon=True,
+    )
+    thread.start()
+    assert ready.wait(30)
+    yield [("127.0.0.1", box["port"])], replica
+
+
+def test_native_client_full_flow(server):
+    from tigerbeetle_tpu.native_client import NativeClient
+
+    addresses, replica = server
+    client = NativeClient(addresses, cluster=CLUSTER)
+    try:
+        accounts = types.accounts_array(
+            [types.account(id=i + 1, ledger=1, code=10) for i in range(6)]
+        )
+        assert client.create_accounts(accounts) == []
+
+        transfers = types.transfers_array(
+            [
+                types.transfer(
+                    id=100 + i, debit_account_id=1 + i % 6,
+                    credit_account_id=1 + (i + 1) % 6, amount=9, ledger=1,
+                    code=10,
+                )
+                for i in range(12)
+            ]
+        )
+        assert client.create_transfers(transfers) == []
+
+        rows = client.lookup_accounts([1, 2, 3])
+        assert len(rows) == 3
+        total_debits = sum(int(r["debits_posted_lo"]) for r in rows)
+        assert total_debits > 0
+
+        # Failure results round-trip with exact codes.
+        bad = types.transfers_array(
+            [types.transfer(id=0, debit_account_id=1, credit_account_id=2,
+                            amount=1, ledger=1, code=10)]
+        )
+        results = client.create_transfers(bad)
+        assert results == [
+            (0, int(types.CreateTransferResult.id_must_not_be_zero))
+        ]
+    finally:
+        client.close()
+
+
+def test_native_client_session_continuity(server):
+    """Sequential requests share one registered session (request numbers
+    advance; duplicate submission dedupes server-side)."""
+    from tigerbeetle_tpu.native_client import NativeClient
+
+    addresses, replica = server
+    client = NativeClient(addresses, cluster=CLUSTER)
+    try:
+        accounts = types.accounts_array(
+            [types.account(id=50 + i, ledger=1, code=10) for i in range(3)]
+        )
+        assert client.create_accounts(accounts) == []
+        for k in range(5):
+            rows = client.lookup_accounts([51])
+            assert len(rows) == 1
+        assert len(replica.sessions) == 1
+        session = next(iter(replica.sessions.values()))
+        assert session.request >= 6
+    finally:
+        client.close()

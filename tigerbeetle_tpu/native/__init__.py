@@ -15,7 +15,8 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["aegis.cpp"]
+_SOURCES = ["aegis.cpp", "tb_client.cpp"]
+_HEADERS = ["tb_types.h", "tb_client.h"]
 _LIB_PATH = os.path.join(_DIR, "libtb.so")
 
 _lock = threading.Lock()
@@ -28,7 +29,9 @@ def _stale() -> bool:
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
     return any(
-        os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime for s in _SOURCES
+        os.path.exists(os.path.join(_DIR, s))
+        and os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime
+        for s in _SOURCES + _HEADERS
     )
 
 
@@ -36,14 +39,17 @@ def _build() -> None:
     sources = [os.path.join(_DIR, s) for s in _SOURCES]
     tmp = _LIB_PATH + f".tmp.{os.getpid()}"
     cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        "-o", tmp, *sources,
+        "g++", "-std=c++17", "-O3", "-march=native", "-shared", "-fPIC",
+        "-pthread", "-o", tmp, *sources,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (subprocess.CalledProcessError, OSError) as err:
+    except (subprocess.CalledProcessError, OSError):
         # -march=native may be unavailable (cross/sandboxed); retry generic.
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, *sources]
+        cmd = [
+            "g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
+            "-o", tmp, *sources,
+        ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     os.replace(tmp, _LIB_PATH)
 
@@ -70,6 +76,17 @@ def load():
             ]
             lib.tb_checksum_batch.restype = None
             lib.tb_aesni_enabled.restype = ctypes.c_int
+            # tb_client C ABI (tb_client.h); callback/packet types are bound
+            # by the ctypes wrapper in ../native_client.py.
+            lib.tb_client_init.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p,
+            ]
+            lib.tb_client_init.restype = ctypes.c_int
+            lib.tb_client_submit.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.tb_client_submit.restype = None
+            lib.tb_client_deinit.argtypes = [ctypes.c_void_p]
+            lib.tb_client_deinit.restype = None
             _lib = lib
         except Exception:
             _build_failed = True

@@ -12,6 +12,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <cstddef>
 
 #if defined(__AES__) && defined(__SSE2__)
@@ -95,8 +96,6 @@ static void checksum_impl(const uint8_t *data, size_t len, uint8_t out[16]) {
 }
 
 #else  // portable fallback: table-based AES round
-
-#include <mutex>
 
 static uint8_t SBOX[256];
 static uint32_t T0[256], T1[256], T2[256], T3[256];

@@ -1,0 +1,394 @@
+// tb_client: native client library (see tb_client.h).
+//
+// Mirrors the reference's embedded client (src/clients/c/tb_client/
+// context.zig:29-50, thread.zig): submissions enqueue onto a lock-protected
+// list; a dedicated IO thread drains it, speaking the 256-byte-header wire
+// protocol (src/vsr/message_header.zig via ../vsr/wire.py) over blocking TCP
+// with reply timeouts, address rotation on failure, and session
+// registration/retry semantics matching vsr/client.zig: one in-flight
+// hash-chained request at a time, duplicate replies discarded by request
+// checksum.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tb_client.h"
+
+extern "C" void tb_checksum(const uint8_t* data, uint64_t len, uint8_t* out16);
+
+namespace {
+
+constexpr uint32_t kHeaderSize = 256;
+constexpr uint32_t kMessageSizeMax = 1u << 20;
+constexpr uint8_t kCommandRequest = 5;
+constexpr uint8_t kCommandReply = 8;
+constexpr uint8_t kCommandEviction = 18;
+constexpr uint8_t kOperationRegister = 2;
+
+// Header field offsets (must match vsr/wire.py _FRAME + REQUEST/REPLY tails).
+constexpr size_t kOffChecksum = 0;
+constexpr size_t kOffChecksumBody = 32;
+constexpr size_t kOffCluster = 80;
+constexpr size_t kOffSize = 96;
+constexpr size_t kOffCommand = 110;
+constexpr size_t kOffReqParent = 128;
+constexpr size_t kOffReqClient = 160;
+constexpr size_t kOffReqSession = 176;
+constexpr size_t kOffReqRequest = 192;
+constexpr size_t kOffReqOperation = 196;
+constexpr size_t kOffRepRequestChecksum = 128;
+constexpr size_t kOffRepOp = 208;
+
+void put_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+void put_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
+uint32_t get_u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+uint64_t get_u64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+struct Address {
+    std::string host;
+    uint16_t port;
+};
+
+struct Client {
+    uint8_t cluster_id[16];
+    std::vector<Address> addresses;
+    size_t addr_index = 0;
+    uintptr_t completion_context;
+    tb_completion_t on_completion;
+
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<tb_packet_t*> queue;
+    bool shutdown = false;
+    std::thread io_thread;
+
+    int fd = -1;
+    uint8_t client_id[16];
+    uint64_t session = 0;
+    uint32_t request_number = 0;
+    uint8_t parent[16] = {0};
+
+    std::vector<uint8_t> request_buf;
+    std::vector<uint8_t> reply_buf;
+    bool evicted = false;
+};
+
+enum class RoundtripResult { kOk, kShutdown, kEvicted };
+
+void set_checksums(uint8_t* header, const uint8_t* body, uint32_t body_size) {
+    put_u32(header + kOffSize, kHeaderSize + body_size);
+    tb_checksum(body, body_size, header + kOffChecksumBody);
+    tb_checksum(header + 16, kHeaderSize - 16, header + kOffChecksum);
+}
+
+bool verify_header(const uint8_t* header) {
+    uint8_t expect[16];
+    tb_checksum(header + 16, kHeaderSize - 16, expect);
+    return memcmp(expect, header + kOffChecksum, 16) == 0;
+}
+
+bool read_exact(int fd, uint8_t* buf, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+        ssize_t r = ::read(fd, buf + got, n - got);
+        if (r <= 0) return false;
+        got += static_cast<size_t>(r);
+    }
+    return true;
+}
+
+bool write_all(int fd, const uint8_t* buf, size_t n) {
+    size_t sent = 0;
+    while (sent < n) {
+        ssize_t r = ::write(fd, buf + sent, n - sent);
+        if (r <= 0) return false;
+        sent += static_cast<size_t>(r);
+    }
+    return true;
+}
+
+void disconnect(Client* c) {
+    if (c->fd >= 0) {
+        ::close(c->fd);
+        c->fd = -1;
+    }
+}
+
+bool connect_any(Client* c) {
+    for (size_t attempt = 0; attempt < c->addresses.size(); ++attempt) {
+        const Address& a = c->addresses[(c->addr_index + attempt) %
+                                        c->addresses.size()];
+        struct addrinfo hints = {};
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        struct addrinfo* res = nullptr;
+        char port[16];
+        snprintf(port, sizeof port, "%u", a.port);
+        if (getaddrinfo(a.host.c_str(), port, &hints, &res) != 0) continue;
+        int fd = ::socket(res->ai_family, res->ai_socktype, 0);
+        if (fd < 0) {
+            freeaddrinfo(res);
+            continue;
+        }
+        struct timeval tv = {2, 0};  // bounded reply wait (client failover)
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+        int nodelay = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nodelay, sizeof nodelay);
+        int ok = ::connect(fd, res->ai_addr, res->ai_addrlen);
+        freeaddrinfo(res);
+        if (ok != 0) {
+            ::close(fd);
+            continue;
+        }
+        c->addr_index = (c->addr_index + attempt) % c->addresses.size();
+        c->fd = fd;
+        return true;
+    }
+    return false;
+}
+
+// Build a request message into c->request_buf; returns its header checksum
+// in `request_checksum`.
+void build_request(Client* c, uint8_t operation, const uint8_t* data,
+                   uint32_t data_size, uint8_t request_checksum[16]) {
+    c->request_buf.assign(kHeaderSize + data_size, 0);
+    uint8_t* h = c->request_buf.data();
+    memcpy(h + kOffCluster, c->cluster_id, 16);
+    h[108] = 0;  // version
+    h[kOffCommand] = kCommandRequest;
+    memcpy(h + kOffReqParent, c->parent, 16);
+    memcpy(h + kOffReqClient, c->client_id, 16);
+    put_u64(h + kOffReqSession, c->session);
+    put_u32(h + kOffReqRequest, c->request_number);
+    h[kOffReqOperation] = operation;
+    if (data_size) memcpy(h + kHeaderSize, data, data_size);
+    set_checksums(h, h + kHeaderSize, data_size);
+    memcpy(request_checksum, h + kOffChecksum, 16);
+}
+
+// Send the built request and wait for its reply (retrying on timeout /
+// reconnect, rotating addresses).  The reply body lands in c->reply_buf.
+RoundtripResult roundtrip(Client* c, const uint8_t request_checksum[16],
+                          int max_tries) {
+    for (int tries = 0; max_tries < 0 || tries < max_tries; ++tries) {
+        {
+            std::unique_lock<std::mutex> lk(c->mu);
+            if (c->shutdown) return RoundtripResult::kShutdown;
+        }
+        if (c->fd < 0 && !connect_any(c)) {
+            usleep(50 * 1000);
+            continue;
+        }
+        if (!write_all(c->fd, c->request_buf.data(), c->request_buf.size())) {
+            disconnect(c);
+            c->addr_index = (c->addr_index + 1) % c->addresses.size();
+            continue;
+        }
+        // Read replies until ours (duplicates/pongs are skipped).
+        for (;;) {
+            uint8_t header[kHeaderSize];
+            if (!read_exact(c->fd, header, kHeaderSize)) {
+                disconnect(c);
+                c->addr_index = (c->addr_index + 1) % c->addresses.size();
+                break;  // resend
+            }
+            if (!verify_header(header)) {
+                disconnect(c);
+                break;
+            }
+            uint32_t size = get_u32(header + kOffSize);
+            if (size < kHeaderSize || size > kMessageSizeMax) {
+                disconnect(c);
+                break;
+            }
+            uint32_t body_size = size - kHeaderSize;
+            c->reply_buf.assign(body_size, 0);
+            if (body_size &&
+                !read_exact(c->fd, c->reply_buf.data(), body_size)) {
+                disconnect(c);
+                break;
+            }
+            uint8_t body_sum[16];
+            tb_checksum(c->reply_buf.data(), body_size, body_sum);
+            if (memcmp(body_sum, header + kOffChecksumBody, 16) != 0) {
+                disconnect(c);
+                break;
+            }
+            uint8_t command = header[kOffCommand];
+            if (command == kCommandEviction) {
+                c->evicted = true;
+                return RoundtripResult::kEvicted;
+            }
+            if (command != kCommandReply) continue;
+            if (memcmp(header + kOffRepRequestChecksum, request_checksum,
+                       16) != 0) {
+                continue;  // stale/duplicate reply
+            }
+            if (c->request_number == 0) {
+                // Register reply: session = commit number of the register op
+                // (vsr/client.zig session registration).
+                c->session = get_u64(header + kOffRepOp);
+            }
+            memcpy(c->parent, request_checksum, 16);
+            c->request_number += 1;
+            return RoundtripResult::kOk;
+        }
+    }
+    return RoundtripResult::kShutdown;
+}
+
+bool register_session(Client* c) {
+    uint8_t request_checksum[16];
+    build_request(c, kOperationRegister, nullptr, 0, request_checksum);
+    return roundtrip(c, request_checksum, 200) == RoundtripResult::kOk;
+}
+
+void io_thread_main(Client* c) {
+    for (;;) {
+        tb_packet_t* packet = nullptr;
+        {
+            std::unique_lock<std::mutex> lk(c->mu);
+            c->cv.wait(lk, [c] { return c->shutdown || !c->queue.empty(); });
+            if (c->shutdown) break;
+            packet = c->queue.front();
+            c->queue.pop_front();
+        }
+        if (packet->data_size > kMessageSizeMax - kHeaderSize) {
+            packet->status = TB_PACKET_TOO_MUCH_DATA;
+            c->on_completion(c->completion_context, packet, nullptr, 0);
+            continue;
+        }
+        if (packet->operation < 128 || packet->operation > 133) {
+            packet->status = TB_PACKET_INVALID_OPERATION;
+            c->on_completion(c->completion_context, packet, nullptr, 0);
+            continue;
+        }
+        if (c->evicted) {
+            packet->status = TB_PACKET_CLIENT_EVICTED;
+            c->on_completion(c->completion_context, packet, nullptr, 0);
+            continue;
+        }
+        uint8_t request_checksum[16];
+        build_request(c, packet->operation,
+                      static_cast<const uint8_t*>(packet->data),
+                      packet->data_size, request_checksum);
+        switch (roundtrip(c, request_checksum, -1)) {
+            case RoundtripResult::kOk:
+                packet->status = TB_PACKET_OK;
+                c->on_completion(c->completion_context, packet,
+                                 c->reply_buf.data(),
+                                 static_cast<uint32_t>(c->reply_buf.size()));
+                break;
+            case RoundtripResult::kEvicted:
+                packet->status = TB_PACKET_CLIENT_EVICTED;
+                c->on_completion(c->completion_context, packet, nullptr, 0);
+                break;
+            case RoundtripResult::kShutdown:
+                packet->status = TB_PACKET_CLIENT_SHUTDOWN;
+                c->on_completion(c->completion_context, packet, nullptr, 0);
+                break;
+        }
+    }
+    // Drain queued packets with shutdown status.
+    std::unique_lock<std::mutex> lk(c->mu);
+    while (!c->queue.empty()) {
+        tb_packet_t* packet = c->queue.front();
+        c->queue.pop_front();
+        packet->status = TB_PACKET_CLIENT_SHUTDOWN;
+        lk.unlock();
+        c->on_completion(c->completion_context, packet, nullptr, 0);
+        lk.lock();
+    }
+}
+
+bool parse_addresses(const char* s, std::vector<Address>* out) {
+    std::string all(s ? s : "");
+    size_t pos = 0;
+    while (pos < all.size()) {
+        size_t comma = all.find(',', pos);
+        std::string part = all.substr(
+            pos, comma == std::string::npos ? std::string::npos : comma - pos);
+        size_t colon = part.rfind(':');
+        if (colon == std::string::npos) return false;
+        int port = atoi(part.substr(colon + 1).c_str());
+        if (port <= 0 || port > 65535) return false;
+        std::string host = part.substr(0, colon);
+        out->push_back({host.empty() ? "127.0.0.1" : host,
+                        static_cast<uint16_t>(port)});
+        if (comma == std::string::npos) break;
+        pos = comma + 1;
+    }
+    return !out->empty();
+}
+
+}  // namespace
+
+extern "C" {
+
+tb_status_t tb_client_init(void** client_out, const uint8_t cluster_id[16],
+                           const char* addresses,
+                           uintptr_t completion_context,
+                           tb_completion_t on_completion) {
+    auto* c = new (std::nothrow) Client();
+    if (!c) return TB_STATUS_OUT_OF_MEMORY;
+    memcpy(c->cluster_id, cluster_id, 16);
+    c->completion_context = completion_context;
+    c->on_completion = on_completion;
+    if (!parse_addresses(addresses, &c->addresses)) {
+        delete c;
+        return TB_STATUS_ADDRESS_INVALID;
+    }
+    // Ephemeral random nonzero client id (vsr/client.zig client_id).
+    std::random_device rd;
+    for (int i = 0; i < 16; i += 4) {
+        uint32_t r = rd();
+        memcpy(c->client_id + i, &r, 4);
+    }
+    c->client_id[0] |= 1;
+    if (!connect_any(c)) {
+        delete c;
+        return TB_STATUS_CONNECT_FAILED;
+    }
+    if (!register_session(c)) {
+        disconnect(c);
+        delete c;
+        return TB_STATUS_CONNECT_FAILED;
+    }
+    c->io_thread = std::thread(io_thread_main, c);
+    *client_out = c;
+    return TB_STATUS_SUCCESS;
+}
+
+void tb_client_submit(void* client, tb_packet_t* packet) {
+    auto* c = static_cast<Client*>(client);
+    std::unique_lock<std::mutex> lk(c->mu);
+    c->queue.push_back(packet);
+    c->cv.notify_one();
+}
+
+void tb_client_deinit(void* client) {
+    auto* c = static_cast<Client*>(client);
+    {
+        std::unique_lock<std::mutex> lk(c->mu);
+        c->shutdown = true;
+        c->cv.notify_one();
+    }
+    if (c->io_thread.joinable()) c->io_thread.join();
+    disconnect(c);
+    delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,71 @@
+/* tb_client: the embeddable C client for tigerbeetle-tpu clusters.
+ *
+ * Mirrors the reference's tb_client C ABI (src/clients/c/tb_client.h,
+ * tb_client.zig:1-70): the application acquires packets, submits them, and
+ * receives completions on a dedicated client IO thread.  One in-flight
+ * request at a time per client (vsr/client.zig), retries and primary
+ * failover are internal.
+ *
+ * Build: part of libtb.so (tigerbeetle_tpu/native/); link or dlopen it.
+ */
+#ifndef TB_CLIENT_H
+#define TB_CLIENT_H
+
+#include <stdint.h>
+#include "tb_types.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+    TB_STATUS_SUCCESS = 0,
+    TB_STATUS_ADDRESS_INVALID = 1,
+    TB_STATUS_CONNECT_FAILED = 2,
+    TB_STATUS_OUT_OF_MEMORY = 3,
+} tb_status_t;
+
+typedef enum {
+    TB_PACKET_OK = 0,
+    TB_PACKET_TOO_MUCH_DATA = 1,
+    TB_PACKET_INVALID_OPERATION = 2,
+    TB_PACKET_CLIENT_SHUTDOWN = 3,
+    TB_PACKET_TIMEOUT = 4,
+    TB_PACKET_CLIENT_EVICTED = 5,
+} tb_packet_status_t;
+
+typedef struct tb_packet {
+    struct tb_packet* next;   /* internal queue link */
+    void* user_data;          /* opaque, returned in the completion */
+    uint8_t operation;        /* tb_operation_t */
+    uint8_t status;           /* tb_packet_status_t, set at completion */
+    uint32_t data_size;
+    const void* data;         /* events (accounts/transfers/ids/filter) */
+} tb_packet_t;
+
+/* Completion callback, invoked on the client IO thread.  reply points at
+ * the result body (event results / rows); valid only during the call. */
+typedef void (*tb_completion_t)(uintptr_t context, tb_packet_t* packet,
+                                const uint8_t* reply, uint32_t reply_size);
+
+/* Create a client: connects to one of the comma-separated host:port
+ * addresses, registers a session, spawns the IO thread. */
+tb_status_t tb_client_init(void** client_out,
+                           const uint8_t cluster_id[16],
+                           const char* addresses,
+                           uintptr_t completion_context,
+                           tb_completion_t on_completion);
+
+/* Enqueue a packet (thread-safe). The packet and its data must stay alive
+ * until its completion fires. */
+void tb_client_submit(void* client, tb_packet_t* packet);
+
+/* Drain in-flight work, stop the IO thread, free the client.  Queued
+ * packets complete with TB_PACKET_CLIENT_SHUTDOWN. */
+void tb_client_deinit(void* client);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TB_CLIENT_H */

@@ -1,0 +1,136 @@
+"""Python wrapper over the native tb_client C ABI (native/tb_client.cpp).
+
+The reference ships its client as an embeddable C library with language
+wrappers on top (src/clients/c + Go/Java/.NET/Node, SURVEY §2.6); this is
+the Python wrapper over ours — the same packet/completion ABI any other
+language binds via its C FFI.  The synchronous helpers mirror client.py's
+API so the two client implementations are interchangeable in tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import native, types
+from .client import ClientEvicted, _decode_results, _encode_ids
+from .vsr import wire
+
+
+class TbPacket(ctypes.Structure):
+    _fields_ = [
+        ("next", ctypes.c_void_p),
+        ("user_data", ctypes.c_void_p),
+        ("operation", ctypes.c_uint8),
+        ("status", ctypes.c_uint8),
+        ("data_size", ctypes.c_uint32),
+        ("data", ctypes.c_void_p),
+    ]
+
+
+COMPLETION_FN = ctypes.CFUNCTYPE(
+    None, ctypes.c_size_t, ctypes.POINTER(TbPacket),
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+)
+
+PACKET_OK = 0
+PACKET_CLIENT_EVICTED = 5
+
+
+class NativeClientUnavailable(RuntimeError):
+    pass
+
+
+class NativeClient:
+    """Synchronous convenience facade over the async packet ABI."""
+
+    def __init__(self, addresses: Sequence[Tuple[str, int]], cluster: int):
+        lib = native.load()
+        if lib is None:
+            raise NativeClientUnavailable("libtb.so unavailable (no g++?)")
+        self.lib = lib
+        self._lock = threading.Lock()
+        # token -> (packet, body_buf, event, [status, reply]).  Entries stay
+        # referenced until their completion fires — the C side holds raw
+        # pointers into packet/body (tb_client.h lifetime contract), so a
+        # timed-out request's buffers must NOT be garbage collected.
+        self._pending: dict = {}
+        self._next_token = 1
+
+        # The callback must outlive the client (referenced from C).
+        def on_completion(ctx, packet_ptr, reply_ptr, reply_size):
+            packet = packet_ptr.contents
+            token = int(packet.user_data or 0)
+            reply = (
+                ctypes.string_at(reply_ptr, reply_size)
+                if reply_size and reply_ptr else b""
+            )
+            with self._lock:
+                entry = self._pending.pop(token, None)
+            if entry is None:
+                return  # completion for an abandoned (timed-out) request
+            entry[3][0] = int(packet.status)
+            entry[3][1] = reply
+            entry[2].set()
+
+        self._cb = COMPLETION_FN(on_completion)
+        handle = ctypes.c_void_p()
+        addr_str = ",".join(f"{h}:{p}" for h, p in addresses).encode()
+        cluster_bytes = cluster.to_bytes(16, "little")
+        status = lib.tb_client_init(
+            ctypes.byref(handle), cluster_bytes, addr_str, 0,
+            ctypes.cast(self._cb, ctypes.c_void_p),
+        )
+        if status != 0:
+            raise ConnectionError(f"tb_client_init failed: status {status}")
+        self.handle = handle
+
+    def request(self, operation: wire.Operation, body: bytes,
+                timeout_s: float = 30.0) -> bytes:
+        packet = TbPacket()
+        buf = ctypes.create_string_buffer(body, len(body))
+        packet.operation = int(operation)
+        packet.data_size = len(body)
+        packet.data = ctypes.cast(buf, ctypes.c_void_p)
+        event = threading.Event()
+        result = [None, None]  # [status, reply]
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            packet.user_data = token
+            self._pending[token] = (packet, buf, event, result)
+        self.lib.tb_client_submit(self.handle, ctypes.byref(packet))
+        if not event.wait(timeout_s):
+            # Leave the pending entry in place: the C IO thread still holds
+            # pointers into packet/buf; the entry is dropped (and the refs
+            # released) only when its completion eventually fires.
+            raise TimeoutError("native client request timed out")
+        if result[0] == PACKET_CLIENT_EVICTED:
+            raise ClientEvicted("session evicted")
+        if result[0] != PACKET_OK:
+            raise RuntimeError(f"packet failed: status {result[0]}")
+        return result[1] or b""
+
+    # tb_client-style batch helpers (client.py parity).
+
+    def create_accounts(self, accounts: np.ndarray) -> List[Tuple[int, int]]:
+        return _decode_results(
+            self.request(wire.Operation.create_accounts, accounts.tobytes())
+        )
+
+    def create_transfers(self, transfers: np.ndarray) -> List[Tuple[int, int]]:
+        return _decode_results(
+            self.request(wire.Operation.create_transfers, transfers.tobytes())
+        )
+
+    def lookup_accounts(self, ids: Sequence[int]) -> np.ndarray:
+        body = self.request(wire.Operation.lookup_accounts, _encode_ids(ids))
+        return np.frombuffer(body, dtype=types.ACCOUNT_DTYPE)
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.tb_client_deinit(self.handle)
+            self.handle = None
