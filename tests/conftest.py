@@ -3,20 +3,17 @@
 The image pre-sets ``JAX_PLATFORMS=axon`` (a remote-TPU tunnel) and its
 sitecustomize registers the remote PJRT plugin (with remote compilation) into
 every interpreter at startup, which makes test compiles/dispatches network
-round trips (5-20x slowdown) — and jax is already imported by the time conftest
-runs, so env vars are too late.  Instead: override the platform via jax.config
-and deregister the axon backend factory before any backend initializes.
-
-Tests get 8 virtual CPU devices so sharding/collective paths are exercised
-without TPU hardware (the driver separately dry-runs the multi-chip path;
-bench.py uses the real chip).
+round trips (5-20x slowdown).  jaxenv.force_cpu() deregisters the plugin and
+pins 8 virtual CPU devices so sharding/collective paths are exercised without
+TPU hardware (the driver separately dry-runs the multi-chip path; bench.py
+uses the real chip).
 """
 
-import jax
+import os
+import sys
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from jax._src import xla_bridge  # noqa: E402
+from tigerbeetle_tpu import jaxenv  # noqa: E402
 
-xla_bridge._backend_factories.pop("axon", None)
+jaxenv.force_cpu(8)
