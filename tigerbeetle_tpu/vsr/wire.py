@@ -208,7 +208,11 @@ START_VIEW_DTYPE = _dtype([
     ("op", "<u8"),               # canonical head of the new view
     ("commit", "<u8"),           # new primary's commit_min
     ("checkpoint_op", "<u8"),
-    ("reserved", "V104"),
+    # Echo of request_start_view's nonce (0 for unsolicited broadcasts):
+    # pairs an SV response to its RSV so a recovering replica cannot install
+    # a stale same-view snapshot (message_header.zig StartView.nonce).
+    ("nonce_lo", "<u8"), ("nonce_hi", "<u8"),
+    ("reserved", "V88"),
 ])
 
 REQUEST_START_VIEW_DTYPE = _dtype([
@@ -234,7 +238,11 @@ HEADERS_DTYPE = _dtype([("reserved", "V128")])  # body = prepare headers
 REQUEST_REPLY_DTYPE = _dtype([
     ("reply_checksum_lo", "<u8"), ("reply_checksum_hi", "<u8"),
     ("client_lo", "<u8"), ("client_hi", "<u8"),
-    ("reserved", "V96"),
+    # Requester's session number (register commit op): a peer still holding
+    # the client's PREVIOUS session must not serve that session's reply for
+    # an equal request number.
+    ("session", "<u8"),
+    ("reserved", "V88"),
 ])
 
 # State sync (vsr/sync.zig): a lagging replica fetches the primary's latest
