@@ -271,3 +271,41 @@ class TestGetAccountHistory:
         dev.ledger = ledger2
         check_history_query(dev, ref, filt(1))
         assert len(dev.get_account_history(filt(1))) == 1
+
+
+class TestSortedRunsIndex:
+    """The Bentley-Saxe index (ops/index.py) under multi-level merges and
+    rebuild-after-restore (round-2 VERDICT #4)."""
+
+    def test_incremental_matches_rebuild(self):
+        cfg = LedgerConfig(
+            accounts_capacity_log2=10, transfers_capacity_log2=11,
+            posted_capacity_log2=10, history_capacity_log2=10,
+            max_probe=1 << 9,
+        )
+        dev = TpuStateMachine(cfg, batch_lanes=64)
+        ref = M.ReferenceStateMachine()
+        seed(dev, ref)
+        # Many small batches force several carry merges at base=64.
+        tid = 100
+        for b in range(9):
+            rows = [
+                dict(id=tid + i, debit_account_id=1 + (tid + i) % 5,
+                     credit_account_id=6 - (tid + i) % 5 % 5 or 6,
+                     amount=1 + i, ledger=1, code=10)
+                for i in range(13)
+            ]
+            for r in rows:
+                if r["credit_account_id"] == r["debit_account_id"]:
+                    r["credit_account_id"] = r["debit_account_id"] % 6 + 1
+            run_transfers(dev, ref, [types.transfer(**r) for r in rows])
+            tid += 13
+        assert sum(dev.index.occupied) >= 2, "expected multi-level occupancy"
+        for acct in (1, 2, 5, 6):
+            for f in (filt(acct), filt(acct, flags=DEBITS),
+                      filt(acct, flags=CREDITS | REVERSED, limit=7)):
+                check_transfers_query(dev, ref, f)
+        # Force a rebuild (as after restart/state-sync) and re-check parity.
+        dev.index.reset()
+        for acct in (1, 6):
+            check_transfers_query(dev, ref, filt(acct))

@@ -74,6 +74,11 @@ class TpuStateMachine:
         # Growth hint only (NOT a dispatch precondition): history rows can
         # only ever append if some create_accounts batch requested the flag.
         self._history_accounts_possible = False
+        # Secondary index for get_account_transfers (ops/index.py): derived
+        # state, rebuilt from the table after restore/state-sync.
+        from .ops.index import TransferIndex
+
+        self.index = TransferIndex(base=batch_lanes)
 
     # -- prepare (state_machine.zig:503-512) --------------------------------
 
@@ -194,6 +199,7 @@ class TpuStateMachine:
                 self._transfers_bound += count
                 self._posted_bound += pv_count
                 self._history_bound += hist_count
+                self._index_append(soa, codes, count)
                 results = self._compress(codes, count)
                 self._update_commit_timestamp(codes, count, timestamp)
                 return results
@@ -301,9 +307,17 @@ class TpuStateMachine:
             self._transfers_bound += count
             self._posted_bound += pv_count
             self._history_bound += hist_count
+            self._index_append(soa, codes, count)
         results = self._compress(codes, count)
         self._update_commit_timestamp(codes, count, timestamp)
         return results
+
+    def _index_append(self, soa: dict, codes: np.ndarray, count: int) -> None:
+        ok = np.zeros(self.batch_lanes, dtype=bool)
+        ok[:count] = codes[:count] == 0
+        self.index.append_batch(
+            self.ledger, soa["id_lo"], soa["id_hi"], jnp.asarray(ok)
+        )
 
     def _update_commit_timestamp(
         self, codes: np.ndarray, count: int, timestamp: int
@@ -375,28 +389,33 @@ class TpuStateMachine:
 
     def get_account_transfers(self, filt: np.void) -> np.ndarray:
         """Transfers on either side of the filtered account, timestamp-ordered
-        (prefetch_get_account_transfers, state_machine.zig:693-723)."""
-        from .ops import query
+        (prefetch_get_account_transfers, state_machine.zig:693-723).
 
+        Served from the sorted-runs secondary index (ops/index.py): a few
+        binary searches + a bounded gather per level — flat in table capacity
+        — instead of round 1's full-table argsort."""
         window = self._filter_window(filt)
         if window is None:
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
         acct_lo, acct_hi, ts_min, ts_max, descending, limit = window
         flags = int(filt["flags"])
-        k = min(self.config.transfers_capacity, QUERY_ROWS_MAX)
-        valid, rows = query.scan_transfers(
+        # Static candidate cap: the next power of two covering the largest
+        # reply (one compiled query program per level layout).
+        k = 1 << (QUERY_ROWS_MAX - 1).bit_length()
+        valid, tid_lo, tid_hi = self.index.query(
             self.ledger,
             jnp.uint64(acct_lo), jnp.uint64(acct_hi),
             jnp.uint64(ts_min), jnp.uint64(ts_max),
             jnp.bool_(bool(flags & types.AccountFilterFlags.DEBITS)),
             jnp.bool_(bool(flags & types.AccountFilterFlags.CREDITS)),
-            jnp.bool_(descending),
             k,
+            bool(descending),
         )
-        valid = np.asarray(valid)
-        host = {name: np.asarray(col) for name, col in rows.items()}
+        found, cols = sm.lookup_transfers(self.ledger, tid_lo, tid_hi)
+        valid = np.asarray(valid) & np.asarray(found)
+        host = {name: np.asarray(col) for name, col in cols.items()}
         out = types.from_soa(host, types.TRANSFER_DTYPE)
-        return out[valid][: min(limit, k)]
+        return out[valid][: min(limit, QUERY_ROWS_MAX)]
 
     def get_account_history(self, filt: np.void) -> np.ndarray:
         """Balance history of a HISTORY-flagged account
@@ -467,6 +486,9 @@ class TpuStateMachine:
         self._history_accounts_possible = bool(
             state.get("history_accounts_possible", True)
         )
+        # The ledger was just swapped underneath us (restart or state sync):
+        # the derived index no longer matches and rebuilds on next use.
+        self.index.reset()
 
     # -- parity surface ------------------------------------------------------
 

@@ -1,15 +1,9 @@
-"""Device query kernels: get_account_transfers / get_account_history.
+"""Device query kernel: get_account_history.
 
-The reference answers these with LSM index scans — per-field CompositeKey
-trees walked through a ScanBuilder with union-merge of the debit/credit
-conditions, a timestamp range, direction, and limit
-(state_machine.zig:693-892, lsm/scan_builder.zig).
-
-On TPU the transfers groove is a flat HBM SoA table, so the idiomatic plan is
-a *masked full-table scan*: one vectorized predicate over every slot (a few
-fused elementwise ops over columns already resident in HBM), then an order-by
-key sort to pick the top-``k`` matches.  There is no tree to descend and no
-index to maintain on the write path — the "index" is the predicate itself.
+The reference answers queries with LSM index scans (state_machine.zig:693-892,
+lsm/scan_builder.zig).  get_account_transfers is served by the sorted-runs
+secondary index (ops/index.py); the history log below is already
+timestamp-ordered and bounded, so a masked scan + top-k sort suffices for it.
 Timestamps are unique per object (strictly-increasing assignment), so the sort
 key never ties and the result order is total, matching the reference's
 ascending/descending scan directions exactly.
@@ -38,46 +32,6 @@ def _top_k(key: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     order = jnp.argsort(key)
     top = order[-k:][::-1]
     return top, key[top] != 0
-
-
-@functools.partial(jax.jit, static_argnames=("k",))
-def scan_transfers(
-    ledger: sm.Ledger,
-    acct_lo: jax.Array,
-    acct_hi: jax.Array,
-    ts_min: jax.Array,
-    ts_max: jax.Array,
-    want_debits: jax.Array,
-    want_credits: jax.Array,
-    descending: jax.Array,
-    k: int,
-) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Transfers where the account is on the filtered side(s), timestamp in
-    [ts_min, ts_max], ordered by timestamp, first ``k``.
-
-    Returns (valid[k], rows dict incl. id_lo/id_hi); rows beyond the match
-    count have valid=False.
-    """
-    t = ledger.transfers
-    live = ((t.key_lo != 0) | (t.key_hi != 0)) & ~t.tombstone
-    ts = t.cols["timestamp"]
-    on_debit = (
-        want_debits
-        & (t.cols["debit_account_id_lo"] == acct_lo)
-        & (t.cols["debit_account_id_hi"] == acct_hi)
-    )
-    on_credit = (
-        want_credits
-        & (t.cols["credit_account_id_lo"] == acct_lo)
-        & (t.cols["credit_account_id_hi"] == acct_hi)
-    )
-    match = live & (on_debit | on_credit) & (ts >= ts_min) & (ts <= ts_max)
-    key = jnp.where(match, jnp.where(descending, ts, ~ts), jnp.uint64(0))
-    top, valid = _top_k(key, k)
-    rows = {name: col[top] for name, col in t.cols.items()}
-    rows["id_lo"] = t.key_lo[top]
-    rows["id_hi"] = t.key_hi[top]
-    return valid, rows
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
