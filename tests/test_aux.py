@@ -1,4 +1,7 @@
-"""Aux subsystems: tracer, statsd, hash_log, flags, AOF (SURVEY §5)."""
+"""Aux subsystems: tracer, statsd, hash_log, AOF (SURVEY §5).
+
+(The reference's comptime flags.zig CLI parser has no separate analogue here:
+argparse in cli.py is the idiomatic Python equivalent.)"""
 
 import dataclasses
 import json
@@ -11,7 +14,6 @@ import pytest
 
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.config import LEDGER_TEST, TEST_MIN
-from tigerbeetle_tpu.utils import flags
 from tigerbeetle_tpu.utils.hash_log import HashDivergence, HashLog
 from tigerbeetle_tpu.utils.statsd import StatsD
 from tigerbeetle_tpu.utils.tracer import Tracer
@@ -95,35 +97,6 @@ def test_hash_log_pinpoints_divergence(tmp_path):
     short.log(1000, "commit 0")
     with pytest.raises(HashDivergence, match="shorter"):
         short.finish()
-
-
-# -- flags --------------------------------------------------------------------
-
-@dataclasses.dataclass
-class _StartArgs:
-    path: str
-    addresses: str = "127.0.0.1:3000"
-    cache_accounts_log2: Optional[int] = None
-    verbose: bool = False
-
-
-def test_flags_parses_dataclass():
-    args = flags.parse(
-        _StartArgs,
-        ["data.tb", "--addresses=1.2.3.4:99", "--cache-accounts-log2", "0x14",
-         "--verbose"],
-    )
-    assert args == _StartArgs("data.tb", "1.2.3.4:99", 20, True)
-
-
-def test_flags_defaults_and_errors():
-    assert flags.parse(_StartArgs, ["d"]).addresses == "127.0.0.1:3000"
-    with pytest.raises(SystemExit):
-        flags.parse(_StartArgs, [])  # missing positional
-    with pytest.raises(SystemExit):
-        flags.parse(_StartArgs, ["d", "--bogus"])  # unknown flag (fatal)
-    with pytest.raises(SystemExit):
-        flags.parse(_StartArgs, ["d", "--cache-accounts-log2", "abc"])
 
 
 # -- AOF ----------------------------------------------------------------------

@@ -12,7 +12,7 @@ import pytest
 
 from tigerbeetle_tpu.config import TEST_MIN
 from tigerbeetle_tpu.sim.storage import SimStorage
-from tigerbeetle_tpu.utils import ewah, flags
+from tigerbeetle_tpu.utils import ewah
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import SuperBlock, SuperBlockState
@@ -122,21 +122,3 @@ def test_fuzz_ewah_decode_garbage():
             pass
 
 
-@dataclasses.dataclass
-class _FuzzArgs:
-    path: str
-    level: int = 0
-    on: bool = False
-    name: Optional[str] = None
-
-
-def test_fuzz_flags_no_unexpected_exceptions():
-    rng = random.Random(6)
-    vocab = ["p", "--level", "--on", "--name", "--bogus", "=x", "7", "0x1f",
-             "--level=3", "true", "--name=a b", "-x", ""]
-    for trial in range(300):
-        argv = [rng.choice(vocab) for _ in range(rng.randint(0, 6))]
-        try:
-            flags.parse(_FuzzArgs, argv)
-        except SystemExit:
-            pass  # fatal-error policy: the only acceptable failure mode

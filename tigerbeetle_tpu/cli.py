@@ -51,6 +51,8 @@ def main(argv=None) -> int:
     p_start.add_argument("--cache-transfers-log2", type=int, default=None)
     p_start.add_argument("--aof", default=None, metavar="PATH",
                          help="append-only audit log of committed prepares")
+    p_start.add_argument("--statsd", default=None, metavar="HOST:PORT",
+                         help="emit StatsD metrics (UDP, best-effort)")
 
     p_version = sub.add_parser("version")
     p_version.add_argument("--verbose", action="store_true")
@@ -179,6 +181,15 @@ def _cmd_format(args) -> int:
     return 0
 
 
+def _make_statsd(value):
+    if not value:
+        return None
+    from .utils.statsd import StatsD
+
+    host, port = _parse_addresses(value)[0]
+    return StatsD(host, port)
+
+
 def _cmd_start(args) -> int:
     from .config import LedgerConfig
     from .net.bus import run_server
@@ -209,7 +220,10 @@ def _cmd_start(args) -> int:
         def ready(actual_port):
             print(f"listening {host}:{actual_port}", flush=True)
 
-        run_cluster_server(replica, addresses, ready_callback=ready)
+        run_cluster_server(
+            replica, addresses, ready_callback=ready,
+            statsd=_make_statsd(args.statsd),
+        )
         return 0
 
     replica = Replica(args.path, ledger_config=ledger_config, aof_path=args.aof)
@@ -231,7 +245,8 @@ def _cmd_start(args) -> int:
         # bound port on stdout so a parent process can parse it.
         print(f"listening {host}:{actual_port}", flush=True)
 
-    run_server(replica, host, port, ready_callback=ready)
+    run_server(replica, host, port, ready_callback=ready,
+               statsd=_make_statsd(args.statsd))
     return 0
 
 

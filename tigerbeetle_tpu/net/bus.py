@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import numpy as np
@@ -63,10 +64,11 @@ class ReplicaServer:
     src/tigerbeetle/main.zig:133+266-269)."""
 
     def __init__(self, replica: Replica, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, statsd=None) -> None:
         self.replica = replica
         self.host = host
         self.port = port
+        self.statsd = statsd  # utils.statsd.StatsD; never blocks, optional
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> int:
@@ -124,7 +126,25 @@ class ReplicaServer:
             log.warning("wrong cluster %x", wire.u128(h, "cluster"))
             return []
         if command == wire.Command.request:
-            return self.replica.on_request(h, body)
+            if self.statsd is None:
+                return self.replica.on_request(h, body)
+            # Metrics mirror the reference benchmark's statsd emission
+            # (statsd.zig, benchmark_load.zig:120-129): request counts and
+            # commit latency, best-effort UDP.
+            t0 = time.monotonic()
+            out = self.replica.on_request(h, body)
+            self.statsd.count("requests")
+            self.statsd.timing(
+                "request_ms", (time.monotonic() - t0) * 1000.0
+            )
+            try:
+                op = wire.Operation(int(h["operation"]))
+                if op in (wire.Operation.create_accounts,
+                          wire.Operation.create_transfers):
+                    self.statsd.count("events", len(body) // 128)
+            except ValueError:
+                pass
+            return out
         if command == wire.Command.ping_client:
             pong = wire.new_header(
                 wire.Command.pong_client, cluster=self.replica.cluster,
@@ -137,11 +157,11 @@ class ReplicaServer:
 
 
 def run_server(replica: Replica, host: str = "127.0.0.1", port: int = 0,
-               ready_callback=None) -> None:
+               ready_callback=None, statsd=None) -> None:
     """Blocking entry point: serve until cancelled."""
 
     async def main():
-        server = ReplicaServer(replica, host, port)
+        server = ReplicaServer(replica, host, port, statsd=statsd)
         actual_port = await server.start()
         if ready_callback is not None:
             ready_callback(actual_port)

@@ -40,10 +40,12 @@ class ClusterServer:
         replica: VsrReplica,
         addresses: List[Tuple[str, int]],
         tick_interval: float = 0.01,
+        statsd=None,
     ) -> None:
         assert replica.replica_count == len(addresses), (
             replica.replica_count, addresses
         )
+        self.statsd = statsd  # utils.statsd.StatsD; best-effort, optional
         self.replica = replica
         self.addresses = addresses
         self.index = replica.replica
@@ -189,6 +191,15 @@ class ClusterServer:
                     writer.write(wire.encode(pong))
                     await writer.drain()
                     continue
+                if self.statsd is not None and command == wire.Command.request:
+                    self.statsd.count("requests")
+                    try:
+                        op = wire.Operation(int(h["operation"]))
+                        if op in (wire.Operation.create_accounts,
+                                  wire.Operation.create_transfers):
+                            self.statsd.count("events", len(body) // 128)
+                    except ValueError:
+                        pass
                 out = self.replica.on_message(h, command, body)
                 await self._route(out)
                 await writer.drain()
@@ -234,11 +245,12 @@ def run_cluster_server(
     replica: VsrReplica,
     addresses: List[Tuple[str, int]],
     ready_callback=None,
+    statsd=None,
 ) -> None:
     """Blocking entry point: serve one cluster replica until cancelled."""
 
     async def main():
-        server = ClusterServer(replica, addresses)
+        server = ClusterServer(replica, addresses, statsd=statsd)
         port = await server.start()
         if ready_callback is not None:
             ready_callback(port)

@@ -195,6 +195,9 @@ class SimCluster:
         ledger_config: Optional[LedgerConfig] = None,
         batch_lanes: int = 64,
         net: Optional[PacketSimulator] = None,
+        read_fault_probability: float = 0.0,
+        misdirect_probability: float = 0.0,
+        hash_log: bool = True,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
@@ -211,9 +214,25 @@ class SimCluster:
         self.wall_offsets = [
             self.rng.randrange(-40, 40) * 1_000_000 for _ in range(self.n)
         ]
+        # One fault atlas across the cluster keeps injected storage faults
+        # repairable (never a quorum of copies of one object).
+        from .storage import FaultAtlas
+
+        self.atlas = FaultAtlas(self.n)
         self.storages = [
-            SimStorage(self.config, seed=seed * 101 + i) for i in range(self.n)
+            SimStorage(
+                self.config, seed=seed * 101 + i, replica=i, atlas=self.atlas,
+                read_fault_probability=read_fault_probability,
+                misdirect_probability=misdirect_probability,
+            )
+            for i in range(self.n)
         ]
+        # Divergence oracle: per-replica op->digest logs that SURVIVE
+        # restarts (like the disk), so crash-replay digests are checked
+        # against the original run (utils/hash_log.OpHashLog).
+        from ..utils.hash_log import OpHashLog
+
+        self.hash_logs = [OpHashLog() if hash_log else None for _ in range(self.n)]
         self.replicas: List[Optional[VsrReplica]] = [None] * self.n
         self.alive = [False] * self.n
         for i in range(self.n):
@@ -260,6 +279,7 @@ class SimCluster:
             monotonic=monotonic,
             realtime=realtime,
             seed=self.seed * 31 + i,
+            hash_log=self.hash_logs[i],
         )
 
     def start(self, i: int) -> None:
@@ -350,7 +370,16 @@ class SimCluster:
             i: (r.commit_min, r.status, r.machine.digest()) for i, r in live
         }
         values = set(states.values())
-        assert len(values) == 1, f"replicas diverged: {states}"
+        if len(values) != 1:
+            from ..utils.hash_log import first_divergence
+
+            logs = [log for log in self.hash_logs if log is not None]
+            pin = first_divergence(logs) if logs else None
+            raise AssertionError(
+                f"replicas diverged: {states}"
+                + (f"; first divergence at op {pin[0]}: "
+                   f"{ {r: hex(d) for r, d in pin[1].items()} }" if pin else "")
+            )
 
     def check_conservation(self) -> None:
         """Double-entry invariant: Σ debits_posted == Σ credits_posted and

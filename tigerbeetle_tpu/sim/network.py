@@ -37,6 +37,9 @@ class PacketSimulator:
         self.replay_probability = replay_probability
         self._queue: List[Tuple[int, int, Addr, Addr, bytes]] = []
         self._seq = 0
+        # Clogged directed paths: (src, dst) -> deadline tick (packets are
+        # held, not dropped, until then).
+        self._clogged: Dict[Tuple[Addr, Addr], int] = {}
         # Partition: mapping addr -> group id; cross-group packets drop.
         # None = fully connected.  Clients are unaffected unless listed.
         self._groups: Optional[Dict[Addr, int]] = None
@@ -54,8 +57,43 @@ class PacketSimulator:
             for addr in members:
                 self._groups[addr] = gid
 
+    def partition_mode(self, replicas: List[Addr], mode: str) -> bool:
+        """Random partition in one of the reference's modes
+        (packet_simulator.zig:10-62): ``uniform_size`` (random split point of
+        a shuffled order), ``uniform_partition`` (each replica flips a fair
+        coin), ``isolate_single`` (one random replica alone).  Returns True
+        if a partition was actually installed (a degenerate coin-flip draw
+        may produce none)."""
+        rs = list(replicas)
+        if mode == "isolate_single":
+            lone = self.rng.choice(rs)
+            self.partition([[lone], [r for r in rs if r != lone]])
+        elif mode == "uniform_size":
+            self.rng.shuffle(rs)
+            cut = self.rng.randint(1, len(rs) - 1)
+            self.partition([rs[:cut], rs[cut:]])
+        elif mode == "uniform_partition":
+            a = [r for r in rs if self.rng.random() < 0.5]
+            b = [r for r in rs if r not in a]
+            if not a or not b:
+                return False  # degenerate draw: no partition
+            self.partition([a, b])
+        else:
+            raise ValueError(f"unknown partition mode {mode}")
+        return True
+
     def heal(self) -> None:
         self._groups = None
+
+    def clog(self, src: Addr, dst: Addr, until: int) -> None:
+        """Clog one directed path: packets queue but are HELD (not dropped)
+        until the deadline passes (packet_simulator.zig clogging)."""
+        self._clogged[(src, dst)] = max(self._clogged.get((src, dst), 0), until)
+
+    def clog_random(self, replicas: List[Addr], now: int, duration: int) -> None:
+        src, dst = self.rng.sample(list(replicas), 2)
+        self.clog(src, dst, now + duration)
+        self.clog(dst, src, now + duration)
 
     def _blocked(self, src: Addr, dst: Addr) -> bool:
         if self._groups is None:
@@ -93,15 +131,24 @@ class PacketSimulator:
 
     def deliver(self, now: int) -> List[Tuple[Addr, Addr, bytes]]:
         """Pop all packets due at or before ``now`` (partition is checked
-        again at delivery: packets in flight when a partition forms drop)."""
+        again at delivery: packets in flight when a partition forms drop;
+        clogged paths requeue their packets past the clog deadline)."""
         out = []
+        requeue = []
         while self._queue and self._queue[0][0] <= now:
             _, _, src, dst, message = heapq.heappop(self._queue)
             if self._blocked(src, dst):
                 self.dropped += 1
                 continue
+            deadline = self._clogged.get((src, dst), 0)
+            if deadline > now:
+                self._seq += 1
+                requeue.append((deadline + 1, self._seq, src, dst, message))
+                continue
             self.delivered += 1
             out.append((src, dst, message))
+        for item in requeue:
+            heapq.heappush(self._queue, item)
         return out
 
     @property

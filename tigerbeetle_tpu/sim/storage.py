@@ -1,48 +1,164 @@
-"""Simulated storage: in-memory data file with crash/corruption fault injection.
+"""Simulated storage: in-memory data file with seeded fault injection.
 
-The analogue of the reference's testing storage (src/testing/storage.zig:1-25):
-an in-memory "disk" that survives replica restarts, models torn writes at
-crash time (writes since the last fsync may be lost, partially applied, or
-bit-flipped), and supports targeted corruption of WAL slots so repair paths
-can be exercised.  All randomness is seeded — a (seed, schedule) pair replays
-identically (VOPR determinism, SURVEY §4.2).
+The analogue of the reference's testing storage (src/testing/storage.zig:1-25,
+1,012 LoC): an in-memory "disk" that survives replica restarts and models
+
+- crash-time torn writes (writes since the last fsync may be lost, torn, or
+  survive),
+- latent sector errors per zone (persistent corruption surfacing at read
+  time, storage.zig read_sectors fault path),
+- misdirected writes (a write lands on the wrong slot of its zone,
+  storage.zig misdirect modeling),
+- targeted WAL-slot corruption for scripted scenarios,
+
+all coordinated by a cluster-wide ``FaultAtlas`` that guarantees injected
+faults stay REPAIRABLE: no object (WAL slot, superblock copy, reply slot) is
+corrupted on enough replicas to destroy the last good copy
+(testing/storage.zig ClusterFaultAtlas).  All randomness is seeded — a
+(seed, schedule) pair replays identically (VOPR determinism, SURVEY §4.2).
 """
 
 from __future__ import annotations
 
 import random
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..config import ClusterConfig
 from ..vsr.storage import Layout
 
 
+class FaultAtlas:
+    """Cluster-level budget: which (zone, object) pairs may still be
+    corrupted on which replica without making repair impossible.
+
+    Policy (mirroring ClusterFaultAtlas's intent, not its layout): a given
+    object may be corrupted on at most ``max(0, ceil(replica_count/2) - 1)``
+    replicas — always leaving a majority intact; superblock copies are
+    per-replica objects, at most 1 of the 4 copies each."""
+
+    def __init__(self, replica_count: int) -> None:
+        self.replica_count = replica_count
+        self.budget = max(0, (replica_count + 1) // 2 - 1)
+        self._hit: Dict[Tuple[str, int], Set[int]] = {}
+        self._superblock_copies: Dict[int, Set[int]] = {}
+
+    def allow(self, replica: int, zone: str, obj: int) -> bool:
+        if zone == "superblock":
+            copies = self._superblock_copies.setdefault(replica, set())
+            if len(copies) >= 1 and obj not in copies:
+                return False
+            copies.add(obj)
+            return True
+        hit = self._hit.setdefault((zone, obj), set())
+        if replica in hit:
+            return True  # re-corrupting an already-hit object is free
+        if len(hit) >= self.budget:
+            return False
+        hit.add(replica)
+        return True
+
+
 class SimStorage:
     """Drop-in for vsr.storage.Storage (read/write/sync/close + layout)."""
 
-    def __init__(self, config: Optional[ClusterConfig] = None, seed: int = 0):
+    def __init__(
+        self,
+        config: Optional[ClusterConfig] = None,
+        seed: int = 0,
+        *,
+        replica: int = 0,
+        atlas: Optional[FaultAtlas] = None,
+        read_fault_probability: float = 0.0,
+        misdirect_probability: float = 0.0,
+    ):
         self.config = config or ClusterConfig()
         self.layout = Layout(self.config)
         self.buf = bytearray(self.layout.total_size)
         self.rng = random.Random(seed)
+        self.replica = replica
+        self.atlas = atlas or FaultAtlas(1)
+        self.read_fault_probability = read_fault_probability
+        self.misdirect_probability = misdirect_probability
         # Writes since the last sync: (offset, old_bytes) for crash rollback.
         self.pending: List[Tuple[int, bytes]] = []
         self.reads = 0
         self.writes = 0
         self.syncs = 0
+        self.faults_injected = 0
+
+    # -- zone resolution ------------------------------------------------------
+
+    def _zone_of(self, offset: int) -> Tuple[str, int, int, int]:
+        """(zone name, object index, object offset, object size)."""
+        lay, cfg = self.layout, self.config
+        if offset < lay.wal_headers_offset:
+            size = lay.wal_headers_offset // 4 or 1
+            i = offset // size
+            return "superblock", i, i * size, size
+        if offset < lay.wal_prepares_offset:
+            size = cfg.header_size
+            i = (offset - lay.wal_headers_offset) // size
+            return "wal_headers", i, lay.wal_headers_offset + i * size, size
+        if offset < lay.client_replies_offset:
+            size = cfg.message_size_max
+            i = (offset - lay.wal_prepares_offset) // size
+            return "wal_prepares", i, lay.wal_prepares_offset + i * size, size
+        size = cfg.message_size_max
+        i = (offset - lay.client_replies_offset) // size
+        return "client_replies", i, lay.client_replies_offset + i * size, size
 
     # -- Storage interface ----------------------------------------------------
 
     def read(self, offset: int, size: int) -> bytes:
         assert offset + size <= self.layout.total_size
         self.reads += 1
+        # Latent sector error: persistent corruption surfacing on read —
+        # corrupt the underlying object once (atlas-gated), so retries see
+        # the same damage until repair rewrites it.
+        if self.read_fault_probability and (
+            self.rng.random() < self.read_fault_probability
+        ):
+            zone, obj, obj_off, obj_size = self._zone_of(offset)
+            if self.atlas.allow(self.replica, zone, obj):
+                self.corrupt(obj_off, obj_size)
+                self.faults_injected += 1
         return bytes(self.buf[offset : offset + size])
 
     def write(self, offset: int, data: bytes) -> None:
         assert offset + len(data) <= self.layout.total_size
         self.writes += 1
+        # Misdirected write: lands on a neighboring object of the same zone.
+        # BOTH objects are damaged — the intended one misses its write and
+        # the victim is clobbered — so BOTH are atlas-charged, or the fault
+        # is not injected (repairability invariant).
+        if self.misdirect_probability and (
+            self.rng.random() < self.misdirect_probability
+        ):
+            zone, obj, obj_off, obj_size = self._zone_of(offset)
+            if zone in ("wal_headers", "wal_prepares"):
+                delta = self.rng.choice([-1, 1]) * obj_size
+                wrong = offset + delta
+                zlo, zhi = self._zone_bounds(zone)
+                victim = obj + (1 if delta > 0 else -1)
+                if (
+                    zlo <= wrong and wrong + len(data) <= zhi
+                    and self.atlas.allow(self.replica, zone, victim)
+                    and self.atlas.allow(self.replica, zone, obj)
+                ):
+                    self.faults_injected += 1
+                    offset = wrong
         self.pending.append((offset, bytes(self.buf[offset : offset + len(data)])))
         self.buf[offset : offset + len(data)] = data
+
+    def _zone_bounds(self, zone: str) -> Tuple[int, int]:
+        lay = self.layout
+        if zone == "wal_headers":
+            return lay.wal_headers_offset, lay.wal_prepares_offset
+        if zone == "wal_prepares":
+            return lay.wal_prepares_offset, lay.client_replies_offset
+        if zone == "client_replies":
+            return lay.client_replies_offset, lay.total_size
+        return 0, lay.wal_headers_offset
 
     def sync(self) -> None:
         self.syncs += 1

@@ -55,6 +55,11 @@ def run_seed(
         loss_probability=rng.choice([0.0, 0.02, 0.1]),
         replay_probability=rng.choice([0.0, 0.02]),
     )
+    # Storage adversary (testing/storage.zig families): latent read faults
+    # and misdirected writes, atlas-bounded so damage stays repairable.
+    read_fault_p = rng.choice([0.0, 0.0, 0.001, 0.004])
+    misdirect_p = rng.choice([0.0, 0.0, 0.001])
+    partition_modes = ["isolate_single", "uniform_size", "uniform_partition"]
 
     def go(workdir: str) -> VoprResult:
         cluster = SimCluster(
@@ -64,6 +69,8 @@ def run_seed(
             seed=seed,
             requests_per_client=requests,
             net=net,
+            read_fault_probability=read_fault_p,
+            misdirect_probability=misdirect_p,
         )
         faults = 0
         down: set = set()
@@ -84,15 +91,23 @@ def run_seed(
                     cluster.restart(back)
                     down.discard(back)
                 elif r < 0.0055 and not partitioned and n_replicas >= 3:
-                    lone = rng.randrange(n_replicas)
-                    cluster.partition(
-                        [[lone], [i for i in range(n_replicas) if i != lone]]
-                    )
-                    partitioned = True
-                    faults += 1
+                    if net.partition_mode(
+                        [("replica", i) for i in range(n_replicas)],
+                        rng.choice(partition_modes),
+                    ):
+                        partitioned = True
+                        faults += 1
                 elif r < 0.007 and partitioned:
                     cluster.heal()
                     partitioned = False
+                elif r < 0.009 and n_replicas >= 2:
+                    # Clog one replica<->replica path for a while
+                    # (packet_simulator.zig clogging).
+                    net.clog_random(
+                        [("replica", i) for i in range(n_replicas)],
+                        cluster.t, rng.randint(50, 400),
+                    )
+                    faults += 1
             # Heal everything; the cluster must converge.
             cluster.heal()
             for i in sorted(down):

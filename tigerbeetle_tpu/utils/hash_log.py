@@ -71,3 +71,41 @@ class HashLog:
                 f"hash_log: check run is shorter than the recording "
                 f"({self.position}/{len(self._expected)})"
             )
+
+
+class OpHashLog:
+    """Per-op ledger digests: the cross-replica / crash-replay divergence
+    oracle wired into VsrReplica commits by the VOPR cluster.
+
+    A crash-restarted replica replays committed ops; determinism demands the
+    replayed digest EQUAL the original, so a re-record of a differing value
+    raises immediately (the strongest single-replica check).  Across
+    replicas, ``first_divergence`` names the first op where two logs
+    disagree — turning "final states differ" into "they diverged at op 17"
+    (testing/hash_log.zig:1-5)."""
+
+    def __init__(self) -> None:
+        self.digests: dict = {}
+
+    def record(self, op: int, digest: int) -> None:
+        prev = self.digests.get(op)
+        if prev is not None and prev != digest:
+            raise HashDivergence(
+                f"hash_log: replay divergence at op {op}: "
+                f"{digest:#x} != recorded {prev:#x}"
+            )
+        self.digests[op] = digest
+
+
+def first_divergence(logs: List["OpHashLog"]) -> Optional[tuple]:
+    """First (op, {replica: digest}) where any two logs disagree."""
+    ops = sorted({op for log in logs for op in log.digests})
+    for op in ops:
+        seen = {
+            i: log.digests[op]
+            for i, log in enumerate(logs)
+            if op in log.digests
+        }
+        if len(set(seen.values())) > 1:
+            return op, seen
+    return None

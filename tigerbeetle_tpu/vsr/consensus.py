@@ -1264,6 +1264,15 @@ class VsrReplica(Replica):
                     for r in range(self.replica_count):
                         if r != self.replica and r not in entry.ok_from:
                             out.append((("replica", r), message))
+            if self._ticks - self._last_repair >= REPAIR_INTERVAL and (
+                self.missing or self.stash or self._header_gaps()
+            ):
+                # The primary repairs too: its own journal copy of a
+                # committed-elsewhere op can be latently corrupt (found by
+                # the VOPR read-fault family; commit would stall forever).
+                self._last_repair = self._ticks
+                out.extend(self._request_missing())
+                out.extend(self._repair_gaps())
 
         elif self.status == NORMAL:
             # Backup: watch for a dead primary.

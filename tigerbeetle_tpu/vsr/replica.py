@@ -68,8 +68,12 @@ class Replica:
         time_ns=time.time_ns,
         storage: Optional[Storage] = None,
         aof_path: Optional[str] = None,
+        hash_log=None,
     ) -> None:
         self.data_path = data_path
+        # Optional determinism oracle (utils/hash_log.OpHashLog): per-commit
+        # ledger digests; wired by the VOPR cluster.
+        self.hash_log = hash_log
         self.config = cluster_config or ClusterConfig()
         self.ledger_config = ledger_config or LedgerConfig()
         self.batch_lanes = batch_lanes
@@ -321,6 +325,14 @@ class Replica:
                              operation=operation.name):
                 result_body = self._execute(operation, body, timestamp)
             self.commit_min = op
+            if self.hash_log is not None and operation in (
+                wire.Operation.create_accounts,
+                wire.Operation.create_transfers,
+            ):
+                # Determinism oracle (testing/hash_log.zig): per-op ledger
+                # digests pinpoint the FIRST diverging commit across
+                # replicas or across a crash-replay (sim/cluster.py).
+                self.hash_log.record(op, int(self.machine.digest()))
 
         reply_h = wire.new_header(
             wire.Command.reply,
