@@ -229,6 +229,72 @@ class Client:
         return np.frombuffer(body, dtype=types.TRANSFER_DTYPE)
 
 
+    # -- batch demux (state_machine.zig:114-165, client.zig:45-104) ----------
+
+    def create_accounts_multi(
+        self, batches: Sequence[np.ndarray]
+    ) -> List[List[Tuple[int, int]]]:
+        """Multiplex N logical create_accounts batches into ONE request
+        message and split the reply per batch."""
+        return self._submit_multi(
+            wire.Operation.create_accounts, batches,
+            self.config.batch_max_create_accounts,
+        )
+
+    def create_transfers_multi(
+        self, batches: Sequence[np.ndarray]
+    ) -> List[List[Tuple[int, int]]]:
+        return self._submit_multi(
+            wire.Operation.create_transfers, batches,
+            self.config.batch_max_create_transfers,
+        )
+
+    def _submit_multi(self, operation, batches, batch_max):
+        assert batch_logical_allowed(operation)
+        counts = [len(b) for b in batches]
+        assert sum(counts) <= batch_max, "multiplexed batches exceed batch_max"
+        body = b"".join(np.ascontiguousarray(b).tobytes() for b in batches)
+        results = _decode_results(self.request(operation, body))
+        return Demuxer(counts).split(results)
+
+
+def batch_logical_allowed(operation: wire.Operation) -> bool:
+    """Operations whose events are independent fixed-size rows with
+    index-keyed results — the only ones that can share a message
+    (state_machine.zig batch_logical_allowed)."""
+    return operation in (
+        wire.Operation.create_accounts, wire.Operation.create_transfers
+    )
+
+
+class Demuxer:
+    """Split one multiplexed reply among logical batches: each batch gets
+    the (index, result) pairs falling in its event range, rebased to its own
+    zero (state_machine.zig DemuxerType)."""
+
+    def __init__(self, event_counts: Sequence[int]) -> None:
+        self.event_counts = list(event_counts)
+
+    def split(
+        self, results: List[Tuple[int, int]]
+    ) -> List[List[Tuple[int, int]]]:
+        out: List[List[Tuple[int, int]]] = []
+        lo = 0
+        it = iter(sorted(results))
+        cur = next(it, None)
+        for count in self.event_counts:
+            hi = lo + count
+            mine: List[Tuple[int, int]] = []
+            while cur is not None and cur[0] < hi:
+                assert cur[0] >= lo, "result index out of any batch range"
+                mine.append((cur[0] - lo, cur[1]))
+                cur = next(it, None)
+            out.append(mine)
+            lo = hi
+        assert cur is None, "result index beyond the multiplexed ranges"
+        return out
+
+
 def _encode_ids(ids: Sequence[int]) -> bytes:
     arr = np.zeros(2 * len(ids), dtype="<u8")
     for i, value in enumerate(ids):

@@ -84,6 +84,10 @@ struct Client {
     std::vector<uint8_t> request_buf;
     std::vector<uint8_t> reply_buf;
     bool evicted = false;
+    // Upper bound for MULTIPLEXED request messages: must match the server's
+    // message_size_max (grouping two individually-valid packets past the
+    // server's limit would make it drop the request and wedge the group).
+    uint32_t message_size_max = kMessageSizeMax;
 };
 
 enum class RoundtripResult { kOk, kShutdown, kEvicted };
@@ -256,7 +260,19 @@ bool register_session(Client* c) {
     return roundtrip(c, request_checksum, 200) == RoundtripResult::kOk;
 }
 
+// Batch demux (state_machine.zig:114-165, client.zig:45-104): while the IO
+// thread was busy, callers may have queued more logical batches.  Packets of
+// the same create_* operation ride ONE request message (events concatenated)
+// and the (index, result) reply rows are split per packet, rebased to each
+// packet's own event range.
+bool batch_logical_allowed(uint8_t operation) {
+    return operation == 128 || operation == 129;  // create_accounts/transfers
+}
+
 void io_thread_main(Client* c) {
+    std::vector<tb_packet_t*> group;
+    std::vector<uint8_t> body;
+    std::vector<uint8_t> slice;
     for (;;) {
         tb_packet_t* packet = nullptr;
         {
@@ -281,24 +297,88 @@ void io_thread_main(Client* c) {
             c->on_completion(c->completion_context, packet, nullptr, 0);
             continue;
         }
+
+        group.clear();
+        group.push_back(packet);
+        if (batch_logical_allowed(packet->operation) &&
+            packet->data_size % 128 == 0) {
+            std::unique_lock<std::mutex> lk(c->mu);
+            uint64_t total = packet->data_size;
+            while (!c->queue.empty()) {
+                tb_packet_t* next = c->queue.front();
+                if (next->operation != packet->operation) break;
+                if (next->data_size % 128 != 0) break;
+                if (total + next->data_size > c->message_size_max - kHeaderSize)
+                    break;
+                total += next->data_size;
+                group.push_back(next);
+                c->queue.pop_front();
+            }
+        }
+
+        const uint8_t* data = static_cast<const uint8_t*>(packet->data);
+        uint32_t data_size = packet->data_size;
+        if (group.size() > 1) {
+            body.clear();
+            for (tb_packet_t* p : group) {
+                const uint8_t* d = static_cast<const uint8_t*>(p->data);
+                body.insert(body.end(), d, d + p->data_size);
+            }
+            data = body.data();
+            data_size = static_cast<uint32_t>(body.size());
+        }
+
         uint8_t request_checksum[16];
-        build_request(c, packet->operation,
-                      static_cast<const uint8_t*>(packet->data),
-                      packet->data_size, request_checksum);
+        build_request(c, packet->operation, data, data_size,
+                      request_checksum);
         switch (roundtrip(c, request_checksum, -1)) {
-            case RoundtripResult::kOk:
-                packet->status = TB_PACKET_OK;
-                c->on_completion(c->completion_context, packet,
-                                 c->reply_buf.data(),
-                                 static_cast<uint32_t>(c->reply_buf.size()));
+            case RoundtripResult::kOk: {
+                if (group.size() == 1) {
+                    packet->status = TB_PACKET_OK;
+                    c->on_completion(
+                        c->completion_context, packet, c->reply_buf.data(),
+                        static_cast<uint32_t>(c->reply_buf.size()));
+                    break;
+                }
+                // Demux: reply rows are {u32 index, u32 result} over the
+                // concatenated event ranges, already index-ascending.
+                const uint8_t* rows = c->reply_buf.data();
+                size_t n_rows = c->reply_buf.size() / 8;
+                size_t row = 0;
+                uint32_t lo = 0;
+                for (tb_packet_t* p : group) {
+                    uint32_t hi = lo + p->data_size / 128;
+                    slice.clear();
+                    while (row < n_rows) {
+                        uint32_t idx;
+                        memcpy(&idx, rows + row * 8, 4);
+                        if (idx >= hi) break;
+                        if (idx < lo) { ++row; continue; }  // defensive: malformed reply row
+                        uint32_t rebased = idx - lo;
+                        uint8_t out[8];
+                        memcpy(out, &rebased, 4);
+                        memcpy(out + 4, rows + row * 8 + 4, 4);
+                        slice.insert(slice.end(), out, out + 8);
+                        ++row;
+                    }
+                    p->status = TB_PACKET_OK;
+                    c->on_completion(c->completion_context, p, slice.data(),
+                                     static_cast<uint32_t>(slice.size()));
+                    lo = hi;
+                }
                 break;
+            }
             case RoundtripResult::kEvicted:
-                packet->status = TB_PACKET_CLIENT_EVICTED;
-                c->on_completion(c->completion_context, packet, nullptr, 0);
+                for (tb_packet_t* p : group) {
+                    p->status = TB_PACKET_CLIENT_EVICTED;
+                    c->on_completion(c->completion_context, p, nullptr, 0);
+                }
                 break;
             case RoundtripResult::kShutdown:
-                packet->status = TB_PACKET_CLIENT_SHUTDOWN;
-                c->on_completion(c->completion_context, packet, nullptr, 0);
+                for (tb_packet_t* p : group) {
+                    p->status = TB_PACKET_CLIENT_SHUTDOWN;
+                    c->on_completion(c->completion_context, p, nullptr, 0);
+                }
                 break;
         }
     }
@@ -389,6 +469,15 @@ void tb_client_deinit(void* client) {
     if (c->io_thread.joinable()) c->io_thread.join();
     disconnect(c);
     delete c;
+}
+
+tb_status_t tb_client_set_message_size_max(void* client, uint32_t bytes) {
+    Client* c = static_cast<Client*>(client);
+    if (bytes < kHeaderSize + 128 || bytes > kMessageSizeMax) {
+        return TB_STATUS_ADDRESS_INVALID;
+    }
+    c->message_size_max = bytes;
+    return TB_STATUS_SUCCESS;
 }
 
 }  // extern "C"

@@ -60,6 +60,11 @@ tb_status_t tb_client_init(void** client_out,
  * until its completion fires. */
 void tb_client_submit(void* client, tb_packet_t* packet);
 
+/* Cap MULTIPLEXED request messages to the server's message_size_max so
+ * batched packets are never merged past what the server will accept.
+ * Returns nonzero if bytes is out of range. Default: 1 MiB. */
+tb_status_t tb_client_set_message_size_max(void* client, uint32_t bytes);
+
 /* Drain in-flight work, stop the IO thread, free the client.  Queued
  * packets complete with TB_PACKET_CLIENT_SHUTDOWN. */
 void tb_client_deinit(void* client);

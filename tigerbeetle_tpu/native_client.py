@@ -47,7 +47,8 @@ class NativeClientUnavailable(RuntimeError):
 class NativeClient:
     """Synchronous convenience facade over the async packet ABI."""
 
-    def __init__(self, addresses: Sequence[Tuple[str, int]], cluster: int):
+    def __init__(self, addresses: Sequence[Tuple[str, int]], cluster: int,
+                 message_size_max: int = 1 << 20):
         lib = native.load()
         if lib is None:
             raise NativeClientUnavailable("libtb.so unavailable (no g++?)")
@@ -87,9 +88,21 @@ class NativeClient:
         if status != 0:
             raise ConnectionError(f"tb_client_init failed: status {status}")
         self.handle = handle
+        if message_size_max != 1 << 20:
+            # Batched packets must never merge past the server's limit.
+            rc = lib.tb_client_set_message_size_max(
+                handle, ctypes.c_uint32(message_size_max)
+            )
+            if rc != 0:
+                raise ValueError(
+                    f"unsupported message_size_max {message_size_max}"
+                )
 
-    def request(self, operation: wire.Operation, body: bytes,
-                timeout_s: float = 30.0) -> bytes:
+    def submit(self, operation: wire.Operation, body: bytes):
+        """Enqueue one packet; returns a wait(timeout_s)->bytes handle.
+        Packets of the same create_* operation queued while the IO thread is
+        busy ride ONE request message and are demuxed by the C client
+        (tb_client.cpp batch demux; state_machine.zig:114-165)."""
         packet = TbPacket()
         buf = ctypes.create_string_buffer(body, len(body))
         packet.operation = int(operation)
@@ -103,16 +116,24 @@ class NativeClient:
             packet.user_data = token
             self._pending[token] = (packet, buf, event, result)
         self.lib.tb_client_submit(self.handle, ctypes.byref(packet))
-        if not event.wait(timeout_s):
-            # Leave the pending entry in place: the C IO thread still holds
-            # pointers into packet/buf; the entry is dropped (and the refs
-            # released) only when its completion eventually fires.
-            raise TimeoutError("native client request timed out")
-        if result[0] == PACKET_CLIENT_EVICTED:
-            raise ClientEvicted("session evicted")
-        if result[0] != PACKET_OK:
-            raise RuntimeError(f"packet failed: status {result[0]}")
-        return result[1] or b""
+
+        def wait(timeout_s: float = 30.0) -> bytes:
+            if not event.wait(timeout_s):
+                # Leave the pending entry in place: the C IO thread still
+                # holds pointers into packet/buf; the entry is dropped (and
+                # the refs released) only when its completion fires.
+                raise TimeoutError("native client request timed out")
+            if result[0] == PACKET_CLIENT_EVICTED:
+                raise ClientEvicted("session evicted")
+            if result[0] != PACKET_OK:
+                raise RuntimeError(f"packet failed: status {result[0]}")
+            return result[1] or b""
+
+        return wait
+
+    def request(self, operation: wire.Operation, body: bytes,
+                timeout_s: float = 30.0) -> bytes:
+        return self.submit(operation, body)(timeout_s)
 
     # tb_client-style batch helpers (client.py parity).
 
