@@ -1,0 +1,247 @@
+// Go client for tigerbeetle-tpu: a cgo wrapper over the native tb_client
+// C ABI (tigerbeetle_tpu/native/tb_client.{h,cpp}) — the same architecture
+// as the reference's Go client (src/clients/go, cgo over tb_client).
+//
+// Build: the shared library must be built once (importing the Python
+// package builds it lazily, or:
+//   g++ -std=c++17 -O2 -shared -fPIC -pthread \
+//       -o tigerbeetle_tpu/native/libtb.so tigerbeetle_tpu/native/*.cpp
+// ). Then:
+//   cd clients/go && go test ./... (with TB_ADDRESS=host:port serving)
+package tigerbeetle
+
+/*
+#cgo CFLAGS: -I${SRCDIR}/../../tigerbeetle_tpu/native
+#cgo LDFLAGS: -L${SRCDIR}/../../tigerbeetle_tpu/native -ltb -Wl,-rpath,${SRCDIR}/../../tigerbeetle_tpu/native
+#include <stdlib.h>
+#include <string.h>
+#include "tb_client.h"
+
+extern void tbGoOnCompletion(uintptr_t ctx, tb_packet_t* packet,
+                             const uint8_t* reply, uint32_t reply_size);
+static tb_status_t tb_go_init(void** out, const uint8_t cluster[16],
+                              const char* addresses, uintptr_t ctx) {
+    return tb_client_init(out, cluster, addresses, ctx,
+                          (tb_completion_t)tbGoOnCompletion);
+}
+*/
+import "C"
+
+import (
+	"encoding/binary"
+	"errors"
+	"fmt"
+	"sync"
+	"unsafe"
+)
+
+// Packet statuses (tb_client.h tb_packet_status_t).
+const (
+	packetOK            = 0
+	packetTooMuchData   = 1
+	packetInvalidOp     = 2
+	packetClientEvicted = 5
+)
+
+var (
+	ErrEvicted = errors.New("tigerbeetle: session evicted")
+	ErrClosed  = errors.New("tigerbeetle: client closed")
+)
+
+type completion struct {
+	status uint8
+	reply  []byte
+}
+
+// Client owns one native tb_client instance (an IO thread + session).
+type Client struct {
+	handle unsafe.Pointer
+	ctx    uintptr
+
+	mu       sync.Mutex
+	pending  map[uint64]chan completion
+	next     uint64
+	closed   bool
+	inflight sync.WaitGroup // submits holding the native handle alive
+}
+
+var (
+	registryMu sync.Mutex
+	registry   = map[uintptr]*Client{}
+	nextCtx    uintptr = 1
+)
+
+// NewClient connects to one of the comma-separated host:port addresses and
+// registers a session.
+func NewClient(clusterID Uint128, addresses string) (*Client, error) {
+	c := &Client{pending: map[uint64]chan completion{}, next: 1}
+	registryMu.Lock()
+	c.ctx = nextCtx
+	nextCtx++
+	registry[c.ctx] = c
+	registryMu.Unlock()
+
+	var cluster [16]byte
+	binary.LittleEndian.PutUint64(cluster[0:8], clusterID.Lo)
+	binary.LittleEndian.PutUint64(cluster[8:16], clusterID.Hi)
+	addrs := C.CString(addresses)
+	defer C.free(unsafe.Pointer(addrs))
+	var handle unsafe.Pointer
+	status := C.tb_go_init(
+		&handle, (*C.uint8_t)(unsafe.Pointer(&cluster[0])), addrs,
+		C.uintptr_t(c.ctx),
+	)
+	if status != 0 {
+		registryMu.Lock()
+		delete(registry, c.ctx)
+		registryMu.Unlock()
+		return nil, fmt.Errorf("tb_client_init failed: status %d", status)
+	}
+	c.handle = handle
+	return c, nil
+}
+
+// SetMessageSizeMax caps multiplexed request messages to the server's
+// message_size_max (required when the server runs a smaller-than-default
+// configuration).
+func (c *Client) SetMessageSizeMax(bytes uint32) error {
+	if C.tb_client_set_message_size_max(c.handle, C.uint32_t(bytes)) != 0 {
+		return fmt.Errorf("unsupported message_size_max %d", bytes)
+	}
+	return nil
+}
+
+// submit sends one packet; the C IO thread may multiplex it with other
+// queued packets of the same operation (batch demux).
+func (c *Client) submit(operation Operation, data []byte) ([]byte, error) {
+	c.mu.Lock()
+	if c.closed {
+		c.mu.Unlock()
+		return nil, ErrClosed
+	}
+	// Holds the native handle alive until this submit completes: Close()
+	// waits for in-flight submits before tb_client_deinit frees it.
+	c.inflight.Add(1)
+	defer c.inflight.Done()
+	token := c.next
+	c.next++
+	ch := make(chan completion, 1)
+	c.pending[token] = ch
+	c.mu.Unlock()
+
+	// cgo pointer rules: C retains the packet + data past this call, so
+	// both live in C memory.
+	packet := (*C.tb_packet_t)(C.malloc(C.sizeof_tb_packet_t))
+	C.memset(unsafe.Pointer(packet), 0, C.sizeof_tb_packet_t)
+	var cdata unsafe.Pointer
+	if len(data) > 0 {
+		cdata = C.CBytes(data)
+	}
+	packet.user_data = unsafe.Pointer(uintptr(token))
+	packet.operation = C.uint8_t(operation)
+	packet.data_size = C.uint32_t(len(data))
+	packet.data = cdata
+	C.tb_client_submit(c.handle, packet)
+
+	done := <-ch
+	if cdata != nil {
+		C.free(cdata)
+	}
+	C.free(unsafe.Pointer(packet))
+	switch done.status {
+	case packetOK:
+		return done.reply, nil
+	case packetClientEvicted:
+		return nil, ErrEvicted
+	default:
+		return nil, fmt.Errorf("packet failed: status %d", done.status)
+	}
+}
+
+// Close drains in-flight work and frees the native client.
+func (c *Client) Close() {
+	c.mu.Lock()
+	if c.closed {
+		c.mu.Unlock()
+		return
+	}
+	c.closed = true
+	c.mu.Unlock()
+	c.inflight.Wait()
+	C.tb_client_deinit(c.handle)
+	registryMu.Lock()
+	delete(registry, c.ctx)
+	registryMu.Unlock()
+}
+
+// CreateAccounts submits one batch; returns per-event failures.
+func (c *Client) CreateAccounts(accounts []Account) ([]EventResult, error) {
+	if len(accounts) == 0 {
+		return nil, nil
+	}
+	body := encodeSlice(unsafe.Pointer(&accounts[0]), len(accounts), AccountSize)
+	reply, err := c.submit(OperationCreateAccounts, body)
+	if err != nil {
+		return nil, err
+	}
+	return decodeResults(reply), nil
+}
+
+func (c *Client) CreateTransfers(transfers []Transfer) ([]EventResult, error) {
+	if len(transfers) == 0 {
+		return nil, nil
+	}
+	body := encodeSlice(unsafe.Pointer(&transfers[0]), len(transfers), TransferSize)
+	reply, err := c.submit(OperationCreateTransfers, body)
+	if err != nil {
+		return nil, err
+	}
+	return decodeResults(reply), nil
+}
+
+// LookupAccounts returns the found accounts (misses omitted).
+func (c *Client) LookupAccounts(ids []Uint128) ([]Account, error) {
+	reply, err := c.submit(OperationLookupAccounts, encodeIDs(ids))
+	if err != nil {
+		return nil, err
+	}
+	out := make([]Account, len(reply)/AccountSize)
+	if len(out) > 0 {
+		copy(unsafe.Slice((*byte)(unsafe.Pointer(&out[0])), len(reply)), reply)
+	}
+	return out, nil
+}
+
+func (c *Client) LookupTransfers(ids []Uint128) ([]Transfer, error) {
+	reply, err := c.submit(OperationLookupTransfers, encodeIDs(ids))
+	if err != nil {
+		return nil, err
+	}
+	out := make([]Transfer, len(reply)/TransferSize)
+	if len(out) > 0 {
+		copy(unsafe.Slice((*byte)(unsafe.Pointer(&out[0])), len(reply)), reply)
+	}
+	return out, nil
+}
+
+func encodeSlice(ptr unsafe.Pointer, n, size int) []byte {
+	return unsafe.Slice((*byte)(ptr), n*size)
+}
+
+func encodeIDs(ids []Uint128) []byte {
+	body := make([]byte, 16*len(ids))
+	for i, id := range ids {
+		binary.LittleEndian.PutUint64(body[16*i:], id.Lo)
+		binary.LittleEndian.PutUint64(body[16*i+8:], id.Hi)
+	}
+	return body
+}
+
+func decodeResults(reply []byte) []EventResult {
+	out := make([]EventResult, len(reply)/EventResultSize)
+	for i := range out {
+		out[i].Index = binary.LittleEndian.Uint32(reply[8*i:])
+		out[i].Result = binary.LittleEndian.Uint32(reply[8*i+4:])
+	}
+	return out
+}

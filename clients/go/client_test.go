@@ -1,0 +1,93 @@
+// Integration test: requires a live server and the built native library.
+//
+//	python -m tigerbeetle_tpu format /tmp/go.tb --cluster 0xBEEF
+//	python -m tigerbeetle_tpu start /tmp/go.tb --addresses 127.0.0.1:7001 &
+//	cd clients/go && TB_ADDRESS=127.0.0.1:7001 TB_CLUSTER=0xBEEF go test ./...
+//
+// (This image ships no Go toolchain; the test runs wherever one exists.
+// The struct layouts themselves are guarded hermetically by
+// tests/test_bindings.py against the canonical types.py dtypes.)
+package tigerbeetle
+
+import (
+	"os"
+	"strconv"
+	"strings"
+	"testing"
+	"unsafe"
+)
+
+func TestLayouts(t *testing.T) {
+	if unsafe.Sizeof(Account{}) != AccountSize {
+		t.Fatalf("Account size %d != %d", unsafe.Sizeof(Account{}), AccountSize)
+	}
+	if unsafe.Sizeof(Transfer{}) != TransferSize {
+		t.Fatalf("Transfer size %d != %d", unsafe.Sizeof(Transfer{}), TransferSize)
+	}
+	if unsafe.Offsetof(Account{}.Timestamp) != 120 {
+		t.Fatalf("Account.Timestamp offset %d", unsafe.Offsetof(Account{}.Timestamp))
+	}
+	if unsafe.Offsetof(Transfer{}.Amount) != 48 {
+		t.Fatalf("Transfer.Amount offset %d", unsafe.Offsetof(Transfer{}.Amount))
+	}
+}
+
+func TestFullFlow(t *testing.T) {
+	addr := os.Getenv("TB_ADDRESS")
+	if addr == "" {
+		t.Skip("TB_ADDRESS not set (needs a live server)")
+	}
+	cluster := Uint128{Lo: 0xBEEF}
+	if s := os.Getenv("TB_CLUSTER"); s != "" {
+		v, err := strconv.ParseUint(strings.TrimPrefix(s, "0x"), 16, 64)
+		if err != nil {
+			t.Fatal(err)
+		}
+		cluster = Uint128{Lo: v}
+	}
+	c, err := NewClient(cluster, addr)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer c.Close()
+
+	accounts := []Account{
+		{ID: Uint128{Lo: 1}, Ledger: 1, Code: 10},
+		{ID: Uint128{Lo: 2}, Ledger: 1, Code: 10},
+	}
+	failures, err := c.CreateAccounts(accounts)
+	if err != nil {
+		t.Fatal(err)
+	}
+	for _, f := range failures {
+		if CreateAccountResult(f.Result) != CreateAccountResultExists {
+			t.Fatalf("account %d failed: %d", f.Index, f.Result)
+		}
+	}
+
+	transfers := []Transfer{{
+		ID:              Uint128{Lo: uint64(os.Getpid())<<16 | 1},
+		DebitAccountID:  Uint128{Lo: 1},
+		CreditAccountID: Uint128{Lo: 2},
+		Amount:          Uint128{Lo: 42},
+		Ledger:          1,
+		Code:            10,
+	}}
+	if failures, err = c.CreateTransfers(transfers); err != nil {
+		t.Fatal(err)
+	}
+	if len(failures) != 0 {
+		t.Fatalf("transfer failed: %+v", failures)
+	}
+
+	rows, err := c.LookupAccounts([]Uint128{{Lo: 1}, {Lo: 2}})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(rows) != 2 {
+		t.Fatalf("lookup returned %d rows", len(rows))
+	}
+	if rows[0].DebitsPosted.Lo == 0 {
+		t.Fatal("debits not posted")
+	}
+}

@@ -1,0 +1,35 @@
+package tigerbeetle
+
+/*
+#include "tb_client.h"
+*/
+import "C"
+
+import "unsafe"
+
+// tbGoOnCompletion is invoked on the native IO thread for every finished
+// packet (tb_client.h tb_completion_t). It copies the reply out of the
+// C-owned buffer (valid only during the call) and wakes the waiter.
+//
+//export tbGoOnCompletion
+func tbGoOnCompletion(ctx C.uintptr_t, packet *C.tb_packet_t,
+	reply *C.uint8_t, replySize C.uint32_t) {
+	registryMu.Lock()
+	c := registry[uintptr(ctx)]
+	registryMu.Unlock()
+	if c == nil {
+		return
+	}
+	token := uint64(uintptr(packet.user_data))
+	var buf []byte
+	if replySize > 0 && reply != nil {
+		buf = C.GoBytes(unsafe.Pointer(reply), C.int(replySize))
+	}
+	c.mu.Lock()
+	ch := c.pending[token]
+	delete(c.pending, token)
+	c.mu.Unlock()
+	if ch != nil {
+		ch <- completion{status: uint8(packet.status), reply: buf}
+	}
+}

@@ -1,0 +1,3 @@
+module github.com/tigerbeetle-tpu/clients/go
+
+go 1.21

@@ -1,0 +1,112 @@
+"""Bindings generators: the checked-in Go/TS/C sources must match
+regeneration from the canonical types (the reference's one-source-of-truth
+discipline, src/clients/*_bindings.zig), and the emitted layouts must agree
+with the numpy dtypes field-for-field."""
+
+import os
+import re
+
+import numpy as np
+
+from tigerbeetle_tpu import bindings, types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_go_types_up_to_date():
+    with open(os.path.join(ROOT, "clients", "go", "types.go")) as f:
+        assert f.read() == bindings.generate_go_types(), (
+            "clients/go/types.go is stale: python -m tigerbeetle_tpu.bindings"
+        )
+
+
+def test_ts_types_up_to_date():
+    with open(os.path.join(ROOT, "clients", "typescript", "src", "types.ts")) as f:
+        assert f.read() == bindings.generate_ts_types(), (
+            "clients/typescript/src/types.ts is stale: "
+            "python -m tigerbeetle_tpu.bindings"
+        )
+
+
+def _dtype_layout(dtype: np.dtype):
+    """{field: (offset, size)} with u128 lo/hi pairs joined."""
+    out = {}
+    fields = list(dtype.names)
+    i = 0
+    while i < len(fields):
+        name = fields[i]
+        ftype, off = dtype.fields[name][:2]
+        if name.endswith("_lo") and i + 1 < len(fields) and (
+            fields[i + 1] == name[:-3] + "_hi"
+        ):
+            out[name[:-3]] = (off, 16)
+            i += 2
+            continue
+        out[name] = (off, ftype.itemsize)
+        i += 1
+    return out
+
+
+def test_go_offsets_match_dtypes():
+    """Every '// offset N' annotation in the generated Go equals the numpy
+    field offset, and the size constants equal itemsize."""
+    src = bindings.generate_go_types()
+    for go_name, dtype in (
+        ("Account", types.ACCOUNT_DTYPE),
+        ("Transfer", types.TRANSFER_DTYPE),
+        ("EventResult", types.EVENT_RESULT_DTYPE),
+        ("AccountFilter", types.ACCOUNT_FILTER_DTYPE),
+    ):
+        block = re.search(
+            rf"type {go_name} struct \{{(.*?)\n\}}", src, re.S
+        ).group(1)
+        offsets = [int(m) for m in re.findall(r"// offset (\d+)", block)]
+        want = sorted(off for off, _ in _dtype_layout(dtype).values())
+        assert sorted(offsets) == want, (go_name, offsets, want)
+        assert f"const {go_name}Size = {dtype.itemsize}" in src
+
+
+def test_ts_roundtrip_offsets():
+    """The TS encode/decode functions cover every non-reserved byte range
+    exactly once (per the dtype layout)."""
+    src = bindings.generate_ts_types()
+    for ts_name, dtype in (
+        ("Account", types.ACCOUNT_DTYPE),
+        ("Transfer", types.TRANSFER_DTYPE),
+    ):
+        assert f"export const {ts_name}Size = {dtype.itemsize};" in src
+        enc = re.search(
+            rf"export function encode{ts_name}.*?\n\}}", src, re.S
+        ).group(0)
+        written = sorted(
+            int(m) for m in re.findall(r"offset \+ (\d+)", enc)
+        )
+        expected = []
+        fields = list(dtype.names)
+        i = 0
+        while i < len(fields):
+            name = fields[i]
+            ftype, off = dtype.fields[name][:2]
+            if name.endswith("_lo") and i + 1 < len(fields) and (
+                fields[i + 1] == name[:-3] + "_hi"
+            ):
+                expected += [off, off + 8]
+                i += 2
+                continue
+            if ftype.kind != "V":  # V-blobs (true padding) are skipped
+                expected.append(off)
+            i += 1
+        assert written == sorted(expected), (ts_name, written, expected)
+
+
+def test_enum_values_emitted():
+    go = bindings.generate_go_types()
+    ts = bindings.generate_ts_types()
+    for e in (types.CreateAccountResult, types.CreateTransferResult,
+              types.AccountFlags, types.TransferFlags):
+        for member in e:
+            assert f"= {member.value}" in go
+            assert f"= {member.value}," in ts
+    # Spot-check precedence-critical codes.
+    assert "CreateTransferResultExists CreateTransferResult = 46" in go
+    assert "pendingTransferExpired = 35" in ts
