@@ -1,0 +1,130 @@
+"""Adaptive timeouts + bounded send queues (round-2 VERDICT #10)."""
+
+import random
+
+import pytest
+
+from tigerbeetle_tpu.sim import PacketSimulator, SimCluster
+from tigerbeetle_tpu.vsr.consensus import NORMAL
+from tigerbeetle_tpu.vsr.timeout import Rtt, Timeout
+
+
+class TestTimeout:
+    def test_backoff_grows_and_caps(self):
+        t = Timeout(random.Random(1), base_ticks=10, max_ticks=80)
+        t.reset(0)
+        intervals = []
+        now = 0
+        for _ in range(8):
+            # advance until it fires, record the gap
+            start = now
+            while not t.fired(now):
+                now += 1
+            intervals.append(now - start)
+        assert intervals[0] >= 10
+        assert max(intervals) <= 80 + 1
+        # Later intervals trend upward (backoff), allowing jitter noise.
+        assert sum(intervals[4:]) > sum(intervals[:4])
+
+    def test_reset_returns_to_base(self):
+        t = Timeout(random.Random(2), base_ticks=10, max_ticks=160)
+        t.reset(0)
+        now = 0
+        for _ in range(5):
+            while not t.fired(now):
+                now += 1
+        t.reset(now)
+        start = now
+        while not t.fired(now):
+            now += 1
+        assert now - start <= 20  # base + jitter, not the backed-off 160
+
+    def test_rtt_adaptation(self):
+        rtt = Rtt(initial_ticks=2.0)
+        t = Timeout(random.Random(3), base_ticks=5, max_ticks=400,
+                    rtt=rtt, rtt_multiple=4.0)
+        t.reset(0)
+        now = 0
+        while not t.fired(now):
+            now += 1
+        fast = now
+        for _ in range(64):
+            rtt.sample(50.0)  # the network got slow
+        t.reset(now)
+        start = now
+        while not t.fired(now):
+            now += 1
+        assert (now - start) >= 4 * 40, "timeout did not scale with RTT"
+        assert fast < 4 * 40
+
+    def test_deterministic_under_seed(self):
+        a = Timeout(random.Random(9), 10, 80)
+        b = Timeout(random.Random(9), 10, 80)
+        for now in range(0, 500, 7):
+            assert a.fired(now) == b.fired(now)
+
+
+class TestConvergenceUnderHeavyLoss:
+    def test_view_change_converges_at_30pct_loss(self, tmp_path):
+        """The verdict's bar: view-change convergence under 30% loss —
+        fixed cadences storm or stall; adaptive backoff must converge."""
+        net = PacketSimulator(seed=77, loss_probability=0.30)
+        cluster = SimCluster(
+            str(tmp_path), n_replicas=3, n_clients=1, seed=76,
+            requests_per_client=4, net=net,
+        )
+        cluster.run(400)
+        primary = next(
+            r.primary_index() for r in cluster.replicas if r is not None
+        )
+        cluster.crash(primary)
+        ok = cluster.run_until(
+            lambda: any(
+                a and r.status == NORMAL and r.view > 0
+                for r, a in zip(cluster.replicas, cluster.alive)
+            ),
+            max_ticks=60_000,
+        )
+        assert ok, "no view change under 30% loss"
+        cluster.restart(primary)
+        ok = cluster.run_until(
+            lambda: cluster.clients_done() and cluster.converged(),
+            max_ticks=90_000,
+        )
+        assert ok
+        cluster.check_converged()
+        cluster.check_conservation()
+
+
+class TestBoundedSendQueue:
+    def test_overflowing_writer_drops_messages_not_connection(self):
+        from tigerbeetle_tpu.net.cluster_bus import ClusterServer
+
+        class FakeTransport:
+            def get_write_buffer_size(self):
+                return ClusterServer.SEND_BUFFER_MAX + 1
+
+        class FakeWriter:
+            transport = FakeTransport()
+            closed = False
+            writes = 0
+
+            def write(self, data):
+                self.writes += 1
+
+            def close(self):
+                self.closed = True
+
+        server = ClusterServer.__new__(ClusterServer)
+        server.peer_writers = {1: FakeWriter()}
+        server.client_writers = {}
+        server.dropped_sends = 0
+        server._last_drop_log = 0.0
+
+        import asyncio
+
+        asyncio.run(server._route([(("replica", 1), b"xx")] * 3))
+        w = server.peer_writers[1]
+        assert server.dropped_sends == 3
+        assert w.writes == 0
+        assert not w.closed, "backpressure must drop messages, not the link"

@@ -55,6 +55,11 @@ class ClusterServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self._tasks: List[asyncio.Task] = []
         self.port: Optional[int] = None
+        self.dropped_sends = 0  # bounded-send-queue drops (backpressure)
+        self._last_drop_log = 0.0
+        # RTT-adaptive timeouts convert monotonic ns to consensus ticks;
+        # keep the conversion in lockstep with the actual tick cadence.
+        replica.tick_ns = int(tick_interval * 1e9)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -215,7 +220,8 @@ class ClusterServer:
     # -- outbound routing -----------------------------------------------------
 
     # Bounded send queue per connection (message_pool.zig's static budget):
-    # a peer that stops reading is disconnected, not buffered without limit.
+    # messages to a peer that stops reading are DROPPED (adaptive retry
+    # timeouts re-send); the connection itself stays up.
     SEND_BUFFER_MAX = 8 * (1 << 20)
 
     async def _route(self, envelopes) -> None:
@@ -226,9 +232,19 @@ class ClusterServer:
                 w = self.client_writers.get(ident)
             if w is None:
                 continue  # not connected: timeouts re-send
+            # Bounded send queue (message_bus.zig / message_pool.zig:17-58
+            # discipline): a clogged peer's messages DROP — the adaptive
+            # retry timeouts re-send — so a slow consumer can never grow
+            # replica memory unboundedly.  The connection stays up.
             if w.transport.get_write_buffer_size() > self.SEND_BUFFER_MAX:
-                log.warning("send queue overflow, dropping connection")
-                w.close()
+                self.dropped_sends += 1
+                now = asyncio.get_event_loop().time()
+                if now - self._last_drop_log > 1.0:  # throttled visibility
+                    self._last_drop_log = now
+                    log.warning(
+                        "send queue full: dropped %d messages so far",
+                        self.dropped_sends,
+                    )
                 continue
             w.write(message)
 

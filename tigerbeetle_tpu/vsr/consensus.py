@@ -78,6 +78,7 @@ VIEW_CHANGE_ESCALATE = 200   # stuck view change: try the next view
 RECOVERING_RESEND = 30       # request_start_view cadence while recovering
 REPAIR_INTERVAL = 15
 SYNC_RESEND = 30
+TICK_NS = 10_000_000  # default tick length; the TCP bus overrides tick_ns
 
 
 def quorums(replica_count: int) -> Tuple[int, int]:
@@ -150,15 +151,31 @@ class VsrReplica(Replica):
         self._ticks = 0
         self._last_ping = -PING_INTERVAL
         self._last_commit_sent = 0
-        self._last_prepare_resend = 0
         self._last_primary_word = 0
-        self._last_vc_resend = 0
         self._vc_started = 0
-        self._last_rsv = 0
-        self._last_repair = 0
         self._last_sync_req = 0
         self._heartbeat_jitter = 0
         self._recovering_since = 0
+
+        # Adaptive retry timeouts (vsr.zig:543-712): RTT-tracked base +
+        # exponential backoff + jitter, reset on progress (vsr/timeout.py).
+        from .timeout import Rtt, Timeout
+
+        self.rtt = Rtt()
+        self._prepare_timeout = Timeout(
+            self.prng, PREPARE_RESEND, PREPARE_RESEND * 8, rtt=self.rtt,
+            rtt_multiple=4.0,
+        )
+        self._vc_timeout = Timeout(
+            self.prng, VIEW_CHANGE_RESEND, VIEW_CHANGE_RESEND * 6
+        )
+        self._rsv_timeout = Timeout(
+            self.prng, RECOVERING_RESEND, RECOVERING_RESEND * 8
+        )
+        self._repair_timeout = Timeout(
+            self.prng, REPAIR_INTERVAL, REPAIR_INTERVAL * 8, rtt=self.rtt,
+            rtt_multiple=3.0,
+        )
 
         self.clock: Optional[Clock] = None
 
@@ -215,11 +232,11 @@ class VsrReplica(Replica):
         sets self.op/parent_checksum/headers to the contiguous chained
         suffix anchored at the checkpoint (cf. Replica._replay)."""
         anchor = recovery.entries.get(self.commit_min)
+        if anchor is None and self.commit_min == 0:
+            anchor = self._restore_root()  # deterministic; see replica.py
         if anchor is not None:
             self.parent_checksum = wire.header_checksum(anchor.header)
             self.headers[self.commit_min] = anchor.header
-        elif self.commit_min == 0:
-            raise RuntimeError("WAL: root prepare missing")
         else:
             self.parent_checksum = 0
         self.op = self.commit_min
@@ -578,6 +595,11 @@ class VsrReplica(Replica):
         if entry is None or entry.checksum != wire.u128(h, "prepare_checksum"):
             return []
         entry.ok_from.add(int(h["replica"]))
+        if len(entry.ok_from) == self.quorum_replication:
+            # Reset only on REAL progress (an entry reaching quorum) — a
+            # duplicate ok, or oks for other entries, must not starve the
+            # re-broadcast of a stuck one.
+            self._prepare_timeout.reset(self._ticks)
         out: List[Msg] = []
         self._maybe_commit_pipeline(out)
         return out
@@ -664,7 +686,7 @@ class VsrReplica(Replica):
         self.view = new_view
         self.status = VIEW_CHANGE
         self._vc_started = self._ticks
-        self._last_vc_resend = self._ticks
+        self._vc_timeout.reset(self._ticks)
         self._dvc_sent_for = None
         self.pipeline.clear()
         self._persist_view()
@@ -813,18 +835,25 @@ class VsrReplica(Replica):
             self.parent_checksum = wire.header_checksum(head)
 
     def _request_missing(self, dvcs=None) -> List[Msg]:
-        """request_prepare for every missing body, spread over peers."""
+        """request_prepare for every missing body, spread over peers.
+
+        The starting peer ROTATES per call: a fixed per-op target would ask
+        the same replica forever, and that replica's own copy can be
+        latently corrupt (found by the VOPR read-fault family) — the healthy
+        peer would never be asked and repair would never complete."""
         out: List[Msg] = []
         peers = [r for r in range(self.replica_count) if r != self.replica]
         if not peers:
             return out
+        self._repair_rotation = getattr(self, "_repair_rotation", 0) + 1
         for i, (op, checksum) in enumerate(sorted(self.missing.items())):
+            peer = peers[(i + self._repair_rotation) % len(peers)]
             req = self._hdr(
                 wire.Command.request_prepare,
                 prepare_op=op,
                 prepare_checksum=checksum,
             )
-            out.append((("replica", peers[i % len(peers)]), wire.encode(req)))
+            out.append((("replica", peer), wire.encode(req)))
         return out
 
     def _finish_view_change(self, view: int) -> List[Msg]:
@@ -1048,6 +1077,7 @@ class VsrReplica(Replica):
         op = int(h["op"])
         self.journal.write_prepare(wire.encode(h, body))
         del self.missing[op]
+        self._repair_timeout.reset(self._ticks)  # repair progressing
         if getattr(self, "_new_view_pending", None) is not None and (
             not self.missing
         ):
@@ -1205,10 +1235,11 @@ class VsrReplica(Replica):
         return [(("replica", int(h["replica"])), wire.encode(pong))]
 
     def on_pong(self, h: np.ndarray, body: bytes) -> List[Msg]:
-        self.clock.learn(
-            int(h["replica"]),
-            int(h["ping_timestamp_monotonic"]),
-            int(h["pong_timestamp_wall"]),
+        ping_mono = int(h["ping_timestamp_monotonic"])
+        self.clock.learn(int(h["replica"]), ping_mono, int(h["pong_timestamp_wall"]))
+        # Feed the retry timeouts' RTT estimate (vsr.zig:593-634).
+        self.rtt.sample(
+            (self._monotonic() - ping_mono) / getattr(self, "tick_ns", TICK_NS)
         )
         return []
 
@@ -1248,10 +1279,7 @@ class VsrReplica(Replica):
                     timestamp_monotonic=self.clock.ping_timestamp(),
                 )
                 out.extend(self._broadcast(wire.encode(commit)))
-            if self.pipeline and (
-                self._ticks - self._last_prepare_resend >= PREPARE_RESEND
-            ):
-                self._last_prepare_resend = self._ticks
+            if self.pipeline and self._prepare_timeout.fired(self._ticks):
                 # Timeout fallback: re-broadcast unquorumed prepares to all
                 # backups (the ring is the fast path, this is the safety net).
                 for entry in self.pipeline.values():
@@ -1264,13 +1292,12 @@ class VsrReplica(Replica):
                     for r in range(self.replica_count):
                         if r != self.replica and r not in entry.ok_from:
                             out.append((("replica", r), message))
-            if self._ticks - self._last_repair >= REPAIR_INTERVAL and (
-                self.missing or self.stash or self._header_gaps()
+            if (self.missing or self.stash or self._header_gaps()) and (
+                self._repair_timeout.fired(self._ticks)
             ):
                 # The primary repairs too: its own journal copy of a
                 # committed-elsewhere op can be latently corrupt (found by
                 # the VOPR read-fault family; commit would stall forever).
-                self._last_repair = self._ticks
                 out.extend(self._request_missing())
                 out.extend(self._repair_gaps())
 
@@ -1281,10 +1308,10 @@ class VsrReplica(Replica):
             ):
                 self._last_primary_word = self._ticks
                 out.extend(self._begin_view_change(self.view + 1))
-            elif self._ticks - self._last_repair >= REPAIR_INTERVAL and (
+            elif (
                 self.missing or self.stash or self._header_gaps()
-            ):
-                self._last_repair = self._ticks
+                or self.commit_max > self.op
+            ) and self._repair_timeout.fired(self._ticks):
                 out.extend(self._request_missing())
                 out.extend(self._repair_gaps())
                 # Header gaps: request by op with checksum 0 ("whatever you
@@ -1297,12 +1324,21 @@ class VsrReplica(Replica):
                         prepare_checksum=0,
                     )
                     out.append((("replica", primary), wire.encode(req)))
+                if self.commit_max > self.op:
+                    # Missing log SUFFIX (commit heartbeats got ahead of our
+                    # head, e.g. the tail prepare was lost repeatedly): fetch
+                    # the suffix headers; bodies repair via `missing`.
+                    req = self._hdr(
+                        wire.Command.request_headers,
+                        op_min=self.op + 1,
+                        op_max=self.commit_max,
+                    )
+                    out.append((("replica", primary), wire.encode(req)))
 
         elif self.status == VIEW_CHANGE:
             if self._ticks - self._vc_started >= VIEW_CHANGE_ESCALATE:
                 out.extend(self._begin_view_change(self.view + 1))
-            elif self._ticks - self._last_vc_resend >= VIEW_CHANGE_RESEND:
-                self._last_vc_resend = self._ticks
+            elif self._vc_timeout.fired(self._ticks):
                 svc = self._hdr(wire.Command.start_view_change)
                 out.extend(self._broadcast(wire.encode(svc)))
                 if self._dvc_sent_for == self.view and (
@@ -1321,8 +1357,7 @@ class VsrReplica(Replica):
                     )
 
         elif self.status == RECOVERING:
-            if self._ticks - self._last_rsv >= RECOVERING_RESEND:
-                self._last_rsv = self._ticks
+            if self._rsv_timeout.fired(self._ticks):
                 out.extend(self._request_start_view(self.view))
                 # If nobody answers (total cluster restart), force a view
                 # change so the cluster re-certifies its log.  Time base is

@@ -195,13 +195,31 @@ class Replica:
 
         return self.journal.recover()
 
+    def _restore_root(self):
+        """Regenerate + rewrite the deterministic root prepare (op 0 is a
+        pure function of the cluster id, replica.format): a latent fault on
+        its WAL slot must not brick recovery."""
+        root = wire.new_header(
+            wire.Command.prepare,
+            cluster=self.cluster,
+            op=0,
+            operation=int(wire.Operation.root),
+        )
+        raw = wire.encode(root, b"")
+        self.journal.write_prepare(raw)
+        h, _, _ = wire.decode(raw)
+        entry = type("Entry", (), {})()
+        entry.header = h
+        entry.body = b""
+        return entry
+
     def _replay(self, recovery) -> None:
         """Replay the contiguous, hash-chained WAL suffix beyond commit_min."""
         # Find the chain anchor: the entry at commit_min (or the root).
         anchor = recovery.entries.get(self.commit_min)
+        if anchor is None and self.commit_min == 0:
+            anchor = self._restore_root()
         if anchor is None:
-            if self.commit_min == 0:
-                raise RuntimeError("WAL: root prepare missing")
             # The checkpoint op's slot was since overwritten by a newer op
             # (ring wrapped): it must chain from the checkpoint regardless —
             # the chain links below still verify each step.
