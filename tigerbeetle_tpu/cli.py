@@ -53,6 +53,10 @@ def main(argv=None) -> int:
                          help="append-only audit log of committed prepares")
     p_start.add_argument("--statsd", default=None, metavar="HOST:PORT",
                          help="emit StatsD metrics (UDP, best-effort)")
+    p_start.add_argument("--hot-transfers-log2-max", type=int, default=None,
+                         help="cap the device-resident transfers window at "
+                              "2^N slots; older transfers spill to a cold "
+                              "host store (BASELINE config 4 tiering)")
 
     p_version = sub.add_parser("version")
     p_version.add_argument("--verbose", action="store_true")
@@ -226,7 +230,12 @@ def _cmd_start(args) -> int:
         )
         return 0
 
-    replica = Replica(args.path, ledger_config=ledger_config, aof_path=args.aof)
+    hot_max = (
+        1 << args.hot_transfers_log2_max
+        if args.hot_transfers_log2_max is not None else None
+    )
+    replica = Replica(args.path, ledger_config=ledger_config,
+                      aof_path=args.aof, hot_transfers_capacity_max=hot_max)
     replica.open()
     if replica.replica_count != 1:
         # A multi-replica data file must never be served solo: commits

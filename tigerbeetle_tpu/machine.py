@@ -51,6 +51,8 @@ class TpuStateMachine:
         ledger_config: Optional[LedgerConfig] = None,
         batch_lanes: int = 8192,
         force_sequential: bool = False,
+        spill_dir: Optional[str] = None,
+        hot_transfers_capacity_max: Optional[int] = None,
     ) -> None:
         cfg = ledger_config or LedgerConfig()
         self.config = cfg
@@ -79,6 +81,23 @@ class TpuStateMachine:
         from .ops.index import TransferIndex
 
         self.index = TransferIndex(base=batch_lanes)
+        # Tiered transfers store (ops/cold.py): hot device window + cold
+        # host spill; None spill_dir with no cap = tiering off (everything
+        # stays hot).
+        from .ops.cold import ColdStore, make_bloom
+
+        self.cold = ColdStore(spill_dir)
+        self.hot_transfers_capacity_max = hot_transfers_capacity_max
+        self._tiering = (
+            spill_dir is not None or hot_transfers_capacity_max is not None
+        )
+        self._bloom_log2 = 20
+        self._bloom_np = None
+        self._bloom_dev = None
+        self._evictions = 0
+        if self._tiering:
+            self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
+            self._bloom_dev = make_bloom(self._bloom_log2)
 
     # -- prepare (state_machine.zig:503-512) --------------------------------
 
@@ -189,9 +208,13 @@ class TpuStateMachine:
         pv_count, hist_count = self._transfer_growth_counts(batch)
         self._grow_if_needed(transfers=count, posted=pv_count, history=hist_count)
         soa = self._pad_soa(batch)
-        for _attempt in range(4):
+        cold_checked = (
+            jnp.zeros((self.batch_lanes,), jnp.bool_) if self._tiering else None
+        )
+        for _attempt in range(8):
             self.ledger, codes, kflags = tf.create_transfers_full(
-                self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
+                self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp),
+                self._bloom_dev, cold_checked,
             )
             kflags = int(kflags)
             if kflags == 0:
@@ -202,7 +225,25 @@ class TpuStateMachine:
                 self._index_append(soa, codes, count)
                 results = self._compress(codes, count)
                 self._update_commit_timestamp(codes, count, timestamp)
+                # Deferred tier rebalance: eviction is only safe BETWEEN
+                # batches (mid-loop it would invalidate the certification
+                # and the batch's hot gathers).
+                self._maybe_evict_between_batches()
                 return results
+            ev0 = self._evictions
+            if kflags & tf.FLAG_COLD:
+                # Possible cold-tier ids: resolve exactly on the host,
+                # rehydrate any real cold rows into the hot table, and
+                # certify the batch so Bloom false positives terminate.
+                self._resolve_cold(batch)
+                # Any eviction voids the certification: freshly-cold rows
+                # must be re-detected by the Bloom on the next attempt.
+                cold_checked = (
+                    jnp.ones((self.batch_lanes,), jnp.bool_)
+                    if self._evictions == ev0
+                    else jnp.zeros((self.batch_lanes,), jnp.bool_)
+                )
+                continue
             if kflags & tf.FLAG_SEQ:
                 # Order-dependent batch (balancing / limit accounts / deep
                 # intra-batch chains): exact sequential execution.
@@ -210,7 +251,114 @@ class TpuStateMachine:
             # Probe overflow despite load management (hash clustering):
             # grow the flagged tables and retry — the kernel applied nothing.
             self._grow_flagged(kflags)
+            if self._tiering and self._evictions != ev0 and cold_checked is not None:
+                cold_checked = jnp.zeros((self.batch_lanes,), jnp.bool_)
         raise RuntimeError("transfer kernel could not place batch after growth")
+
+    def _maybe_evict_between_batches(self) -> None:
+        hot_max = self.hot_transfers_capacity_max
+        if hot_max is not None and self._transfers_bound * 2 > hot_max and (
+            self.ledger.transfers.capacity >= hot_max
+        ):
+            self.evict_cold(0.5)
+
+    # -- cold tier (ops/cold.py) --------------------------------------------
+
+    def _resolve_cold(self, batch: np.ndarray) -> None:
+        """Host-exact resolution of a FLAG_COLD batch: rehydrate every cold
+        row referenced by id or pending_id into the hot table."""
+        ids = {
+            (int(r["id_lo"]), int(r["id_hi"])) for r in batch
+        } | {
+            (int(r["pending_id_lo"]), int(r["pending_id_hi"])) for r in batch
+        }
+        ids.discard((0, 0))
+        found = self.cold.lookup_many(sorted(ids))
+        if not found:
+            return
+        # Skip ids already hot (an earlier rehydration): double-inserting a
+        # key would corrupt the hot table's uniqueness invariant.
+        keys = sorted(found)
+        hot_found, _ = sm.lookup_transfers(
+            self.ledger,
+            jnp.asarray([k[0] for k in keys], jnp.uint64),
+            jnp.asarray([k[1] for k in keys], jnp.uint64),
+        )
+        hot_found = np.asarray(hot_found)
+        rows = [found[k] for k, h in zip(keys, hot_found) if not h]
+        if rows:
+            self._rehydrate(np.stack(rows).view(types.TRANSFER_DTYPE))
+
+    def _rehydrate(self, rows: np.ndarray) -> None:
+        """Insert cold rows back into the hot table (immutable duplicates of
+        their cold copies; a later eviction may spill them again)."""
+        from .ops import hash_table as ht_mod
+
+        # No eviction here (evictions mid-commit invalidate the batch's
+        # certification); a slightly-elevated load factor until the next
+        # between-batches rebalance is fine.
+        self._grow_if_needed(transfers=len(rows), evict_ok=False)
+        n = len(rows)
+        lanes = max(self.batch_lanes, 1 << (n - 1).bit_length() if n else 1)
+        padded = np.zeros(lanes, dtype=types.TRANSFER_DTYPE)
+        padded[:n] = rows
+        soa = {k: jnp.asarray(v) for k, v in types.to_soa(padded).items()}
+        mask = jnp.arange(lanes) < n
+        id_lo, id_hi = soa.pop("id_lo"), soa.pop("id_hi")
+        row_cols = {
+            name: soa[name].astype(dt)
+            for name, dt in sm.TRANSFER_COLS.items()
+        }
+        transfers, _ = ht_mod.insert(
+            self.ledger.transfers, id_lo, id_hi, mask, row_cols,
+            self.config.max_probe,
+        )
+        if bool(np.asarray(transfers.probe_overflow)):
+            raise RuntimeError("cold rehydration overflowed the hot table")
+        self.ledger = self.ledger.replace(transfers=transfers)
+        self._transfers_bound += n
+
+    def evict_cold(self, frac: float = 0.5) -> int:
+        """Spill the oldest ~frac of live hot transfers to the cold store.
+        Deterministic given the ledger state; called at checkpoint
+        boundaries by the replica, or directly under memory pressure.
+        Returns the number of rows evicted."""
+        from .ops import cold as cold_mod
+
+        if not self._tiering:
+            self._tiering = True
+            self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
+        num = max(1, min(999, int(frac * 1000)))
+        threshold = cold_mod.eviction_threshold(self.ledger.transfers, num, 1000)
+        k = self.ledger.transfers.capacity
+        n, key_lo, key_hi, cols = cold_mod.extract_evicted(
+            self.ledger.transfers, threshold, k
+        )
+        rows = cold_mod.rows_to_numpy(n, key_lo, key_hi, cols)
+        if len(rows) == 0:
+            return 0
+        self.cold.append_run(rows)
+        self.ledger = self.ledger.replace(
+            transfers=cold_mod.drop_evicted(self.ledger.transfers, threshold)
+        )
+        cold_mod.bloom_add_host(
+            self._bloom_np, rows["id_lo"].astype(np.uint64),
+            rows["id_hi"].astype(np.uint64),
+        )
+        self._maybe_grow_bloom()
+        self._bloom_dev = jnp.asarray(self._bloom_np)
+        self._transfers_bound = max(0, self._transfers_bound - len(rows))
+        self._evictions += 1
+        # The query index stores ids (not slots), so it stays valid; row
+        # resolution for cold ids happens in get_account_transfers.
+        return len(rows)
+
+    def _maybe_grow_bloom(self) -> None:
+        """Keep >= ~12 bits per cold id (false-positive rate ~1e-3 at 4
+        hashes); rebuild from the runs at the next power of two if not."""
+        while self.cold.count * 12 > (1 << self._bloom_log2):
+            self._bloom_log2 += 2
+            self._bloom_np = self.cold.rebuild_bloom(self._bloom_log2)
 
     def _transfer_growth_counts(self, batch: np.ndarray) -> Tuple[int, int]:
         """(posted rows, history rows) this batch could append at most —
@@ -235,7 +383,7 @@ class TpuStateMachine:
 
     def _grow_if_needed(
         self, accounts: int = 0, transfers: int = 0, posted: int = 0,
-        history: int = 0,
+        history: int = 0, evict_ok: bool = True,
     ) -> None:
         """Keep every table's load factor under 0.5 using host-side row
         bounds (no device sync; bounds only overestimate)."""
@@ -251,7 +399,23 @@ class TpuStateMachine:
             led.transfers.capacity, self._transfers_bound + transfers
         )
         if cap != led.transfers.capacity:
-            led = led.replace(transfers=ht.grow(led.transfers, cap))
+            hot_max = self.hot_transfers_capacity_max
+            if hot_max is not None and cap > hot_max and (
+                led.transfers.capacity >= hot_max
+            ):
+                if evict_ok:
+                    # At the hot ceiling: spill the old half to the cold
+                    # store instead of growing (BASELINE config 4 tiering).
+                    self.ledger = led
+                    self.evict_cold(0.5)
+                    led = self.ledger
+                # else: accept elevated load until the between-batches
+                # rebalance (MAX_PROBE absorbs it).
+            else:
+                if hot_max is not None:
+                    cap = min(cap, max(hot_max, led.transfers.capacity))
+                if cap != led.transfers.capacity:
+                    led = led.replace(transfers=ht.grow(led.transfers, cap))
         cap = self._target_capacity(led.posted.capacity, self._posted_bound + posted)
         if cap != led.posted.capacity:
             led = led.replace(posted=ht.grow(led.posted, cap))
@@ -269,7 +433,18 @@ class TpuStateMachine:
         if kflags & tf.FLAG_GROW_ACCOUNTS:
             led = led.replace(accounts=ht.grow(led.accounts, led.accounts.capacity * 2))
         if kflags & tf.FLAG_GROW_TRANSFERS:
-            led = led.replace(transfers=ht.grow(led.transfers, led.transfers.capacity * 2))
+            hot_max = self.hot_transfers_capacity_max
+            if hot_max is not None and led.transfers.capacity >= hot_max:
+                # Never allocate past the HBM budget the ceiling encodes:
+                # make room by spilling instead (certification is reset by
+                # the caller via the eviction counter).
+                self.ledger = led
+                self.evict_cold(0.5)
+                led = self.ledger
+            else:
+                led = led.replace(
+                    transfers=ht.grow(led.transfers, led.transfers.capacity * 2)
+                )
         if kflags & tf.FLAG_GROW_POSTED:
             led = led.replace(posted=ht.grow(led.posted, led.posted.capacity * 2))
         self.ledger = led
@@ -286,6 +461,10 @@ class TpuStateMachine:
                 self._history_accounts_possible = True
             pv_count = hist_count = 0
         else:
+            if self.cold.count:
+                # The scan path only sees the hot table: rehydrate any cold
+                # rows this batch references so its semantics stay exact.
+                self._resolve_cold(batch)
             pv_count, hist_count = self._transfer_growth_counts(batch)
             self._grow_if_needed(
                 transfers=count, posted=pv_count, history=hist_count
@@ -351,6 +530,21 @@ class TpuStateMachine:
         found = np.asarray(found)
         host = {k: np.asarray(v) for k, v in cols.items()}
         rows = types.from_soa(host, types.TRANSFER_DTYPE)
+        if self.cold.count and not found.all():
+            # Misses may be cold (evicted): merge rows from the spill,
+            # preserving request order.
+            out = []
+            for i, ident in enumerate(ids):
+                if found[i]:
+                    out.append(rows[i])
+                else:
+                    row = self.cold.lookup(ident & U64_MAX, ident >> 64)
+                    if row is not None:
+                        out.append(row)
+            return (
+                np.stack(out).view(types.TRANSFER_DTYPE)
+                if out else np.zeros(0, dtype=types.TRANSFER_DTYPE)
+            )
         return rows[found]
 
     # -- queries (state_machine.zig:693-892, 1128-1195) ----------------------
@@ -412,10 +606,30 @@ class TpuStateMachine:
             bool(descending),
         )
         found, cols = sm.lookup_transfers(self.ledger, tid_lo, tid_hi)
-        valid = np.asarray(valid) & np.asarray(found)
+        idx_valid = np.asarray(valid)
+        found = np.asarray(found)
         host = {name: np.asarray(col) for name, col in cols.items()}
         out = types.from_soa(host, types.TRANSFER_DTYPE)
-        return out[valid][: min(limit, QUERY_ROWS_MAX)]
+        if self.cold.count and bool((idx_valid & ~found).any()):
+            # Index hits whose rows were evicted: resolve from the spill,
+            # preserving timestamp order.
+            tl, th = np.asarray(tid_lo), np.asarray(tid_hi)
+            merged = []
+            for i in range(len(idx_valid)):
+                if not idx_valid[i]:
+                    continue
+                if found[i]:
+                    merged.append(out[i])
+                else:
+                    row = self.cold.lookup(int(tl[i]), int(th[i]))
+                    if row is not None:
+                        merged.append(row)
+            rows_np = (
+                np.stack(merged).view(types.TRANSFER_DTYPE)
+                if merged else np.zeros(0, dtype=types.TRANSFER_DTYPE)
+            )
+            return rows_np[: min(limit, QUERY_ROWS_MAX)]
+        return out[idx_valid & found][: min(limit, QUERY_ROWS_MAX)]
 
     def get_account_history(self, filt: np.void) -> np.ndarray:
         """Balance history of a HISTORY-flagged account
@@ -462,6 +676,8 @@ class TpuStateMachine:
             "posted_bound": self._posted_bound,
             "history_bound": self._history_bound,
             "history_accounts_possible": self._history_accounts_possible,
+            "cold_manifest": self.cold.manifest(),
+            "bloom_log2": self._bloom_log2,
         }
 
     def restore_host_state(self, state: dict) -> None:
@@ -486,6 +702,19 @@ class TpuStateMachine:
         self._history_accounts_possible = bool(
             state.get("history_accounts_possible", True)
         )
+        manifest = state.get("cold_manifest", [])
+        if manifest:
+            self._tiering = True
+            self.cold.load_manifest(manifest)
+            self._bloom_log2 = int(state.get("bloom_log2", self._bloom_log2))
+            self._bloom_np = self.cold.rebuild_bloom(self._bloom_log2)
+            self._bloom_dev = jnp.asarray(self._bloom_np)
+        elif self.cold.runs:
+            # Restored to a pre-eviction checkpoint: drop stale in-memory
+            # cold state (files stay; older checkpoints may reference them).
+            self.cold.clear()
+            self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
+            self._bloom_dev = jnp.asarray(self._bloom_np)
         # The ledger was just swapped underneath us (restart or state sync):
         # the derived index no longer matches and rebuilds on next use.
         self.index.reset()

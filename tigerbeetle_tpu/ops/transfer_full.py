@@ -72,6 +72,7 @@ FLAG_SEQ = 1  # order-dependent semantics: run the sequential path
 FLAG_GROW_ACCOUNTS = 2  # a probe hit MAX_PROBE: grow the table + retry
 FLAG_GROW_TRANSFERS = 4
 FLAG_GROW_POSTED = 8
+FLAG_COLD = 16  # an id/pending_id may live in the cold spill: host resolves
 
 _U32MASK = jnp.uint64(0xFFFFFFFF)
 
@@ -159,12 +160,16 @@ def create_transfers_full_impl(
     batch: Dict[str, jax.Array],
     count: jax.Array,
     timestamp: jax.Array,
+    bloom: jax.Array = None,
+    cold_checked: jax.Array = None,
 ) -> Tuple[Ledger, jax.Array, jax.Array]:
     """Returns (ledger', codes uint32[N], flags uint32 scalar).
 
     flags == 0: the batch was applied and ``codes`` are the final results.
     flags != 0: NOTHING was applied (ledger' == ledger value-wise); the host
-    must grow the flagged tables and/or re-route to the sequential path.
+    must grow the flagged tables, resolve cold ids (FLAG_COLD: ``bloom`` is
+    the cold-id filter, ``cold_checked`` marks lanes the host already
+    certified), and/or re-route to the sequential path.
     """
     n = batch["id_lo"].shape[0]
     assert n <= 1 << 14, "leg sort key packs (slot, legpos<2^15)"
@@ -234,6 +239,29 @@ def create_transfers_full_impl(
         )
         | jnp.where(postedT_look.overflow, jnp.uint32(FLAG_GROW_POSTED), jnp.uint32(0))
     )
+
+    # Cold-tier membership (ops/cold.py): an id or pending_id missing from
+    # the HOT table but hitting the cold Bloom filter needs host resolution
+    # (exact exists-precedence demands the cold row). cold_checked lanes were
+    # already certified not-cold by the host, so false positives terminate.
+    if bloom is not None:
+        from .cold import bloom_check_impl
+
+        checked = (
+            cold_checked if cold_checked is not None
+            else jnp.zeros((n,), jnp.bool_)
+        )
+        cold_ids = (
+            valid & ~ex_look.found & ~checked
+            & bloom_check_impl(bloom, tid.lo, tid.hi)
+        )
+        cold_pend = (
+            postvoid & ~p_look.found & ~checked
+            & bloom_check_impl(bloom, pend_id.lo, pend_id.hi)
+        )
+        probe_grow = probe_grow | jnp.where(
+            jnp.any(cold_ids | cold_pend), jnp.uint32(FLAG_COLD), jnp.uint32(0)
+        )
 
     idx = _build_id_index(tid.lo, tid.hi)
 

@@ -69,6 +69,7 @@ class Replica:
         storage: Optional[Storage] = None,
         aof_path: Optional[str] = None,
         hash_log=None,
+        hot_transfers_capacity_max: Optional[int] = None,
     ) -> None:
         self.data_path = data_path
         # Optional determinism oracle (utils/hash_log.OpHashLog): per-commit
@@ -97,7 +98,11 @@ class Replica:
             self.aof = AOF(aof_path)
         self.superblock = SuperBlock(self.storage)
         self.journal = Journal(self.storage)
-        self.machine = TpuStateMachine(self.ledger_config, batch_lanes=batch_lanes)
+        self.machine = TpuStateMachine(
+            self.ledger_config, batch_lanes=batch_lanes,
+            spill_dir=(data_path + ".cold") if hot_transfers_capacity_max else None,
+            hot_transfers_capacity_max=hot_transfers_capacity_max,
+        )
 
         self.cluster = 0
         self.replica = 0
@@ -521,6 +526,14 @@ class Replica:
             self._checkpoint_inner()
 
     def _checkpoint_inner(self) -> None:
+        # Tiering: spill the older half of the hot transfers window when it
+        # is filling (deterministic: driven by the committed op stream; the
+        # runs written here become durable with this checkpoint's manifest).
+        m = self.machine
+        if m.hot_transfers_capacity_max is not None and (
+            m._transfers_bound * 2 > m.hot_transfers_capacity_max
+        ):
+            m.evict_cold(0.5)
         # Session replies live in the client_replies zone; make them durable
         # before the superblock references their sizes.
         self.storage.sync()
@@ -560,6 +573,7 @@ class Replica:
         # GC only after the superblock referencing the new manifest is
         # durable (crash before this point must find the old files intact).
         self.forest.gc()
+        self.machine.cold.gc()  # superseded cold runs (same discipline)
 
     def close(self) -> None:
         if self.aof is not None:
