@@ -278,6 +278,40 @@ class TestReplicaLifecycle:
         assert r2.machine.balances_snapshot() == balances
         r2.close()
 
+    def test_async_checkpoint_overlaps_serving(self, data_path):
+        """async_checkpoint (the TCP server mode): the expensive half runs
+        on a background thread while requests keep being served; the
+        durable state after drain + restart matches a synchronous run's."""
+        Replica.format(data_path, cluster=1, cluster_config=TEST_CONFIG)
+        r = make_replica(data_path)
+        r.async_checkpoint = True
+        session = register(r, 0xAB)
+        request(r, 0xAB, session, 1, wire.Operation.create_accounts,
+                accounts_body(range(1, 11)))
+        n = 2
+        served_during_flight = 0
+        for i in range(3 * TEST_CONFIG.vsr_checkpoint_interval + 5):
+            rh, cmd, _ = request(
+                r, 0xAB, session, n, wire.Operation.create_transfers,
+                transfers_body([(1 + i % 10, 1 + (i + 1) % 10, 5)],
+                               first_id=20_000 + i),
+            )
+            assert cmd == wire.Command.reply
+            if r._ckpt_thread is not None:
+                served_during_flight += 1
+            n += 1
+        r._checkpoint_drain()
+        assert r.op_checkpoint > 0
+        digest = r.machine.digest()
+        balances = r.machine.balances_snapshot()
+        r.close()
+
+        r2 = make_replica(data_path)
+        assert r2.op_checkpoint > 0
+        assert r2.machine.digest() == digest
+        assert r2.machine.balances_snapshot() == balances
+        r2.close()
+
     def test_wal_wrap_many_checkpoints(self, data_path):
         """Ops far beyond slot_count: the ring wraps, checkpoints rotate."""
         Replica.format(data_path, cluster=1, cluster_config=TEST_CONFIG)

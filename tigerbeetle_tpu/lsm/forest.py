@@ -129,15 +129,24 @@ class Forest:
         (base_checksum, manifest_checksum) for the superblock.  Writes a
         delta run when possible, a full base snapshot otherwise (first
         checkpoint, capacity change, or major compaction due)."""
-        cur = checkpoint_mod.ledger_to_arrays(ledger)
+        return self.checkpoint_arrays(
+            checkpoint_mod.ledger_to_arrays(ledger), meta, op
+        )
+
+    def checkpoint_arrays(
+        self, cur: Dict[str, np.ndarray], meta: dict, op: int
+    ) -> Tuple[int, int]:
+        """checkpoint() on a pre-captured host snapshot — the overlapped
+        checkpoint thread calls this so no device access happens off the
+        serving thread."""
         if self.prev is None or self._shapes_changed(cur):
-            base_checksum = self._write_base(ledger, meta, op)
+            base_checksum = self._write_base(cur, meta, op)
         else:
             delta, rows = self._delta(cur)
             cumulative = rows + sum(r.rows for r in self.manifest.runs)
             if cumulative >= max(1, self.manifest.base_rows) * self.major_ratio:
                 # Deltas rival the base: major compaction (rewrite base).
-                base_checksum = self._write_base(ledger, meta, op)
+                base_checksum = self._write_base(cur, meta, op)
             else:
                 seq = self.manifest.next_seq
                 run_checksum = self._write_run(seq, op, delta, meta)
@@ -146,7 +155,13 @@ class Forest:
                     RunRef(seq=seq, op=op, file_checksum=run_checksum, rows=rows)
                 )
                 if len(self.manifest.runs) > self.compact_runs_max:
-                    self._compact(op, meta)
+                    try:
+                        self._compact(op, meta)
+                    except (OSError, RuntimeError):
+                        # A live run is corrupt/missing on disk: skip the
+                        # merge (runs stay referenced); restart-time
+                        # verify() routes the damage to peer block repair.
+                        pass
                 base_checksum = self.manifest.base_checksum
         self.prev = cur
         manifest_checksum = self._write_manifest(op)
@@ -178,9 +193,19 @@ class Forest:
             next_seq=self.manifest.next_seq,
         )
 
-    def _write_base(self, ledger, meta: dict, op: int) -> int:
-        _, file_checksum = checkpoint_mod.save(self.data_path, op, ledger, meta)
-        self._reset_manifest(ledger, op, file_checksum)
+    def _write_base(self, cur: Dict[str, np.ndarray], meta: dict, op: int) -> int:
+        _, file_checksum = checkpoint_mod.save_arrays(
+            self.data_path, op, cur, meta
+        )
+        occupied = ~cur["accounts/tombstone"] & (
+            (cur["accounts/key_lo"] != 0) | (cur["accounts/key_hi"] != 0)
+        )
+        self.manifest = Manifest(
+            base_op=op,
+            base_checksum=file_checksum,
+            base_rows=int(occupied.sum()) + int(cur["transfers/count"]),
+            next_seq=self.manifest.next_seq,
+        )
         return file_checksum
 
     def _delta(
@@ -380,6 +405,91 @@ class Forest:
         return (
             json.loads(bytes(meta_arr).decode()) if meta_arr is not None else {}
         )
+
+    # -- peer block repair (grid_blocks_missing.zig's role) -------------------
+    #
+    # Checkpoint files are content-addressed by their AEGIS whole-file
+    # checksum (manifest checksum pinned by the superblock, base/run
+    # checksums pinned by the manifest), so a replica with a corrupt or
+    # missing file can fetch EXACTLY that file from any peer holding bytes
+    # with the same checksum — no trust required beyond the checksum chain.
+
+    def verify(self, op: int, manifest_checksum: int) -> List[Tuple[str, int, int]]:
+        """Check every file the checkpoint at ``op`` needs; returns damaged
+        refs as (kind, ident, expected_checksum) — empty means ``open(op,
+        manifest_checksum)`` will succeed.  If the manifest itself is
+        damaged, only it is reported (the rest is unknowable until it is
+        repaired — the caller re-verifies after each repair)."""
+        try:
+            with open(self.manifest_path(op), "rb") as f:
+                blob = f.read()
+            if checksum(blob) != manifest_checksum:
+                raise RuntimeError
+            manifest = Manifest.from_json(blob)
+        except (OSError, RuntimeError, ValueError, KeyError):
+            return [("manifest", op, manifest_checksum)]
+        damaged: List[Tuple[str, int, int]] = []
+        base_path = checkpoint_mod.path_for(self.data_path, manifest.base_op)
+        if self._file_checksum(base_path) != manifest.base_checksum:
+            damaged.append(("base", manifest.base_op, manifest.base_checksum))
+        for ref in manifest.runs:
+            if self._file_checksum(self.run_path(ref.seq)) != ref.file_checksum:
+                damaged.append(("run", ref.seq, ref.file_checksum))
+        return damaged
+
+    @staticmethod
+    def _file_checksum(path: str) -> Optional[int]:
+        try:
+            with open(path, "rb") as f:
+                return checksum(f.read())
+        except OSError:
+            return None
+
+    def _block_path(self, kind: str, ident: int) -> str:
+        if kind == "manifest":
+            return self.manifest_path(ident)
+        if kind == "base":
+            return checkpoint_mod.path_for(self.data_path, ident)
+        assert kind == "run", kind
+        return self.run_path(ident)
+
+    def locate_block(
+        self, kind: str, ident: int, block_checksum: int
+    ) -> Optional[str]:
+        """Responder lookup: a local file whose bytes hash to
+        ``block_checksum``.  Tries the hinted path first, then (for runs)
+        scans the live manifest — seq numbering may differ across replicas
+        when their checkpoint histories diverged; the checksum is the real
+        address."""
+        path = self._block_path(kind, ident)
+        if self._file_checksum(path) == block_checksum:
+            return path
+        if kind == "run":
+            for ref in self.manifest.runs:
+                if ref.file_checksum == block_checksum:
+                    candidate = self.run_path(ref.seq)
+                    if self._file_checksum(candidate) == block_checksum:
+                        return candidate
+        if kind == "manifest":
+            # Serve our current manifest regardless of the op suffix.
+            current = max(
+                [self.manifest.base_op] + [r.op for r in self.manifest.runs],
+                default=ident,
+            )
+            candidate = self.manifest_path(current)
+            if self._file_checksum(candidate) == block_checksum:
+                return candidate
+        return None
+
+    def repair_block(
+        self, kind: str, ident: int, expected_checksum: int, blob: bytes
+    ) -> bool:
+        """Install fetched bytes for a damaged file; False if the bytes do
+        not hash to the pinned checksum (corrupt/malicious peer)."""
+        if checksum(blob) != expected_checksum:
+            return False
+        _atomic_write(self._block_path(kind, ident), blob)
+        return True
 
     # -- sync materialization & GC -------------------------------------------
 

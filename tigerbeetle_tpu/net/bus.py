@@ -159,6 +159,10 @@ class ReplicaServer:
 def run_server(replica: Replica, host: str = "127.0.0.1", port: int = 0,
                ready_callback=None, statsd=None) -> None:
     """Blocking entry point: serve until cancelled."""
+    # Overlap checkpoints with request processing (replica.zig:3153-3169):
+    # safe in solo mode — no view changes, so no concurrent superblock
+    # writer; the sim keeps checkpoints synchronous for determinism.
+    replica.async_checkpoint = True
 
     async def main():
         server = ReplicaServer(replica, host, port, statsd=statsd)

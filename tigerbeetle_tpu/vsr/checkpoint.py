@@ -99,7 +99,17 @@ def save(
 ) -> Tuple[str, int]:
     """Write the snapshot for checkpoint ``op`` atomically; returns
     (path, file_checksum)."""
-    arrays = ledger_to_arrays(ledger)
+    return save_arrays(data_path, op, ledger_to_arrays(ledger), meta)
+
+
+def save_arrays(
+    data_path: str, op: int, arrays: Dict[str, np.ndarray],
+    meta: Optional[dict] = None,
+) -> Tuple[str, int]:
+    """save() on a pre-captured host snapshot (ledger_to_arrays output) —
+    lets the overlapped-checkpoint thread write without touching device
+    state."""
+    arrays = dict(arrays)
     arrays["meta"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8
     ).copy()

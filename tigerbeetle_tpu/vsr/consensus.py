@@ -55,7 +55,7 @@ import numpy as np
 from . import checkpoint as checkpoint_mod
 from . import wire
 from .clock import Clock
-from .replica import InvalidRequest, Replica, Session
+from .replica import ForestDamage, InvalidRequest, Replica, Session
 from .superblock import SuperBlockState
 
 # An outbound envelope: (("replica", index) | ("client", client_id), bytes).
@@ -78,6 +78,15 @@ VIEW_CHANGE_ESCALATE = 200   # stuck view change: try the next view
 RECOVERING_RESEND = 30       # request_start_view cadence while recovering
 REPAIR_INTERVAL = 15
 SYNC_RESEND = 30
+BLOCK_REPAIR_RESEND = 20     # per-chunk block-repair timeout before rotating
+
+# request_blocks/block kind codes <-> forest file kinds.
+_BLOCK_KIND_CODE = {
+    "manifest": wire.BLOCK_KIND_MANIFEST,
+    "base": wire.BLOCK_KIND_BASE,
+    "run": wire.BLOCK_KIND_RUN,
+}
+_BLOCK_KIND_NAME = {v: k for k, v in _BLOCK_KIND_CODE.items()}
 TICK_NS = 10_000_000  # default tick length; the TCP bus overrides tick_ns
 
 
@@ -145,6 +154,14 @@ class VsrReplica(Replica):
         # Sync state (lagging replica fetching a checkpoint snapshot).
         self.sync_target: Optional[dict] = None
         self.sync_buffer = bytearray()
+        # Explicit sync responder (block-repair fallback: primary unknown,
+        # rotate through peers); None = target the current view's primary.
+        self._sync_peer: Optional[int] = None
+
+        # Peer block repair (grid_blocks_missing.zig's role): damaged
+        # checkpoint files being refetched before the replica can open.
+        self._block_repair: Optional[dict] = None
+        self.blocks_repaired = 0
 
         # Tick counters.  First ping fires on the first tick so the cluster
         # clock synchronizes before the first client request.
@@ -184,6 +201,13 @@ class VsrReplica(Replica):
     def primary_index(self, view: Optional[int] = None) -> int:
         return (self.view if view is None else view) % self.replica_count
 
+    def _init_clock(self) -> None:
+        self.clock = Clock(
+            self.replica_count, self.replica, self._monotonic, self._realtime
+        )
+        self.time_ns = self._primary_now
+        self._heartbeat_jitter = self.prng.randrange(NORMAL_HEARTBEAT // 2)
+
     @property
     def is_primary(self) -> bool:
         return self.status == NORMAL and self.primary_index() == self.replica
@@ -203,15 +227,20 @@ class VsrReplica(Replica):
         ops — a restarted replica must first learn commit_max from the
         cluster (a journaled op may have been discarded by a view change
         while we were down)."""
-        recovery = self._open_durable_state()
+        try:
+            recovery = self._open_durable_state()
+        except ForestDamage as err:
+            if self.replica_count == 1:
+                raise  # solo: no peer to repair from
+            self._enter_block_repair(err.damage)
+            return
+        self._post_open(recovery)
+
+    def _post_open(self, recovery) -> None:
         self.commit_max = self.commit_min
         self.log_view = getattr(self._sb_state, "log_view", self.view)
         self._load_chain(recovery)
-        self.clock = Clock(
-            self.replica_count, self.replica, self._monotonic, self._realtime
-        )
-        self.time_ns = self._primary_now
-        self._heartbeat_jitter = self.prng.randrange(NORMAL_HEARTBEAT // 2)
+        self._init_clock()
         if self.replica_count == 1:
             # Sole replica: everything chained is committed by definition.
             self._replay_solo()
@@ -262,6 +291,8 @@ class VsrReplica(Replica):
             assert read is not None, op
             h, body = read
             self._commit_prepare(h, body, replay=True)
+            if self._checkpoint_due():
+                self.checkpoint()
         self.commit_max = self.commit_min
 
     def _persist_view(self) -> None:
@@ -282,6 +313,13 @@ class VsrReplica(Replica):
     ) -> List[Msg]:
         if wire.u128(h, "cluster") != self.cluster:
             return []
+        if self._block_repair is not None and command not in (
+            wire.Command.block, wire.Command.ping, wire.Command.pong
+        ):
+            # Until our checkpoint files are whole we have no ledger to
+            # serve from and no log to vote with; only repair traffic (and
+            # clock pings) may proceed.
+            return []
         handler = {
             wire.Command.request: self.on_request_msg,
             wire.Command.prepare: self.on_prepare,
@@ -298,6 +336,8 @@ class VsrReplica(Replica):
             wire.Command.pong: self.on_pong,
             wire.Command.request_sync_checkpoint: self.on_request_sync_checkpoint,
             wire.Command.sync_checkpoint: self.on_sync_checkpoint,
+            wire.Command.request_blocks: self.on_request_blocks,
+            wire.Command.block: self.on_block,
             wire.Command.request_reply: self.on_request_reply,
             wire.Command.reply: self.on_reply_repair,
         }.get(command)
@@ -367,6 +407,8 @@ class VsrReplica(Replica):
             return []  # drop: cannot assign timestamps
         if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
             return []  # pipeline full: client will retry
+        if self.op + 1 > self.op_prepare_max:
+            return []  # WAL full until the next checkpoint: client retries
 
         prepare_h, prepare_body = self._prepare(h, body, operation)
         op = int(prepare_h["op"])
@@ -464,6 +506,14 @@ class VsrReplica(Replica):
                 self._commit_journal(out)
             return out
 
+        if op > self.op_prepare_max:
+            # WAL bound (vsr.zig op_prepare_max): journaling this would
+            # overwrite a ring slot holding an op we have not committed.
+            # Drop — don't even stash (a stalled replica would accumulate a
+            # ring's worth) — the primary's resends / repair refetch it once
+            # our checkpoint advances.
+            return out
+
         if view < self.view:
             if self.status == NORMAL and op <= self.op:
                 existing = self.headers.get(op)
@@ -538,7 +588,7 @@ class VsrReplica(Replica):
 
     def _drain_stash(self, out: List[Msg]) -> None:
         """Chain in any stashed prepares that now fit."""
-        while self.op + 1 in self.stash:
+        while self.op + 1 in self.stash and self.op + 1 <= self.op_prepare_max:
             h, body = self.stash.pop(self.op + 1)
             if wire.u128(h, "parent") != self.parent_checksum:
                 break
@@ -666,9 +716,15 @@ class VsrReplica(Replica):
                 client = wire.u128(read[0], "client")
                 if client:
                     out.append((("client", client), reply))
-        if self._checkpoint_due():
-            self.checkpoint()
-            self._prune_headers()
+            if self._checkpoint_due():
+                # Checkpoint INSIDE the commit loop, so it lands exactly on
+                # op_checkpoint + interval on every replica regardless of
+                # commit batching — aligned checkpoint ops make the forests
+                # byte-identical across replicas (deterministic deltas),
+                # which peer block repair depends on (vsr.zig
+                # Checkpoint.checkpoint_after's fixed schedule).
+                self.checkpoint()
+                self._prune_headers()
 
     def _prune_headers(self) -> None:
         floor = self.op_checkpoint - 1
@@ -699,6 +755,12 @@ class VsrReplica(Replica):
     def on_start_view_change(self, h: np.ndarray, body: bytes) -> List[Msg]:
         view = int(h["view"])
         if view < self.view or self.replica_count == 1:
+            return []
+        if self.sync_target is not None:
+            # A syncing replica has no log to vote with; joining the view
+            # change would strand the half-fetched snapshot (sync_target
+            # survives but nothing resumes it).  Keep syncing; we rejoin
+            # via request_start_view after the install.
             return []
         out: List[Msg] = []
         if view > self.view:
@@ -747,6 +809,8 @@ class VsrReplica(Replica):
         view = int(h["view"])
         if view < self.view:
             return []
+        if self.sync_target is not None:
+            return []  # syncing: see on_start_view_change
         out: List[Msg] = []
         if view > self.view:
             out.extend(self._begin_view_change(view))
@@ -917,7 +981,7 @@ class VsrReplica(Replica):
         view = int(h["view"])
         if view < self.view or (view == self.view and self.status == NORMAL):
             return []
-        if self.status == SYNCING:
+        if self.sync_target is not None:
             # Keep fetching; a view change only moves where chunks come from.
             if view > self.view:
                 self.view = view
@@ -951,7 +1015,9 @@ class VsrReplica(Replica):
                 return sync
 
         self.status = NORMAL
-        self._install_headers(target_op, by_op)
+        # WAL bound: adopt at most a ring's worth beyond our checkpoint;
+        # commits advance the checkpoint and repair fetches the rest.
+        self._install_headers(min(target_op, self.op_prepare_max), by_op)
 
         # Ack the uncommitted suffix so the new primary can commit it.
         for op in range(self.commit_min + 1, self.op + 1):
@@ -1063,6 +1129,8 @@ class VsrReplica(Replica):
                         self.missing[op] = checksum
         for ch in sorted(headers, key=lambda x: int(x["op"])):
             op = int(ch["op"])
+            if op > self.op_prepare_max:
+                break  # WAL bound: cannot take bodies this far ahead yet
             if op == self.op + 1 and wire.u128(ch, "parent") == (
                 self.parent_checksum
             ):
@@ -1084,6 +1152,154 @@ class VsrReplica(Replica):
             # All repairs done: finish becoming primary.
             pending = self._new_view_pending
             self._pending_finish = pending
+
+    # -- peer block repair (grid_blocks_missing.zig's role) -------------------
+    #
+    # A replica that finds its checkpoint FILES (manifest / base snapshot /
+    # delta runs) corrupt or missing at open does not discard its state:
+    # each file is content-addressed by a checksum pinned from above (the
+    # superblock pins the manifest, the manifest pins base + runs), so the
+    # replica fetches exactly the damaged files from peers, chunk by chunk,
+    # verifies them against the pinned checksums, and then opens normally.
+    # Only if no peer can serve the bytes (peers checkpointed past us and
+    # GC'd, or histories diverged) does it fall back to full state sync.
+
+    def _enter_block_repair(self, damage) -> None:
+        self._init_clock()
+        self.status = RECOVERING
+        self._recovering_since = self._ticks
+        self._block_repair = {
+            "queue": list(damage),      # [(kind, ident, checksum), ...]
+            "buf": bytearray(),         # bytes of queue[0] fetched so far
+            "peer": self._next_peer(self.replica),
+            "attempts": 0,              # timed-out requests since progress
+            "requested": False,
+            # Fire the first request on the very next tick, not after a
+            # full resend interval.
+            "last_req": self._ticks - BLOCK_REPAIR_RESEND,
+        }
+
+    def _next_peer(self, p: int) -> int:
+        p = (p + 1) % self.replica_count
+        if p == self.replica:
+            p = (p + 1) % self.replica_count
+        return p
+
+    def _request_block(self) -> List[Msg]:
+        br = self._block_repair
+        kind, ident, expect = br["queue"][0]
+        req = self._hdr(
+            wire.Command.request_blocks,
+            block_kind=_BLOCK_KIND_CODE[kind],
+            block_id=ident,
+            block_checksum=expect,
+            offset=len(br["buf"]),
+        )
+        br["requested"] = True
+        br["last_req"] = self._ticks
+        return [(("replica", br["peer"]), wire.encode(req))]
+
+    def _tick_block_repair(self) -> List[Msg]:
+        br = self._block_repair
+        if self._ticks - br["last_req"] < BLOCK_REPAIR_RESEND:
+            return []
+        if br["requested"]:
+            # The outstanding request timed out: rotate peers and restart
+            # the current file (a different peer's chunks must align).
+            br["attempts"] += 1
+            br["peer"] = self._next_peer(br["peer"])
+            br["buf"] = bytearray()
+            if br["attempts"] >= 3 * self.replica_count:
+                return self._block_repair_fallback()
+        return self._request_block()
+
+    def on_request_blocks(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        kind = _BLOCK_KIND_NAME.get(int(h["block_kind"]))
+        if kind is None:
+            return []
+        expect = wire.u128(h, "block_checksum")
+        offset = int(h["offset"])
+        path = self.forest.locate_block(kind, int(h["block_id"]), expect)
+        if path is None:
+            return []
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                total = f.tell()
+                if offset >= total:
+                    return []
+                f.seek(offset)
+                chunk = f.read(self.config.message_body_size_max)
+        except OSError:
+            return []
+        resp = self._hdr(
+            wire.Command.block,
+            block_kind=int(h["block_kind"]),
+            block_id=int(h["block_id"]),
+            block_checksum=expect,
+            offset=offset,
+            total=total,
+        )
+        return [(("replica", int(h["replica"])), wire.encode(resp, chunk))]
+
+    def on_block(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        br = self._block_repair
+        if br is None or not br["queue"]:
+            return []
+        kind, ident, expect = br["queue"][0]
+        if (
+            int(h["block_kind"]) != _BLOCK_KIND_CODE[kind]
+            or wire.u128(h, "block_checksum") != expect
+        ):
+            return []  # stale response for a file we already finished
+        if int(h["offset"]) != len(br["buf"]):
+            return self._request_block()
+        br["buf"].extend(body)
+        br["attempts"] = 0
+        if len(br["buf"]) < int(h["total"]):
+            return self._request_block()
+        if not self.forest.repair_block(kind, ident, expect, bytes(br["buf"])):
+            # Bytes don't hash to the pinned checksum (corrupt/malicious
+            # peer): retry the whole file from the next peer.
+            br["buf"] = bytearray()
+            br["peer"] = self._next_peer(br["peer"])
+            return self._request_block()
+        br["queue"].pop(0)
+        br["buf"] = bytearray()
+        self.blocks_repaired += 1
+        if br["queue"]:
+            return self._request_block()
+        return self._finish_block_repair()
+
+    def _finish_block_repair(self) -> List[Msg]:
+        """All queued files repaired: re-verify and open.  A repaired
+        manifest may reveal more damage (its base/runs were unknowable
+        while it was corrupt) — requeue and keep going."""
+        try:
+            recovery = self._open_durable_state()
+        except ForestDamage as err:
+            br = self._block_repair
+            br["queue"] = list(err.damage)
+            br["buf"] = bytearray()
+            br["attempts"] = 0
+            return self._request_block()
+        self._block_repair = None
+        self._post_open(recovery)
+        if self.status == RECOVERING:
+            return self._request_start_view(self.view)
+        return []
+
+    def _block_repair_fallback(self) -> List[Msg]:
+        """No peer holds our damaged files: discard the local checkpoint
+        and fetch the cluster's latest full snapshot (state sync)."""
+        self._block_repair = None
+        self.journal.recover()  # journal rings are independent of the forest
+        self.status = SYNCING
+        self.sync_target = {"checkpoint_op": 0, "total": None}  # 0 = latest
+        self.sync_buffer = bytearray()
+        self._sync_peer = self._next_peer(self.replica)
+        self._last_sync_req = self._ticks
+        return self._request_sync_chunk()
 
     # -- state sync (vsr/sync.zig) --------------------------------------------
 
@@ -1108,11 +1324,19 @@ class VsrReplica(Replica):
             checkpoint_op=self.sync_target["checkpoint_op"],
             offset=len(self.sync_buffer),
         )
-        return [(("replica", self.primary_index()), wire.encode(req))]
+        target = (
+            self._sync_peer if self._sync_peer is not None
+            else self.primary_index()
+        )
+        return [(("replica", target), wire.encode(req))]
 
     def on_request_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
         checkpoint_op = int(h["checkpoint_op"])
         offset = int(h["offset"])
+        # checkpoint_op 0 = "whatever is latest" (block-repair fallback:
+        # the requester's own checkpoint is unusable, any current one will do).
+        if checkpoint_op == 0:
+            checkpoint_op = self.op_checkpoint
         if checkpoint_op != self.op_checkpoint or self.op_checkpoint == 0:
             return []
         try:
@@ -1142,9 +1366,12 @@ class VsrReplica(Replica):
         return [(("replica", int(h["replica"])), wire.encode(resp, chunk))]
 
     def on_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
-        if self.status != SYNCING or self.sync_target is None:
+        if self.sync_target is None:
             return []
         checkpoint_op = int(h["checkpoint_op"])
+        if self.sync_target["checkpoint_op"] == 0 and not self.sync_buffer:
+            # "Latest" request: pin to whichever checkpoint answered first.
+            self.sync_target["checkpoint_op"] = checkpoint_op
         if checkpoint_op != self.sync_target["checkpoint_op"]:
             return []
         if int(h["offset"]) != len(self.sync_buffer):
@@ -1220,6 +1447,7 @@ class VsrReplica(Replica):
         self.forest.gc()
         self.sync_target = None
         self.sync_buffer = bytearray()
+        self._sync_peer = None
         self.status = RECOVERING
         self._recovering_since = self._ticks
         return self._request_start_view(self.view)
@@ -1268,6 +1496,24 @@ class VsrReplica(Replica):
                 ping_timestamp_monotonic=self.clock.ping_timestamp(),
             )
             out.extend(self._broadcast(wire.encode(ping)))
+
+        if self._block_repair is not None:
+            out.extend(self._tick_block_repair())
+            return out
+
+        if self.sync_target is not None:
+            # A sync in flight is the only way forward regardless of what
+            # status a concurrent view change left us in — resume it rather
+            # than stranding the half-fetched snapshot.
+            self.status = SYNCING
+            if self._ticks - self._last_sync_req >= SYNC_RESEND:
+                self._last_sync_req = self._ticks
+                if self._sync_peer is not None:
+                    # Explicit-peer sync (block-repair fallback): a silent
+                    # responder means we guessed wrong — rotate.
+                    self._sync_peer = self._next_peer(self._sync_peer)
+                out.extend(self._request_sync_chunk())
+            return out
 
         if self.status == NORMAL and self.is_primary:
             if self._ticks - self._last_commit_sent >= COMMIT_HEARTBEAT:
@@ -1369,8 +1615,4 @@ class VsrReplica(Replica):
                 ):
                     out.extend(self._begin_view_change(self.view + 1))
 
-        elif self.status == SYNCING:
-            if self._ticks - self._last_sync_req >= SYNC_RESEND:
-                self._last_sync_req = self._ticks
-                out.extend(self._request_sync_chunk())
         return out

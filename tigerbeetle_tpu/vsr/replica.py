@@ -58,6 +58,19 @@ class InvalidRequest(Exception):
     (message_header.zig Request.invalid_header)."""
 
 
+class ForestDamage(RuntimeError):
+    """Checkpoint files (manifest/base/runs) are corrupt or missing.
+
+    ``damage`` lists (kind, ident, expected_checksum) triples.  A solo
+    replica treats this as fatal; a consensus replica repairs the files
+    from peers via request_blocks/block (the reference's
+    grid_blocks_missing.zig path) before falling back to full state sync."""
+
+    def __init__(self, damage):
+        super().__init__(f"checkpoint files damaged: {damage}")
+        self.damage = damage
+
+
 class Replica:
     def __init__(
         self,
@@ -100,13 +113,28 @@ class Replica:
         self.journal = Journal(self.storage)
         self.machine = TpuStateMachine(
             self.ledger_config, batch_lanes=batch_lanes,
-            spill_dir=(data_path + ".cold") if hot_transfers_capacity_max else None,
+            # Always derived from the data file (not from the CLI flag): a
+            # restart WITHOUT --hot-transfers-log2-max must still be able to
+            # reload a checkpoint whose cold_manifest references the spill.
+            spill_dir=data_path + ".cold",
             hot_transfers_capacity_max=hot_transfers_capacity_max,
         )
 
         self.cluster = 0
         self.replica = 0
         self.replica_count = 1
+        # Optional commit observer (testing/auditor.py): called with every
+        # committed op's (op, operation, timestamp, body, results, replay)
+        # — the simulator's op-ordered reply auditor hooks in here.
+        self.commit_observer = None
+        # Overlapped checkpointing (single-replica TCP server only; see
+        # checkpoint()).  _ckpt_thread holds the in-flight background write;
+        # _ckpt_result its finished SuperBlockState until adopted.
+        self.async_checkpoint = False
+        self._ckpt_thread = None
+        # (SuperBlockState, cold_garbage) of a finished background write.
+        self._ckpt_result = None
+        self._ckpt_error: Optional[BaseException] = None
         self.view = 0
         self.op = 0                 # latest journaled op
         self.commit_min = 0         # latest committed (executed) op
@@ -167,9 +195,20 @@ class Replica:
 
         if sb.op_checkpoint > 0 or sb.checkpoint_file_checksum != 0:
             if sb.manifest_checksum:
-                ledger, meta = self.forest.open(
-                    sb.op_checkpoint, sb.manifest_checksum
-                )
+                try:
+                    ledger, meta = self.forest.open(
+                        sb.op_checkpoint, sb.manifest_checksum
+                    )
+                except (OSError, RuntimeError, ValueError, KeyError) as err:
+                    # Only now pay for a full verify pass (the happy path
+                    # reads each file exactly once): enumerate what is
+                    # damaged so consensus can fetch it from peers.
+                    damage = self.forest.verify(
+                        sb.op_checkpoint, sb.manifest_checksum
+                    )
+                    if damage:
+                        raise ForestDamage(damage) from err
+                    raise
             else:  # legacy full-snapshot checkpoint (no manifest)
                 ledger, meta = checkpoint_mod.load(
                     self.data_path, sb.op_checkpoint, sb.checkpoint_file_checksum
@@ -246,6 +285,10 @@ class Replica:
             self.op = op
             self.commit_min = op
             op += 1
+            if self._checkpoint_due():
+                # Keep checkpoint ops on the fixed op_checkpoint + interval
+                # grid even through replay (see consensus._commit_journal).
+                self.checkpoint()
 
     # -- request handling (the hot path, §3.2) -------------------------------
 
@@ -279,6 +322,11 @@ class Replica:
                 return [session.reply_bytes]
             return []
 
+        self._checkpoint_poll()
+        if self.op + 1 > self.op_prepare_max:
+            # WAL full until the in-flight checkpoint lands (op_prepare_max
+            # backpressure): drop, the client retries.
+            return []
         prepare_h, prepare_body = self._prepare(header, body, operation)
         reply = self._commit_prepare(prepare_h, prepare_body, replay=False)
         assert reply is not None
@@ -356,6 +404,11 @@ class Replica:
                 # digests pinpoint the FIRST diverging commit across
                 # replicas or across a crash-replay (sim/cluster.py).
                 self.hash_log.record(op, int(self.machine.digest()))
+
+        if self.commit_observer is not None:
+            self.commit_observer(
+                op, operation.name, timestamp, body, result_body, replay
+            )
 
         reply_h = wire.new_header(
             wire.Command.reply,
@@ -514,6 +567,17 @@ class Replica:
 
     # -- checkpointing (replica.zig:3153-3169) --------------------------------
 
+    @property
+    def op_prepare_max(self) -> int:
+        """Highest op this replica may journal (vsr.zig op_prepare_max).
+        The WAL ring must always retain every op in (op_checkpoint, op] —
+        commits replay from it and recovery anchors at the checkpoint — so
+        the head may lead the checkpoint by at most the ring size.  A
+        replica at this bound stalls until its next checkpoint; a lagging
+        replica's head then falls behind the cluster's checkpoint, which is
+        exactly the state-sync trigger."""
+        return self.op_checkpoint + self.config.journal_slot_count - 1
+
     def _checkpoint_due(self) -> bool:
         return (
             self.commit_min - self.op_checkpoint
@@ -521,24 +585,41 @@ class Replica:
         )
 
     def checkpoint(self) -> None:
-        """Durably snapshot ledger + sessions + superblock at commit_min."""
+        """Durably snapshot ledger + sessions + superblock at commit_min.
+
+        With ``async_checkpoint`` on (the single-replica TCP server), the
+        expensive half — forest delta + file writes + fsync + superblock —
+        runs on a background thread while the replica keeps serving
+        (replica.zig:3153-3169 overlaps checkpoint with the pipeline the
+        same way); only the device→host snapshot is taken inline.  The sim
+        and cluster mode stay synchronous: the sim for determinism, the
+        cluster because a concurrent view change's superblock write
+        (_persist_view) would race the background one."""
+        if self.async_checkpoint:
+            self._checkpoint_poll()
+            if self._ckpt_thread is not None:
+                return  # one in flight; re-triggered when due after it lands
+            self._checkpoint_async_start()
+            return
         with tracer.span("checkpoint", op=self.commit_min):
             self._checkpoint_inner()
 
     def _checkpoint_inner(self) -> None:
+        arrays, meta, fields = self._checkpoint_capture()
+        state = self._checkpoint_write(arrays, meta, fields)
+        self._checkpoint_adopt(state, fields["cold_garbage"])
+
+    def _checkpoint_capture(self):
+        """The inline half of a checkpoint: everything that must be
+        consistent with THIS commit_min — evictions, session snapshot,
+        device→host ledger snapshot, digest, clocks."""
         # Tiering: spill the older half of the hot transfers window when it
         # is filling (deterministic: driven by the committed op stream; the
         # runs written here become durable with this checkpoint's manifest).
         m = self.machine
-        if m.hot_transfers_capacity_max is not None and (
-            m._transfers_bound * 2 > m.hot_transfers_capacity_max
-        ):
-            m.evict_cold(0.5)
-        # Session replies live in the client_replies zone; make them durable
-        # before the superblock references their sizes.
-        self.storage.sync()
+        m._maybe_evict_between_batches()
         meta = {
-            "machine": self.machine.host_state(),
+            "machine": m.host_state(),
             "sessions": {
                 f"{client:032x}": {
                     "session": s.session,
@@ -549,33 +630,106 @@ class Replica:
                 for client, s in self.sessions.items()
             },
         }
-        file_checksum, manifest_checksum = self.forest.checkpoint(
-            self.machine.ledger, meta, self.commit_min
+        arrays = checkpoint_mod.ledger_to_arrays(m.ledger)
+        fields = dict(
+            view=self.view,
+            log_view=getattr(self, "log_view", self.view),
+            commit_min=self.commit_min,
+            commit_max=self.op,
+            ledger_digest=m.digest(),
+            prepare_timestamp=m.prepare_timestamp,
+            commit_timestamp=m.commit_timestamp,
+            # Cold runs superseded as of THIS capture: the only ones whose
+            # deletion this checkpoint's durability justifies.  Runs merged
+            # AFTER capture (concurrent evictions under async_checkpoint)
+            # are referenced by the captured cold_manifest and must survive
+            # until the NEXT checkpoint lands.
+            cold_garbage=list(m.cold.garbage),
+        )
+        return arrays, meta, fields
+
+    def _checkpoint_write(self, arrays, meta, fields) -> SuperBlockState:
+        """The expensive half (file writes + fsync + superblock): safe off
+        the serving thread — it touches only the captured host snapshot,
+        the forest files, and distinct storage zones."""
+        # Session replies live in the client_replies zone; make them durable
+        # before the superblock references their sizes.
+        self.storage.sync()
+        op = fields["commit_min"]
+        file_checksum, manifest_checksum = self.forest.checkpoint_arrays(
+            arrays, meta, op
         )
         state = SuperBlockState(
             cluster=self.cluster,
             replica=self.replica,
             replica_count=self.replica_count,
-            view=self.view,
-            log_view=getattr(self, "log_view", self.view),
-            commit_min=self.commit_min,
-            commit_max=self.op,
-            op_checkpoint=self.commit_min,
+            view=fields["view"],
+            log_view=fields["log_view"],
+            commit_min=op,
+            commit_max=fields["commit_max"],
+            op_checkpoint=op,
             checkpoint_file_checksum=file_checksum,
-            ledger_digest=self.machine.digest(),
-            prepare_timestamp=self.machine.prepare_timestamp,
-            commit_timestamp=self.machine.commit_timestamp,
+            ledger_digest=fields["ledger_digest"],
+            prepare_timestamp=fields["prepare_timestamp"],
+            commit_timestamp=fields["commit_timestamp"],
             manifest_checksum=manifest_checksum,
         )
         self.superblock.checkpoint(state)
+        return state
+
+    def _checkpoint_adopt(self, state: SuperBlockState, cold_garbage) -> None:
         self._sb_state = state
-        self.op_checkpoint = self.commit_min
+        self.op_checkpoint = state.op_checkpoint
         # GC only after the superblock referencing the new manifest is
         # durable (crash before this point must find the old files intact).
         self.forest.gc()
-        self.machine.cold.gc()  # superseded cold runs (same discipline)
+        # Same discipline for cold runs — restricted to the files that were
+        # already superseded AT CAPTURE (see _checkpoint_capture).
+        self.machine.cold.gc(cold_garbage)
+
+    # -- overlapped checkpoint (async_checkpoint; replica.zig:3153-3169) ------
+
+    def _checkpoint_async_start(self) -> None:
+        import threading
+
+        arrays, meta, fields = self._checkpoint_capture()
+        self._ckpt_error = None
+
+        def work():
+            try:
+                state = self._checkpoint_write(arrays, meta, fields)
+                self._ckpt_result = (state, fields["cold_garbage"])
+            except Exception as err:  # noqa: BLE001 — surfaced at poll
+                self._ckpt_error = err
+
+        t = threading.Thread(
+            target=work, name="tb-checkpoint", daemon=True
+        )
+        self._ckpt_thread = t
+        with tracer.span("checkpoint_async_start", op=fields["commit_min"]):
+            t.start()
+
+    def _checkpoint_poll(self) -> None:
+        """Adopt a finished background checkpoint (serving thread only)."""
+        t = self._ckpt_thread
+        if t is None or t.is_alive():
+            return
+        self._ckpt_thread = None
+        if self._ckpt_error is not None:
+            raise RuntimeError("background checkpoint failed") from (
+                self._ckpt_error
+            )
+        (state, cold_garbage), self._ckpt_result = self._ckpt_result, None
+        self._checkpoint_adopt(state, cold_garbage)
+
+    def _checkpoint_drain(self) -> None:
+        t = self._ckpt_thread
+        if t is not None:
+            t.join()
+            self._checkpoint_poll()
 
     def close(self) -> None:
+        self._checkpoint_drain()
         if self.aof is not None:
             self.aof.close()
         self.storage.close()

@@ -245,6 +245,31 @@ REQUEST_REPLY_DTYPE = _dtype([
     ("reserved", "V88"),
 ])
 
+# Peer block repair (vsr/grid_blocks_missing.zig's role): a replica whose
+# local checkpoint FILES (manifest / base snapshot / delta run) are corrupt
+# or missing fetches just those files from peers, addressed by checksum —
+# instead of discarding its whole state and running full state sync.
+BLOCK_KIND_MANIFEST = 0
+BLOCK_KIND_BASE = 1
+BLOCK_KIND_RUN = 2
+
+REQUEST_BLOCKS_DTYPE = _dtype([
+    ("block_checksum_lo", "<u8"), ("block_checksum_hi", "<u8"),
+    ("block_id", "<u8"),         # manifest/base: checkpoint op; run: seq
+    ("offset", "<u8"),           # byte offset into the file
+    ("block_kind", "u1"),        # BLOCK_KIND_*
+    ("reserved", "V95"),
+])
+
+BLOCK_DTYPE = _dtype([
+    ("block_checksum_lo", "<u8"), ("block_checksum_hi", "<u8"),
+    ("block_id", "<u8"),
+    ("offset", "<u8"),
+    ("total", "<u8"),            # total file size
+    ("block_kind", "u1"),
+    ("reserved", "V87"),
+])
+
 # State sync (vsr/sync.zig): a lagging replica fetches the primary's latest
 # checkpoint snapshot in message-sized chunks.
 REQUEST_SYNC_CHECKPOINT_DTYPE = _dtype([
@@ -281,6 +306,8 @@ COMMAND_DTYPES = {
     Command.request_prepare: REQUEST_PREPARE_DTYPE,
     Command.headers: HEADERS_DTYPE,
     Command.request_reply: REQUEST_REPLY_DTYPE,
+    Command.request_blocks: REQUEST_BLOCKS_DTYPE,
+    Command.block: BLOCK_DTYPE,
     Command.request_sync_checkpoint: REQUEST_SYNC_CHECKPOINT_DTYPE,
     Command.sync_checkpoint: SYNC_CHECKPOINT_DTYPE,
 }
