@@ -1,0 +1,133 @@
+"""Op-ordered reply auditor (testing/auditor.py — auditor.zig's role).
+
+The oracle-model replay must hold under healthy runs, crash-replays, and
+faults — and must CATCH a build that commits wrong-but-conserving results
+(which digest/conservation checks cannot see if every replica is equally
+wrong).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.sim import PacketSimulator, SimCluster
+from tigerbeetle_tpu.testing.auditor import AuditError
+
+
+def make_cluster(tmp_path, seed=1, n=3, clients=2, requests=8, **kw):
+    net = PacketSimulator(seed=seed + 1, **kw.pop("net_kw", {}))
+    return SimCluster(
+        str(tmp_path), n_replicas=n, n_clients=clients, seed=seed,
+        requests_per_client=requests, net=net, **kw,
+    )
+
+
+def finish(cluster, max_ticks=60_000):
+    ok = cluster.run_until(
+        lambda: cluster.clients_done() and cluster.converged(),
+        max_ticks=max_ticks,
+    )
+    assert ok, "no convergence"
+    cluster.check_converged()
+    cluster.check_conservation()
+
+
+def test_healthy_run_fully_audited(tmp_path):
+    cluster = make_cluster(tmp_path, seed=71)
+    finish(cluster)
+    a = cluster.auditor
+    assert a is not None
+    assert a.audited > 0
+    # Every committed op was eventually replayed through the model (no
+    # permanent gaps in the observed commit order).
+    assert a.next_op == max(a.records) + 1
+
+
+def test_crash_replay_audited(tmp_path):
+    """A restarted replica re-commits from its WAL; the auditor compares
+    those replays bit-for-bit against the original commits."""
+    cluster = make_cluster(tmp_path, seed=72, requests=12)
+    cluster.run(600)
+    victim = next(
+        i for i in range(3)
+        if cluster.alive[i] and cluster.replicas[i].commit_min > 2
+    )
+    cluster.crash(victim)
+    cluster.run(300)
+    cluster.restart(victim)
+    finish(cluster, max_ticks=90_000)
+    assert cluster.auditor.audited > 0
+
+
+def test_lossy_network_audited(tmp_path):
+    cluster = make_cluster(
+        tmp_path, seed=73, requests=10,
+        net_kw=dict(loss_probability=0.05, delay_mean=3),
+    )
+    finish(cluster, max_ticks=120_000)
+    assert cluster.auditor.audited > 0
+
+
+def test_auditor_catches_wrong_result_code(tmp_path):
+    """A build that mis-codes one result (conserving, identical on every
+    replica — invisible to digests and conservation) must fail the audit."""
+    cluster = make_cluster(tmp_path, seed=74, requests=8)
+
+    # Break all replicas identically: the 3rd create_transfers commit
+    # reports result code 0 (ok) for a lane the machine rejected — the
+    # classic wrong-but-conserving lie.
+    broken = {"count": 0}
+    for i in range(3):
+        machine = cluster.replicas[i].machine
+        orig = machine.commit_batch
+
+        def lying(operation, batch, timestamp, _orig=orig, _m=machine):
+            results = _orig(operation, batch, timestamp)
+            if operation == "create_transfers":
+                broken["count"] += 1
+                if broken["count"] % 9 == 3 and results:
+                    results = results[:-1]  # drop a failure -> implies "ok"
+            return results
+
+        machine.commit_batch = lying
+
+    with pytest.raises(AuditError):
+        for _ in range(400):
+            cluster.run(50)
+            if cluster.clients_done() and cluster.converged():
+                # Converged without the audit tripping: the lie survived.
+                raise AssertionError("auditor missed the mis-coded result")
+
+
+def test_auditor_catches_cross_replica_divergence(tmp_path):
+    """One replica committing different results than the rest must trip the
+    bit-for-bit cross-replica comparison (before any state checker runs)."""
+    cluster = make_cluster(tmp_path, seed=75, requests=8)
+    machine = cluster.replicas[2].machine
+    orig = machine.commit_batch
+    state = {"n": 0}
+
+    def diverging(operation, batch, timestamp):
+        results = orig(operation, batch, timestamp)
+        if operation == "create_transfers":
+            state["n"] += 1
+            if state["n"] == 2:
+                results = list(results) + [(len(batch) - 1, 99)]
+        return results
+
+    machine.commit_batch = diverging
+    with pytest.raises(AuditError):
+        for _ in range(400):
+            cluster.run(50)
+            if cluster.clients_done() and cluster.converged():
+                raise AssertionError("auditor missed the divergent replica")
+
+
+def test_pending_expiry_mirrored(tmp_path):
+    """Pending transfers with short timeouts: post-after-expiry outcomes
+    must match the model's expiry mirror exactly (the workload generates
+    pending transfers with 0-5s timeouts and the sim clock advances 10ms
+    per tick, so some pendings expire mid-run)."""
+    cluster = make_cluster(tmp_path, seed=76, clients=3, requests=20)
+    finish(cluster, max_ticks=120_000)
+    assert cluster.auditor.audited > 10

@@ -162,3 +162,65 @@ class TestEvictionExactness:
         want = ref.create_transfers([M.transfer_from_row(r) for r in batch])
         assert got == want
         assert got == [(0, int(types.CreateTransferResult.exists))]
+
+    def test_restart_query_includes_cold(self, tmp_path):
+        """After a restart the rebuilt index must cover the cold tier too:
+        get_account_transfers would otherwise silently drop every evicted
+        transfer (the rebuild scans only the hot table)."""
+        dev, ref = make_pair(tmp_path)
+        self._fill(dev, ref, 400, 40_000)
+        assert dev.cold.count > 0
+        dev2 = TpuStateMachine(
+            CFG, batch_lanes=64, spill_dir=str(tmp_path / "cold"),
+            hot_transfers_capacity_max=256,
+        )
+        dev2.ledger = dev.ledger
+        dev2.restore_host_state(dev.host_state())
+        f = np.zeros(1, dtype=types.ACCOUNT_FILTER_DTYPE)[0]
+        f["account_id_lo"] = 1
+        f["limit"] = 8000
+        f["flags"] = 3
+        got_rows = dev2.get_account_transfers(f)
+        want_rows = ref.get_account_transfers(1, 0, 0, 8000, 3)
+        assert [int(r["id_lo"]) for r in got_rows] == [t.id for t in want_rows]
+
+    def test_restart_without_cap_reads_cold_manifest(self, tmp_path):
+        """A restart that omits the hot-cap flag must still reload a
+        checkpoint whose cold_manifest references the spill directory."""
+        dev, ref = make_pair(tmp_path)
+        self._fill(dev, ref, 400, 50_000)
+        assert dev.cold.count > 0
+        dev2 = TpuStateMachine(
+            CFG, batch_lanes=64, spill_dir=str(tmp_path / "cold"),
+        )
+        dev2.ledger = dev.ledger
+        dev2.restore_host_state(dev.host_state())
+        assert dev2.cold.count == dev.cold.count
+        sample = [50_000, 50_001]
+        got = dev2.lookup_transfers(sample)
+        want = ref.lookup_transfers(sample)
+        assert len(got) == len(want) == 2
+
+    def test_run_names_never_reused(self, tmp_path):
+        """Run file sequence numbers are monotonic across merges and
+        reloads — a reused name would overwrite bytes an older checkpoint
+        still references."""
+        store = cold_mod.ColdStore(str(tmp_path / "c"))
+        rows = types.transfers_array([
+            types.transfer(id=i + 1, debit_account_id=1, credit_account_id=2,
+                           amount=1, ledger=1, code=10)
+            for i in range(4)
+        ])
+        seen = set()
+        for k in range(store.MAX_RUNS * 3):
+            rows["id_lo"] = np.arange(4, dtype=np.uint64) + 1 + 10 * k
+            store.append_run(rows.copy())
+            for p in store.run_paths:
+                seen.add(p)
+        # Every live + garbage path is distinct; nothing ever collided.
+        assert len(seen) == len(set(seen))
+        all_named = set(store.run_paths) | set(store.garbage)
+        assert len(all_named) == len(store.run_paths) + len(store.garbage)
+        # A fresh store over the same directory continues the sequence.
+        store2 = cold_mod.ColdStore(str(tmp_path / "c"))
+        assert store2.next_seq == store.next_seq

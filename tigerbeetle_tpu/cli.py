@@ -219,6 +219,7 @@ def _cmd_start(args) -> int:
             args.path, ledger_config=ledger_config, aof_path=args.aof
         )
         replica.open()
+        replica.machine.warmup()  # compile before announcing readiness
         host = addresses[replica.replica][0]
 
         def ready(actual_port):
@@ -248,6 +249,10 @@ def _cmd_start(args) -> int:
         )
         return 1
     (host, port), = addresses
+    # Compile the commit kernels BEFORE announcing readiness: the first
+    # create_transfers otherwise eats the full jit latency inside a client's
+    # request timeout window.
+    replica.machine.warmup()
 
     def ready(actual_port):
         # Port-0 trick for tooling (reference main.zig:239-264): print the

@@ -172,7 +172,17 @@ def ensure_backend(timeout_s: float = 240.0, announce=print) -> str:
         )
         env = child_env(cpu=True)
         env["TB_TPU_REEXEC"] = "1"
-        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        argv = sys.argv
+        spec = getattr(sys.modules.get("__main__"), "__spec__", None)
+        if spec is not None and spec.name:
+            # Launched via ``python -m mod``: argv[0] is the module FILE,
+            # which cannot be re-run as a plain script (relative imports
+            # lose their package) — re-exec with -m and the original name.
+            mod = spec.name
+            if mod.endswith(".__main__"):
+                mod = mod[: -len(".__main__")]
+            argv = ["-m", mod] + argv[1:]
+        os.execve(sys.executable, [sys.executable] + argv, env)
     if "error" in result:
         announce(
             f"# accelerator init failed ({type(result['error']).__name__}: "

@@ -88,9 +88,11 @@ class TpuStateMachine:
 
         self.cold = ColdStore(spill_dir)
         self.hot_transfers_capacity_max = hot_transfers_capacity_max
-        self._tiering = (
-            spill_dir is not None or hot_transfers_capacity_max is not None
-        )
+        # Tiering is driven by the hot-window cap (evictions never trigger
+        # without one); a spill_dir alone is just where cold state WOULD
+        # live — restore_host_state turns tiering on when a checkpoint's
+        # cold_manifest says evictions already happened.
+        self._tiering = hot_transfers_capacity_max is not None
         self._bloom_log2 = 20
         self._bloom_np = None
         self._bloom_dev = None
@@ -98,6 +100,29 @@ class TpuStateMachine:
         if self._tiering:
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
             self._bloom_dev = make_bloom(self._bloom_log2)
+
+    def warmup(self) -> None:
+        """Force-compile the hot commit kernels with zero-count batches so
+        the first client request doesn't pay tens of seconds of jit latency
+        (the CLI calls this before announcing ``listening``).  The kernels
+        are functional — results are discarded, state is untouched."""
+        from .ops import transfer_full as tf
+
+        # The kernels donate the ledger buffers: adopt the returned ledger
+        # (a zero-count batch applies nothing, so it is value-identical).
+        soa_a = self._pad_soa(np.zeros(0, dtype=types.ACCOUNT_DTYPE))
+        self.ledger, codes_a = sm.create_accounts(
+            self.ledger, soa_a, jnp.uint64(0), jnp.uint64(1)
+        )
+        soa_t = self._pad_soa(np.zeros(0, dtype=types.TRANSFER_DTYPE))
+        cold_checked = (
+            jnp.zeros((self.batch_lanes,), jnp.bool_) if self._tiering else None
+        )
+        self.ledger, codes_t, kflags = tf.create_transfers_full(
+            self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
+            self._bloom_dev, cold_checked,
+        )
+        np.asarray(codes_a), np.asarray(codes_t), int(kflags)
 
     # -- prepare (state_machine.zig:503-512) --------------------------------
 
@@ -593,6 +618,14 @@ class TpuStateMachine:
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
         acct_lo, acct_hi, ts_min, ts_max, descending, limit = window
         flags = int(filt["flags"])
+        if self.index.stale:
+            # Rebuild here (not inside index.query) so the cold-tier runs
+            # are indexed too — a restart/state-sync rebuild from the hot
+            # table alone would silently drop every evicted transfer from
+            # query results.
+            self.index.rebuild(
+                self.ledger, extra_rows=[np.asarray(r) for r in self.cold.runs]
+            )
         # Static candidate cap: the next power of two covering the largest
         # reply (one compiled query program per level layout).
         k = 1 << (QUERY_ROWS_MAX - 1).bit_length()
@@ -608,6 +641,17 @@ class TpuStateMachine:
         found, cols = sm.lookup_transfers(self.ledger, tid_lo, tid_hi)
         idx_valid = np.asarray(valid)
         found = np.asarray(found)
+        # Dedupe repeated index entries for one transfer id (a rebuild can
+        # index a rehydrated transfer from both the hot table and its cold
+        # run).  Results are timestamp-ordered, so duplicates are adjacent.
+        tl_np, th_np = np.asarray(tid_lo), np.asarray(tid_hi)
+        if len(tl_np) > 1:
+            dup = np.zeros(len(tl_np), dtype=bool)
+            dup[1:] = (
+                idx_valid[1:] & idx_valid[:-1]
+                & (tl_np[1:] == tl_np[:-1]) & (th_np[1:] == th_np[:-1])
+            )
+            idx_valid = idx_valid & ~dup
         host = {name: np.asarray(col) for name, col in cols.items()}
         out = types.from_soa(host, types.TRANSFER_DTYPE)
         if self.cold.count and bool((idx_valid & ~found).any()):

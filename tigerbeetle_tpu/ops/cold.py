@@ -30,6 +30,7 @@ written at eviction become durable with the same checkpoint).
 from __future__ import annotations
 
 import functools
+import io
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -38,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types
+from ..utils.fs import atomic_write
+from ..vsr.checksum import checksum as _checksum
 from . import hash_table as ht
 from . import state_machine as sm
 
@@ -119,12 +122,33 @@ class ColdStore:
         self.directory = directory
         self.runs: List[np.ndarray] = []
         self.run_paths: List[str] = []
+        # Whole-file AEGIS checksums, parallel to run_paths: pinned into the
+        # checkpoint's cold_manifest so restart detects on-disk corruption
+        # of evicted rows (the same checksum-chain discipline as the forest).
+        self.run_checksums: List[int] = []
         # Files superseded by a merge: deletable only AFTER a checkpoint
         # superblock referencing the merged manifest is durable (the repo's
         # GC-after-superblock discipline) — gc() is that hook.
         self.garbage: List[str] = []
-        if directory:
-            os.makedirs(directory, exist_ok=True)
+        # Run filenames carry a sequence number that NEVER reuses a value
+        # present on disk: an old checkpoint's cold_manifest may reference
+        # files this in-memory state no longer tracks (post-merge garbage,
+        # or runs written after the checkpoint we restored to), and a name
+        # collision would silently replace those bytes.
+        self.next_seq = 0
+        self._scan_next_seq()
+
+    def _scan_next_seq(self) -> None:
+        if not self.directory or not os.path.isdir(self.directory):
+            return
+        for entry in os.listdir(self.directory):
+            parts = entry.split("_")
+            if parts[0] == "run" and len(parts) > 1 and parts[1].isdigit():
+                self.next_seq = max(self.next_seq, int(parts[1]) + 1)
+
+    def _ensure_dir(self) -> None:
+        if self.directory and not os.path.isdir(self.directory):
+            os.makedirs(self.directory, exist_ok=True)
 
     @property
     def count(self) -> int:
@@ -138,68 +162,69 @@ class ColdStore:
             return
         rows = rows[self._sort_key(rows)]
         if self.directory:
-            path = os.path.join(
-                self.directory, f"run_{len(self.run_paths):06d}_{len(rows)}.npy"
-            )
-            np.save(path, rows)
-            self._fsync(path)
+            path, file_checksum = self._write_run_file(rows)
             self.runs.append(np.load(path, mmap_mode="r"))
             self.run_paths.append(path)
+            self.run_checksums.append(file_checksum)
         else:
             self.runs.append(rows)
             self.run_paths.append("")
+            self.run_checksums.append(0)
         if len(self.runs) > self.MAX_RUNS:
             self._merge_all()
+
+    def _write_run_file(self, rows: np.ndarray) -> Tuple[str, int]:
+        self._ensure_dir()
+        path = os.path.join(
+            self.directory, f"run_{self.next_seq:06d}_{len(rows)}.npy"
+        )
+        self.next_seq += 1
+        buf = io.BytesIO()
+        np.save(buf, rows)
+        blob = buf.getvalue()
+        atomic_write(path, blob)
+        return path, _checksum(blob)
 
     def _merge_all(self) -> None:
         merged = np.concatenate([np.asarray(r) for r in self.runs])
         merged = merged[self._sort_key(merged)]
         old_paths = [p for p in self.run_paths if p]
-        self.runs, self.run_paths = [], []
+        self.runs, self.run_paths, self.run_checksums = [], [], []
         if self.directory:
-            path = os.path.join(
-                self.directory,
-                f"run_merged_{len(merged)}_{len(self.garbage)}.npy",
-            )
-            tmp = path + ".tmp.npy"
-            np.save(tmp, merged)
-            os.replace(tmp, path)
-            self._fsync(path)
+            path, file_checksum = self._write_run_file(merged)
             self.runs = [np.load(path, mmap_mode="r")]
             self.run_paths = [path]
+            self.run_checksums = [file_checksum]
             # A checkpoint taken BEFORE this merge still references the old
             # files; defer their deletion to gc() (post-superblock).
             self.garbage.extend(p for p in old_paths if p != path)
         else:
             self.runs = [merged]
             self.run_paths = [""]
+            self.run_checksums = [0]
 
-    def _fsync(self, path: str) -> None:
-        fd = os.open(path, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-        dfd = os.open(self.directory, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-
-    def gc(self) -> None:
-        """Delete superseded run files — call only after the checkpoint
-        superblock referencing the CURRENT manifest is durable."""
-        for p in self.garbage:
+    def gc(self, paths: Optional[List[str]] = None) -> None:
+        """Delete superseded run files — call only after a checkpoint
+        superblock NOT referencing them is durable.  ``paths`` restricts
+        deletion to files already superseded when that checkpoint was
+        captured (async checkpointing: files merged away AFTER the capture
+        are still referenced by the captured manifest and must wait for
+        the next checkpoint)."""
+        doomed = set(self.garbage) if paths is None else (
+            set(paths) & set(self.garbage)
+        )
+        for p in doomed:
             try:
                 os.remove(p)
             except OSError:
                 pass
-        self.garbage = []
+        self.garbage = [p for p in self.garbage if p not in doomed]
 
     def clear(self) -> None:
         """Drop in-memory state (restore to a pre-eviction checkpoint);
         files stay on disk — they may be referenced by older checkpoints."""
-        self.runs, self.run_paths, self.garbage = [], [], []
+        self.runs, self.run_paths, self.run_checksums = [], [], []
+        self.garbage = []
 
     def lookup(self, id_lo: int, id_hi: int) -> Optional[np.void]:
         """Newest-first binary search across runs."""
@@ -237,19 +262,33 @@ class ColdStore:
 
     def manifest(self) -> List[dict]:
         return [
-            {"path": os.path.basename(p), "rows": int(len(r))}
-            for p, r in zip(self.run_paths, self.runs)
+            {
+                "path": os.path.basename(p),
+                "rows": int(len(r)),
+                "checksum": f"{c:032x}",
+            }
+            for p, r, c in zip(self.run_paths, self.runs, self.run_checksums)
         ]
 
     def load_manifest(self, manifest: List[dict]) -> None:
         assert self.directory, "cold store reload requires a directory"
-        self.runs, self.run_paths = [], []
+        self.runs, self.run_paths, self.run_checksums = [], [], []
         for entry in manifest:
             path = os.path.join(self.directory, entry["path"])
+            expect = int(entry.get("checksum", "0"), 16)
+            if expect:
+                with open(path, "rb") as f:
+                    actual = _checksum(f.read())
+                if actual != expect:
+                    raise RuntimeError(
+                        f"cold run corrupt: {path} (checksum mismatch)"
+                    )
             run = np.load(path, mmap_mode="r")
             assert len(run) == entry["rows"], f"cold run truncated: {path}"
             self.runs.append(run)
             self.run_paths.append(path)
+            self.run_checksums.append(expect)
+        self._scan_next_seq()  # never reuse any on-disk name
 
 
 # ---------------------------------------------------------------------------
@@ -310,9 +349,11 @@ def drop_evicted(table: ht.Table, threshold_ts: jax.Array) -> ht.Table:
 
 
 def rows_to_numpy(n, key_lo, key_hi, cols) -> np.ndarray:
-    """Assemble extracted device rows into a host TRANSFER_DTYPE array."""
+    """Assemble extracted device rows into a host TRANSFER_DTYPE array.
+    Slices ON DEVICE before the pull: an eviction transfers O(evicted)
+    bytes, not O(hot-window capacity)."""
     count = int(n)
-    host = {name: np.asarray(col)[:count] for name, col in cols.items()}
-    host["id_lo"] = np.asarray(key_lo)[:count]
-    host["id_hi"] = np.asarray(key_hi)[:count]
+    host = {name: np.asarray(col[:count]) for name, col in cols.items()}
+    host["id_lo"] = np.asarray(key_lo[:count])
+    host["id_hi"] = np.asarray(key_hi[:count])
     return types.from_soa(host, types.TRANSFER_DTYPE)

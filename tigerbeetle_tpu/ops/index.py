@@ -82,6 +82,7 @@ def _merge(levels: List[Dict[str, jax.Array]]) -> Dict[str, jax.Array]:
 
 
 _merge_jit = jax.jit(_merge)
+_sort_level_jit = jax.jit(_sort_level)
 
 
 @functools.partial(jax.jit, static_argnames=("acct_field", "capacity"))
@@ -252,9 +253,14 @@ class TransferIndex:
                 self.occupied[j] = False
         self.occupied[k] = True
 
-    def rebuild(self, ledger: sm.Ledger) -> None:
+    def rebuild(self, ledger: sm.Ledger, extra_rows=()) -> None:
         """Full rebuild from the live table (restart / state sync / explicit
-        invalidation). One argsort of the table per side."""
+        invalidation). One argsort of the table per side.
+
+        ``extra_rows``: host TRANSFER_DTYPE arrays to index as well — the
+        cold-tier runs, whose rows left the hot table but must stay
+        queryable (get_account_transfers resolves their ids from the
+        spill)."""
         cap = max(self.base, ledger.transfers.capacity)
         k = (cap // self.base - 1).bit_length()
         self.dr_levels, self.cr_levels, self.occupied = [], [], []
@@ -266,7 +272,43 @@ class TransferIndex:
             ledger, "credit_account_id", self.base << k
         )
         self.occupied[k] = True
+        for rows in extra_rows:
+            self._add_host_rows(rows)
         self.stale = False
+
+    def _add_host_rows(self, rows) -> None:
+        """Occupy a free level with host rows (cold-tier runs at rebuild)."""
+        import numpy as np
+
+        rows = np.asarray(rows)
+        n = len(rows)
+        if n == 0:
+            return
+        j = max(0, ((n + self.base - 1) // self.base - 1).bit_length())
+        self._ensure_level(j)
+        while self.occupied[j]:
+            j += 1
+            self._ensure_level(j)
+
+        def level(acct_field):
+            cap = self.base << j
+
+            def col(vals):
+                out = np.full((cap,), U64M, np.uint64)
+                out[:n] = vals
+                return jnp.asarray(out)
+
+            return _sort_level_jit({
+                "acct_lo": col(rows[acct_field + "_lo"]),
+                "acct_hi": col(rows[acct_field + "_hi"]),
+                "ts": col(rows["timestamp"]),
+                "tid_lo": col(rows["id_lo"]),
+                "tid_hi": col(rows["id_hi"]),
+            })
+
+        self.dr_levels[j] = level("debit_account_id")
+        self.cr_levels[j] = level("credit_account_id")
+        self.occupied[j] = True
 
     # -- queries ------------------------------------------------------------
 

@@ -198,6 +198,7 @@ class SimCluster:
         read_fault_probability: float = 0.0,
         misdirect_probability: float = 0.0,
         hash_log: bool = True,
+        audit: bool = True,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
@@ -233,6 +234,12 @@ class SimCluster:
         from ..utils.hash_log import OpHashLog
 
         self.hash_logs = [OpHashLog() if hash_log else None for _ in range(self.n)]
+        # Op-ordered reply auditor (testing/auditor.py, auditor.zig's role):
+        # every replica's commits — including crash-replays — are checked
+        # bit-for-bit against each other and against the oracle model.
+        from ..testing.auditor import Auditor
+
+        self.auditor = Auditor() if audit else None
         self.replicas: List[Optional[VsrReplica]] = [None] * self.n
         self.alive = [False] * self.n
         for i in range(self.n):
@@ -270,7 +277,7 @@ class SimCluster:
         def realtime(i=i):
             return WALL_EPOCH_NS + (self.t + 1) * TICK_NS + self.wall_offsets[i]
 
-        return VsrReplica(
+        replica = VsrReplica(
             self._data_path(i),
             cluster_config=self.config,
             ledger_config=self.ledger_config,
@@ -281,6 +288,14 @@ class SimCluster:
             seed=self.seed * 31 + i,
             hash_log=self.hash_logs[i],
         )
+        if self.auditor is not None:
+            def observe(op, operation, ts, body, results, replay, i=i):
+                self.auditor.observe_commit(
+                    op, operation, ts, body, results, replica=i, replay=replay
+                )
+
+            replica.commit_observer = observe
+        return replica
 
     def start(self, i: int) -> None:
         assert not self.alive[i]

@@ -1,0 +1,121 @@
+"""Op-ordered write-path auditor for the cluster simulator.
+
+The role of the reference's Workload/Auditor pair
+(src/testing/state_machine/auditor.zig:1-4, workload.zig:1-19): the
+reference auditor tracks in-flight requests, a pending-expiry mirror, and
+per-event ALLOWED-result sets, because its clients observe replies with no
+global order and must tolerate every legal interleaving.
+
+This auditor is stricter, because it can be: the VSR reply/prepare headers
+carry the assigned op and batch timestamp, so total commit order is
+observable.  Hooked into every replica's commit path (production code —
+``Replica._commit_prepare``), it:
+
+- stages each committed ``(op, operation, timestamp, body, results)``;
+- asserts every replica (and every crash-replay of the same replica)
+  commits byte-identical results for the same op — a content-level
+  divergence oracle that pinpoints the op (hash_log pinpoints only the
+  ledger digest);
+- replays the ops in contiguous commit order through the scalar oracle
+  model (testing/model.py) and asserts the produced result codes match
+  EXACTLY — wrong-but-conserving results that digest checks cannot see
+  (e.g. a transfer applied with a wrong result code, an expiry missed)
+  fail here.  The pending-expiry mirror is the model itself: it applies
+  pending timeouts from the committed batch timestamps.
+
+Read-only operations (lookups/queries) occupy ops in the total order but
+do not advance the model; their correctness is covered by the differential
+query tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import types
+from . import model as M
+
+WRITE_OPS = ("create_accounts", "create_transfers")
+
+
+class AuditError(AssertionError):
+    """A committed result diverged — across replicas, across a replay, or
+    from the oracle model."""
+
+
+def _encode_results(results) -> bytes:
+    """Mirror of vsr.replica._encode_results (kept independent so a bug
+    there cannot hide itself from the audit)."""
+    arr = np.zeros(len(results), dtype=types.EVENT_RESULT_DTYPE)
+    for i, (index, code) in enumerate(results):
+        arr[i]["index"] = index
+        arr[i]["result"] = code
+    return arr.tobytes()
+
+
+class Auditor:
+    def __init__(self) -> None:
+        self.model = M.ReferenceStateMachine()
+        # op -> (operation, timestamp, body, result_body): every commit of
+        # an op must match the first observation bit-for-bit.
+        self.records: Dict[int, Tuple[str, int, bytes, bytes]] = {}
+        self.next_op = 1      # lowest op not yet replayed through the model
+        self.audited = 0      # write ops validated against the model
+
+    def observe_commit(
+        self,
+        op: int,
+        operation: str,
+        timestamp: int,
+        body: bytes,
+        result_body: bytes,
+        replica: int,
+        replay: bool,
+    ) -> None:
+        rec = (operation, timestamp, bytes(body), bytes(result_body))
+        prev = self.records.get(op)
+        if prev is not None:
+            if prev != rec:
+                raise AuditError(
+                    f"op {op}: replica {replica} (replay={replay}) committed "
+                    f"{operation} with diverging body/results vs the first "
+                    f"commit of this op"
+                )
+            return
+        self.records[op] = rec
+        self._drain()
+
+    def _drain(self) -> None:
+        while self.next_op in self.records:
+            operation, timestamp, body, result_body = self.records[self.next_op]
+            if operation == "create_accounts":
+                events = [
+                    M.account_from_row(r)
+                    for r in np.frombuffer(body, dtype=types.ACCOUNT_DTYPE)
+                ]
+                expected = _encode_results(
+                    self.model.execute(operation, timestamp, events)
+                )
+            elif operation == "create_transfers":
+                events = [
+                    M.transfer_from_row(r)
+                    for r in np.frombuffer(body, dtype=types.TRANSFER_DTYPE)
+                ]
+                expected = _encode_results(
+                    self.model.execute(operation, timestamp, events)
+                )
+            else:
+                expected = None  # register / reads: order-occupying no-ops
+            if expected is not None:
+                if expected != result_body:
+                    got = np.frombuffer(result_body, dtype=types.RESULT_DTYPE)
+                    want = np.frombuffer(expected, dtype=types.RESULT_DTYPE)
+                    raise AuditError(
+                        f"op {self.next_op} ({operation}, ts={timestamp}): "
+                        f"cluster results diverge from the oracle model: "
+                        f"got {got.tolist()[:8]} want {want.tolist()[:8]}"
+                    )
+                self.audited += 1
+            self.next_op += 1
