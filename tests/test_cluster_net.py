@@ -1,7 +1,10 @@
 """Multi-replica cluster over real TCP (net/cluster_bus.py).
 
 The integration ring (SURVEY §4.6): three VsrReplicas served by ClusterServer
-on localhost, driven black-box by the synchronous client library.
+on localhost, driven black-box by the synchronous client library — including
+the scenarios the in-process simulator cannot cover at the socket level:
+primary kill with client failover under load, and a backup restart that
+rejoins and catches up over real TCP.
 """
 
 import asyncio
@@ -16,7 +19,7 @@ from tigerbeetle_tpu import types
 from tigerbeetle_tpu.client import Client
 from tigerbeetle_tpu.config import LEDGER_TEST, TEST_MIN
 from tigerbeetle_tpu.net.cluster_bus import ClusterServer
-from tigerbeetle_tpu.vsr.consensus import VsrReplica
+from tigerbeetle_tpu.vsr.consensus import NORMAL, VsrReplica
 
 CLUSTER = 0x77
 
@@ -33,85 +36,200 @@ def free_ports(n):
     return ports
 
 
-@pytest.fixture
-def tcp_cluster(tmp_path):
-    n = 3
-    addresses = [("127.0.0.1", p) for p in free_ports(n)]
-    replicas = []
-    for i in range(n):
-        path = str(tmp_path / f"r{i}.data")
-        VsrReplica.format(
-            path, cluster=CLUSTER, replica=i, replica_count=n,
-            cluster_config=TEST_MIN,
-        )
+class TcpCluster:
+    """n replicas on localhost TCP with per-replica stop/restart."""
+
+    def __init__(self, tmp_path, n=3):
+        self.n = n
+        self.tmp_path = tmp_path
+        self.addresses = [("127.0.0.1", p) for p in free_ports(n)]
+        self.replicas = [None] * n
+        self.servers = [None] * n
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self.thread.start()
+        for i in range(n):
+            VsrReplica.format(
+                self._path(i), cluster=CLUSTER, replica=i, replica_count=n,
+                cluster_config=TEST_MIN,
+            )
+            self.start(i)
+
+    def _path(self, i):
+        return str(self.tmp_path / f"r{i}.data")
+
+    def _run(self, coro, timeout=15):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def start(self, i):
+        assert self.servers[i] is None
         r = VsrReplica(
-            path, cluster_config=TEST_MIN, ledger_config=LEDGER_TEST,
+            self._path(i), cluster_config=TEST_MIN, ledger_config=LEDGER_TEST,
             batch_lanes=64, seed=i,
         )
         r.open()
-        replicas.append(r)
+        self.replicas[i] = r
 
-    loop = asyncio.new_event_loop()
-    servers = []
-
-    async def boot():
-        for i in range(n):
-            server = ClusterServer(replicas[i], addresses, tick_interval=0.005)
+        async def boot():
+            server = ClusterServer(r, self.addresses, tick_interval=0.005)
             await server.start()
-            servers.append(server)
+            return server
 
-    thread = threading.Thread(target=loop.run_forever, daemon=True)
-    thread.start()
-    asyncio.run_coroutine_threadsafe(boot(), loop).result(timeout=10)
+        self.servers[i] = self._run(boot())
+
+    def stop(self, i):
+        """Hard-stop replica i (socket-level: peers see a disconnect)."""
+        server, self.servers[i] = self.servers[i], None
+        self.replicas[i] = None
+
+        async def down():
+            await server.close()
+
+        self._run(down())
+
+    def restart(self, i):
+        self.start(i)
+
+    def close(self):
+        for i in range(self.n):
+            if self.servers[i] is not None:
+                self.stop(i)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+        self.loop.close()
+
+    # -- observers ----------------------------------------------------------
+
+    def live(self):
+        return [r for r in self.replicas if r is not None]
+
+    def primary_index(self):
+        for i, r in enumerate(self.replicas):
+            if r is not None and r.status == NORMAL and r.is_primary:
+                return i
+        return None
+
+    def wait(self, predicate, timeout=30, what="condition"):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if predicate():
+                return
+            time.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {what}: "
+                             f"{[(r.status, r.view, r.commit_min) if r else None for r in self.replicas]}")
+
+    def wait_converged(self, min_commit=1, timeout=30):
+        def ok():
+            live = self.live()
+            if len(live) < 2:
+                return False
+            if any(r.status != NORMAL for r in live):
+                return False
+            commits = {r.commit_min for r in live}
+            return len(commits) == 1 and commits.pop() >= min_commit and (
+                len({r.machine.digest() for r in live}) == 1
+            )
+
+        self.wait(ok, timeout, "cluster convergence")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = TcpCluster(tmp_path)
     try:
-        yield addresses, replicas
+        yield c
     finally:
-        async def shutdown():
-            for s in servers:
-                await s.close()
-
-        asyncio.run_coroutine_threadsafe(shutdown(), loop).result(timeout=10)
-        loop.call_soon_threadsafe(loop.stop)
-        thread.join(timeout=5)
-        loop.close()
+        c.close()
 
 
-def test_three_replica_tcp_cluster(tcp_cluster):
-    addresses, replicas = tcp_cluster
-    client = Client(addresses, cluster=CLUSTER, timeout_s=30.0)
+def make_accounts(client, n=8):
+    accounts = types.accounts_array(
+        [types.account(id=i + 1, ledger=1, code=10) for i in range(n)]
+    )
+    assert client.create_accounts(accounts) == []
+
+
+def transfer_batch(first_id, count, amount=1):
+    return types.transfers_array(
+        [
+            types.transfer(
+                id=first_id + i, debit_account_id=1 + i % 8,
+                credit_account_id=1 + (i + 1) % 8, amount=amount,
+                ledger=1, code=10,
+            )
+            for i in range(count)
+        ]
+    )
+
+
+def test_three_replica_tcp_cluster(cluster):
+    client = Client(cluster.addresses, cluster=CLUSTER, timeout_s=30.0)
     try:
-        accounts = types.accounts_array(
-            [types.account(id=i + 1, ledger=1, code=10) for i in range(8)]
-        )
-        assert client.create_accounts(accounts) == []
-
-        transfers = types.transfers_array(
-            [
-                types.transfer(
-                    id=100 + i,
-                    debit_account_id=1 + i % 8,
-                    credit_account_id=1 + (i + 1) % 8,
-                    amount=10 + i,
-                    ledger=1,
-                    code=10,
-                )
-                for i in range(16)
-            ]
-        )
-        assert client.create_transfers(transfers) == []
-
+        make_accounts(client)
+        assert client.create_transfers(transfer_batch(100, 16, amount=10)) == []
         rows = client.lookup_accounts([1, 2])
         assert len(rows) == 2
-        # Replicated commits: every replica eventually executes every op.
-        deadline = time.time() + 20
-        while time.time() < deadline:
-            commits = [r.commit_min for r in replicas]
-            if len(set(commits)) == 1 and commits[0] >= 3:
-                break
-            time.sleep(0.1)
-        commits = [r.commit_min for r in replicas]
-        assert len(set(commits)) == 1, f"replicas at different commits: {commits}"
-        digests = {r.machine.digest() for r in replicas}
-        assert len(digests) == 1, "replica state diverged"
+        cluster.wait_converged(min_commit=3)
+    finally:
+        client.close()
+
+
+def test_primary_kill_failover_under_load(cluster):
+    """Kill the primary's process (socket-level) mid-load: the client fails
+    over, the backups elect a new primary, and no transfer is lost or
+    applied twice."""
+    client = Client(cluster.addresses, cluster=CLUSTER, timeout_s=60.0)
+    try:
+        make_accounts(client)
+        batches = 10
+        per_batch = 8
+        for k in range(batches):
+            if k == 4:
+                primary = cluster.primary_index()
+                assert primary is not None
+                cluster.stop(primary)
+            # Exactly-once across the failover: the client retries with the
+            # same request number, so a duplicate commit would double-apply
+            # (caught below by the balance sum).
+            assert client.create_transfers(
+                transfer_batch(1000 + k * per_batch, per_batch)
+            ) == [], f"batch {k} failed"
+        cluster.wait_converged(min_commit=1)
+        # Σ posted debits over all accounts == one per transfer committed.
+        rows = client.lookup_accounts(list(range(1, 9)))
+        total = sum(int(r["debits_posted_lo"]) for r in rows)
+        assert total == batches * per_batch, (
+            f"lost/duplicated transfers across failover: {total}"
+        )
+    finally:
+        client.close()
+
+
+def test_backup_restart_rejoins_over_tcp(cluster):
+    """A backup hard-stopped during load reopens from its data file, redials
+    the mesh, repairs its WAL over TCP, and converges."""
+    client = Client(cluster.addresses, cluster=CLUSTER, timeout_s=60.0)
+    try:
+        make_accounts(client)
+        primary = cluster.primary_index()
+        assert primary is not None
+        backup = (primary + 1) % cluster.n
+        cluster.stop(backup)
+        for k in range(6):
+            assert client.create_transfers(
+                transfer_batch(2000 + k * 8, 8)
+            ) == []
+        cluster.restart(backup)
+        cluster.wait(
+            lambda: all(
+                r is not None and r.status == NORMAL
+                and r.commit_min == cluster.replicas[primary].commit_min
+                for r in cluster.replicas
+            ),
+            timeout=45,
+            what="backup to catch up",
+        )
+        digests = {r.machine.digest() for r in cluster.replicas}
+        assert len(digests) == 1, "restarted backup diverged"
     finally:
         client.close()

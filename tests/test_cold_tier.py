@@ -215,12 +215,13 @@ class TestEvictionExactness:
         for k in range(store.MAX_RUNS * 3):
             rows["id_lo"] = np.arange(4, dtype=np.uint64) + 1 + 10 * k
             store.append_run(rows.copy())
-            for p in store.run_paths:
-                seen.add(p)
-        # Every live + garbage path is distinct; nothing ever collided.
-        assert len(seen) == len(set(seen))
-        all_named = set(store.run_paths) | set(store.garbage)
-        assert len(all_named) == len(store.run_paths) + len(store.garbage)
+            seen.update(store.run_paths)
+            seen.update(store.garbage)
+        # next_seq counts every file ever written (appends + merges); a
+        # reused name would collapse two writes onto one path and make
+        # the distinct-path count fall short.
+        assert len(seen) == store.next_seq
+        assert not (set(store.run_paths) & set(store.garbage))
         # A fresh store over the same directory continues the sequence.
         store2 = cold_mod.ColdStore(str(tmp_path / "c"))
         assert store2.next_seq == store.next_seq

@@ -174,7 +174,9 @@ def ensure_backend(timeout_s: float = 240.0, announce=print) -> str:
         env["TB_TPU_REEXEC"] = "1"
         argv = sys.argv
         spec = getattr(sys.modules.get("__main__"), "__spec__", None)
-        if spec is not None and spec.name:
+        if spec is not None and spec.name and spec.name != "__main__":
+            # (spec.name == "__main__" means zipapp/directory execution —
+            # argv already re-runs correctly as-is.)
             # Launched via ``python -m mod``: argv[0] is the module FILE,
             # which cannot be re-run as a plain script (relative imports
             # lose their package) — re-exec with -m and the original name.

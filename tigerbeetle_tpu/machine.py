@@ -81,6 +81,12 @@ class TpuStateMachine:
         from .ops.index import TransferIndex
 
         self.index = TransferIndex(base=batch_lanes)
+        # Every index rebuild (incl. the stale fallback inside query) must
+        # also cover the cold-tier runs, or restarts drop evicted
+        # transfers from query results.
+        self.index.extra_rows_provider = (
+            lambda: [np.asarray(r) for r in self.cold.runs]
+        )
         # Tiered transfers store (ops/cold.py): hot device window + cold
         # host spill; None spill_dir with no cap = tiering off (everything
         # stays hot).
@@ -618,14 +624,6 @@ class TpuStateMachine:
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
         acct_lo, acct_hi, ts_min, ts_max, descending, limit = window
         flags = int(filt["flags"])
-        if self.index.stale:
-            # Rebuild here (not inside index.query) so the cold-tier runs
-            # are indexed too — a restart/state-sync rebuild from the hot
-            # table alone would silently drop every evicted transfer from
-            # query results.
-            self.index.rebuild(
-                self.ledger, extra_rows=[np.asarray(r) for r in self.cold.runs]
-            )
         # Static candidate cap: the next power of two covering the largest
         # reply (one compiled query program per level layout).
         k = 1 << (QUERY_ROWS_MAX - 1).bit_length()

@@ -54,6 +54,7 @@ class ClusterServer:
         self.client_writers: Dict[int, asyncio.StreamWriter] = {}
         self._server: Optional[asyncio.base_events.Server] = None
         self._tasks: List[asyncio.Task] = []
+        self._accepted: set = set()  # live inbound transports (see close())
         self.port: Optional[int] = None
         self.dropped_sends = 0  # bounded-send-queue drops (backpressure)
         self._last_drop_log = 0.0
@@ -86,11 +87,21 @@ class ClusterServer:
             t.cancel()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-        for w in list(self.peer_writers.values()) + list(
-            self.client_writers.values()
+        # Close every transport we know of — outbound writers AND accepted
+        # inbound connections.  Do NOT await Server.wait_closed(): since
+        # Python 3.12 it waits for all connection handlers to finish, and a
+        # live peer's inbound connection never ends on its own — a hard
+        # stop of a busy replica would hang forever.
+        for w in (
+            list(self.peer_writers.values())
+            + list(self.client_writers.values())
+            + list(self._accepted)
         ):
-            w.close()
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._accepted.clear()
 
     # -- peer connections -----------------------------------------------------
 
@@ -128,9 +139,11 @@ class ClusterServer:
     ) -> None:
         """Accepted connection: replica j<i, or a client — identified by
         the first valid message."""
+        self._accepted.add(writer)
         try:
             await self._read_loop(reader, writer, peer=None)
         finally:
+            self._accepted.discard(writer)
             for table in (self.peer_writers, self.client_writers):
                 for key, w in list(table.items()):
                     if w is writer:

@@ -215,6 +215,10 @@ class TransferIndex:
         # comes only from restore/state-sync (reset()), and is cured by a
         # wholesale rebuild on next use.
         self.stale = False
+        # Source of extra host rows to index at rebuild (the machine wires
+        # its cold-tier runs here): the stale-rebuild fallback must cover
+        # them too, or evicted transfers silently vanish from queries.
+        self.extra_rows_provider = None
 
     # -- maintenance --------------------------------------------------------
 
@@ -253,14 +257,20 @@ class TransferIndex:
                 self.occupied[j] = False
         self.occupied[k] = True
 
-    def rebuild(self, ledger: sm.Ledger, extra_rows=()) -> None:
+    def rebuild(self, ledger: sm.Ledger, extra_rows=None) -> None:
         """Full rebuild from the live table (restart / state sync / explicit
         invalidation). One argsort of the table per side.
 
         ``extra_rows``: host TRANSFER_DTYPE arrays to index as well — the
         cold-tier runs, whose rows left the hot table but must stay
         queryable (get_account_transfers resolves their ids from the
-        spill)."""
+        spill).  Defaults to whatever ``extra_rows_provider`` supplies, so
+        EVERY rebuild path (including the stale fallback in query()) covers
+        the cold tier."""
+        if extra_rows is None:
+            extra_rows = (
+                self.extra_rows_provider() if self.extra_rows_provider else ()
+            )
         cap = max(self.base, ledger.transfers.capacity)
         k = (cap // self.base - 1).bit_length()
         self.dr_levels, self.cr_levels, self.occupied = [], [], []

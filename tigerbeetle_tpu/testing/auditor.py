@@ -110,8 +110,12 @@ class Auditor:
                 expected = None  # register / reads: order-occupying no-ops
             if expected is not None:
                 if expected != result_body:
-                    got = np.frombuffer(result_body, dtype=types.RESULT_DTYPE)
-                    want = np.frombuffer(expected, dtype=types.RESULT_DTYPE)
+                    got = np.frombuffer(
+                        result_body, dtype=types.EVENT_RESULT_DTYPE
+                    )
+                    want = np.frombuffer(
+                        expected, dtype=types.EVENT_RESULT_DTYPE
+                    )
                     raise AuditError(
                         f"op {self.next_op} ({operation}, ts={timestamp}): "
                         f"cluster results diverge from the oracle model: "

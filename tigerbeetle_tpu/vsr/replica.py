@@ -327,16 +327,40 @@ class Replica:
             # WAL full until the in-flight checkpoint lands (op_prepare_max
             # backpressure): drop, the client retries.
             return []
-        prepare_h, prepare_body = self._prepare(header, body, operation)
-        reply = self._commit_prepare(prepare_h, prepare_body, replay=False)
+        if self.async_checkpoint:
+            # Server mode: overlap the WAL fsync with the device kernel
+            # (the prefetch-stage role, SURVEY §2 #16 — the reference
+            # overlaps LSM prefetch IO with compute the same way).  The
+            # prepare is WRITTEN before execution; only its fsync runs
+            # concurrently, and the reply is withheld until both the
+            # execution AND the fsync finished — a crash in the window
+            # loses an op no client was ever answered for.
+            prepare_h, prepare_body = self._prepare(header, body, operation,
+                                                    sync=False)
+            fsync = self._io_pool_submit(self.journal.sync)
+            reply = self._commit_prepare(prepare_h, prepare_body, replay=False)
+            fsync.result()
+        else:
+            prepare_h, prepare_body = self._prepare(header, body, operation)
+            reply = self._commit_prepare(prepare_h, prepare_body, replay=False)
         assert reply is not None
         out = [reply]
         if self._checkpoint_due():
             self.checkpoint()
         return out
 
+    def _io_pool_submit(self, fn):
+        if getattr(self, "_io_pool", None) is None:
+            import concurrent.futures
+
+            self._io_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tb-wal-fsync"
+            )
+        return self._io_pool.submit(fn)
+
     def _prepare(
-        self, request_h: np.ndarray, body: bytes, operation: wire.Operation
+        self, request_h: np.ndarray, body: bytes, operation: wire.Operation,
+        sync: bool = True,
     ) -> Tuple[np.ndarray, bytes]:
         """Assign op + timestamp, hash-chain, and journal the prepare."""
         op = self.op + 1
@@ -359,7 +383,7 @@ class Replica:
         )
         h["replica"] = self.replica
         message = wire.encode(h, body)
-        self.journal.write_prepare(message)
+        self.journal.write_prepare(message, sync=sync)
         decoded, _ = wire.decode_header(message)
         self.op = op
         self.parent_checksum = wire.header_checksum(decoded)
@@ -730,6 +754,9 @@ class Replica:
 
     def close(self) -> None:
         self._checkpoint_drain()
+        pool = getattr(self, "_io_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True)
         if self.aof is not None:
             self.aof.close()
         self.storage.close()
