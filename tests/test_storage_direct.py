@@ -1,0 +1,141 @@
+"""Direct IO storage (config.zig direct_io; storage.zig:14+) + ProcessConfig.
+
+O_DIRECT bypasses page-cache writeback (which lies about durability); it
+demands sector-aligned offsets/lengths/buffers, so the Storage layer stages
+through an aligned buffer and read-modify-writes sub-sector slots (the
+256-byte WAL header ring)."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import ClusterConfig, LedgerConfig, ProcessConfig
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.replica import Replica
+from tigerbeetle_tpu.vsr.storage import SECTOR, Storage
+
+TEST_CONFIG = ClusterConfig(message_size_max=8192, journal_slot_count=64)
+TEST_LEDGER = LedgerConfig(
+    accounts_capacity_log2=10, transfers_capacity_log2=12,
+    posted_capacity_log2=10, max_probe=1 << 10,
+)
+
+
+def make_storage(tmp_path, **kw):
+    path = str(tmp_path / "d.tb")
+    Storage.format(path, TEST_CONFIG).close()
+    return Storage(path, TEST_CONFIG, **kw)
+
+
+def test_direct_io_roundtrip_aligned_and_unaligned(tmp_path):
+    s = make_storage(tmp_path, direct_io=True)
+    if not s.direct_io:
+        pytest.skip("filesystem lacks O_DIRECT")
+    try:
+        # Aligned block.
+        blob = os.urandom(2 * SECTOR)
+        s.write(SECTOR * 4, blob)
+        assert s.read(SECTOR * 4, len(blob)) == blob
+        # Sub-sector writes at header-slot granularity (256 B), spanning a
+        # sector boundary — the RMW path must preserve the neighbours.
+        s.write(SECTOR * 4, b"\xaa" * 256)
+        s.write(SECTOR * 5 - 128, b"\xbb" * 256)  # straddles the boundary
+        got = s.read(SECTOR * 4, 2 * SECTOR)
+        assert got[:256] == b"\xaa" * 256
+        assert got[SECTOR - 128 : SECTOR + 128] == b"\xbb" * 256
+        # Everything in between untouched.
+        assert got[256 : SECTOR - 128] == blob[256 : SECTOR - 128]
+        # A transfer larger than the staging buffer chunks correctly.
+        big = os.urandom(s.layout.wal_prepares_size)
+        s.write(s.layout.wal_prepares_offset, big)
+        assert s.read(s.layout.wal_prepares_offset, len(big)) == big
+    finally:
+        s.close()
+
+
+def test_direct_io_fallback_and_required(tmp_path):
+    # Fallback: direct_io requested but unavailable -> buffered, still works.
+    s = make_storage(tmp_path, direct_io=True)
+    direct_supported = s.direct_io
+    s.write(0, b"x" * 100)
+    assert s.read(0, 100) == b"x" * 100
+    s.close()
+    if not direct_supported:
+        with pytest.raises(OSError):
+            make_storage(tmp_path, direct_io=True, direct_io_required=True)
+
+
+def test_replica_on_direct_storage(tmp_path):
+    """Full replica lifecycle (format, requests, checkpoint, restart) with
+    the data file opened O_DIRECT via ProcessConfig."""
+    process = ProcessConfig(direct_io=True)
+    path = str(tmp_path / "r.tb")
+    Replica.format(path, cluster=1, cluster_config=TEST_CONFIG)
+
+    def boot():
+        r = Replica(
+            path, cluster_config=TEST_CONFIG, ledger_config=TEST_LEDGER,
+            batch_lanes=64, process_config=process,
+        )
+        r.open()
+        return r
+
+    r = boot()
+    if not r.storage.direct_io:
+        r.close()
+        pytest.skip("filesystem lacks O_DIRECT")
+
+    client = 0xD1
+    h = wire.new_header(
+        wire.Command.request, cluster=r.cluster, client=client,
+        request=0, operation=int(wire.Operation.register),
+    )
+    out = r.on_request(wire.set_checksums(h, b""), b"")
+    session = int(wire.decode(out[0])[0]["op"])
+
+    accounts = types.accounts_array(
+        [types.account(id=i, ledger=1, code=10) for i in range(1, 9)]
+    )
+    h = wire.new_header(
+        wire.Command.request, cluster=r.cluster, client=client,
+        request=1, session=session,
+        operation=int(wire.Operation.create_accounts),
+    )
+    out = r.on_request(wire.set_checksums(h, accounts.tobytes()),
+                       accounts.tobytes())
+    assert wire.decode(out[0])[1] == wire.Command.reply
+
+    n = 2
+    for i in range(TEST_CONFIG.vsr_checkpoint_interval + 2):
+        batch = types.transfers_array([types.transfer(
+            id=1000 + i, debit_account_id=1 + i % 8,
+            credit_account_id=1 + (i + 1) % 8, amount=3, ledger=1, code=10,
+        )])
+        h = wire.new_header(
+            wire.Command.request, cluster=r.cluster, client=client,
+            request=n, session=session,
+            operation=int(wire.Operation.create_transfers),
+        )
+        out = r.on_request(wire.set_checksums(h, batch.tobytes()),
+                           batch.tobytes())
+        assert wire.decode(out[0])[1] == wire.Command.reply
+        n += 1
+    assert r.op_checkpoint > 0
+    digest = r.machine.digest()
+    r.close()
+
+    r2 = boot()
+    assert r2.storage.direct_io
+    assert r2.machine.digest() == digest
+    r2.close()
+
+
+def test_process_config_defaults():
+    p = ProcessConfig()
+    assert p.tcp_nodelay and not p.direct_io
+    assert p.connection_delay_min_ms < p.connection_delay_max_ms
+    custom = dataclasses.replace(p, tick_ms=5, direct_io=True)
+    assert custom.tick_ms == 5 and custom.direct_io

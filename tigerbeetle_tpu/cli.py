@@ -34,6 +34,9 @@ def main(argv=None) -> int:
         prog="tigerbeetle-tpu",
         description="TPU-native accounting database (TigerBeetle-compatible wire protocol)",
     )
+    from .config import PROCESS_DEFAULT
+
+    default_address = f"{PROCESS_DEFAULT.address}:{PROCESS_DEFAULT.port}"
     sub = parser.add_subparsers(dest="subcommand", required=True)
 
     p_format = sub.add_parser("format", help="initialize a replica data file")
@@ -44,7 +47,7 @@ def main(argv=None) -> int:
 
     p_start = sub.add_parser("start", help="serve a formatted data file")
     p_start.add_argument("path")
-    p_start.add_argument("--addresses", default="127.0.0.1:3000",
+    p_start.add_argument("--addresses", default=default_address,
                          help="host:port to listen on")
     p_start.add_argument("--cache-accounts-log2", type=int, default=None,
                          help="accounts table capacity (log2 slots)")
@@ -53,6 +56,14 @@ def main(argv=None) -> int:
                          help="append-only audit log of committed prepares")
     p_start.add_argument("--statsd", default=None, metavar="HOST:PORT",
                          help="emit StatsD metrics (UDP, best-effort)")
+    p_start.add_argument("--direct-io", action="store_true",
+                         help="open the data file O_DIRECT (sector-aligned "
+                              "IO; bypasses page-cache writeback)")
+    p_start.add_argument("--direct-io-required", action="store_true",
+                         help="refuse to start if the filesystem lacks "
+                              "O_DIRECT instead of falling back")
+    p_start.add_argument("--tick-ms", type=int, default=None,
+                         help="cluster consensus tick cadence")
     p_start.add_argument("--hot-transfers-log2-max", type=int, default=None,
                          help="cap the device-resident transfers window at "
                               "2^N slots; older transfers spill to a cold "
@@ -62,7 +73,7 @@ def main(argv=None) -> int:
     p_version.add_argument("--verbose", action="store_true")
 
     p_repl = sub.add_parser("repl", help="interactive statement shell")
-    p_repl.add_argument("--addresses", default="127.0.0.1:3000")
+    p_repl.add_argument("--addresses", default=default_address)
     p_repl.add_argument("--cluster", type=lambda s: int(s, 0), required=True)
     p_repl.add_argument("--command", default=None,
                         help="one-shot statement(s); omit for interactive")
@@ -199,6 +210,17 @@ def _cmd_start(args) -> int:
     from .net.bus import run_server
     from .vsr.replica import Replica
 
+    import dataclasses as _dc
+
+    from .config import PROCESS_DEFAULT
+
+    process_config = _dc.replace(
+        PROCESS_DEFAULT,
+        direct_io=bool(args.direct_io),
+        direct_io_required=bool(args.direct_io_required),
+        **({"tick_ms": args.tick_ms} if args.tick_ms is not None else {}),
+    )
+
     ledger_config = LedgerConfig()
     if args.cache_accounts_log2 is not None:
         ledger_config = LedgerConfig(
@@ -216,7 +238,8 @@ def _cmd_start(args) -> int:
         from .vsr.consensus import VsrReplica
 
         replica = VsrReplica(
-            args.path, ledger_config=ledger_config, aof_path=args.aof
+            args.path, ledger_config=ledger_config, aof_path=args.aof,
+            process_config=process_config,
         )
         replica.open()
         replica.machine.warmup()  # compile before announcing readiness
@@ -236,7 +259,8 @@ def _cmd_start(args) -> int:
         if args.hot_transfers_log2_max is not None else None
     )
     replica = Replica(args.path, ledger_config=ledger_config,
-                      aof_path=args.aof, hot_transfers_capacity_max=hot_max)
+                      aof_path=args.aof, hot_transfers_capacity_max=hot_max,
+                      process_config=process_config)
     replica.open()
     if replica.replica_count != 1:
         # A multi-replica data file must never be served solo: commits

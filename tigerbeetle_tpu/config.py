@@ -87,9 +87,35 @@ class LedgerConfig:
         return 1 << self.history_capacity_log2
 
 
+@dataclasses.dataclass(frozen=True)
+class ProcessConfig:
+    """Per-process runtime knobs (config.zig ConfigProcess :73-121): free to
+    differ between replicas and across restarts — nothing here affects the
+    storage format or the wire protocol.  Every field is wired into the
+    runtime (servers, storage, CLI); unreferenced knobs don't belong here."""
+
+    # Default listen address (config.zig port/address; the CLI's
+    # --addresses default derives from these).
+    address: str = "127.0.0.1"
+    port: int = 3000
+    # Consensus tick cadence for the TCP cluster server (tick_ms).
+    tick_ms: int = 10
+    # Peer dial backoff window (connection_delay_min/max_ms).
+    connection_delay_min_ms: int = 50
+    connection_delay_max_ms: int = 1000
+    tcp_backlog: int = 64
+    tcp_nodelay: bool = True
+    # O_DIRECT for the zoned data file (direct_io / direct_io_required):
+    # page-cache writeback lies about durability; required=True refuses to
+    # run on filesystems without it instead of silently degrading.
+    direct_io: bool = False
+    direct_io_required: bool = False
+
+
 # Presets, mirroring config.zig:206-303.
 PRODUCTION = ClusterConfig()
 TEST_MIN = ClusterConfig(message_size_max=8192, journal_slot_count=64)
+PROCESS_DEFAULT = ProcessConfig()
 
 LEDGER_TEST = LedgerConfig(
     accounts_capacity_log2=10, transfers_capacity_log2=12, posted_capacity_log2=10,

@@ -63,17 +63,26 @@ class ReplicaServer:
     """Serve one replica over TCP (the `tigerbeetle start` loop,
     src/tigerbeetle/main.zig:133+266-269)."""
 
-    def __init__(self, replica: Replica, host: str = "127.0.0.1",
-                 port: int = 0, statsd=None) -> None:
+    def __init__(self, replica: Replica, host: Optional[str] = None,
+                 port: Optional[int] = None, statsd=None) -> None:
+        from ..config import PROCESS_DEFAULT
+
+        self.process = getattr(replica, "process_config", None) or (
+            PROCESS_DEFAULT
+        )
         self.replica = replica
-        self.host = host
-        self.port = port
+        # ProcessConfig supplies the listen defaults (config.zig
+        # address/port); explicit arguments override.
+        self.host = host if host is not None else self.process.address
+        self.port = port if port is not None else self.process.port
         self.statsd = statsd  # utils.statsd.StatsD; never blocks, optional
         self._server: Optional[asyncio.base_events.Server] = None
+        self._accepted: set = set()
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
-            self._handle_connection, self.host, self.port
+            self._handle_connection, self.host, self.port,
+            backlog=self.process.tcp_backlog,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("replica %d listening on %s:%d",
@@ -88,12 +97,32 @@ class ReplicaServer:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+        # Don't await Server.wait_closed(): since Python 3.12 it waits for
+        # all connection handlers, and an idle client's connection never
+        # ends on its own (see cluster_bus.ClusterServer.close).
+        for w in list(self._accepted):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._accepted.clear()
 
     async def _handle_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
+        if self.process.tcp_nodelay:
+            import socket as _socket
+
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                try:
+                    sock.setsockopt(
+                        _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    pass
+        self._accepted.add(writer)
         try:
             while True:
                 msg = await read_message(
@@ -115,6 +144,7 @@ class ReplicaServer:
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
+            self._accepted.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()

@@ -39,17 +39,26 @@ class ClusterServer:
         self,
         replica: VsrReplica,
         addresses: List[Tuple[str, int]],
-        tick_interval: float = 0.01,
+        tick_interval: Optional[float] = None,
         statsd=None,
+        process_config=None,
     ) -> None:
         assert replica.replica_count == len(addresses), (
             replica.replica_count, addresses
         )
+        from ..config import PROCESS_DEFAULT
+
+        self.process = process_config or getattr(
+            replica, "process_config", None
+        ) or PROCESS_DEFAULT
         self.statsd = statsd  # utils.statsd.StatsD; best-effort, optional
         self.replica = replica
         self.addresses = addresses
         self.index = replica.replica
-        self.tick_interval = tick_interval
+        self.tick_interval = (
+            tick_interval if tick_interval is not None
+            else self.process.tick_ms / 1000.0
+        )
         self.peer_writers: Dict[int, asyncio.StreamWriter] = {}
         self.client_writers: Dict[int, asyncio.StreamWriter] = {}
         self._server: Optional[asyncio.base_events.Server] = None
@@ -60,14 +69,14 @@ class ClusterServer:
         self._last_drop_log = 0.0
         # RTT-adaptive timeouts convert monotonic ns to consensus ticks;
         # keep the conversion in lockstep with the actual tick cadence.
-        replica.tick_ns = int(tick_interval * 1e9)
+        replica.tick_ns = int(self.tick_interval * 1e9)
 
     # -- lifecycle ------------------------------------------------------------
 
     async def start(self) -> int:
         host, port = self.addresses[self.index]
         self._server = await asyncio.start_server(
-            self._on_accept, host, port
+            self._on_accept, host, port, backlog=self.process.tcp_backlog
         )
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("replica %d listening on %s:%d", self.index, host, self.port)
@@ -103,12 +112,29 @@ class ClusterServer:
                 pass
         self._accepted.clear()
 
+    def _set_tcp_options(self, writer: asyncio.StreamWriter) -> None:
+        """Apply ProcessConfig TCP knobs (config.zig tcp_nodelay et al.)."""
+        import socket as _socket
+
+        sock = writer.get_extra_info("socket")
+        if sock is None:
+            return
+        try:
+            if self.process.tcp_nodelay:
+                sock.setsockopt(
+                    _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+                )
+        except OSError:
+            pass
+
     # -- peer connections -----------------------------------------------------
 
     async def _dial_loop(self, j: int) -> None:
         """Keep one outbound connection to replica j alive, with
         exponential backoff (message_bus.zig reconnect discipline)."""
-        backoff = 0.05
+        delay_min = self.process.connection_delay_min_ms / 1000.0
+        delay_max = self.process.connection_delay_max_ms / 1000.0
+        backoff = delay_min
         loop = asyncio.get_event_loop()
         while True:
             host, port = self.addresses[j]
@@ -116,8 +142,9 @@ class ClusterServer:
                 reader, writer = await asyncio.open_connection(host, port)
             except OSError:
                 await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+                backoff = min(backoff * 2, delay_max)
                 continue
+            self._set_tcp_options(writer)
             self.peer_writers[j] = writer
             connected_at = loop.time()
             try:
@@ -129,16 +156,17 @@ class ClusterServer:
             # Reset backoff only after a connection that actually lived —
             # an accept-then-drop listener must still back off exponentially.
             if loop.time() - connected_at > 1.0:
-                backoff = 0.05
+                backoff = delay_min
             else:
                 await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+                backoff = min(backoff * 2, delay_max)
 
     async def _on_accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         """Accepted connection: replica j<i, or a client — identified by
         the first valid message."""
+        self._set_tcp_options(writer)
         self._accepted.add(writer)
         try:
             await self._read_loop(reader, writer, peer=None)

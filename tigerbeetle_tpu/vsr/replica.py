@@ -83,6 +83,7 @@ class Replica:
         aof_path: Optional[str] = None,
         hash_log=None,
         hot_transfers_capacity_max: Optional[int] = None,
+        process_config=None,
     ) -> None:
         self.data_path = data_path
         # Optional determinism oracle (utils/hash_log.OpHashLog): per-commit
@@ -95,8 +96,13 @@ class Replica:
 
         # Injectable storage lets the VOPR simulator substitute an in-memory
         # fault-injecting backend (testing/storage.zig's role).
+        from ..config import PROCESS_DEFAULT
+
+        self.process_config = process_config or PROCESS_DEFAULT
         self.storage = storage if storage is not None else Storage(
-            data_path, self.config
+            data_path, self.config,
+            direct_io=self.process_config.direct_io,
+            direct_io_required=self.process_config.direct_io_required,
         )
         # LSM-equivalent durable layer: base snapshot + delta runs + manifest
         # (lsm/forest.py); full snapshots only at majors/capacity changes.

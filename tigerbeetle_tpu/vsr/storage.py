@@ -24,6 +24,14 @@ SUPERBLOCK_COPIES = 4
 SUPERBLOCK_COPY_SIZE = 4096
 
 
+def _align_down(x: int, a: int) -> int:
+    return x - (x % a)
+
+
+def _align_up(x: int, a: int) -> int:
+    return x + (-x % a)
+
+
 @dataclasses.dataclass(frozen=True)
 class Layout:
     """Zone offsets/sizes derived from the cluster config (vsr.zig:67-152)."""
@@ -67,14 +75,67 @@ class Layout:
         return self.client_replies_offset + self.client_replies_size
 
 
-class Storage:
-    """Positional I/O over the zoned data file."""
+SECTOR = 4096  # direct-IO alignment unit (config.zig sector_size)
 
-    def __init__(self, path: str, config: Optional[ClusterConfig] = None) -> None:
+
+class Storage:
+    """Positional I/O over the zoned data file.
+
+    ``direct_io`` opens the file O_DIRECT (storage.zig:14+ requires it in
+    production: page-cache writeback lies about durability and masks latent
+    sector errors).  O_DIRECT demands sector-aligned offsets, lengths, AND
+    user buffers; Python bytes are unaligned, so all direct transfers stage
+    through a page-aligned mmap buffer, and sub-sector writes (the 256-byte
+    WAL header slots) read-modify-write their containing sectors — the
+    journal's dual rings + checksums already treat a torn sector as a torn
+    write.  Filesystems without O_DIRECT (tmpfs) fall back to buffered+fsync
+    unless ``direct_io_required``."""
+
+    def __init__(
+        self,
+        path: str,
+        config: Optional[ClusterConfig] = None,
+        direct_io: bool = False,
+        direct_io_required: bool = False,
+    ) -> None:
         self.path = path
         self.config = config or ClusterConfig()
         self.layout = Layout(self.config)
-        self.fd = os.open(path, os.O_RDWR)
+        self.direct_io = False
+        if direct_io and hasattr(os, "O_DIRECT"):
+            try:
+                self.fd = os.open(path, os.O_RDWR | os.O_DIRECT)
+                self.direct_io = True
+            except OSError:
+                if direct_io_required:
+                    raise
+                self.fd = os.open(path, os.O_RDWR)
+        else:
+            if direct_io and direct_io_required:
+                raise OSError("O_DIRECT unsupported on this platform")
+            self.fd = os.open(path, os.O_RDWR)
+        if self.direct_io:
+            import threading
+
+            # Page-aligned staging areas, large enough for the biggest
+            # single transfer (a full prepare slot) plus edge sectors —
+            # PER THREAD: the background checkpoint thread
+            # (replica.async_checkpoint) writes the superblock while the
+            # serving thread journals prepares; a shared buffer would
+            # interleave their bytes on disk.
+            self._buf_size = (
+                _align_up(self.config.message_size_max, SECTOR) + 2 * SECTOR
+            )
+            self._buf_local = threading.local()
+
+    def _staging(self):
+        import mmap
+
+        buf = getattr(self._buf_local, "buf", None)
+        if buf is None:
+            buf = mmap.mmap(-1, self._buf_size)
+            self._buf_local.buf = buf
+        return buf
 
     @classmethod
     def format(cls, path: str, config: Optional[ClusterConfig] = None) -> "Storage":
@@ -97,6 +158,8 @@ class Storage:
 
     def read(self, offset: int, size: int) -> bytes:
         assert offset + size <= self.layout.total_size
+        if self.direct_io:
+            return self._read_direct(offset, size)
         data = os.pread(self.fd, size, offset)
         if len(data) < size:  # reading a hole at EOF boundary
             data = data + b"\x00" * (size - len(data))
@@ -104,8 +167,70 @@ class Storage:
 
     def write(self, offset: int, data: bytes) -> None:
         assert offset + len(data) <= self.layout.total_size
+        if self.direct_io:
+            self._write_direct(offset, data)
+            return
         written = os.pwrite(self.fd, data, offset)
         assert written == len(data)
+
+    # -- direct-IO staging ----------------------------------------------------
+
+    def _read_direct(self, offset: int, size: int) -> bytes:
+        step = self._buf_size - 2 * SECTOR
+        out = bytearray()
+        while size > 0:
+            n = min(size, step)
+            out += self._read_direct_one(offset, n)
+            offset += n
+            size -= n
+        return bytes(out)
+
+    def _read_sector(self, view, file_offset: int) -> None:
+        """Read one sector into ``view`` (len SECTOR), zero-filling holes."""
+        got = os.preadv(self.fd, [view], file_offset)
+        if got < SECTOR:
+            view[got:SECTOR] = b"\x00" * (SECTOR - got)
+
+    def _read_direct_one(self, offset: int, size: int) -> bytes:
+        lo = _align_down(offset, SECTOR)
+        hi = _align_up(offset + size, SECTOR)
+        span = hi - lo
+        view = memoryview(self._staging())[:span]
+        got = os.preadv(self.fd, [view], lo)
+        if got < span:  # hole at EOF boundary
+            view[got:span] = b"\x00" * (span - got)
+        return bytes(view[offset - lo : offset - lo + size])
+
+    def _write_direct(self, offset: int, data: bytes) -> None:
+        step = self._buf_size - 2 * SECTOR
+        mv = memoryview(data)
+        while len(mv) > 0:
+            n = min(len(mv), step)
+            self._write_direct_one(offset, mv[:n])
+            offset += n
+            mv = mv[n:]
+
+    def _write_direct_one(self, offset: int, data) -> None:
+        lo = _align_down(offset, SECTOR)
+        hi = _align_up(offset + len(data), SECTOR)
+        span = hi - lo
+        view = memoryview(self._staging())[:span]
+        # Read-modify-write ONLY the partially-overwritten edge sectors
+        # (a WAL prepare is sector-aligned at its start with an unaligned
+        # tail — reading the whole span back would double the device IO on
+        # the hot path).  The checksummed formats treat a torn sector
+        # exactly like a torn write.
+        if offset != lo:
+            self._read_sector(view[:SECTOR], lo)
+        end = offset + len(data)
+        if end != hi and (hi - SECTOR) != lo:
+            self._read_sector(view[span - SECTOR : span], hi - SECTOR)
+        elif end != hi and offset == lo:
+            # Single-sector span with an unaligned tail only.
+            self._read_sector(view[:SECTOR], lo)
+        view[offset - lo : offset - lo + len(data)] = data
+        written = os.pwritev(self.fd, [view], lo)
+        assert written == span
 
     def sync(self) -> None:
         os.fsync(self.fd)
@@ -114,6 +239,12 @@ class Storage:
         if self.fd >= 0:
             os.close(self.fd)
             self.fd = -1
+        if self.direct_io:
+            buf = getattr(self._buf_local, "buf", None)
+            if buf is not None:
+                buf.close()
+                self._buf_local.buf = None
+            # Other threads' staging mmaps are reclaimed with the thread.
 
     def __enter__(self) -> "Storage":
         return self
