@@ -16,6 +16,17 @@ def test_vopr_random_schedule_passes(tmp_path, seed):
     assert result.commits > 0
 
 
+def test_vopr_seed_9002_stale_wal_fork(tmp_path):
+    """Regression: a replica restarting with an uncommitted stale prepare
+    in its WAL (discarded by a view change it slept through) must not
+    commit it when the new view's start_view header window doesn't reach
+    down to it.  Caught by the op-ordered auditor; fixed by the
+    chain-verification floor (consensus._extend_verification)."""
+    result = run_seed(9002, workdir=str(tmp_path), ticks=8_000)
+    assert result.exit_code == EXIT_PASSED, result
+    assert result.commits > 0
+
+
 def test_vopr_tpu_correct_model_is_safe():
     v = vopr_tpu.run(seed=5, n_clusters=256, n_steps=250)
     assert v.sum() == 0, f"{v.sum()} false-positive violations"

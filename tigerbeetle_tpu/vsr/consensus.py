@@ -139,6 +139,14 @@ class VsrReplica(Replica):
         # Journaled prepare headers by op for the live window (chain checks,
         # repair responses, DVC/SV bodies).  Pruned at checkpoint.
         self.headers: Dict[int, np.ndarray] = {}
+        # Chain-verification floor: headers for ops >= _verify_floor are
+        # known canonical (anchored in an SV/DVC install and parent-chained
+        # downward); ops in (commit_min, _verify_floor) are SUSPECT — e.g.
+        # a restarted replica's own WAL suffix, which may hold prepares a
+        # view change since discarded.  _commit_journal refuses to commit a
+        # suspect op (VOPR seed 9002: a stale view-0 register was committed
+        # at op 1 because the view-4 SV window never reached down to it).
+        self._verify_floor = 0
         # Out-of-order prepares waiting for the chain to catch up.
         self.stash: Dict[int, Tuple[np.ndarray, bytes]] = {}
         # Ops whose canonical header is installed but whose body is missing.
@@ -283,6 +291,10 @@ class VsrReplica(Replica):
             op += 1
         if self.op > self.commit_min:
             self.parent_checksum = wire.header_checksum(self.headers[self.op])
+        # Everything re-loaded from our own WAL is suspect until it chains
+        # into canonical state learned from the cluster (solo replicas ARE
+        # the cluster: their WAL is canon by quorum=1).
+        self._verify_floor = self.op + 1 if self.replica_count > 1 else 0
 
     def _replay_solo(self) -> None:
         """Single-replica replay: execute the whole chained suffix."""
@@ -620,6 +632,7 @@ class VsrReplica(Replica):
                     self.headers[op] = h
                     del self.stash[op]
                     out.append(self._send_prepare_ok(h))
+                    self._repipeline(op, h)
                     changed = True
         self._commit_journal(out)
 
@@ -696,11 +709,60 @@ class VsrReplica(Replica):
         out.extend(self._maybe_start_sync(int(h["checkpoint_op"])))
         return out
 
+    def _extend_verification(self) -> None:
+        """Walk the parent chain DOWN from the verification floor, marking
+        headers canonical — and EVICTING a header that does not chain (a
+        stale fork from a discarded view, surviving in our WAL across a
+        restart).  Evicted ops become header gaps; the repair machinery
+        fetches the canonical headers, the gap-fill adoption re-verifies
+        them downward, and this walk resumes."""
+        while self._verify_floor > self.commit_min + 1:
+            f = self._verify_floor
+            h = self.headers.get(f)
+            below = self.headers.get(f - 1)
+            if h is None or below is None:
+                return  # a gap: repair must fetch headers first
+            if wire.u128(h, "parent") == wire.header_checksum(below):
+                self._verify_floor = f - 1
+                continue
+            del self.headers[f - 1]
+            self.stash.pop(f - 1, None)
+            self.missing.pop(f - 1, None)
+            # A primary's re-certification entry built from the stale
+            # header can never quorum (backups ack the canonical checksum);
+            # drop it — _repipeline rebuilds it when the canonical header
+            # is adopted.
+            self.pipeline.pop(f - 1, None)
+            return
+
+    def _repipeline(self, op: int, h: np.ndarray) -> None:
+        """Primary: (re)create the pipeline entry for an uncommitted op
+        whose canonical header was adopted via repair (the entry from
+        _finish_view_change may have been built from a since-evicted stale
+        header; see _extend_verification)."""
+        if self.status != NORMAL or not self.is_primary:
+            return
+        if not (self.commit_min < op <= self.op):
+            return
+        checksum = wire.header_checksum(h)
+        entry = self.pipeline.get(op)
+        if entry is None or entry.checksum != checksum:
+            self.pipeline[op] = PipelineEntry(
+                op=op, checksum=checksum,
+                client=wire.u128(h, "client"), ok_from={self.replica},
+            )
+
     def _commit_journal(self, out: List[Msg]) -> None:
         """Execute journaled ops up to min(commit_max, op), in order
         (replica.zig commit_journal :3176)."""
+        self._extend_verification()
         while self.commit_min < min(self.commit_max, self.op):
             op = self.commit_min + 1
+            if self.replica_count > 1 and op < self._verify_floor:
+                # Suspect suffix (restart before the canonical chain was
+                # re-established): committing now could execute a prepare a
+                # view change discarded.  Repair verifies or replaces it.
+                break
             h = self.headers.get(op)
             if h is None:
                 break
@@ -877,6 +939,11 @@ class VsrReplica(Replica):
         for op in sorted(by_op):
             if op <= self.commit_min:
                 continue
+            if op > target_op:
+                # Beyond the caller's clamp (the WAL bound, op_prepare_max):
+                # installing these would record missing bodies whose fills
+                # journal past the ring's safe window.
+                continue
             ch = by_op[op]
             checksum = wire.header_checksum(ch)
             mine = self.headers.get(op)
@@ -897,6 +964,18 @@ class VsrReplica(Replica):
         head = self.headers.get(self.op)
         if head is not None:
             self.parent_checksum = wire.header_checksum(head)
+        # The installed window is canonical by construction: lower the
+        # verification floor to its start (never raise it — a narrow SV on
+        # an already-verified log must not re-suspect history; the walk in
+        # _extend_verification would re-collapse it anyway, but cheaper not
+        # to).  Anything below the window stays suspect until the chain
+        # walk links it.
+        if by_op:
+            self._verify_floor = min(
+                self._verify_floor,
+                max(self.commit_min + 1, min(by_op)),
+            )
+        self._verify_floor = min(self._verify_floor, self.op + 1)
 
     def _request_missing(self, dvcs=None) -> List[Msg]:
         """request_prepare for every missing body, spread over peers.
@@ -1125,8 +1204,11 @@ class VsrReplica(Replica):
                     ):
                         self.journal.write_prepare(wire.encode(*stashed))
                         self.stash.pop(op, None)
+                        self._repipeline(op, ch)
                     elif not self.journal_has(op, checksum):
                         self.missing[op] = checksum
+                    else:
+                        self._repipeline(op, ch)
         for ch in sorted(headers, key=lambda x: int(x["op"])):
             op = int(ch["op"])
             if op > self.op_prepare_max:
@@ -1145,6 +1227,7 @@ class VsrReplica(Replica):
         op = int(h["op"])
         self.journal.write_prepare(wire.encode(h, body))
         del self.missing[op]
+        self._repipeline(op, h)
         self._repair_timeout.reset(self._ticks)  # repair progressing
         if getattr(self, "_new_view_pending", None) is not None and (
             not self.missing
@@ -1424,6 +1507,7 @@ class VsrReplica(Replica):
         self.stash.clear()
         self.missing.clear()
         self.parent_checksum = 0
+        self._verify_floor = op + 1  # nothing above the snapshot known yet
         manifest_checksum = self.forest.adopt_base(
             ledger, meta, op, target["file_checksum"]
         )
@@ -1546,6 +1630,17 @@ class VsrReplica(Replica):
                 # the VOPR read-fault family; commit would stall forever).
                 out.extend(self._request_missing())
                 out.extend(self._repair_gaps())
+                gaps = self._header_gaps()
+                if gaps:
+                    # Header gaps at the PRIMARY (e.g. _extend_verification
+                    # evicted a stale below-window fork after a restart+
+                    # view-win): fetch canonical headers from the backups —
+                    # without this the commit floor never clears.
+                    req = self._hdr(
+                        wire.Command.request_headers,
+                        op_min=gaps[0], op_max=gaps[-1],
+                    )
+                    out.extend(self._broadcast(wire.encode(req)))
 
         elif self.status == NORMAL:
             # Backup: watch for a dead primary.
