@@ -1,0 +1,158 @@
+// .NET client for tigerbeetle-tpu: P/Invoke over the native tb_client C ABI
+// (tigerbeetle_tpu/native/tb_client.{h,cpp}) — the same architecture as the
+// reference's .NET client (src/clients/dotnet, DllImport over tb_client).
+//
+// Build the shared library once:
+//   g++ -std=c++17 -O2 -shared -fPIC -pthread \
+//       -o tigerbeetle_tpu/native/libtb.so tigerbeetle_tpu/native/*.cpp
+// and make it resolvable (e.g. LD_LIBRARY_PATH=tigerbeetle_tpu/native).
+
+using System;
+using System.Runtime.InteropServices;
+using System.Threading;
+
+namespace TigerBeetle.Tpu
+{
+    public enum PacketStatus : byte
+    {
+        Ok = 0,
+        TooMuchData = 1,
+        InvalidOperation = 2,
+        ClientShutdown = 3,
+        Timeout = 4,
+        ClientEvicted = 5,
+    }
+
+    [StructLayout(LayoutKind.Sequential)]
+    internal struct Packet
+    {
+        public IntPtr Next;      // internal queue link
+        public IntPtr UserData;  // opaque, returned in the completion
+        public byte Operation;
+        public byte Status;
+        public uint DataSize;
+        public IntPtr Data;
+    }
+
+    public sealed class Client : IDisposable
+    {
+        [UnmanagedFunctionPointer(CallingConvention.Cdecl)]
+        private delegate void Completion(
+            UIntPtr context, IntPtr packet, IntPtr reply, uint replySize);
+
+        [DllImport("tb", EntryPoint = "tb_client_init",
+                   CallingConvention = CallingConvention.Cdecl)]
+        private static extern int TbInit(
+            out IntPtr client, byte[] clusterId, string addresses,
+            UIntPtr context, Completion onCompletion);
+
+        [DllImport("tb", EntryPoint = "tb_client_submit",
+                   CallingConvention = CallingConvention.Cdecl)]
+        private static extern void TbSubmit(IntPtr client, IntPtr packet);
+
+        [DllImport("tb", EntryPoint = "tb_client_deinit",
+                   CallingConvention = CallingConvention.Cdecl)]
+        private static extern void TbDeinit(IntPtr client);
+
+        private readonly IntPtr handle;
+        private readonly Completion completion; // pinned by this reference
+        private readonly SemaphoreSlim done = new(0, 1);
+        private readonly object submitLock = new();
+        private byte[]? lastReply;
+        private PacketStatus lastStatus;
+        private bool disposed;
+
+        public Client(UInt128Parts clusterId, string addresses)
+        {
+            var cluster = new byte[16];
+            BitConverter.GetBytes(clusterId.Lo).CopyTo(cluster, 0);
+            BitConverter.GetBytes(clusterId.Hi).CopyTo(cluster, 8);
+            completion = OnCompletion;
+            var status = TbInit(
+                out handle, cluster, addresses, UIntPtr.Zero, completion);
+            if (status != 0)
+                throw new InvalidOperationException(
+                    $"tb_client_init failed: {status}");
+        }
+
+        private void OnCompletion(
+            UIntPtr context, IntPtr packetPtr, IntPtr reply, uint replySize)
+        {
+            var packet = Marshal.PtrToStructure<Packet>(packetPtr);
+            lastStatus = (PacketStatus)packet.Status;
+            if (reply != IntPtr.Zero && replySize > 0)
+            {
+                lastReply = new byte[replySize];
+                Marshal.Copy(reply, lastReply, 0, (int)replySize);
+            }
+            else
+            {
+                lastReply = Array.Empty<byte>();
+            }
+            done.Release();
+        }
+
+        /// <summary>One blocking round trip (the native client allows one
+        /// in-flight request per session, vsr/client.zig).</summary>
+        public byte[] Request(Operation operation, ReadOnlySpan<byte> events)
+        {
+            lock (submitLock)
+            {
+                if (disposed) throw new ObjectDisposedException(nameof(Client));
+                var data = Marshal.AllocHGlobal(events.Length);
+                var packetPtr = Marshal.AllocHGlobal(Marshal.SizeOf<Packet>());
+                try
+                {
+                    unsafe
+                    {
+                        fixed (byte* src = events)
+                        {
+                            Buffer.MemoryCopy(
+                                src, (void*)data, events.Length, events.Length);
+                        }
+                    }
+                    var packet = new Packet
+                    {
+                        Next = IntPtr.Zero,
+                        UserData = IntPtr.Zero,
+                        Operation = (byte)operation,
+                        Status = 0,
+                        DataSize = (uint)events.Length,
+                        Data = data,
+                    };
+                    Marshal.StructureToPtr(packet, packetPtr, false);
+                    TbSubmit(handle, packetPtr);
+                    done.Wait();
+                    if (lastStatus != PacketStatus.Ok)
+                        throw new InvalidOperationException(
+                            $"request failed: {lastStatus}");
+                    return lastReply ?? Array.Empty<byte>();
+                }
+                finally
+                {
+                    Marshal.FreeHGlobal(data);
+                    Marshal.FreeHGlobal(packetPtr);
+                }
+            }
+        }
+
+        public EventResult[] CreateAccounts(ReadOnlySpan<byte> accounts)
+            => DecodeResults(Request(Operation.CreateAccounts, accounts));
+
+        public EventResult[] CreateTransfers(ReadOnlySpan<byte> transfers)
+            => DecodeResults(Request(Operation.CreateTransfers, transfers));
+
+        private static EventResult[] DecodeResults(byte[] reply)
+            => MemoryMarshal.Cast<byte, EventResult>(reply).ToArray();
+
+        public void Dispose()
+        {
+            lock (submitLock)
+            {
+                if (disposed) return;
+                disposed = true;
+                TbDeinit(handle);
+            }
+        }
+    }
+}

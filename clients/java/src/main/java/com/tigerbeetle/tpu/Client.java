@@ -1,0 +1,168 @@
+// Java client for tigerbeetle-tpu: java.lang.foreign (FFM, JDK 22+) over the
+// native tb_client C ABI (tigerbeetle_tpu/native/tb_client.{h,cpp}) — the
+// reference's Java client wraps the same ABI via JNI
+// (src/clients/java); FFM needs no hand-built glue library.
+//
+// Build the shared library once:
+//   g++ -std=c++17 -O2 -shared -fPIC -pthread \
+//       -o tigerbeetle_tpu/native/libtb.so tigerbeetle_tpu/native/*.cpp
+// and run with: java --enable-native-access=ALL-UNNAMED \
+//   -Djava.library.path=tigerbeetle_tpu/native ...
+package com.tigerbeetle.tpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.lang.invoke.MethodHandles;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.util.concurrent.SynchronousQueue;
+
+/**
+ * One native tb_client instance: a client IO thread owning the session,
+ * AEGIS checksums, retries, and primary failover. One blocking in-flight
+ * request at a time (vsr/client.zig semantics).
+ */
+public final class Client implements AutoCloseable {
+    // tb_packet_t layout (tb_client.h): next, user_data, operation, status,
+    // data_size, data — pointer-aligned, so offsets are fixed on LP64.
+    private static final long PKT_NEXT = 0;
+    private static final long PKT_USER_DATA = 8;
+    private static final long PKT_OPERATION = 16;
+    private static final long PKT_STATUS = 17;
+    private static final long PKT_DATA_SIZE = 20;
+    private static final long PKT_DATA = 24;
+    private static final long PKT_SIZE = 32;
+
+    private final Arena arena = Arena.ofShared();
+    private final MemorySegment handle;
+    private final MethodHandle submit;
+    private final MethodHandle deinit;
+    private final SynchronousQueue<byte[]> completions = new SynchronousQueue<>();
+    private volatile byte lastStatus;
+
+    public Client(long clusterLo, long clusterHi, String addresses) {
+        Linker linker = Linker.nativeLinker();
+        SymbolLookup lib = SymbolLookup.libraryLookup("tb", arena);
+        MethodHandle init = linker.downcallHandle(
+            lib.find("tb_client_init").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                ValueLayout.ADDRESS,   // void** client_out
+                ValueLayout.ADDRESS,   // const uint8_t cluster[16]
+                ValueLayout.ADDRESS,   // const char* addresses
+                ValueLayout.JAVA_LONG, // uintptr_t context
+                ValueLayout.ADDRESS)); // tb_completion_t
+        submit = linker.downcallHandle(
+            lib.find("tb_client_submit").orElseThrow(),
+            FunctionDescriptor.ofVoid(ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+        deinit = linker.downcallHandle(
+            lib.find("tb_client_deinit").orElseThrow(),
+            FunctionDescriptor.ofVoid(ValueLayout.ADDRESS));
+
+        MemorySegment callback;
+        try {
+            MethodHandle target = MethodHandles.lookup().findVirtual(
+                Client.class, "onCompletion",
+                java.lang.invoke.MethodType.methodType(
+                    void.class, long.class, MemorySegment.class,
+                    MemorySegment.class, int.class)).bindTo(this);
+            callback = linker.upcallStub(
+                target,
+                FunctionDescriptor.ofVoid(
+                    ValueLayout.JAVA_LONG, ValueLayout.ADDRESS,
+                    ValueLayout.ADDRESS, ValueLayout.JAVA_INT),
+                arena);
+        } catch (ReflectiveOperationException e) {
+            throw new AssertionError(e);
+        }
+
+        MemorySegment cluster = arena.allocate(16);
+        cluster.set(ValueLayout.JAVA_LONG_UNALIGNED, 0, clusterLo);
+        cluster.set(ValueLayout.JAVA_LONG_UNALIGNED, 8, clusterHi);
+        MemorySegment addr = arena.allocateFrom(addresses);
+        MemorySegment out = arena.allocate(ValueLayout.ADDRESS);
+        int status;
+        try {
+            status = (int) init.invoke(out, cluster, addr, 0L, callback);
+        } catch (Throwable t) {
+            throw new AssertionError(t);
+        }
+        if (status != 0) {
+            throw new IllegalStateException("tb_client_init failed: " + status);
+        }
+        handle = out.get(ValueLayout.ADDRESS, 0);
+    }
+
+    // Invoked on the native client IO thread.
+    @SuppressWarnings("unused")
+    private void onCompletion(long context, MemorySegment packet,
+                              MemorySegment reply, int replySize) {
+        MemorySegment pkt = packet.reinterpret(PKT_SIZE);
+        lastStatus = pkt.get(ValueLayout.JAVA_BYTE, PKT_STATUS);
+        byte[] bytes = new byte[Math.max(replySize, 0)];
+        if (replySize > 0) {
+            MemorySegment.copy(reply.reinterpret(replySize), 0,
+                MemorySegment.ofArray(bytes), 0, replySize);
+        }
+        try {
+            completions.put(bytes);
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+        }
+    }
+
+    /** One blocking round trip; returns the raw reply body. */
+    public synchronized byte[] request(int operation, byte[] events) {
+        try (Arena call = Arena.ofConfined()) {
+            MemorySegment data = call.allocate(Math.max(events.length, 1));
+            MemorySegment.copy(MemorySegment.ofArray(events), 0, data, 0,
+                events.length);
+            MemorySegment pkt = call.allocate(PKT_SIZE);
+            pkt.set(ValueLayout.JAVA_LONG, PKT_NEXT, 0);
+            pkt.set(ValueLayout.JAVA_LONG, PKT_USER_DATA, 0);
+            pkt.set(ValueLayout.JAVA_BYTE, PKT_OPERATION, (byte) operation);
+            pkt.set(ValueLayout.JAVA_BYTE, PKT_STATUS, (byte) 0);
+            pkt.set(ValueLayout.JAVA_INT, PKT_DATA_SIZE, events.length);
+            pkt.set(ValueLayout.ADDRESS, PKT_DATA, data);
+            try {
+                submit.invoke(handle, pkt);
+                byte[] reply = completions.take();
+                if (lastStatus != 0) {
+                    throw new IllegalStateException(
+                        "request failed: packet status " + lastStatus);
+                }
+                return reply;
+            } catch (IllegalStateException e) {
+                throw e;
+            } catch (Throwable t) {
+                throw new AssertionError(t);
+            }
+        }
+    }
+
+    /** create_accounts over encoded Account rows; empty result == all ok. */
+    public ByteBuffer createAccounts(byte[] accounts) {
+        return ByteBuffer.wrap(request(Types.Operation.CREATE_ACCOUNTS,
+            accounts)).order(ByteOrder.LITTLE_ENDIAN);
+    }
+
+    /** create_transfers over encoded Transfer rows. */
+    public ByteBuffer createTransfers(byte[] transfers) {
+        return ByteBuffer.wrap(request(Types.Operation.CREATE_TRANSFERS,
+            transfers)).order(ByteOrder.LITTLE_ENDIAN);
+    }
+
+    @Override
+    public void close() {
+        try {
+            deinit.invoke(handle);
+        } catch (Throwable t) {
+            throw new AssertionError(t);
+        }
+        arena.close();
+    }
+}

@@ -99,6 +99,98 @@ def test_ts_roundtrip_offsets():
         assert written == sorted(expected), (ts_name, written, expected)
 
 
+def test_java_types_up_to_date():
+    path = os.path.join(
+        ROOT, "clients", "java", "src", "main", "java", "com",
+        "tigerbeetle", "tpu", "Types.java",
+    )
+    with open(path) as f:
+        assert f.read() == bindings.generate_java_types(), (
+            "clients/java Types.java is stale: "
+            "python -m tigerbeetle_tpu.bindings"
+        )
+
+
+def test_cs_types_up_to_date():
+    with open(os.path.join(ROOT, "clients", "dotnet", "Types.cs")) as f:
+        assert f.read() == bindings.generate_cs_types(), (
+            "clients/dotnet/Types.cs is stale: "
+            "python -m tigerbeetle_tpu.bindings"
+        )
+
+
+def _non_reserved_offsets(dtype: np.dtype, u128):
+    """Field offsets excluding V-blob padding; ``u128(off)`` says which
+    offsets one joined lo/hi pair contributes (built on the same pairing
+    rule as bindings._iter_fields)."""
+    out = []
+    fields = list(dtype.names)
+    i = 0
+    while i < len(fields):
+        fname = fields[i]
+        ftype, off = dtype.fields[fname][:2]
+        if fname.endswith("_lo") and i + 1 < len(fields) and (
+            fields[i + 1] == fname[:-3] + "_hi"
+        ):
+            out += u128(off)
+            i += 2
+            continue
+        if ftype.kind != "V":
+            out.append(off)
+        i += 1
+    return sorted(out)
+
+
+def test_java_accessor_offsets_match_dtypes():
+    """Every ByteBuffer accessor offset in the generated Java equals the
+    numpy field offset (u128 fields as lo/hi longs at off and off+8)."""
+    src = bindings.generate_java_types()
+    for name, dtype in (
+        ("Account", types.ACCOUNT_DTYPE),
+        ("Transfer", types.TRANSFER_DTYPE),
+        ("EventResult", types.EVENT_RESULT_DTYPE),
+        ("AccountFilter", types.ACCOUNT_FILTER_DTYPE),
+    ):
+        block = re.search(
+            rf"public static final class {name} \{{(.*?)\n    \}}", src, re.S
+        ).group(1)
+        assert f"SIZE = {dtype.itemsize};" in block
+        reads = sorted(
+            int(m)
+            for m in re.findall(r"return buffer\.\w+\(offset \+ (\d+)\)", block)
+        )
+        assert reads == _non_reserved_offsets(
+            dtype, lambda off: [off, off + 8]
+        ), (name, reads)
+
+
+def test_cs_field_offsets_match_dtypes():
+    """Every [FieldOffset(N)] in the generated C# equals the numpy field
+    offset, and the explicit struct Size equals itemsize."""
+    src = bindings.generate_cs_types()
+    for name, dtype in (
+        ("Account", types.ACCOUNT_DTYPE),
+        ("Transfer", types.TRANSFER_DTYPE),
+        ("EventResult", types.EVENT_RESULT_DTYPE),
+        ("AccountFilter", types.ACCOUNT_FILTER_DTYPE),
+    ):
+        block = re.search(
+            rf"Size = {dtype.itemsize}\)\]\n    public struct {name}\n"
+            rf"    \{{(.*?)\n    \}}",
+            src, re.S,
+        )
+        assert block is not None, f"struct {name} missing/size wrong"
+        offsets = sorted(
+            int(m) for m in re.findall(r"\[FieldOffset\((\d+)\)\]",
+                                       block.group(1))
+        )
+        # A u128 pair is ONE UInt128Parts field at the pair's base offset;
+        # reserved V-blobs are omitted from explicit-layout structs.
+        assert offsets == _non_reserved_offsets(
+            dtype, lambda off: [off]
+        ), (name, offsets)
+
+
 def test_enum_values_emitted():
     go = bindings.generate_go_types()
     ts = bindings.generate_ts_types()

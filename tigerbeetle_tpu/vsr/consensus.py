@@ -965,15 +965,18 @@ class VsrReplica(Replica):
         if head is not None:
             self.parent_checksum = wire.header_checksum(head)
         # The installed window is canonical by construction: lower the
-        # verification floor to its start (never raise it — a narrow SV on
-        # an already-verified log must not re-suspect history; the walk in
-        # _extend_verification would re-collapse it anyway, but cheaper not
-        # to).  Anything below the window stays suspect until the chain
-        # walk links it.
-        if by_op:
+        # verification floor to its CONTIGUOUS-from-head start (never raise
+        # it — a narrow SV on an already-verified log must not re-suspect
+        # history).  A gapped window (the sender itself had an evicted
+        # header under repair) must not vouch for local headers under its
+        # gaps — only ops the window actually covers become verified;
+        # anything below stays suspect until the chain walk links it.
+        if target_op in by_op:
+            w = target_op
+            while w - 1 in by_op and w - 1 > self.commit_min:
+                w -= 1
             self._verify_floor = min(
-                self._verify_floor,
-                max(self.commit_min + 1, min(by_op)),
+                self._verify_floor, max(self.commit_min + 1, w)
             )
         self._verify_floor = min(self._verify_floor, self.op + 1)
 
