@@ -6,8 +6,8 @@
 // Build the shared library once:
 //   g++ -std=c++17 -O2 -shared -fPIC -pthread \
 //       -o tigerbeetle_tpu/native/libtb.so tigerbeetle_tpu/native/*.cpp
-// and run with: java --enable-native-access=ALL-UNNAMED \
-//   -Djava.library.path=tigerbeetle_tpu/native ...
+// and run with: LD_LIBRARY_PATH=tigerbeetle_tpu/native \
+//   java --enable-native-access=ALL-UNNAMED ...
 package com.tigerbeetle.tpu;
 
 import java.lang.foreign.Arena;
@@ -47,7 +47,11 @@ public final class Client implements AutoCloseable {
 
     public Client(long clusterLo, long clusterHi, String addresses) {
         Linker linker = Linker.nativeLinker();
-        SymbolLookup lib = SymbolLookup.libraryLookup("tb", arena);
+        // mapLibraryName("tb") -> "libtb.so"; dlopen then honors
+        // LD_LIBRARY_PATH / rpath (a bare "tb" would be passed verbatim
+        // and never resolve).
+        SymbolLookup lib = SymbolLookup.libraryLookup(
+            System.mapLibraryName("tb"), arena);
         MethodHandle init = linker.downcallHandle(
             lib.find("tb_client_init").orElseThrow(),
             FunctionDescriptor.of(ValueLayout.JAVA_INT,
@@ -130,7 +134,11 @@ public final class Client implements AutoCloseable {
             pkt.set(ValueLayout.ADDRESS, PKT_DATA, data);
             try {
                 submit.invoke(handle, pkt);
-                byte[] reply = completions.take();
+                // MUST NOT abandon the wait: the native IO thread still
+                // owns pkt/data (the confined arena frees them on exit),
+                // and its completion would block forever on the
+                // SynchronousQueue with no taker.
+                byte[] reply = takeUninterruptibly();
                 if (lastStatus != 0) {
                     throw new IllegalStateException(
                         "request failed: packet status " + lastStatus);
@@ -140,6 +148,23 @@ public final class Client implements AutoCloseable {
                 throw e;
             } catch (Throwable t) {
                 throw new AssertionError(t);
+            }
+        }
+    }
+
+    private byte[] takeUninterruptibly() {
+        boolean interrupted = false;
+        try {
+            while (true) {
+                try {
+                    return completions.take();
+                } catch (InterruptedException e) {
+                    interrupted = true;
+                }
+            }
+        } finally {
+            if (interrupted) {
+                Thread.currentThread().interrupt();
             }
         }
     }
@@ -157,7 +182,10 @@ public final class Client implements AutoCloseable {
     }
 
     @Override
-    public void close() {
+    public synchronized void close() {
+        // synchronized with request(): tearing down the native client (and
+        // the shared arena holding the upcall stub) under an in-flight
+        // packet would crash the IO thread.
         try {
             deinit.invoke(handle);
         } catch (Throwable t) {

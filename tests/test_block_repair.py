@@ -175,7 +175,26 @@ def test_corrupt_base_repaired_from_peer(tmp_path):
     """Base snapshot corruption (the big file) repairs chunk-by-chunk."""
     cluster = make_cluster(tmp_path, seed=64, requests=60)
     run_to_checkpoint(cluster)
-    victim = 1
+
+    def shared_base_victim():
+        checksums = {
+            i: cluster.replicas[i].forest.manifest.base_checksum
+            for i in range(cluster.n)
+            if cluster.alive[i] and cluster.replicas[i].op_checkpoint > 0
+        }
+        for i, c in checksums.items():
+            if any(j != i and cj == c for j, cj in checksums.items()):
+                return i
+        return None
+
+    # Peer repair needs a peer holding the same base bytes (aligned
+    # checkpoint schedules make this the steady state, but transient
+    # skew right after the first checkpoint is possible).
+    ok = cluster.run_until(
+        lambda: shared_base_victim() is not None, max_ticks=120_000
+    )
+    assert ok, "no two replicas ever shared a base snapshot"
+    victim = shared_base_victim()
     _, base_path, _ = _forest_files(cluster, victim)
     cluster.crash(victim)
     _corrupt(base_path)

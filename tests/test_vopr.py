@@ -16,6 +16,37 @@ def test_vopr_random_schedule_passes(tmp_path, seed):
     assert result.commits > 0
 
 
+def test_vopr_seed_10056_two_replica_clock_skew(tmp_path):
+    """Regression: a 2-replica cluster whose wall skew exceeds the RTT
+    could never clock-synchronize (zero-width own-clock interval made the
+    Marzullo quorum of 2 unreachable), so the primary dropped every client
+    request forever.  The own-clock sample now carries the cluster's
+    offset tolerance."""
+    result = run_seed(10056, workdir=str(tmp_path), ticks=8_000)
+    assert result.exit_code == EXIT_PASSED, result
+
+
+def test_vopr_seed_10058_primary_read_fault_commit_stall(tmp_path):
+    """Regression: the primary's pipeline held full ack quorums but a
+    latent read fault on its own journal copy stalled the commit at
+    ack time; after the body was repaired nothing re-drove the pipeline.
+    The missing-fill path and the prepare-timeout tick now retry it."""
+    result = run_seed(10058, workdir=str(tmp_path), ticks=8_000)
+    assert result.exit_code == EXIT_PASSED, result
+
+
+def test_vopr_seed_10133_globally_lost_uncommitted_body(tmp_path):
+    """Regression: a latent read fault destroyed the ONLY copy of an
+    uncommitted prepare (the primary's, before any backup journaled it) —
+    commits wedged and every subsequent view change stalled on the
+    unrepairable body.  The nack protocol (vsr.zig nacks) lets the
+    view-change primary prove no commit quorum was possible and truncate;
+    the stuck primary abdicates into that path."""
+    result = run_seed(10133, workdir=str(tmp_path), ticks=8_000)
+    assert result.exit_code == EXIT_PASSED, result
+    assert result.commits > 14  # progressed past the wedge point
+
+
 def test_vopr_seed_9002_stale_wal_fork(tmp_path):
     """Regression: a replica restarting with an uncommitted stale prepare
     in its WAL (discarded by a view change it slept through) must not

@@ -123,10 +123,13 @@ class Clock:
     def _synchronize(self) -> None:
         """Re-run Marzullo over the sample window (clock.zig synchronize)."""
         intervals = self._window_intervals()
-        # Our own clock is a source too: we believe realtime-monotonic with
-        # perfect confidence in our own frame (interval of width 0).
+        # Our own clock is a source too — trusted only to within the
+        # cluster's offset tolerance (a zero-width own interval would make
+        # a 2-replica cluster unsynchronizable whenever wall skew exceeds
+        # the RTT: own ∩ peer = ∅ and quorum(2) = 2 can never be met).
         own = self.realtime() - self.monotonic()
-        intervals.append(Interval(own, own))
+        own_half = self.offset_tolerance_ns // 2
+        intervals.append(Interval(own - own_half, own + own_half))
         interval, sources = marzullo_smallest_interval(intervals)
         # Quorum: a majority of the cluster must agree (clock.zig
         # window_tuples quorum = replica_count majority).

@@ -110,6 +110,7 @@ class PipelineEntry:
     checksum: int
     client: int                 # 0 for re-certified view-change suffix ops
     ok_from: Set[int] = dataclasses.field(default_factory=set)
+    repair_rounds: int = 0      # timeouts spent with the body unreadable
 
 
 class VsrReplica(Replica):
@@ -151,6 +152,11 @@ class VsrReplica(Replica):
         self.stash: Dict[int, Tuple[np.ndarray, bytes]] = {}
         # Ops whose canonical header is installed but whose body is missing.
         self.missing: Dict[int, int] = {}  # op -> expected header checksum
+        # View-change nack protocol: op -> replicas that provably NEVER
+        # journaled the missing body (vsr.zig nacks).  At a nack quorum the
+        # body cannot have been quorum-journaled, hence never committed,
+        # and the new primary truncates it instead of stalling forever.
+        self._nacks: Dict[int, Set[int]] = {}
 
         self.pipeline: Dict[int, PipelineEntry] = {}
         self.svc_from: Dict[int, Set[int]] = {}
@@ -343,6 +349,7 @@ class VsrReplica(Replica):
             wire.Command.request_start_view: self.on_request_start_view,
             wire.Command.request_headers: self.on_request_headers,
             wire.Command.request_prepare: self.on_request_prepare,
+            wire.Command.nack_prepare: self.on_nack_prepare,
             wire.Command.headers: self.on_headers,
             wire.Command.ping: self.on_ping,
             wire.Command.pong: self.on_pong,
@@ -515,7 +522,14 @@ class VsrReplica(Replica):
             self._fill_missing(h, body)
             if self.status == NORMAL:
                 out.append(self._send_prepare_ok(h))
-                self._commit_journal(out)
+                if self.is_primary:
+                    # The primary may already hold ack quorums for this and
+                    # later pipeline entries (the commit stalled on OUR
+                    # missing/corrupt journal copy — VOPR seed 10058):
+                    # commit via the pipeline, which advances commit_max.
+                    self._maybe_commit_pipeline(out)
+                else:
+                    self._commit_journal(out)
             return out
 
         if op > self.op_prepare_max:
@@ -806,6 +820,7 @@ class VsrReplica(Replica):
         self._vc_started = self._ticks
         self._vc_timeout.reset(self._ticks)
         self._dvc_sent_for = None
+        self._nacks.clear()
         self.pipeline.clear()
         self._persist_view()
         self.svc_from.setdefault(new_view, set()).add(self.replica)
@@ -1159,12 +1174,66 @@ class VsrReplica(Replica):
         op = int(h["op"]) if "op" in h.dtype.names else int(h["prepare_op"])
         checksum = wire.u128(h, "prepare_checksum")
         read = self.journal.read_prepare(op)
-        if read is None:
+        if read is None or (
+            checksum and wire.header_checksum(read[0]) != checksum
+        ):
+            if checksum and op > self.commit_min and (
+                self.journal.never_had(op, checksum)
+            ):
+                # We provably never journaled it: nack, so a view-change
+                # primary can prove a globally-lost uncommitted body was
+                # never quorum-journaled and truncate it (vsr.zig nacks).
+                nack = self._hdr(
+                    wire.Command.nack_prepare,
+                    prepare_op=op,
+                    prepare_checksum=checksum,
+                )
+                return [(("replica", int(h["replica"])), wire.encode(nack))]
             return []
         ph, pbody = read
-        if checksum and wire.header_checksum(ph) != checksum:
-            return []
         return [(("replica", int(h["replica"])), wire.encode(ph, pbody))]
+
+    def on_nack_prepare(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        """A peer provably never journaled a body we're missing.  As the
+        new primary of a pending view change, a nack quorum proves the op
+        was never quorum-journaled — so it never committed — and the
+        canonical suffix truncates at it instead of wedging the view
+        change forever (vsr.zig nack protocol; VOPR seed 10133)."""
+        op = int(h["prepare_op"])
+        checksum = wire.u128(h, "prepare_checksum")
+        if self.missing.get(op) != checksum:
+            return []
+        self._nacks.setdefault(op, set()).add(int(h["replica"]))
+        if self.status != VIEW_CHANGE or self._new_view_pending is None:
+            return []
+        # Nack threshold: with n - q_replication + 1 provably-never-had
+        # replicas (counting ourselves), fewer than q_replication can ever
+        # have journaled it — no commit quorum was possible.
+        nackers = set(self._nacks.get(op, ()))
+        if self.journal.never_had(op, checksum):
+            nackers.add(self.replica)
+        if len(nackers) < self.replica_count - self.quorum_replication + 1:
+            return []
+        # Truncate the canonical suffix from the nack-proven op: everything
+        # above it chains from it and could never commit past it anyway.
+        assert op > self.commit_min
+        for x in [x for x in self.headers if x >= op]:
+            del self.headers[x]
+        for x in [x for x in self.stash if x >= op]:
+            del self.stash[x]
+        for x in [x for x in self.missing if x >= op]:
+            del self.missing[x]
+        for x in [x for x in self._nacks if x >= op]:
+            del self._nacks[x]
+        self.op = op - 1
+        head = self.headers.get(self.op)
+        self.parent_checksum = (
+            wire.header_checksum(head) if head is not None else 0
+        )
+        self._verify_floor = min(self._verify_floor, self.op + 1)
+        if not self.missing:
+            self._pending_finish = self._new_view_pending
+        return []
 
     def on_request_headers(self, h: np.ndarray, body: bytes) -> List[Msg]:
         op_min, op_max = int(h["op_min"]), int(h["op_max"])
@@ -1230,6 +1299,7 @@ class VsrReplica(Replica):
         op = int(h["op"])
         self.journal.write_prepare(wire.encode(h, body))
         del self.missing[op]
+        self._nacks.pop(op, None)
         self._repipeline(op, h)
         self._repair_timeout.reset(self._ticks)  # repair progressing
         if getattr(self, "_new_view_pending", None) is not None and (
@@ -1613,13 +1683,35 @@ class VsrReplica(Replica):
                 )
                 out.extend(self._broadcast(wire.encode(commit)))
             if self.pipeline and self._prepare_timeout.fired(self._ticks):
+                # Quorumed-but-uncommitted entries can linger if the commit
+                # attempt at ack time stalled on a repairable local fault;
+                # retry the pipeline commit before resending.
+                self._maybe_commit_pipeline(out)
                 # Timeout fallback: re-broadcast unquorumed prepares to all
                 # backups (the ring is the fast path, this is the safety net).
-                for entry in self.pipeline.values():
+                for entry in list(self.pipeline.values()):
                     if len(entry.ok_from) >= self.quorum_replication:
                         continue
                     read = self.journal.read_prepare(entry.op)
-                    if read is None:
+                    if read is None or (
+                        wire.header_checksum(read[0]) != entry.checksum
+                    ):
+                        # OUR copy is unreadable (latent fault on the slot).
+                        # Repair it from any backup that journaled it.
+                        self.missing.setdefault(entry.op, entry.checksum)
+                        entry.repair_rounds += 1
+                        if entry.repair_rounds >= 3 * max(
+                            1, self.replica_count - 1
+                        ):
+                            # Peers can't supply it either: abdicate.  The
+                            # view change's nack protocol then proves the
+                            # body was never quorum-journaled and truncates
+                            # it (VOPR seed 10133) — or repairs it if some
+                            # replica does hold it.
+                            out.extend(
+                                self._begin_view_change(self.view + 1)
+                            )
+                            break
                         continue
                     message = wire.encode(read[0], read[1])
                     for r in range(self.replica_count):

@@ -103,6 +103,31 @@ class Journal:
         except ValueError:
             return None
 
+    def never_had(self, op: int, checksum: int) -> bool:
+        """True when this journal PROVABLY never held the prepare
+        (op, checksum) — the safety condition for a view-change nack
+        (vsr.zig nack protocol): an all-zero slot was never written, and a
+        slot holding a DIFFERENT decodable prepare means the requested one
+        was either never journaled here or provably superseded by a
+        canonical-at-selection-time fork (which implies the requested op
+        never committed).  Undecodable non-zero bytes could be a torn
+        write OF the requested prepare — never nack those."""
+        slot = self.slot(op)
+        lay = self.storage.layout
+        head = self.storage.read(
+            lay.wal_prepares_offset + slot * self.config.message_size_max,
+            self.config.header_size,
+        )
+        if not any(head):
+            return True  # virgin slot
+        try:
+            h, command = wire.decode_header(head)
+        except ValueError:
+            return False  # torn/corrupt: might have been (op, checksum)
+        if command != wire.Command.prepare:
+            return False
+        return int(h["op"]) != op or wire.u128(h, "checksum") != checksum
+
     def recover(self) -> Recovery:
         """Scan both rings, disentangle torn writes, return surviving entries."""
         lay = self.storage.layout

@@ -52,6 +52,7 @@ class Command(enum.IntEnum):
     block = 20
     request_sync_checkpoint = 21
     sync_checkpoint = 22
+    nack_prepare = 23
 
 
 VSR_OPERATIONS_RESERVED = 128
@@ -235,6 +236,15 @@ REQUEST_PREPARE_DTYPE = _dtype([
 
 HEADERS_DTYPE = _dtype([("reserved", "V128")])  # body = prepare headers
 
+# Nack: "I provably NEVER journaled this prepare" (vsr.zig's DVC nack
+# protocol) — the view-change primary counts these to prove an uncommitted
+# body is not required for durability and may be truncated.
+NACK_PREPARE_DTYPE = _dtype([
+    ("prepare_checksum_lo", "<u8"), ("prepare_checksum_hi", "<u8"),
+    ("prepare_op", "<u8"),
+    ("reserved", "V104"),
+])
+
 REQUEST_REPLY_DTYPE = _dtype([
     ("reply_checksum_lo", "<u8"), ("reply_checksum_hi", "<u8"),
     ("client_lo", "<u8"), ("client_hi", "<u8"),
@@ -308,6 +318,7 @@ COMMAND_DTYPES = {
     Command.request_reply: REQUEST_REPLY_DTYPE,
     Command.request_blocks: REQUEST_BLOCKS_DTYPE,
     Command.block: BLOCK_DTYPE,
+    Command.nack_prepare: NACK_PREPARE_DTYPE,
     Command.request_sync_checkpoint: REQUEST_SYNC_CHECKPOINT_DTYPE,
     Command.sync_checkpoint: SYNC_CHECKPOINT_DTYPE,
 }
