@@ -1,0 +1,42 @@
+"""Shared plumbing for the demo drivers (the reference ships seven small
+programs exercising each flow against a running cluster, src/demos/demo.zig
++ demo_0*.zig).  Run any demo as:
+
+    python -m tigerbeetle_tpu format /tmp/demo.tb --cluster 1
+    python -m tigerbeetle_tpu start /tmp/demo.tb --addresses 127.0.0.1:3000 &
+    python demos/demo_01_create_accounts.py [host:port]
+
+Each demo prints the request it sends and the decoded reply.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tigerbeetle_tpu import types  # noqa: E402
+from tigerbeetle_tpu.client import Client  # noqa: E402
+
+CLUSTER = 1
+
+
+def connect() -> Client:
+    addr = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1:3000"
+    host, _, port = addr.rpartition(":")
+    return Client([(host or "127.0.0.1", int(port))], cluster=CLUSTER)
+
+
+def show_results(what: str, results) -> None:
+    if not results:
+        print(f"{what}: ok (all events applied)")
+    else:
+        for index, code in results:
+            print(f"{what}: event {index} -> result code {code}")
+
+
+def show_rows(rows) -> None:
+    for r in rows:
+        print("  " + ", ".join(
+            f"{name}={r[name]}" for name in r.dtype.names
+            if not name.startswith(("reserved", "checksum")) and r[name]
+        ))

@@ -1,0 +1,12 @@
+"""Create two accounts (reference: demo_01_create_accounts.zig)."""
+from demo import connect, show_results
+
+from tigerbeetle_tpu import types
+
+with_client = connect()
+accounts = types.accounts_array([
+    types.account(id=1, ledger=1, code=10),
+    types.account(id=2, ledger=1, code=10),
+])
+show_results("create_accounts", with_client.create_accounts(accounts))
+with_client.close()

@@ -1,0 +1,8 @@
+"""Look up the demo accounts (reference: demo_02_lookup_accounts.zig)."""
+from demo import connect, show_rows
+
+client = connect()
+rows = client.lookup_accounts([1, 2])
+print(f"lookup_accounts: {len(rows)} found")
+show_rows(rows)
+client.close()

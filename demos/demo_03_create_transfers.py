@@ -1,0 +1,12 @@
+"""A plain single-phase transfer (reference: demo_03_create_transfers.zig)."""
+from demo import connect, show_results
+
+from tigerbeetle_tpu import types
+
+client = connect()
+transfers = types.transfers_array([
+    types.transfer(id=1, debit_account_id=1, credit_account_id=2,
+                   amount=10_000, ledger=1, code=1),
+])
+show_results("create_transfers", client.create_transfers(transfers))
+client.close()

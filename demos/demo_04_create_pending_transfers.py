@@ -1,0 +1,14 @@
+"""Two-phase step 1: a pending transfer reserves funds
+(reference: demo_04_create_pending_transfers.zig)."""
+from demo import connect, show_results
+
+from tigerbeetle_tpu import types
+
+client = connect()
+transfers = types.transfers_array([
+    types.transfer(id=2, debit_account_id=1, credit_account_id=2,
+                   amount=500, ledger=1, code=1,
+                   flags=types.TransferFlags.PENDING),
+])
+show_results("create_pending", client.create_transfers(transfers))
+client.close()

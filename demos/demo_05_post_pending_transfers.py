@@ -1,0 +1,13 @@
+"""Two-phase step 2a: post (commit) the pending transfer
+(reference: demo_05_post_pending_transfers.zig)."""
+from demo import connect, show_results
+
+from tigerbeetle_tpu import types
+
+client = connect()
+transfers = types.transfers_array([
+    types.transfer(id=3, pending_id=2, ledger=1, code=1,
+                   flags=types.TransferFlags.POST_PENDING_TRANSFER),
+])
+show_results("post_pending", client.create_transfers(transfers))
+client.close()

@@ -1,0 +1,18 @@
+"""Two-phase step 2b: void (roll back) a pending transfer
+(reference: demo_06_void_pending_transfers.zig).  Expects a pending
+transfer id=4 to exist; creates one first for a self-contained run."""
+from demo import connect, show_results
+
+from tigerbeetle_tpu import types
+
+client = connect()
+show_results("create_pending", client.create_transfers(types.transfers_array([
+    types.transfer(id=4, debit_account_id=1, credit_account_id=2,
+                   amount=77, ledger=1, code=1,
+                   flags=types.TransferFlags.PENDING),
+])))
+show_results("void_pending", client.create_transfers(types.transfers_array([
+    types.transfer(id=5, pending_id=4, ledger=1, code=1,
+                   flags=types.TransferFlags.VOID_PENDING_TRANSFER),
+])))
+client.close()

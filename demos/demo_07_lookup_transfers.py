@@ -1,0 +1,8 @@
+"""Look up the demo transfers (reference: demo_07_lookup_transfers.zig)."""
+from demo import connect, show_rows
+
+client = connect()
+rows = client.lookup_transfers([1, 2, 3, 4, 5])
+print(f"lookup_transfers: {len(rows)} found")
+show_rows(rows)
+client.close()

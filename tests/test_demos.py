@@ -1,0 +1,69 @@
+"""The demo drivers (demos/demo_0*.py, mirroring src/demos/) must run
+clean against a live server — they are the first thing a new user tries."""
+
+import asyncio
+import os
+import runpy
+import sys
+import threading
+
+import pytest
+
+from tigerbeetle_tpu.config import ClusterConfig, LedgerConfig
+from tigerbeetle_tpu.net.bus import ReplicaServer
+from tigerbeetle_tpu.vsr.replica import Replica
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMOS = os.path.join(ROOT, "demos")
+
+
+@pytest.mark.slow
+def test_demos_run_in_order(tmp_path, capsys):
+    path = str(tmp_path / "demo.tb")
+    Replica.format(
+        path, cluster=1,
+        cluster_config=ClusterConfig(message_size_max=8192,
+                                     journal_slot_count=64),
+    )
+    replica = Replica(
+        path,
+        cluster_config=ClusterConfig(message_size_max=8192,
+                                     journal_slot_count=64),
+        ledger_config=LedgerConfig(
+            accounts_capacity_log2=10, transfers_capacity_log2=12,
+            posted_capacity_log2=10, max_probe=1 << 10,
+        ),
+        batch_lanes=64,
+    )
+    replica.open()
+    box = {}
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+
+    async def boot():
+        server = ReplicaServer(replica, "127.0.0.1", 0)
+        box["port"] = await server.start()
+        return server
+
+    server = asyncio.run_coroutine_threadsafe(boot(), loop).result(30)
+    old_argv, old_path = sys.argv, list(sys.path)
+    try:
+        sys.path.insert(0, DEMOS)
+        for name in sorted(os.listdir(DEMOS)):
+            if not name.startswith("demo_0"):
+                continue
+            sys.argv = [name, f"127.0.0.1:{box['port']}"]
+            runpy.run_path(os.path.join(DEMOS, name), run_name="__main__")
+            out = capsys.readouterr().out
+            assert "result code" not in out, (name, out)  # every event ok
+    finally:
+        sys.argv, sys.path[:] = old_argv, old_path
+
+        async def down():
+            await server.close()
+
+        asyncio.run_coroutine_threadsafe(down(), loop).result(15)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5)
+        replica.close()

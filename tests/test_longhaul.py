@@ -1,0 +1,53 @@
+"""Long-haul cluster stress: many checkpoint generations, WAL ring wraps,
+crashes, forest compaction, and repair interacting over one run.
+
+The VOPR sweeps cover breadth (many seeds, short schedules); this covers
+depth — a single cluster living through hundreds of ops with periodic
+crash/restart, which exercises: checkpoint alignment across replicas,
+delta-run compaction, restart WAL replay + chain verification, state sync
+of lagging replicas, and the auditor across the whole history.
+"""
+
+import pytest
+
+from tigerbeetle_tpu.sim import PacketSimulator, SimCluster
+
+
+@pytest.mark.slow
+def test_longhaul_crash_cycle(tmp_path):
+    net = PacketSimulator(seed=31, loss_probability=0.01, delay_mean=2)
+    cluster = SimCluster(
+        str(tmp_path), n_replicas=3, n_clients=2, seed=30,
+        requests_per_client=200, net=net,
+    )
+    crashes = 0
+    phase = 0
+    # Run in phases; each phase crashes a different replica mid-load and
+    # restarts it a while later.
+    while not (cluster.clients_done() and cluster.converged()):
+        victim = phase % 3
+        cluster.run(400)
+        if cluster.clients_done() and cluster.converged():
+            break
+        if cluster.alive[victim] and sum(cluster.alive) == 3:
+            cluster.crash(victim)
+            crashes += 1
+            cluster.run(600)
+            cluster.restart(victim)
+        phase += 1
+        assert phase < 400, (
+            f"no progress: "
+            f"{[(r.status, r.view, r.commit_min, r.op) if r else None for r in cluster.replicas]} "
+            f"clients={[(c.requests_done, c.evicted) for c in cluster.clients.values()]}"
+        )
+    cluster.check_converged()
+    cluster.check_conservation()
+    assert crashes >= 5
+    live = [r for r in cluster.replicas if r is not None]
+    # Several checkpoint generations elapsed (interval is 23 in TEST_MIN)
+    # and the WAL ring (64 slots) wrapped multiple times.
+    assert live[0].op_checkpoint > 3 * cluster.config.vsr_checkpoint_interval
+    assert live[0].commit_min > 2 * cluster.config.journal_slot_count
+    # The auditor replayed the entire committed history against the model.
+    assert cluster.auditor.audited > 100
+    assert cluster.auditor.next_op == max(cluster.auditor.records) + 1

@@ -788,7 +788,10 @@ class TpuStateMachine:
                 (int(t) for t in cols["timestamp"]),
             )
         )
-        return sorted((int(a_), int(b), int(c), int(d), int(e), int(f)) for a_, b, c, d, e, f in out)
+        return sorted(
+            (int(a_), int(b), int(c), int(d), int(e), int(f))
+            for a_, b, c, d, e, f in out
+        )
 
     def digest(self) -> int:
         return int(sm.ledger_digest(self.ledger))

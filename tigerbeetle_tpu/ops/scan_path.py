@@ -330,8 +330,14 @@ def _transfer_logic(ledger: Ledger, ev, ev_ts, batch_ts):
     p_ts = p["timestamp"]
 
     # Which accounts do we operate on?
-    dr_id = u128.select(postvoid, U128(p["debit_account_id_lo"], p["debit_account_id_hi"]), t_dr_id)
-    cr_id = u128.select(postvoid, U128(p["credit_account_id_lo"], p["credit_account_id_hi"]), t_cr_id)
+    dr_id = u128.select(
+        postvoid, U128(p["debit_account_id_lo"], p["debit_account_id_hi"]),
+        t_dr_id,
+    )
+    cr_id = u128.select(
+        postvoid, U128(p["credit_account_id_lo"], p["credit_account_id_hi"]),
+        t_cr_id,
+    )
     dr_found, dr_slot = _slookup(ledger.accounts, dr_id.lo, dr_id.hi)
     cr_found, cr_slot = _slookup(ledger.accounts, cr_id.lo, cr_id.hi)
     dr = _gather_row(ledger.accounts, dr_slot, dr_found)
@@ -475,10 +481,11 @@ def _transfer_logic(ledger: Ledger, ev, ev_ts, batch_ts):
     row["amount_lo"] = jnp.where(postvoid, pv_amount.lo, amount.lo)
     row["amount_hi"] = jnp.where(postvoid, pv_amount.hi, amount.hi)
     # Post/void row composition (state_machine.zig:1455-1469).
-    row["debit_account_id_lo"] = jnp.where(postvoid, p["debit_account_id_lo"], ev["debit_account_id_lo"])
-    row["debit_account_id_hi"] = jnp.where(postvoid, p["debit_account_id_hi"], ev["debit_account_id_hi"])
-    row["credit_account_id_lo"] = jnp.where(postvoid, p["credit_account_id_lo"], ev["credit_account_id_lo"])
-    row["credit_account_id_hi"] = jnp.where(postvoid, p["credit_account_id_hi"], ev["credit_account_id_hi"])
+    for side in ("debit_account_id", "credit_account_id"):
+        for lane in ("_lo", "_hi"):
+            row[side + lane] = jnp.where(
+                postvoid, p[side + lane], ev[side + lane]
+            )
     ud128_nz = (ev["user_data_128_lo"] != 0) | (ev["user_data_128_hi"] != 0)
     row["user_data_128_lo"] = jnp.where(
         postvoid,
@@ -490,8 +497,12 @@ def _transfer_logic(ledger: Ledger, ev, ev_ts, batch_ts):
         jnp.where(ud128_nz, ev["user_data_128_hi"], p["user_data_128_hi"]),
         ev["user_data_128_hi"],
     )
-    row["user_data_64"] = jnp.where(postvoid, pick("user_data_64", p["user_data_64"]), ev["user_data_64"])
-    row["user_data_32"] = jnp.where(postvoid, pick("user_data_32", p["user_data_32"]), ev["user_data_32"])
+    row["user_data_64"] = jnp.where(
+        postvoid, pick("user_data_64", p["user_data_64"]), ev["user_data_64"]
+    )
+    row["user_data_32"] = jnp.where(
+        postvoid, pick("user_data_32", p["user_data_32"]), ev["user_data_32"]
+    )
     row["ledger"] = jnp.where(postvoid, p["ledger"], ev["ledger"])
     row["code"] = jnp.where(postvoid, p["code"], ev["code"])
     row["timeout"] = jnp.where(postvoid, jnp.uint32(0), ev["timeout"])

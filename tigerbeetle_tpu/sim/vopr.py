@@ -122,10 +122,14 @@ def run_seed(
                 default=0,
             )
             if not ok:
+                states = [
+                    (r.status, r.view, r.commit_min, r.op) if r else None
+                    for r in cluster.replicas
+                ]
                 return VoprResult(
                     seed, EXIT_LIVENESS,
                     f"no convergence after {settle_ticks} settle ticks: "
-                    f"{[(r.status, r.view, r.commit_min, r.op) if r else None for r in cluster.replicas]}",
+                    f"{states}",
                     cluster.t, commits, faults,
                 )
             cluster.check_converged()
