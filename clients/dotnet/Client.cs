@@ -60,7 +60,7 @@ namespace TigerBeetle.Tpu
         private readonly object submitLock = new();
         private byte[]? lastReply;
         private PacketStatus lastStatus;
-        private bool disposed;
+        private int disposed;  // 0/1 via Interlocked (see Dispose)
 
         public Client(UInt128Parts clusterId, string addresses)
         {
@@ -98,7 +98,8 @@ namespace TigerBeetle.Tpu
         {
             lock (submitLock)
             {
-                if (disposed) throw new ObjectDisposedException(nameof(Client));
+                if (disposed != 0)
+                    throw new ObjectDisposedException(nameof(Client));
                 var data = Marshal.AllocHGlobal(events.Length);
                 var packetPtr = Marshal.AllocHGlobal(Marshal.SizeOf<Packet>());
                 try
@@ -147,12 +148,13 @@ namespace TigerBeetle.Tpu
 
         public void Dispose()
         {
-            lock (submitLock)
-            {
-                if (disposed) return;
-                disposed = true;
-                TbDeinit(handle);
-            }
+            if (Interlocked.Exchange(ref disposed, 1) != 0) return;
+            // WITHOUT submitLock: the native layer completes any in-flight
+            // packet with ClientShutdown (waking the blocked Request) and
+            // joins its IO thread — taking the lock first would deadlock
+            // against a request stuck on an unreachable cluster.
+            TbDeinit(handle);
+            lock (submitLock) { }  // wait for an in-flight Request to unwind
         }
     }
 }

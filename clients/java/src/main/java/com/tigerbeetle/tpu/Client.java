@@ -43,6 +43,9 @@ public final class Client implements AutoCloseable {
     private final MethodHandle submit;
     private final MethodHandle deinit;
     private final SynchronousQueue<byte[]> completions = new SynchronousQueue<>();
+    private final Object requestLock = new Object();
+    private final java.util.concurrent.atomic.AtomicBoolean closed =
+        new java.util.concurrent.atomic.AtomicBoolean();
     private volatile byte lastStatus;
 
     public Client(long clusterLo, long clusterHi, String addresses) {
@@ -120,7 +123,16 @@ public final class Client implements AutoCloseable {
     }
 
     /** One blocking round trip; returns the raw reply body. */
-    public synchronized byte[] request(int operation, byte[] events) {
+    public byte[] request(int operation, byte[] events) {
+        synchronized (requestLock) {
+            return requestLocked(operation, events);
+        }
+    }
+
+    private byte[] requestLocked(int operation, byte[] events) {
+        if (closed.get()) {
+            throw new IllegalStateException("client closed");
+        }
         try (Arena call = Arena.ofConfined()) {
             MemorySegment data = call.allocate(Math.max(events.length, 1));
             MemorySegment.copy(MemorySegment.ofArray(events), 0, data, 0,
@@ -182,15 +194,23 @@ public final class Client implements AutoCloseable {
     }
 
     @Override
-    public synchronized void close() {
-        // synchronized with request(): tearing down the native client (and
-        // the shared arena holding the upcall stub) under an in-flight
-        // packet would crash the IO thread.
+    public void close() {
+        if (!closed.compareAndSet(false, true)) {
+            return;
+        }
+        // Deinit WITHOUT the request lock: the native layer completes any
+        // in-flight packet with CLIENT_SHUTDOWN (waking the blocked
+        // request thread) and joins its IO thread — taking the lock first
+        // would deadlock against a request stuck on an unreachable
+        // cluster.  Only the shared-arena teardown waits for the request
+        // thread to unwind.
         try {
             deinit.invoke(handle);
         } catch (Throwable t) {
             throw new AssertionError(t);
         }
-        arena.close();
+        synchronized (requestLock) {
+            arena.close();
+        }
     }
 }

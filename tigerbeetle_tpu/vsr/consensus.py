@@ -1201,6 +1201,12 @@ class VsrReplica(Replica):
         change forever (vsr.zig nack protocol; VOPR seed 10133)."""
         op = int(h["prepare_op"])
         checksum = wire.u128(h, "prepare_checksum")
+        if int(h["view"]) != self.view:
+            # Stale nack from before our view change (e.g. delayed by a
+            # clogged link, sent while repair ran in an older view, and the
+            # sender may have journaled the body since): only nacks stamped
+            # with OUR view may count toward truncation.
+            return []
         if self.missing.get(op) != checksum:
             return []
         self._nacks.setdefault(op, set()).add(int(h["replica"]))

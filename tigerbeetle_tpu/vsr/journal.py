@@ -111,22 +111,33 @@ class Journal:
         was either never journaled here or provably superseded by a
         canonical-at-selection-time fork (which implies the requested op
         never committed).  Undecodable non-zero bytes could be a torn
-        write OF the requested prepare — never nack those."""
+        write OF the requested prepare — never nack those.
+
+        BOTH rings must agree: a misdirected write can clobber the
+        prepares slot with a different valid prepare, but the redundant
+        headers ring (written last, after the body was durable) would
+        still record that we once held (op, checksum) — that is exactly
+        the disentanglement the dual-ring design exists for."""
         slot = self.slot(op)
         lay = self.storage.layout
-        head = self.storage.read(
-            lay.wal_prepares_offset + slot * self.config.message_size_max,
-            self.config.header_size,
-        )
-        if not any(head):
-            return True  # virgin slot
-        try:
-            h, command = wire.decode_header(head)
-        except ValueError:
-            return False  # torn/corrupt: might have been (op, checksum)
-        if command != wire.Command.prepare:
-            return False
-        return int(h["op"]) != op or wire.u128(h, "checksum") != checksum
+        for offset, size in (
+            (lay.wal_prepares_offset + slot * self.config.message_size_max,
+             self.config.header_size),
+            (lay.wal_headers_offset + slot * self.config.header_size,
+             self.config.header_size),
+        ):
+            head = self.storage.read(offset, size)
+            if not any(head):
+                continue  # virgin ring slot: consistent with never-had
+            try:
+                h, command = wire.decode_header(head)
+            except ValueError:
+                return False  # torn/corrupt: might have been (op, checksum)
+            if command != wire.Command.prepare:
+                return False
+            if int(h["op"]) == op and wire.u128(h, "checksum") == checksum:
+                return False  # this ring remembers holding it
+        return True
 
     def recover(self) -> Recovery:
         """Scan both rings, disentangle torn writes, return surviving entries."""
