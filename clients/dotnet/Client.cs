@@ -58,9 +58,13 @@ namespace TigerBeetle.Tpu
         private readonly Completion completion; // pinned by this reference
         private readonly SemaphoreSlim done = new(0, 1);
         private readonly object submitLock = new();
+        // Guards disposed+submitting: Dispose must not free the native
+        // client while a TbSubmit call is dereferencing it.
+        private readonly object stateLock = new();
+        private bool disposed;
+        private int submitting;
         private byte[]? lastReply;
         private PacketStatus lastStatus;
-        private int disposed;  // 0/1 via Interlocked (see Dispose)
 
         public Client(UInt128Parts clusterId, string addresses)
         {
@@ -98,8 +102,6 @@ namespace TigerBeetle.Tpu
         {
             lock (submitLock)
             {
-                if (disposed != 0)
-                    throw new ObjectDisposedException(nameof(Client));
                 var data = Marshal.AllocHGlobal(events.Length);
                 var packetPtr = Marshal.AllocHGlobal(Marshal.SizeOf<Packet>());
                 try
@@ -122,7 +124,24 @@ namespace TigerBeetle.Tpu
                         Data = data,
                     };
                     Marshal.StructureToPtr(packet, packetPtr, false);
-                    TbSubmit(handle, packetPtr);
+                    lock (stateLock)
+                    {
+                        if (disposed)
+                            throw new ObjectDisposedException(nameof(Client));
+                        submitting++;
+                    }
+                    try
+                    {
+                        TbSubmit(handle, packetPtr);
+                    }
+                    finally
+                    {
+                        lock (stateLock)
+                        {
+                            submitting--;
+                            Monitor.PulseAll(stateLock);
+                        }
+                    }
                     done.Wait();
                     if (lastStatus != PacketStatus.Ok)
                         throw new InvalidOperationException(
@@ -148,11 +167,16 @@ namespace TigerBeetle.Tpu
 
         public void Dispose()
         {
-            if (Interlocked.Exchange(ref disposed, 1) != 0) return;
-            // WITHOUT submitLock: the native layer completes any in-flight
-            // packet with ClientShutdown (waking the blocked Request) and
-            // joins its IO thread — taking the lock first would deadlock
-            // against a request stuck on an unreachable cluster.
+            lock (stateLock)
+            {
+                if (disposed) return;
+                disposed = true;
+                // Wait only for the brief TbSubmit call itself (handle
+                // pin) — NOT for the completion wait: deinit is what wakes
+                // a Request stuck on an unreachable cluster (the native
+                // ClientShutdown drain).
+                while (submitting > 0) Monitor.Wait(stateLock);
+            }
             TbDeinit(handle);
             lock (submitLock) { }  // wait for an in-flight Request to unwind
         }

@@ -44,8 +44,12 @@ public final class Client implements AutoCloseable {
     private final MethodHandle deinit;
     private final SynchronousQueue<byte[]> completions = new SynchronousQueue<>();
     private final Object requestLock = new Object();
-    private final java.util.concurrent.atomic.AtomicBoolean closed =
-        new java.util.concurrent.atomic.AtomicBoolean();
+    // Guards closed+submitting: close() must not free the native client
+    // while a submit() call is dereferencing it (the Go client pins the
+    // handle the same way with an inflight WaitGroup).
+    private final Object stateLock = new Object();
+    private boolean closed;
+    private int submitting;
     private volatile byte lastStatus;
 
     public Client(long clusterLo, long clusterHi, String addresses) {
@@ -130,9 +134,6 @@ public final class Client implements AutoCloseable {
     }
 
     private byte[] requestLocked(int operation, byte[] events) {
-        if (closed.get()) {
-            throw new IllegalStateException("client closed");
-        }
         try (Arena call = Arena.ofConfined()) {
             MemorySegment data = call.allocate(Math.max(events.length, 1));
             MemorySegment.copy(MemorySegment.ofArray(events), 0, data, 0,
@@ -145,7 +146,20 @@ public final class Client implements AutoCloseable {
             pkt.set(ValueLayout.JAVA_INT, PKT_DATA_SIZE, events.length);
             pkt.set(ValueLayout.ADDRESS, PKT_DATA, data);
             try {
-                submit.invoke(handle, pkt);
+                synchronized (stateLock) {
+                    if (closed) {
+                        throw new IllegalStateException("client closed");
+                    }
+                    submitting++;
+                }
+                try {
+                    submit.invoke(handle, pkt);
+                } finally {
+                    synchronized (stateLock) {
+                        submitting--;
+                        stateLock.notifyAll();
+                    }
+                }
                 // MUST NOT abandon the wait: the native IO thread still
                 // owns pkt/data (the confined arena frees them on exit),
                 // and its completion would block forever on the
@@ -195,20 +209,32 @@ public final class Client implements AutoCloseable {
 
     @Override
     public void close() {
-        if (!closed.compareAndSet(false, true)) {
-            return;
+        synchronized (stateLock) {
+            if (closed) {
+                return;
+            }
+            closed = true;
+            // Wait only for the brief submit() call itself (handle pin) —
+            // NOT for the completion wait: deinit is what wakes a request
+            // stuck on an unreachable cluster (CLIENT_SHUTDOWN drain).
+            boolean interrupted = false;
+            while (submitting > 0) {
+                try {
+                    stateLock.wait();
+                } catch (InterruptedException e) {
+                    interrupted = true;
+                }
+            }
+            if (interrupted) {
+                Thread.currentThread().interrupt();
+            }
         }
-        // Deinit WITHOUT the request lock: the native layer completes any
-        // in-flight packet with CLIENT_SHUTDOWN (waking the blocked
-        // request thread) and joins its IO thread — taking the lock first
-        // would deadlock against a request stuck on an unreachable
-        // cluster.  Only the shared-arena teardown waits for the request
-        // thread to unwind.
         try {
             deinit.invoke(handle);
         } catch (Throwable t) {
             throw new AssertionError(t);
         }
+        // Shared-arena teardown waits for the request thread to unwind.
         synchronized (requestLock) {
             arena.close();
         }

@@ -138,7 +138,9 @@ def current_platform() -> Optional[str]:
         return None
 
 
-def ensure_backend(timeout_s: float = 240.0, announce=print) -> str:
+def ensure_backend(
+    timeout_s: float = 240.0, announce=print, reexec: bool = True
+) -> str:
     """Initialize the default backend (accelerator if the env provides one),
     falling back to CPU loudly on failure or hang.  Returns the platform name.
 
@@ -164,6 +166,36 @@ def ensure_backend(timeout_s: float = 240.0, announce=print) -> str:
     t.start()
     t.join(timeout_s)
     if t.is_alive():
+        if not reexec:
+            # Caller runs inside a host process we must not re-exec (the
+            # driver importing entry()): best-effort in-process CPU
+            # fallback — works when the hang is the remote dial itself
+            # rather than a held backend-registry lock.  The fallback gets
+            # its OWN watchdog: force_cpu's jax.devices() can block on the
+            # very lock the stuck probe thread holds, and hanging forever
+            # is strictly worse than raising.
+            announce(
+                f"# backend init hung >{timeout_s:.0f}s; "
+                "attempting in-process CPU fallback", file=sys.stderr,
+            )
+            fb: dict = {}
+
+            def fallback():
+                try:
+                    force_cpu()
+                    fb["ok"] = True
+                except Exception as err:  # noqa: BLE001 — reported below
+                    fb["err"] = err
+
+            ft = threading.Thread(target=fallback, daemon=True)
+            ft.start()
+            ft.join(min(60.0, timeout_s))
+            if fb.get("ok"):
+                return "cpu"
+            raise RuntimeError(
+                "backend init hung and the in-process CPU fallback "
+                f"{'failed: ' + repr(fb['err']) if 'err' in fb else 'also hung'}"
+            )
         if os.environ.get("TB_TPU_REEXEC"):
             raise RuntimeError("backend init hung twice; giving up")
         announce(
