@@ -84,24 +84,43 @@ class Journal:
 
     # -- reads ---------------------------------------------------------------
 
+    def _read_slot(
+        self, slot: int, expect_op: Optional[int] = None
+    ) -> Optional[Tuple[np.ndarray, bytes]]:
+        """Read+verify whatever prepare the slot holds — embedded header
+        first, then exactly the message's ``size`` bytes (a full-slot read
+        would drag message_size_max (1 MiB default) through the page cache
+        per call; this path runs once per committed op on backups).
+        ``expect_op`` bails right after the header decode when the slot
+        holds a different (wrapped) op — no body IO or checksum work."""
+        lay = self.storage.layout
+        base = lay.wal_prepares_offset + slot * self.config.message_size_max
+        head = self.storage.read(base, self.config.header_size)
+        try:
+            h, command = wire.decode_header(head)
+        except ValueError:
+            return None
+        if command != wire.Command.prepare:
+            return None
+        if expect_op is not None and int(h["op"]) != expect_op:
+            return None
+        size = int(h["size"])
+        if size > self.config.message_size_max:
+            return None
+        body = (
+            self.storage.read(base + wire.HEADER_SIZE, size - wire.HEADER_SIZE)
+            if size > wire.HEADER_SIZE else b""
+        )
+        try:
+            wire.verify_body(h, body)
+        except ValueError:
+            return None
+        return h, body
+
     def read_prepare(self, op: int) -> Optional[Tuple[np.ndarray, bytes]]:
         """Read+verify the prepare at ``op``'s slot; None unless the slot
         currently holds exactly ``op``."""
-        slot = self.slot(op)
-        lay = self.storage.layout
-        buf = self.storage.read(
-            lay.wal_prepares_offset + slot * self.config.message_size_max,
-            self.config.message_size_max,
-        )
-        try:
-            h, command = wire.decode_header(buf)
-            if command != wire.Command.prepare or int(h["op"]) != op:
-                return None
-            body = buf[wire.HEADER_SIZE : int(h["size"])]
-            wire.verify_body(h, body)
-            return h, body
-        except ValueError:
-            return None
+        return self._read_slot(self.slot(op), expect_op=op)
 
     def never_had(self, op: int, checksum: int) -> bool:
         """True when this journal PROVABLY never held the prepare
@@ -159,19 +178,11 @@ class Journal:
             except ValueError:
                 ring_hdr = None
 
-            pbuf = self.storage.read(
-                lay.wal_prepares_offset + slot * self.config.message_size_max,
-                self.config.message_size_max,
-            )
-            prepare = None
-            try:
-                ph, pcommand = wire.decode_header(pbuf)
-                if pcommand == wire.Command.prepare:
-                    body = pbuf[wire.HEADER_SIZE : int(ph["size"])]
-                    wire.verify_body(ph, body)
-                    prepare = (ph, body)
-            except ValueError:
-                prepare = None
+            # Sized read (embedded header first): scanning every slot at
+            # its full message_size_max forces the whole prepares ring
+            # (1 GiB at production config) through the page cache on every
+            # open — ~12 s of replica startup for a mostly-virgin ring.
+            prepare = self._read_slot(slot)
 
             if prepare is not None:
                 ph, body = prepare
@@ -184,7 +195,7 @@ class Journal:
                         # Torn/stale header ring entry: prepare is authoritative.
                         self.storage.write(
                             lay.wal_headers_offset + slot * self.config.header_size,
-                            pbuf[: self.config.header_size],
+                            ph.tobytes(),
                         )
                         repaired += 1
             elif ring_hdr is not None:
