@@ -99,5 +99,54 @@ def test_sharded_matches_single_chip(mesh):
     assert not np.asarray(ledger.transfers.probe_overflow).any()
 
 
+def test_sharded_lookup_matches_single_chip(mesh):
+    cfg = LedgerConfig(
+        accounts_capacity_log2=12, transfers_capacity_log2=13,
+        posted_capacity_log2=10,
+    )
+    single = TpuStateMachine(cfg, batch_lanes=LANES)
+    ledger = sharded.make_sharded_ledger(mesh, 1 << 12, 1 << 13, 1 << 10)
+    acc_step = sharded.sharded_create_accounts(mesh)
+    tr_step = sharded.sharded_create_transfers(mesh)
+    acc_lookup = sharded.sharded_lookup(mesh, "accounts")
+    tr_lookup = sharded.sharded_lookup(mesh, "transfers")
+
+    gen = WorkloadGen(seed=33)
+    accounts = gen.accounts_batch(24)
+    single.create_accounts(accounts, wall_clock_ns=1000)
+    ledger, _ = acc_step(
+        ledger, pad_soa(accounts), jnp.uint64(24),
+        jnp.uint64(single.prepare_timestamp),
+    )
+    batch = gen.transfers_batch(80, invalid_rate=0.0, dup_rate=0.0,
+                                pending_rate=0.0)
+    single.create_transfers(batch)
+    ledger, _ = tr_step(
+        ledger, pad_soa(batch), jnp.uint64(len(batch)),
+        jnp.uint64(single.prepare_timestamp),
+    )
+
+    # Mixed present/absent ids, replicated over the mesh.
+    ids = [int(i) for i in accounts["id_lo"][:8]] + [999_999, 0]
+    id_lo = jnp.asarray(np.array(ids + [0] * (LANES - len(ids)), np.uint64))
+    id_hi = jnp.zeros((LANES,), jnp.uint64)
+    found, rows = acc_lookup(ledger, id_lo, id_hi)
+    found = np.asarray(found)
+    want = single.lookup_accounts(ids)
+    assert found[:8].all() and not found[8] and not found[9]
+    # Row contents match the single-chip machine's lookups.
+    got_ts = np.asarray(rows["timestamp"])[:8]
+    assert list(got_ts) == [int(r["timestamp"]) for r in want]
+
+    tids = [int(t) for t in batch["id_lo"][:6]] + [123_456_789]
+    t_lo = jnp.asarray(np.array(tids + [0] * (LANES - len(tids)), np.uint64))
+    found_t, rows_t = tr_lookup(ledger, t_lo, id_hi)
+    found_t = np.asarray(found_t)
+    assert found_t[:6].all() and not found_t[6]
+    want_t = single.lookup_transfers(tids)
+    got_amt = np.asarray(rows_t["amount_lo"])[:6]
+    assert list(got_amt) == [int(r["amount_lo"]) for r in want_t]
+
+
 def test_sharded_visible_devices(mesh):
     assert mesh.devices.size == 8

@@ -23,6 +23,14 @@ collectives over ICI doing the data movement:
 Determinism: every collective is a sum of disjoint (owner-masked) terms, and
 all apply-phase writes are owner-local — byte-identical to the single-chip
 kernels, which the tests check on a virtual 8-device CPU mesh.
+
+Scope: the sharded kernels cover the flagship workload — plain
+create_accounts/create_transfers (the benchmark shape) plus lookups.  The
+full two-phase/balancing kernel (ops/transfer_full.py) runs single-chip;
+its in-batch dependency machinery is pure/replicable, but its gathers and
+applies interleave with local tables, so sharding it is a planned refactor
+rather than a wrapper.  A cluster needing sharded capacity for two-phase
+flows today routes those batches to the owner shard's single-chip path.
 """
 
 from __future__ import annotations
@@ -208,6 +216,32 @@ def sharded_create_transfers(mesh: Mesh):
         )(ledger, batch, count, timestamp)
 
     return jax.jit(step, donate_argnames=("ledger",))
+
+
+def sharded_lookup(mesh: Mesh, table_name: str):
+    """Jitted sharded point-lookup over ``ledger.<table_name>``: every
+    shard probes its local partition for the replicated id batch; one psum
+    per column assembles the full rows on every chip.
+
+    Returns fn(ledger, id_lo, id_hi) -> (found[b], rows{col: [b]})."""
+    n_shards = mesh.devices.size
+    shift = n_shards.bit_length() - 1
+
+    def local_step(ledger: Ledger, id_lo, id_hi):
+        table = getattr(ledger, table_name)
+        g = _ShardGather(table, id_lo, id_hi, n_shards, shift)
+        return g.found, g.rows(table)
+
+    def step(ledger, id_lo, id_hi):
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(_specs_like(ledger), P(), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(ledger, id_lo, id_hi)
+
+    return jax.jit(step)
 
 
 def sharded_create_accounts(mesh: Mesh):
