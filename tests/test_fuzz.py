@@ -110,6 +110,105 @@ def test_fuzz_superblock_quorums():
                 pass  # no valid copies: clean failure
 
 
+def test_fuzz_forest_checkpoint_reopen(tmp_path):
+    """forest_fuzz.zig's role: random batch/checkpoint/compaction histories
+    must reopen to the EXACT ledger state, and corrupting any forest file
+    must make open() raise — never a silently-wrong ledger."""
+    from tigerbeetle_tpu.config import LedgerConfig
+    from tigerbeetle_tpu.lsm.forest import Forest
+    from tigerbeetle_tpu.machine import TpuStateMachine
+    from tigerbeetle_tpu.testing.workload import WorkloadGen
+
+    cfg = LedgerConfig(
+        accounts_capacity_log2=9, transfers_capacity_log2=10,
+        posted_capacity_log2=9, max_probe=1 << 9,
+    )
+    for seed in range(4):
+        rng = random.Random(seed)
+        data_path = str(tmp_path / f"fuzz_{seed}.tb")
+        # Tight compaction knobs so minors AND majors fire within budget.
+        forest = Forest(data_path, compact_runs_max=rng.choice([1, 2, 3]),
+                        major_ratio=rng.choice([0.25, 0.5]))
+        machine = TpuStateMachine(cfg, batch_lanes=64)
+        gen = WorkloadGen(seed=seed * 7 + 1)
+        machine.create_accounts(gen.accounts_batch(16), wall_clock_ns=1)
+
+        op = 0
+        checkpoints = []  # (op, manifest_checksum)
+        for step in range(rng.randint(6, 12)):
+            for _ in range(rng.randint(1, 3)):
+                machine.create_transfers(
+                    gen.transfers_batch(rng.randint(4, 40), invalid_rate=0.1,
+                                        dup_rate=0.1, pending_rate=0.3)
+                )
+            op += 1
+            meta = {"machine": machine.host_state()}
+            _, manifest_checksum = forest.checkpoint(
+                machine.ledger, meta, op
+            )
+            checkpoints.append((op, manifest_checksum))
+
+        from tigerbeetle_tpu.vsr import checkpoint as ckpt_mod
+
+        want_arrays = ckpt_mod.ledger_to_arrays(machine.ledger)
+        digest = machine.digest()
+        final_op, final_manifest = checkpoints[-1]
+
+        def assert_exact(ledger_got, label):
+            got = ckpt_mod.ledger_to_arrays(ledger_got)
+            assert got.keys() == want_arrays.keys(), label
+            for key in want_arrays:
+                assert np.array_equal(
+                    np.asarray(got[key]), np.asarray(want_arrays[key])
+                ), f"{label}: array {key} diverged"
+
+        # Reopen from disk: byte-exact over EVERY table (digest covers only
+        # account balances).
+        reopened = Forest(data_path, compact_runs_max=8)
+        ledger2, meta2 = reopened.open(final_op, final_manifest)
+        assert_exact(ledger2, f"seed {seed} final reopen")
+        machine2 = TpuStateMachine(cfg, batch_lanes=64)
+        machine2.ledger = ledger2
+        machine2.restore_host_state(meta2["machine"])
+        assert machine2.digest() == digest, f"seed {seed}: reopen divergence"
+
+        # A random INTERMEDIATE checkpoint must also reopen cleanly (its
+        # runs/manifest are still on disk — gc only runs post-superblock).
+        mid_op, mid_manifest = rng.choice(checkpoints[:-1]) if (
+            len(checkpoints) > 1
+        ) else checkpoints[-1]
+        mid = Forest(data_path, compact_runs_max=8)
+        mid_ledger, _mid_meta = mid.open(mid_op, mid_manifest)
+        ckpt_mod.ledger_to_arrays(mid_ledger)  # loads + verifies throughout
+
+        # Corrupt one random byte of one random live forest file: open must
+        # raise (the checksum chain), never return a wrong ledger.
+        import os as _os
+
+        files = [reopened.manifest_path(final_op)]
+        from tigerbeetle_tpu.vsr import checkpoint as checkpoint_mod
+
+        files.append(
+            checkpoint_mod.path_for(data_path, reopened.manifest.base_op)
+        )
+        files += [reopened.run_path(r.seq) for r in reopened.manifest.runs]
+        victim = rng.choice(files)
+        size = _os.path.getsize(victim)
+        pos = rng.randrange(size)
+        with open(victim, "r+b") as f:
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([byte[0] ^ 0x40]))
+        with pytest.raises((RuntimeError, ValueError, KeyError, OSError)):
+            broken = Forest(data_path, compact_runs_max=8)
+            led3, _meta3 = broken.open(final_op, final_manifest)
+            # A lucky flip in ignorable padding would be fine ONLY if state
+            # is still byte-exact — anything else must have raised above.
+            assert_exact(led3, f"seed {seed} corrupted reopen")
+            raise RuntimeError("flip was benign")  # satisfy pytest.raises
+
+
 def test_fuzz_ewah_decode_garbage():
     rng = np.random.default_rng(5)
     for trial in range(100):

@@ -230,7 +230,12 @@ def sharded_lookup(mesh: Mesh, table_name: str):
     def local_step(ledger: Ledger, id_lo, id_hi):
         table = getattr(ledger, table_name)
         g = _ShardGather(table, id_lo, id_hi, n_shards, shift)
-        return g.found, g.rows(table)
+        rows = g.rows(table)
+        # Match the single-chip lookup shape (sm.lookup_* include the id
+        # columns so types.from_soa can build full wire rows).
+        rows["id_lo"] = jnp.where(g.found, id_lo, jnp.uint64(0))
+        rows["id_hi"] = jnp.where(g.found, id_hi, jnp.uint64(0))
+        return g.found, rows
 
     def step(ledger, id_lo, id_hi):
         return shard_map(
