@@ -136,7 +136,24 @@ class ColdStore:
         # or runs written after the checkpoint we restored to), and a name
         # collision would silently replace those bytes.
         self.next_seq = 0
+        # path -> whole-file checksum memo: run files are immutable
+        # (atomic_write never rewrites in place), so verify/load/locate
+        # never need to hash the same bytes twice.  Entries drop at gc.
+        self._path_checksums: Dict[str, int] = {}
         self._scan_next_seq()
+
+    def _file_checksum_cached(self, path: str) -> Optional[int]:
+        have = self._path_checksums.get(path)
+        if have is not None:
+            return have
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        have = _checksum(blob)
+        self._path_checksums[path] = have
+        return have
 
     def _scan_next_seq(self) -> None:
         if not self.directory or not os.path.isdir(self.directory):
@@ -219,6 +236,8 @@ class ColdStore:
             except OSError:
                 pass
         self.garbage = [p for p in self.garbage if p not in doomed]
+        for p in doomed:
+            self._path_checksums.pop(p, None)
 
     def clear(self) -> None:
         """Drop in-memory state (restore to a pre-eviction checkpoint);
@@ -270,6 +289,54 @@ class ColdStore:
             for p, r, c in zip(self.run_paths, self.runs, self.run_checksums)
         ]
 
+    def verify_manifest(self, manifest: List[dict]) -> List[Tuple[str, int]]:
+        """(basename, checksum) of manifest entries whose file is missing or
+        corrupt locally — a state-synced checkpoint references the
+        RESPONDER's cold runs, which must be fetched before load_manifest
+        can succeed (consensus cold-fetch over request_blocks)."""
+        damaged = []
+        for entry in manifest:
+            expect = int(entry.get("checksum", "0"), 16)
+            path = os.path.join(self.directory or "", entry["path"])
+            have = self._file_checksum_cached(path)
+            if have is None or (expect and have != expect):
+                damaged.append((entry["path"], expect))
+            elif not expect and len(np.load(path, mmap_mode="r")) != entry["rows"]:
+                damaged.append((entry["path"], expect))
+        return damaged
+
+    def locate_by_checksum(self, checksum: int) -> Optional[str]:
+        """Responder lookup: an on-disk run file whose bytes hash to
+        ``checksum`` (cold runs are content-addressed across replicas the
+        same way forest files are).  Checks live runs first, then the rest
+        of the spill directory — a checkpoint being synced may reference
+        runs that a later merge moved to the garbage list (still on disk
+        until the next gc)."""
+        for path, have in zip(self.run_paths, self.run_checksums):
+            if path and have == checksum:
+                return path
+        if not self.directory or not os.path.isdir(self.directory):
+            return None
+        for entry in os.listdir(self.directory):
+            if not entry.startswith("run_"):
+                continue
+            path = os.path.join(self.directory, entry)
+            if self._file_checksum_cached(path) == checksum:
+                return path
+        return None
+
+    def install_file(self, basename: str, checksum: int, blob: bytes) -> bool:
+        """Write fetched cold-run bytes under the manifest's name; False on
+        a checksum mismatch (corrupt/malicious peer)."""
+        if _checksum(blob) != checksum:
+            return False
+        assert self.directory, "cold install requires a directory"
+        self._ensure_dir()
+        path = os.path.join(self.directory, basename)
+        atomic_write(path, blob)
+        self._path_checksums[path] = checksum
+        return True
+
     def load_manifest(self, manifest: List[dict]) -> None:
         assert self.directory, "cold store reload requires a directory"
         self.runs, self.run_paths, self.run_checksums = [], [], []
@@ -277,8 +344,11 @@ class ColdStore:
             path = os.path.join(self.directory, entry["path"])
             expect = int(entry.get("checksum", "0"), 16)
             if expect:
-                with open(path, "rb") as f:
-                    actual = _checksum(f.read())
+                # Memoized: a verify_manifest just before (the sync-install
+                # path) already hashed these immutable files once.
+                actual = self._file_checksum_cached(path)
+                if actual is None:
+                    raise FileNotFoundError(path)
                 if actual != expect:
                     raise RuntimeError(
                         f"cold run corrupt: {path} (checksum mismatch)"

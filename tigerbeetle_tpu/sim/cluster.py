@@ -199,6 +199,7 @@ class SimCluster:
         misdirect_probability: float = 0.0,
         hash_log: bool = True,
         audit: bool = True,
+        hot_transfers_capacity_max: Optional[int] = None,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
@@ -207,6 +208,9 @@ class SimCluster:
         self.config = config or TEST_MIN
         self.ledger_config = ledger_config or LEDGER_TEST
         self.batch_lanes = batch_lanes
+        # Optional cold-tier cap: evictions + rehydration run under
+        # consensus and crash/restart (BASELINE config-4 tiering).
+        self.hot_transfers_capacity_max = hot_transfers_capacity_max
         self.rng = random.Random(seed)
         self.net = net or PacketSimulator(seed=seed + 1)
         self.t = 0
@@ -287,6 +291,7 @@ class SimCluster:
             realtime=realtime,
             seed=self.seed * 31 + i,
             hash_log=self.hash_logs[i],
+            hot_transfers_capacity_max=self.hot_transfers_capacity_max,
         )
         if self.auditor is not None:
             def observe(op, operation, ts, body, results, replay, i=i):

@@ -85,6 +85,7 @@ _BLOCK_KIND_CODE = {
     "manifest": wire.BLOCK_KIND_MANIFEST,
     "base": wire.BLOCK_KIND_BASE,
     "run": wire.BLOCK_KIND_RUN,
+    "cold": wire.BLOCK_KIND_COLD,
 }
 _BLOCK_KIND_NAME = {v: k for k, v in _BLOCK_KIND_CODE.items()}
 TICK_NS = 10_000_000  # default tick length; the TCP bus overrides tick_ns
@@ -176,6 +177,10 @@ class VsrReplica(Replica):
         # checkpoint files being refetched before the replica can open.
         self._block_repair: Optional[dict] = None
         self.blocks_repaired = 0
+        # Cold-tier fetch during state sync: a synced checkpoint's
+        # cold_manifest references the responder's LOCAL spill files, which
+        # we must fetch (by checksum) before the install can complete.
+        self._cold_fetch: Optional[dict] = None
 
         # Tick counters.  First ping fires on the first tick so the cluster
         # clock synchronizes before the first client request.
@@ -1381,7 +1386,10 @@ class VsrReplica(Replica):
             return []
         expect = wire.u128(h, "block_checksum")
         offset = int(h["offset"])
-        path = self.forest.locate_block(kind, int(h["block_id"]), expect)
+        if kind == "cold":
+            path = self.machine.cold.locate_by_checksum(expect)
+        else:
+            path = self.forest.locate_block(kind, int(h["block_id"]), expect)
         if path is None:
             return []
         try:
@@ -1406,6 +1414,8 @@ class VsrReplica(Replica):
 
     def on_block(self, h: np.ndarray, body: bytes) -> List[Msg]:
         br = self._block_repair
+        if br is None and self._cold_fetch is not None:
+            return self._on_cold_block(h, body)
         if br is None or not br["queue"]:
             return []
         kind, ident, expect = br["queue"][0]
@@ -1530,6 +1540,11 @@ class VsrReplica(Replica):
     def on_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
         if self.sync_target is None:
             return []
+        if self._cold_fetch is not None:
+            # Snapshot already fully fetched; a late/duplicate chunk must
+            # not re-trigger the install (it would reset the in-progress
+            # cold-run fetch and livelock).
+            return []
         checkpoint_op = int(h["checkpoint_op"])
         if self.sync_target["checkpoint_op"] == 0 and not self.sync_buffer:
             # "Latest" request: pin to whichever checkpoint answered first.
@@ -1545,6 +1560,53 @@ class VsrReplica(Replica):
         if len(self.sync_buffer) < self.sync_target["total"]:
             self._last_sync_req = self._ticks
             return self._request_sync_chunk()
+        return self._install_sync_checkpoint()
+
+    def _sync_responder(self) -> int:
+        return (
+            self._sync_peer if self._sync_peer is not None
+            else self.primary_index()
+        )
+
+    def _request_cold_chunk(self) -> List[Msg]:
+        cf = self._cold_fetch
+        _basename, checksum = cf["queue"][0]
+        req = self._hdr(
+            wire.Command.request_blocks,
+            block_kind=wire.BLOCK_KIND_COLD,
+            block_id=0,
+            block_checksum=checksum,
+            offset=len(cf["buf"]),
+        )
+        return [(("replica", self._sync_responder()), wire.encode(req))]
+
+    def _on_cold_block(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        cf = self._cold_fetch
+        if not cf["queue"] or int(h["block_kind"]) != wire.BLOCK_KIND_COLD:
+            return []
+        basename, checksum = cf["queue"][0]
+        if wire.u128(h, "block_checksum") != checksum:
+            return []
+        if int(h["offset"]) != len(cf["buf"]):
+            return self._request_cold_chunk()
+        cf["buf"].extend(body)
+        cf["attempts"] = 0
+        # Progress resets the sync resend timer, or the tick would wipe an
+        # in-flight multi-chunk transfer every SYNC_RESEND ticks.
+        self._last_sync_req = self._ticks
+        if len(cf["buf"]) < int(h["total"]):
+            return self._request_cold_chunk()
+        if not self.machine.cold.install_file(
+            basename, checksum, bytes(cf["buf"])
+        ):
+            cf["buf"] = bytearray()
+            return self._request_cold_chunk()
+        cf["queue"].pop(0)
+        cf["buf"] = bytearray()
+        if cf["queue"]:
+            return self._request_cold_chunk()
+        # All spill files present: complete the deferred install.
+        self._cold_fetch = None
         return self._install_sync_checkpoint()
 
     def _install_sync_checkpoint(self) -> List[Msg]:
@@ -1566,6 +1628,21 @@ class VsrReplica(Replica):
             self.sync_buffer = bytearray()
             self._last_sync_req = self._ticks
             return self._request_sync_chunk()
+        # Cold tier: the checkpoint's cold_manifest names spill files LOCAL
+        # to the responder — fetch (by checksum) any we lack before the
+        # install can complete (re-entered once the fetch drains).
+        cold_manifest = meta["machine"].get("cold_manifest", [])
+        if cold_manifest and self.machine.cold.directory:
+            damage = self.machine.cold.verify_manifest(cold_manifest)
+            if damage:
+                self._cold_fetch = {
+                    "queue": damage,        # [(basename, checksum), ...]
+                    "buf": bytearray(),
+                    "attempts": 0,
+                }
+                self._last_sync_req = self._ticks
+                return self._request_cold_chunk()
+        self._cold_fetch = None
         self.machine.ledger = ledger
         self.machine.restore_host_state(meta["machine"])
         self.sessions = {
@@ -1671,11 +1748,30 @@ class VsrReplica(Replica):
             self.status = SYNCING
             if self._ticks - self._last_sync_req >= SYNC_RESEND:
                 self._last_sync_req = self._ticks
-                if self._sync_peer is not None:
-                    # Explicit-peer sync (block-repair fallback): a silent
-                    # responder means we guessed wrong — rotate.
-                    self._sync_peer = self._next_peer(self._sync_peer)
-                out.extend(self._request_sync_chunk())
+                if self._cold_fetch is not None:
+                    cf = self._cold_fetch
+                    cf["attempts"] += 1
+                    if cf["attempts"] >= 3 * self.replica_count:
+                        # No reachable replica serves these cold runs
+                        # (GC'd past this checkpoint): restart the sync at
+                        # whatever is latest instead of waiting forever.
+                        self._cold_fetch = None
+                        self.sync_target = {"checkpoint_op": 0, "total": None}
+                        self.sync_buffer = bytearray()
+                        if self._sync_peer is not None:
+                            self._sync_peer = self._next_peer(self._sync_peer)
+                        out.extend(self._request_sync_chunk())
+                    else:
+                        if self._sync_peer is not None:
+                            self._sync_peer = self._next_peer(self._sync_peer)
+                        cf["buf"] = bytearray()
+                        out.extend(self._request_cold_chunk())
+                else:
+                    if self._sync_peer is not None:
+                        # Explicit-peer sync (block-repair fallback): a
+                        # silent responder means we guessed wrong — rotate.
+                        self._sync_peer = self._next_peer(self._sync_peer)
+                    out.extend(self._request_sync_chunk())
             return out
 
         if self.status == NORMAL and self.is_primary:

@@ -262,6 +262,7 @@ REQUEST_REPLY_DTYPE = _dtype([
 BLOCK_KIND_MANIFEST = 0
 BLOCK_KIND_BASE = 1
 BLOCK_KIND_RUN = 2
+BLOCK_KIND_COLD = 3          # cold-tier spill run (addressed by checksum)
 
 REQUEST_BLOCKS_DTYPE = _dtype([
     ("block_checksum_lo", "<u8"), ("block_checksum_hi", "<u8"),
