@@ -59,6 +59,12 @@ def run_seed(
     # and misdirected writes, atlas-bounded so damage stays repairable.
     read_fault_p = rng.choice([0.0, 0.0, 0.001, 0.004])
     misdirect_p = rng.choice([0.0, 0.0, 0.001])
+    # Some schedules run TIERED (hot-window cap forces evictions), so the
+    # cold spill + rehydration + sync-fetch paths sit under the same fuzz
+    # net as everything else.  Drawn from a SEPARATE stream: consuming a
+    # draw from the schedule rng would shift every pinned regression
+    # seed's fault schedule.
+    hot_cap = random.Random(seed ^ 0xC01D).choice([None, None, None, 128])
     partition_modes = ["isolate_single", "uniform_size", "uniform_partition"]
 
     def go(workdir: str) -> VoprResult:
@@ -71,6 +77,7 @@ def run_seed(
             net=net,
             read_fault_probability=read_fault_p,
             misdirect_probability=misdirect_p,
+            hot_transfers_capacity_max=hot_cap,
         )
         faults = 0
         down: set = set()
