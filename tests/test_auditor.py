@@ -123,6 +123,92 @@ def test_auditor_catches_cross_replica_divergence(tmp_path):
                 raise AssertionError("auditor missed the divergent replica")
 
 
+def test_auditor_catches_wrong_lookup_reply(tmp_path):
+    """Reads are audited too: a machine that drops a row from a committed
+    lookup reply (identically on every replica) must fail the audit."""
+    cluster = make_cluster(tmp_path, seed=77, requests=10)
+    for i in range(3):
+        machine = cluster.replicas[i].machine
+        orig = machine.lookup_accounts
+
+        def lying(ids, _orig=orig):
+            rows = _orig(ids)
+            return rows[:-1] if len(rows) > 1 else rows
+
+        machine.lookup_accounts = lying
+    with pytest.raises(AuditError):
+        for _ in range(400):
+            cluster.run(50)
+            if cluster.clients_done() and cluster.converged():
+                raise AssertionError("auditor missed the dropped lookup row")
+
+
+def test_audit_lookup_transfers_unit():
+    """Direct drive of the lookup_transfers audit branch (the sim workload
+    only issues lookup_accounts): correct replies pass, any flipped byte
+    fails."""
+    from tigerbeetle_tpu.config import LedgerConfig
+    from tigerbeetle_tpu.machine import TpuStateMachine
+    from tigerbeetle_tpu.testing.auditor import Auditor
+
+    cfg = LedgerConfig(accounts_capacity_log2=9, transfers_capacity_log2=10,
+                       posted_capacity_log2=9, max_probe=1 << 9)
+    machine = TpuStateMachine(cfg, batch_lanes=64)
+    auditor = Auditor()
+
+    accounts = types.accounts_array(
+        [types.account(id=i, ledger=1, code=10) for i in (1, 2, 3)]
+    )
+    acc_results = machine.create_accounts(accounts, wall_clock_ns=100)
+    ts_accounts = machine.prepare_timestamp
+    from tigerbeetle_tpu.testing.auditor import _encode_results
+
+    auditor.observe_commit(
+        1, "create_accounts", ts_accounts, accounts.tobytes(),
+        _encode_results(acc_results), replica=0, replay=False,
+    )
+    transfers = types.transfers_array([
+        types.transfer(id=10 + i, debit_account_id=1 + i % 3,
+                       credit_account_id=1 + (i + 1) % 3, amount=5 + i,
+                       ledger=1, code=10)
+        for i in range(4)
+    ])
+    tr_results = machine.create_transfers(transfers)
+    ts_transfers = machine.prepare_timestamp
+    auditor.observe_commit(
+        2, "create_transfers", ts_transfers, transfers.tobytes(),
+        _encode_results(tr_results), replica=0, replay=False,
+    )
+    ids = [10, 11, 12, 999]
+    body = np.zeros(2 * len(ids), dtype="<u8")
+    body[0::2] = ids
+    reply = machine.lookup_transfers(ids).tobytes()
+    auditor.observe_commit(
+        3, "lookup_transfers", ts_transfers, body.tobytes(),
+        reply, replica=0, replay=False,
+    )
+    assert auditor.next_op == 4  # all replayed clean
+
+    bad = bytearray(reply)
+    bad[40] ^= 0x01  # flip one byte anywhere in the rows
+    with pytest.raises(AuditError):
+        auditor2 = Auditor()
+        auditor2.observe_commit(
+            1, "create_accounts", ts_accounts,
+            accounts.tobytes(), _encode_results(acc_results),
+            replica=0, replay=False,
+        )
+        auditor2.observe_commit(
+            2, "create_transfers", ts_transfers,
+            transfers.tobytes(), _encode_results(tr_results),
+            replica=0, replay=False,
+        )
+        auditor2.observe_commit(
+            3, "lookup_transfers", ts_transfers, body.tobytes(),
+            bytes(bad), replica=0, replay=False,
+        )
+
+
 def test_pending_expiry_mirrored(tmp_path):
     """Pending transfers with short timeouts: post-after-expiry outcomes
     must match the model's expiry mirror exactly (the workload generates

@@ -376,10 +376,17 @@ def _cmd_benchmark(args) -> int:
                 # timer and exclude it, so throughput and percentiles
                 # measure steady state (benchmark_load.zig likewise).
                 warmed = True
+                warmup_latency = time.monotonic() - bt0
+                warmup_accepted = count - len(results)
                 t0 = time.monotonic()
             sent += count
             tid += count
         elapsed = max(time.monotonic() - t0, 1e-9)
+        if not latencies:
+            # Single-batch run: the warmup sample is all there is.
+            latencies = [warmup_latency]
+            accepted = warmup_accepted
+            elapsed = max(warmup_latency, 1e-9)
 
         lat_ms = sorted(1e3 * l for l in latencies)
 

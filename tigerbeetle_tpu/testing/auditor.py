@@ -106,8 +106,11 @@ class Auditor:
                 expected = _encode_results(
                     self.model.execute(operation, timestamp, events)
                 )
+            elif operation in ("lookup_accounts", "lookup_transfers"):
+                self._audit_lookup(operation, body, result_body)
+                expected = None
             else:
-                expected = None  # register / reads: order-occupying no-ops
+                expected = None  # register / query ops: order-occupying
             if expected is not None:
                 if expected != result_body:
                     got = np.frombuffer(
@@ -123,3 +126,29 @@ class Auditor:
                     )
                 self.audited += 1
             self.next_op += 1
+
+    def _audit_lookup(self, operation, body, result_body) -> None:
+        """Reads occupy the commit order too: the committed reply rows must
+        match the model EXACTLY — the model's rows are re-encoded to the
+        wire dtypes and compared byte-for-byte, covering every field
+        (digests can't see a wrong lookup reply)."""
+        import dataclasses as _dc
+
+        ids_arr = np.frombuffer(body, dtype="<u8").reshape(-1, 2)
+        ids = [int(lo) | (int(hi) << 64) for lo, hi in ids_arr]
+        if operation == "lookup_accounts":
+            objs = self.model.lookup_accounts(ids)
+            want = types.accounts_array(
+                [types.account(**_dc.asdict(o)) for o in objs]
+            ).tobytes() if objs else b""
+        else:
+            objs = self.model.lookup_transfers(ids)
+            want = types.transfers_array(
+                [types.transfer(**_dc.asdict(o)) for o in objs]
+            ).tobytes() if objs else b""
+        if want != result_body:
+            raise AuditError(
+                f"op {self.next_op} ({operation}): committed reply "
+                f"({len(result_body) // 128} rows) diverges byte-wise from "
+                f"the model ({len(objs)} rows)"
+            )
