@@ -49,9 +49,10 @@ class TestStorageFaultFamilies:
         )
 
     def test_misdirected_writes_survived(self, tmp_path):
-        # ~50 WAL writes happen in this run; 0.05 reliably fires a few
-        # misdirects under the atlas's double-charge gate.
-        cluster = make_cluster(tmp_path, seed=32, misdirect_probability=0.05)
+        # ~50 WAL writes happen in this run, and only NON-CORE replicas
+        # inject (SimCluster.core); 0.2 reliably fires a few misdirects
+        # under the atlas's double-charge gate.
+        cluster = make_cluster(tmp_path, seed=32, misdirect_probability=0.2)
         cluster.run(2_000)
         finish(cluster)
         assert sum(s.faults_injected for s in cluster.storages) > 0, (
@@ -136,3 +137,45 @@ class TestHashLogOracle:
             [log for log in cluster.hash_logs if log is not None]
         )
         assert pin is not None and pin[0] == op
+
+
+def test_misdirected_wal_write_cannot_lose_committed_op(tmp_path):
+    """Regression (storage-adversary seed 31000): a misdirected WAL write
+    silently landed a committed prepare's bytes in the wrong slot; with the
+    only intact copy on an offline replica, the nack protocol 'proved' the
+    op was never quorum-journaled and a view change truncated COMMITTED
+    history (hash_log caught the rewrite).  The journal now verifies every
+    prepare write by read-back before the ack can go out."""
+    import random
+
+    seed = 31000
+    rng = random.Random(seed)
+    net = PacketSimulator(seed=seed + 1, loss_probability=0.05,
+                          replay_probability=0.02, delay_mean=3)
+    cluster = SimCluster(
+        str(tmp_path), n_replicas=3, n_clients=2, seed=seed,
+        requests_per_client=15, net=net,
+        read_fault_probability=0.01, misdirect_probability=0.004,
+    )
+    down = set()
+    # Storage faults are active: only non-core replicas may crash (see
+    # SimCluster.core — a faulted copy plus a crashed holder of the same
+    # committed op exceeds the f=1 budget no protocol survives).
+    crashable = [i for i in range(3) if i not in cluster.core]
+    for t in range(9000):
+        cluster.step()
+        r = rng.random()
+        if r < 0.002 and len(down) + 1 < 3:
+            v = rng.randrange(3)
+            if v in crashable and v not in down and cluster.alive[v]:
+                cluster.crash(v)
+                down.add(v)
+        elif r < 0.005 and down:
+            b = rng.choice(sorted(down))
+            if not cluster.alive[b]:
+                cluster.restart(b)
+            down.discard(b)
+    for i in range(3):
+        if not cluster.alive[i]:
+            cluster.restart(i)  # scheduled crash or journal-failure stop
+    finish(cluster)

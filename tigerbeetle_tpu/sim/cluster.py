@@ -34,6 +34,7 @@ from ..config import ClusterConfig, LedgerConfig, LEDGER_TEST, TEST_MIN
 from ..testing.workload import WorkloadGen
 from ..vsr import wire
 from ..vsr.consensus import NORMAL, VsrReplica
+from ..vsr.journal import JournalWriteFailure
 from .network import PacketSimulator
 from .storage import SimStorage
 
@@ -224,11 +225,35 @@ class SimCluster:
         from .storage import FaultAtlas
 
         self.atlas = FaultAtlas(self.n)
+        # The CORE (simulator.zig's Core): a view-change-quorum-sized set
+        # of replicas exempt from STORAGE faults.  A fault on one quorum
+        # member's copy of a committed op plus the OTHER member being
+        # merely offline exceeds every protocol's budget (2 lost copies at
+        # f=1) — the atlas alone cannot see crash overlap, so the standing
+        # guarantee is a damage-free electable quorum.  The randomized
+        # schedulers (sim/vopr.py, adversary tests) additionally refrain
+        # from CRASHING core members while storage faults are active;
+        # scripted tests without fault probabilities may crash anyone.
+        from ..vsr.consensus import quorums
+
+        core_size = quorums(self.n)[1]
+        faults_requested = read_fault_probability or misdirect_probability
+        if faults_requested and core_size >= self.n:
+            # Exempting everyone would silently disable the requested
+            # fault families (n <= 2): leave one replica faultable — such
+            # tiny clusters have no surviving-quorum guarantee under
+            # faults anyway.
+            core_size = self.n - 1
+        self.core = set(self.rng.sample(range(self.n), core_size))
         self.storages = [
             SimStorage(
                 self.config, seed=seed * 101 + i, replica=i, atlas=self.atlas,
-                read_fault_probability=read_fault_probability,
-                misdirect_probability=misdirect_probability,
+                read_fault_probability=(
+                    0.0 if i in self.core else read_fault_probability
+                ),
+                misdirect_probability=(
+                    0.0 if i in self.core else misdirect_probability
+                ),
             )
             for i in range(self.n)
         ]
@@ -338,7 +363,14 @@ class SimCluster:
                     h, command, body = wire.decode(message)
                 except ValueError:
                     continue  # corrupt frame: dropped like a bad TCP peer
-                out = self.replicas[ident].on_message(h, command, body)
+                try:
+                    out = self.replicas[ident].on_message(h, command, body)
+                except JournalWriteFailure:
+                    # Persistently misdirected medium: fail-stop — the
+                    # replica crashes (and may be restarted by the fault
+                    # schedule); the cluster must survive it.
+                    self.crash(ident)
+                    continue
                 self._route(dst, out)
             else:
                 client = self.clients.get(ident)
@@ -351,7 +383,10 @@ class SimCluster:
                 client.on_message(h, command, body, self.t)
         for i in range(self.n):
             if self.alive[i]:
-                self._route(("replica", i), self.replicas[i].tick())
+                try:
+                    self._route(("replica", i), self.replicas[i].tick())
+                except JournalWriteFailure:
+                    self.crash(i)
         for cid, client in self.clients.items():
             self._route(("client", cid), client.tick(self.t))
 

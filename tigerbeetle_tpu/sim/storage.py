@@ -124,6 +124,13 @@ class SimStorage:
                 self.faults_injected += 1
         return bytes(self.buf[offset : offset + size])
 
+    def read_nofault(self, offset: int, size: int) -> bytes:
+        """Injection-free read for the journal's write verification: an
+        injected fault there would be healed by the immediate rewrite but
+        would charge the atlas and shift every seed's dice."""
+        assert offset + size <= self.layout.total_size
+        return bytes(self.buf[offset : offset + size])
+
     def write(self, offset: int, data: bytes) -> None:
         assert offset + len(data) <= self.layout.total_size
         self.writes += 1

@@ -82,6 +82,16 @@ def run_seed(
         faults = 0
         down: set = set()
         partitioned = False
+        # With storage faults active, never crash CORE replicas: a faulted
+        # copy on a non-core replica plus a crashed core holder of the
+        # same object would exceed the f=1 budget no protocol survives
+        # (simulator.zig's liveness core; see SimCluster.core).
+        if read_fault_p or misdirect_p:
+            crashable = [
+                i for i in range(n_replicas) if i not in cluster.core
+            ]
+        else:
+            crashable = list(range(n_replicas))
         try:
             for t in range(ticks):
                 cluster.step()
@@ -89,13 +99,18 @@ def run_seed(
                 r = rng.random()
                 if r < 0.002 and len(down) + 1 < n_replicas:
                     victim = rng.randrange(n_replicas)
-                    if victim not in down:
+                    # alive check: the sim fail-stops a replica itself on a
+                    # persistent journal write failure.
+                    if victim in crashable and victim not in down and (
+                        cluster.alive[victim]
+                    ):
                         cluster.crash(victim)
                         down.add(victim)
                         faults += 1
                 elif r < 0.004 and down:
                     back = rng.choice(sorted(down))
-                    cluster.restart(back)
+                    if not cluster.alive[back]:
+                        cluster.restart(back)
                     down.discard(back)
                 elif r < 0.0055 and not partitioned and n_replicas >= 3:
                     if net.partition_mode(
@@ -115,10 +130,12 @@ def run_seed(
                         cluster.t, rng.randint(50, 400),
                     )
                     faults += 1
-            # Heal everything; the cluster must converge.
+            # Heal everything; the cluster must converge.  Restart every
+            # dead replica — scheduled crashes AND sim fail-stops.
             cluster.heal()
-            for i in sorted(down):
-                cluster.restart(i)
+            for i in range(n_replicas):
+                if not cluster.alive[i]:
+                    cluster.restart(i)
             down.clear()
             ok = cluster.run_until(
                 lambda: cluster.clients_done() and cluster.converged(),

@@ -306,6 +306,22 @@ class VsrReplica(Replica):
         # into canonical state learned from the cluster (solo replicas ARE
         # the cluster: their WAL is canon by quorum=1).
         self._verify_floor = self.op + 1 if self.replica_count > 1 else 0
+        # RECOVERING-HEAD detection (replica.zig status.recovering_head):
+        # when recovery shows our chained head is AMPUTATED — headers
+        # recovered beyond it with bodies lost, foreign (misdirected-write)
+        # slot content, or a persisted commit_min above it — our log must
+        # not vouch in a view change.  Presenting a truncated op under our
+        # real (possibly highest) log_view would WIN the canonical
+        # selection and truncate committed history (storage-adversary seed
+        # 31000: a twice-read-faulted ex-primary's (log_view=3, op=24) log
+        # beat the intact backup's (log_view=0, op=28)).
+        beyond_head = any(op > self.op for op in recovery.entries)
+        persisted_commit = getattr(self._sb_state, "commit_min", 0)
+        self._log_suspect = self.replica_count > 1 and (
+            bool(recovery.foreign_slots)
+            or beyond_head
+            or persisted_commit > self.op
+        )
 
     def _replay_solo(self) -> None:
         """Single-replica replay: execute the whole chained suffix."""
@@ -320,11 +336,15 @@ class VsrReplica(Replica):
 
     def _persist_view(self) -> None:
         """Quorum-write view/log_view into the superblock so a restarted
-        replica never regresses its view (replica.zig view durability)."""
+        replica never regresses its view (replica.zig view durability).
+        commit_min rides along: a restart whose WAL chain ends below it is
+        PROOF of an amputated suffix (recovering-head detection)."""
         if self._sb_state is None:
             return
         state = dataclasses.replace(
-            self._sb_state, view=self.view, log_view=self.log_view
+            self._sb_state, view=self.view, log_view=self.log_view,
+            commit_min=max(self._sb_state.commit_min, self.commit_min),
+            commit_max=max(self._sb_state.commit_max, self.commit_max),
         )
         self.superblock.checkpoint(state)
         self._sb_state = state
@@ -433,6 +453,12 @@ class VsrReplica(Replica):
             return []  # pipeline full: client will retry
         if self.op + 1 > self.op_prepare_max:
             return []  # WAL full until the next checkpoint: client retries
+        if self.commit_max > self.op:
+            # Ops at/below the known commit watermark exist that we don't
+            # hold headers for (e.g. a recovering-head DVC's commit claim):
+            # assigning a FRESH op at their position would fork committed
+            # history.  Repair/sync must close the gap first.
+            return []
 
         prepare_h, prepare_body = self._prepare(h, body, operation)
         op = int(prepare_h["op"])
@@ -859,6 +885,17 @@ class VsrReplica(Replica):
         (replica.zig send_do_view_change)."""
         if self.status != VIEW_CHANGE:
             return []
+        if getattr(self, "_log_suspect", False):
+            # Recovering-head (replica.zig status.recovering_head): a log
+            # with amputation evidence neither counts toward the DVC
+            # quorum nor donates its log — the view change completes from
+            # clean replicas, and we rejoin via their start_view.  The
+            # predicate is narrow (foreign slots / recovered headers with
+            # lost bodies beyond the head / persisted commit above the
+            # head): a benign torn tail leaves no recovered header (the
+            # headers ring is written last), so ordinary crash-restarts
+            # do not abstain.
+            return []
         if len(self.svc_from.get(self.view, ())) < self.quorum_view_change:
             return []
         return self._send_dvc()
@@ -871,6 +908,7 @@ class VsrReplica(Replica):
             commit=self.commit_min,
             checkpoint_op=self.op_checkpoint,
             log_view=self.log_view,
+            log_suspect=int(getattr(self, "_log_suspect", False)),
         )
         body = wire.pack_headers(self._suffix_headers())
         message = wire.encode(dvc, body)
@@ -902,19 +940,23 @@ class VsrReplica(Replica):
             headers = wire.unpack_headers(body)
         except ValueError:
             return out
+        if int(h["log_suspect"]):
+            return out  # recovering-head: neither quorum vote nor log donor
         self.dvc_from.setdefault(view, {})[int(h["replica"])] = {
             "log_view": int(h["log_view"]),
             "op": int(h["op"]),
             "commit": int(h["commit"]),
             "headers": headers,
         }
-        # Our own state counts toward the DVC quorum.
-        self.dvc_from[view][self.replica] = {
-            "log_view": self.log_view,
-            "op": self.op,
-            "commit": self.commit_min,
-            "headers": self._suffix_headers(),
-        }
+        # Our own state counts toward the DVC quorum — unless recovering-
+        # head (see _maybe_send_dvc): then only clean logs may select.
+        if not getattr(self, "_log_suspect", False):
+            self.dvc_from[view][self.replica] = {
+                "log_view": self.log_view,
+                "op": self.op,
+                "commit": self.commit_min,
+                "headers": self._suffix_headers(),
+            }
         if len(self.dvc_from[view]) >= self.quorum_view_change:
             out.extend(self._install_canonical_log(view))
         return out
@@ -1044,6 +1086,7 @@ class VsrReplica(Replica):
         self.view = view
         self.log_view = view
         self._new_view_pending = None
+        self._log_suspect = False  # the canonical quorum log is ours now
         self._persist_view()
         self.svc_from.pop(view, None)
         self.dvc_from.pop(view, None)
@@ -1120,6 +1163,9 @@ class VsrReplica(Replica):
         # WAL bound: adopt at most a ring's worth beyond our checkpoint;
         # commits advance the checkpoint and repair fetches the rest.
         self._install_headers(min(target_op, self.op_prepare_max), by_op)
+        # The canonical log just replaced whatever a misdirected write may
+        # have clobbered: our log is certified again.
+        self._log_suspect = False
 
         # Ack the uncommitted suffix so the new primary can commit it.
         for op in range(self.commit_min + 1, self.op + 1):
@@ -1664,6 +1710,7 @@ class VsrReplica(Replica):
         self.missing.clear()
         self.parent_checksum = 0
         self._verify_floor = op + 1  # nothing above the snapshot known yet
+        self._log_suspect = False    # snapshot replaced the clobbered WAL
         manifest_checksum = self.forest.adopt_base(
             ledger, meta, op, target["file_checksum"]
         )

@@ -38,11 +38,25 @@ class RecoveredEntry:
     body: Optional[bytes]       # None => faulty (header known, body lost)
 
 
+class JournalWriteFailure(RuntimeError):
+    """A WAL write failed read-back verification repeatedly (persistently
+    misdirected/faulty medium).  Fail-stop for a real replica; the
+    simulator models it as a replica crash."""
+
+
 @dataclasses.dataclass
 class Recovery:
     entries: Dict[int, RecoveredEntry]
     faulty_slots: List[int]
     repaired_headers: int
+    # Slots holding a DECODABLE prepare whose op maps to a DIFFERENT slot:
+    # impossible from legitimate writes, so it is PROOF of a misdirected
+    # write — and the clobbered slot may have held an op this replica
+    # ACKED.  A replica with foreign slots must not vouch for its log in a
+    # view change until a start_view re-certifies it (storage-adversary
+    # seed 31000: doing so let a VC quorum that excluded the op's other
+    # holder truncate committed history).
+    foreign_slots: List[int] = dataclasses.field(default_factory=list)
 
 
 class Journal:
@@ -61,23 +75,55 @@ class Journal:
         with tracer.span("journal_write", size=len(message)):
             self._write_prepare(message, sync)
 
+    # Write-verification retries: a misdirected write (disk firmware lying
+    # about the LBA) lands the bytes elsewhere while the call "succeeds".
+    WRITE_RETRIES = 3
+
+    def _verify_meaningful(self) -> bool:
+        """Read-back verification only means something when reads reach the
+        medium: O_DIRECT, or the simulator's fault-injecting storage.  A
+        buffered read is served by the page cache the write just populated
+        and would match even if the platter write misdirected."""
+        return getattr(self.storage, "direct_io", True)
+
     def _write_prepare(self, message: bytes, sync: bool) -> None:
         h, command = wire.decode_header(message)
         assert command == wire.Command.prepare
         assert len(message) == int(h["size"]) <= self.config.message_size_max
         slot = self.slot(int(h["op"]))
         lay = self.storage.layout
-        self.storage.write(
-            lay.wal_prepares_offset + slot * self.config.message_size_max, message
+        head = message[: self.config.header_size]
+        verify = self._verify_meaningful()
+        # Verification reads bypass the simulator's read-fault injection
+        # when the backend offers that (read_nofault): a fault injected on
+        # the read-back would be healed by the immediate rewrite anyway,
+        # but it would charge the fault atlas and shift every seed's dice.
+        read = getattr(self.storage, "read_nofault", self.storage.read)
+        targets = (
+            (lay.wal_prepares_offset + slot * self.config.message_size_max,
+             message),
+            (lay.wal_headers_offset + slot * self.config.header_size,
+             head),
         )
-        if sync:
-            self.storage.sync()
-        self.storage.write(
-            lay.wal_headers_offset + slot * self.config.header_size,
-            message[: self.config.header_size],
-        )
-        if sync:
-            self.storage.sync()
+        for offset, payload in targets:
+            for attempt in range(self.WRITE_RETRIES):
+                self.storage.write(offset, payload)
+                if sync:
+                    self.storage.sync()
+                # Read-back custody check: the prepare_ok this write
+                # authorizes asserts "I hold this prepare" — and the nack
+                # protocol later trusts never_had()'s ring inspection, so a
+                # silently-misdirected write here could let a view change
+                # truncate a COMMITTED op (VOPR storage-adversary find).
+                if not verify or read(
+                    offset, self.config.header_size
+                ) == head:
+                    break
+            else:
+                raise JournalWriteFailure(
+                    f"journal write for op {int(h['op'])} failed "
+                    f"verification {self.WRITE_RETRIES}x (misdirected IO?)"
+                )
 
     def sync(self) -> None:
         self.storage.sync()
@@ -125,37 +171,36 @@ class Journal:
     def never_had(self, op: int, checksum: int) -> bool:
         """True when this journal PROVABLY never held the prepare
         (op, checksum) — the safety condition for a view-change nack
-        (vsr.zig nack protocol): an all-zero slot was never written, and a
-        slot holding a DIFFERENT decodable prepare means the requested one
-        was either never journaled here or provably superseded by a
-        canonical-at-selection-time fork (which implies the requested op
-        never committed).  Undecodable non-zero bytes could be a torn
-        write OF the requested prepare — never nack those.
-
-        BOTH rings must agree: a misdirected write can clobber the
-        prepares slot with a different valid prepare, but the redundant
-        headers ring (written last, after the body was durable) would
-        still record that we once held (op, checksum) — that is exactly
-        the disentanglement the dual-ring design exists for."""
+        (vsr.zig nack protocol, ``prepare_inhabited``): ONLY a slot that is
+        all-zero in BOTH rings qualifies.  Anything else — a torn write,
+        corruption, or even a different valid prepare — could be the
+        aftermath of once holding (and having ACKED) the requested one: a
+        misdirected write of a LATER op can land different-but-valid bytes
+        on a committed op's slot, so "holds something else" proves
+        nothing.  (Found by the storage adversary, seed 31000: two such
+        clobbers plus an offline replica truncated committed history.)"""
         slot = self.slot(op)
         lay = self.storage.layout
-        for offset, size in (
-            (lay.wal_prepares_offset + slot * self.config.message_size_max,
-             self.config.header_size),
-            (lay.wal_headers_offset + slot * self.config.header_size,
-             self.config.header_size),
+        for offset in (
+            lay.wal_prepares_offset + slot * self.config.message_size_max,
+            lay.wal_headers_offset + slot * self.config.header_size,
         ):
-            head = self.storage.read(offset, size)
+            head = self.storage.read(offset, self.config.header_size)
             if not any(head):
-                continue  # virgin ring slot: consistent with never-had
+                continue  # virgin ring slot
+            # Prior-lap content for THIS slot also proves never-had: a
+            # legitimate write of the requested (newer) op would have
+            # overwritten it, and nothing can write the OLDER op back.
+            # Without this, a wrapped ring (every slot inhabited forever)
+            # would permanently disable the nack protocol.
             try:
                 h, command = wire.decode_header(head)
             except ValueError:
                 return False  # torn/corrupt: might have been (op, checksum)
             if command != wire.Command.prepare:
                 return False
-            if int(h["op"]) == op and wire.u128(h, "checksum") == checksum:
-                return False  # this ring remembers holding it
+            if self.slot(int(h["op"])) != slot or int(h["op"]) >= op:
+                return False  # foreign (misdirect) or the op itself
         return True
 
     def recover(self) -> Recovery:
@@ -164,6 +209,7 @@ class Journal:
         headers_buf = self.storage.read(lay.wal_headers_offset, lay.wal_headers_size)
         entries: Dict[int, RecoveredEntry] = {}
         faulty: List[int] = []
+        foreign: List[int] = []
         repaired = 0
 
         for slot in range(self.slot_count):
@@ -174,7 +220,10 @@ class Journal:
             try:
                 h, command = wire.decode_header(hbuf)
                 if command == wire.Command.prepare:
-                    ring_hdr = h
+                    if self.slot(int(h["op"])) != slot:
+                        foreign.append(slot)  # misdirected-write evidence
+                    else:
+                        ring_hdr = h
             except ValueError:
                 ring_hdr = None
 
@@ -184,6 +233,9 @@ class Journal:
             # open — ~12 s of replica startup for a mostly-virgin ring.
             prepare = self._read_slot(slot)
 
+            if prepare is not None and self.slot(int(prepare[0]["op"])) != slot:
+                foreign.append(slot)  # misdirected-write evidence
+                prepare = None
             if prepare is not None:
                 ph, body = prepare
                 op = int(ph["op"])
@@ -208,4 +260,7 @@ class Journal:
 
         if repaired:
             self.storage.sync()
-        return Recovery(entries=entries, faulty_slots=faulty, repaired_headers=repaired)
+        return Recovery(
+            entries=entries, faulty_slots=faulty, repaired_headers=repaired,
+            foreign_slots=sorted(set(foreign)),
+        )

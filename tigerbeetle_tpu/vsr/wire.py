@@ -202,7 +202,13 @@ DO_VIEW_CHANGE_DTYPE = _dtype([
     ("commit", "<u8"),           # sender's commit_min
     ("checkpoint_op", "<u8"),
     ("log_view", "<u4"),         # view in which the sender's log was current
-    ("reserved", "V100"),
+    # Recovering-head marker: the sender's WAL shows an amputated suffix
+    # (headers beyond its chained head / foreign slots), so its (log_view,
+    # op) must LOSE canonical selection to any clean log — but still count
+    # toward the view-change quorum (abstaining entirely would deadlock a
+    # quorum of benignly-restarted replicas).
+    ("log_suspect", "u1"),
+    ("reserved", "V99"),
 ])
 
 START_VIEW_DTYPE = _dtype([
