@@ -165,6 +165,84 @@ class TestStartViewNonce:
         assert r.status == NORMAL
 
 
+class TestLaggingPrimaryAbdicatesToSync:
+    """Round-2 advisor (medium): a new primary whose WAL ring cannot hold
+    the canonical DVC suffix must neither install unclamped (repair fills
+    would journal past op_prepare_max, overwriting live slots) nor clamp
+    (truncating possibly-committed canonical ops).  It abdicates into state
+    sync; peers' view-change timeouts elect the next primary."""
+
+    def test_canonical_beyond_wal_bound_triggers_sync(self, tmp_path):
+        from tigerbeetle_tpu.vsr.consensus import SYNCING
+
+        r = make_replica(tmp_path, 1, n=2)  # primary of view 1
+        r.status = "view_change"
+        r.view = 1
+        bound = r.op_prepare_max
+        target = bound + 10
+        headers = []
+        for op in range(target - 3, target + 1):
+            h = wire.new_header(
+                wire.Command.prepare, cluster=CLUSTER, view=0, op=op,
+                commit=0,
+            )
+            headers.append(wire.set_checksums(h))
+        r.dvc_from[1] = {
+            0: {"log_view": 0, "op": target, "commit": 0, "headers": headers},
+            1: {"log_view": 0, "op": 0, "commit": 0, "headers": []},
+        }
+        out = r._install_canonical_log(1)
+        assert r.status == SYNCING
+        assert r.sync_target is not None
+        assert r.op <= bound, "head must not pass the WAL ring bound"
+        assert not r.missing, "no repair fills beyond op_prepare_max"
+        # The escape emits a sync-chunk request, not a start_view.
+        cmds = [wire.decode(m)[1] for _, m in out]
+        assert cmds == [wire.Command.request_sync_checkpoint]
+
+    def test_canonical_within_bound_installs_normally(self, tmp_path):
+        r = make_replica(tmp_path, 1, n=2)
+        r.status = "view_change"
+        r.view = 1
+        h = wire.new_header(
+            wire.Command.prepare, cluster=CLUSTER, view=0, op=1, commit=0,
+        )
+        r.dvc_from[1] = {
+            0: {
+                "log_view": 0, "op": 1, "commit": 0,
+                "headers": [wire.set_checksums(h)],
+            },
+            1: {"log_view": 0, "op": 0, "commit": 0, "headers": []},
+        }
+        r._install_canonical_log(1)
+        assert r.op == 1
+        assert r.sync_target is None
+
+
+class TestColdManifestPathSafety:
+    """Round-2 advisor (low): peer-supplied manifest basenames must not
+    escape the spill directory."""
+
+    def test_install_file_rejects_traversal(self, tmp_path):
+        from tigerbeetle_tpu.ops.cold import ColdStore, _checksum
+
+        store = ColdStore(str(tmp_path / "spill"))
+        blob = b"\x00" * 64
+        for evil in ("../evil", "a/b", "..", ".", ""):
+            assert not store.install_file(evil, _checksum(blob), blob)
+        assert not (tmp_path / "evil").exists()
+        assert store.install_file("run_ok.npy", _checksum(blob), blob)
+
+    def test_verify_manifest_rejects_traversal(self, tmp_path):
+        from tigerbeetle_tpu.ops.cold import ColdStore
+
+        store = ColdStore(str(tmp_path / "spill"))
+        with pytest.raises(ValueError):
+            store.verify_manifest(
+                [{"path": "../x", "rows": 0, "checksum": "0" * 32}]
+            )
+
+
 class TestBusClassificationUpgrade:
     def test_peer_after_client_first_message(self):
         """Exercise the classification logic: first message client-typed,

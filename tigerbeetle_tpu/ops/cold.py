@@ -111,6 +111,12 @@ def bloom_add_host(bloom_np: np.ndarray, id_lo: np.ndarray, id_hi: np.ndarray) -
 # ---------------------------------------------------------------------------
 
 
+def _safe_basename(name: str) -> bool:
+    """Peer-supplied manifest names must be plain basenames — anything that
+    could resolve outside the spill directory is rejected."""
+    return bool(name) and os.path.basename(name) == name and name not in (".", "..")
+
+
 class ColdStore:
     """Append-only spill of evicted transfer rows: each run is an id-sorted
     TRANSFER_DTYPE array in a .npy file (memmap-read); lookups binary-search
@@ -296,8 +302,11 @@ class ColdStore:
         can succeed (consensus cold-fetch over request_blocks)."""
         damaged = []
         for entry in manifest:
+            name = entry["path"]
+            if not _safe_basename(name):
+                raise ValueError(f"unsafe cold-run manifest path: {name!r}")
             expect = int(entry.get("checksum", "0"), 16)
-            path = os.path.join(self.directory or "", entry["path"])
+            path = os.path.join(self.directory or "", name)
             have = self._file_checksum_cached(path)
             if have is None or (expect and have != expect):
                 damaged.append((entry["path"], expect))
@@ -327,7 +336,10 @@ class ColdStore:
 
     def install_file(self, basename: str, checksum: int, blob: bytes) -> bool:
         """Write fetched cold-run bytes under the manifest's name; False on
-        a checksum mismatch (corrupt/malicious peer)."""
+        a checksum mismatch or an unsafe name (corrupt/malicious peer — a
+        path-traversing entry like '../x' must not escape the spill dir)."""
+        if not _safe_basename(basename):
+            return False
         if _checksum(blob) != checksum:
             return False
         assert self.directory, "cold install requires a directory"

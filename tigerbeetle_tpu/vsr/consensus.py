@@ -971,6 +971,19 @@ class VsrReplica(Replica):
         )
         out: List[Msg] = []
         target_op = canonical["op"]
+        if target_op > self.op_prepare_max:
+            # Our WAL ring cannot hold the canonical suffix — our checkpoint
+            # lags at least a full ring behind the cluster's head.  Neither
+            # option at this altitude is safe: installing unclamped would
+            # journal repair fills beyond the ring bound (overwriting live
+            # slots), and clamping would truncate possibly-committed
+            # canonical ops and finish the view with an invented head.  We
+            # cannot lead this view.  Fetch the cluster's latest checkpoint
+            # instead (sync handlers drop further view-change traffic while
+            # sync_target is set); peers' view-change timeouts elect the
+            # next primary meanwhile — abdication by silence, as when a
+            # syncing replica receives an SVC.
+            return self._start_full_sync()
         by_op = {int(ch["op"]): ch for ch in canonical["headers"]}
         self._install_headers(target_op, by_op)
 
@@ -1512,14 +1525,28 @@ class VsrReplica(Replica):
         and fetch the cluster's latest full snapshot (state sync)."""
         self._block_repair = None
         self.journal.recover()  # journal rings are independent of the forest
-        self.status = SYNCING
-        self.sync_target = {"checkpoint_op": 0, "total": None}  # 0 = latest
-        self.sync_buffer = bytearray()
-        self._sync_peer = self._next_peer(self.replica)
-        self._last_sync_req = self._ticks
-        return self._request_sync_chunk()
+        return self._start_full_sync()
 
     # -- state sync (vsr/sync.zig) --------------------------------------------
+
+    def _start_full_sync(self) -> List[Msg]:
+        """Enter state sync targeting the cluster's LATEST checkpoint
+        (checkpoint_op 0 = whatever the responder has).  Single entry point
+        for every full-sync trigger — block-repair fallback, lagging-primary
+        abdication, hostile-manifest restart — so sync-entry invariants
+        (abandoning a pending view finish, resetting the fetch buffer) hold
+        on every path."""
+        # A half-finished view change must not be resumable after the sync
+        # installs: _finish_view_change(stale view) would regress self.view.
+        self._new_view_pending = None
+        self.status = SYNCING
+        self.sync_target = {"checkpoint_op": 0, "total": None}
+        self.sync_buffer = bytearray()
+        self._sync_peer = self._next_peer(
+            self._sync_peer if self._sync_peer is not None else self.replica
+        )
+        self._last_sync_req = self._ticks
+        return self._request_sync_chunk()
 
     def _maybe_start_sync(self, primary_checkpoint_op: int) -> List[Msg]:
         """If the primary's checkpoint is beyond our journal *head*, our WAL
@@ -1679,7 +1706,15 @@ class VsrReplica(Replica):
         # install can complete (re-entered once the fetch drains).
         cold_manifest = meta["machine"].get("cold_manifest", [])
         if cold_manifest and self.machine.cold.directory:
-            damage = self.machine.cold.verify_manifest(cold_manifest)
+            try:
+                damage = self.machine.cold.verify_manifest(cold_manifest)
+            except ValueError:
+                # Malicious/corrupt manifest (path-traversing entry): restart
+                # the sync at whatever-is-latest from the NEXT responder.
+                # Re-pinning the hostile peer's checkpoint_op would drop
+                # every honest responder's reply (they serve only their own
+                # checkpoint) and livelock the fetch.
+                return self._start_full_sync()
             if damage:
                 self._cold_fetch = {
                     "queue": damage,        # [(basename, checksum), ...]
@@ -1735,6 +1770,9 @@ class VsrReplica(Replica):
         self.sync_target = None
         self.sync_buffer = bytearray()
         self._sync_peer = None
+        # Any view finish deferred before the sync refers to pre-snapshot
+        # state; resuming it would regress the view.  Rejoin fresh.
+        self._new_view_pending = None
         self.status = RECOVERING
         self._recovering_since = self._ticks
         return self._request_start_view(self.view)

@@ -203,10 +203,13 @@ DO_VIEW_CHANGE_DTYPE = _dtype([
     ("checkpoint_op", "<u8"),
     ("log_view", "<u4"),         # view in which the sender's log was current
     # Recovering-head marker: the sender's WAL shows an amputated suffix
-    # (headers beyond its chained head / foreign slots), so its (log_view,
-    # op) must LOSE canonical selection to any clean log — but still count
-    # toward the view-change quorum (abstaining entirely would deadlock a
-    # quorum of benignly-restarted replicas).
+    # (headers beyond its chained head / foreign slots).  Suspect replicas
+    # fully abstain from the view change — they neither donate a log nor
+    # count toward the DVC quorum (consensus._maybe_send_dvc) — matching
+    # the reference's status.recovering_head.  The predicate is narrow
+    # (amputation *evidence*, not any crash), so benign restarts still
+    # vote; a cluster with a view-change quorum of simultaneously-suspect
+    # replicas requires operator intervention, as in the reference.
     ("log_suspect", "u1"),
     ("reserved", "V99"),
 ])
