@@ -1,0 +1,363 @@
+"""Differential tests for the native host data-plane engine.
+
+Three layers of evidence (mirroring the device kernels' own test strategy):
+1. engine vs scalar oracle (testing/model.py) — code-for-code and
+   balance-for-balance on the same randomized mixed workloads the vectorized
+   kernel is tested with (tests/test_transfer_full.py).
+2. engine vs DEVICE EXECUTOR — the same batches committed through both
+   executors must produce bit-identical ledgers (same slots, same bytes):
+   the engine shares ops/hash_table.py's probe discipline, so digests match.
+3. conversion round-trip — HostLedger -> device Ledger -> HostLedger is
+   lossless.
+
+Reference analogue: src/testing/state_machine.zig (a second implementation
+exists precisely to be diffed against).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LedgerConfig
+from tigerbeetle_tpu.host_engine import engine_available
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.testing import model as M
+
+from tests.test_transfer_full import CFG, run_batch, transfers_array
+
+pytestmark = pytest.mark.skipif(
+    not engine_available(), reason="native engine not built (no toolchain)"
+)
+
+
+def make_host_pair(n_accounts=16, history=(), limits=()):
+    dev = TpuStateMachine(CFG, batch_lanes=256, host_engine=True)
+    ref = M.ReferenceStateMachine()
+    rows = []
+    for i in range(n_accounts):
+        flags = 0
+        if i in history:
+            flags |= types.AccountFlags.HISTORY
+        if i in limits:
+            flags |= types.AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+        rows.append(types.account(id=i + 1, ledger=1, code=10, flags=flags))
+    accounts = types.accounts_array(rows)
+    got = dev.create_accounts(accounts, wall_clock_ns=1)
+    want = ref.create_accounts([M.account_from_row(r) for r in accounts], 1)
+    assert got == want
+    return dev, ref
+
+
+class TestEngineVsOracle:
+    def test_validation_ladder(self):
+        dev, ref = make_host_pair()
+        run_batch(dev, ref, transfers_array([
+            dict(id=0, debit_account_id=1, credit_account_id=2, amount=1,
+                 ledger=1, code=1),                       # id zero
+            dict(id=10, debit_account_id=1, credit_account_id=1, amount=1,
+                 ledger=1, code=1),                       # same accounts
+            dict(id=11, debit_account_id=1, credit_account_id=99, amount=1,
+                 ledger=1, code=1),                       # missing credit
+            dict(id=12, debit_account_id=1, credit_account_id=2, amount=0,
+                 ledger=1, code=1),                       # zero amount
+            dict(id=13, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=2, code=1),                       # wrong ledger
+            dict(id=14, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=0),                       # zero code
+            dict(id=15, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, timeout=9),            # timeout w/o pending
+            dict(id=16, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1),                       # ok
+            dict(id=16, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1),                       # exists
+            dict(id=16, debit_account_id=1, credit_account_id=2, amount=6,
+                 ledger=1, code=1),                       # different amount
+        ]))
+
+    def test_two_phase_flow(self):
+        dev, ref = make_host_pair()
+        run_batch(dev, ref, transfers_array([
+            dict(id=100 + i, debit_account_id=1 + i % 8,
+                 credit_account_id=9 + i % 8, amount=10 + i, ledger=1, code=1,
+                 flags=types.TransferFlags.PENDING, timeout=3600)
+            for i in range(32)
+        ]))
+        run_batch(dev, ref, transfers_array(
+            [dict(id=200 + i, pending_id=100 + i, ledger=1, code=1,
+                  flags=types.TransferFlags.POST_PENDING_TRANSFER)
+             for i in range(16)]
+            + [dict(id=300 + i, pending_id=116 + i,
+                    flags=types.TransferFlags.VOID_PENDING_TRANSFER)
+               for i in range(8)]
+            + [dict(id=400, pending_id=100,     # already posted
+                    flags=types.TransferFlags.POST_PENDING_TRANSFER)]
+            + [dict(id=401, pending_id=116,     # already voided
+                    flags=types.TransferFlags.VOID_PENDING_TRANSFER)]
+        ))
+
+    def test_linked_chains_rollback(self):
+        dev, ref = make_host_pair()
+        L = types.TransferFlags.LINKED
+        run_batch(dev, ref, transfers_array([
+            # chain that fails mid-way: all roll back
+            dict(id=500, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, flags=L),
+            dict(id=501, debit_account_id=2, credit_account_id=3, amount=5,
+                 ledger=1, code=1, flags=L),
+            dict(id=502, debit_account_id=1, credit_account_id=1, amount=5,
+                 ledger=1, code=1),  # fails (same accounts), breaks chain
+            # chain that succeeds
+            dict(id=510, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, flags=L),
+            dict(id=511, debit_account_id=2, credit_account_id=3, amount=5,
+                 ledger=1, code=1),
+            # rolled-back id is insertable afterwards
+            dict(id=500, debit_account_id=3, credit_account_id=4, amount=7,
+                 ledger=1, code=1),
+        ]))
+
+    def test_chain_open_at_batch_end(self):
+        dev, ref = make_host_pair()
+        L = types.TransferFlags.LINKED
+        run_batch(dev, ref, transfers_array([
+            dict(id=600, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, flags=L),
+            dict(id=601, debit_account_id=2, credit_account_id=3, amount=5,
+                 ledger=1, code=1, flags=L),
+        ]))
+
+    def test_balancing_and_limits(self):
+        dev, ref = make_host_pair(limits=(0,))
+        B = types.TransferFlags
+        # Fund account 1 (credits) so balancing-debit has room.
+        run_batch(dev, ref, transfers_array([
+            dict(id=700, debit_account_id=2, credit_account_id=1, amount=100,
+                 ledger=1, code=1),
+        ]))
+        run_batch(dev, ref, transfers_array([
+            # balancing debit clamps to the remaining credit room
+            dict(id=701, debit_account_id=1, credit_account_id=3, amount=250,
+                 ledger=1, code=1, flags=B.BALANCING_DEBIT),
+            # now exhausted: exceeds_credits
+            dict(id=702, debit_account_id=1, credit_account_id=3, amount=10,
+                 ledger=1, code=1, flags=B.BALANCING_DEBIT),
+            # limit account: plain debit beyond credits fails
+            dict(id=703, debit_account_id=1, credit_account_id=3, amount=10,
+                 ledger=1, code=1),
+            dict(id=704, debit_account_id=3, credit_account_id=4, amount=10,
+                 ledger=1, code=1, flags=B.BALANCING_CREDIT),
+        ]))
+
+    def test_history_accounts(self):
+        dev, ref = make_host_pair(history=(0, 3))
+        run_batch(dev, ref, transfers_array([
+            dict(id=800 + i, debit_account_id=1 + (i % 4),
+                 credit_account_id=5 + (i % 4), amount=3 + i, ledger=1, code=1)
+            for i in range(24)
+        ]))
+        assert dev._host_led.history_count == int(dev.ledger.history.count)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_two_phase_stream(self, seed):
+        rng = np.random.default_rng(seed)
+        dev, ref = make_host_pair(
+            n_accounts=12,
+            history=(0,) if seed % 3 == 0 else (),
+            limits=(11,) if seed % 4 == 0 else (),
+        )
+        next_id = 2000
+        live_pending: list = []
+        for _batch in range(6):
+            specs = []
+            for _ in range(int(rng.integers(20, 60))):
+                kind = rng.random()
+                if kind < 0.40 or not live_pending:
+                    dr = int(rng.integers(1, 13))
+                    cr = dr % 12 + 1
+                    flags = 0
+                    r = rng.random()
+                    if r < 0.4:
+                        flags = types.TransferFlags.PENDING
+                    elif r < 0.5:
+                        flags = types.TransferFlags.LINKED
+                    specs.append(dict(
+                        id=next_id, debit_account_id=dr, credit_account_id=cr,
+                        amount=int(rng.integers(0, 100)), ledger=1, code=1,
+                        timeout=int(rng.integers(0, 3))
+                        if flags == types.TransferFlags.PENDING else 0,
+                        flags=flags,
+                    ))
+                    if flags == types.TransferFlags.PENDING:
+                        live_pending.append(next_id)
+                    next_id += 1
+                else:
+                    pid = int(rng.choice(live_pending))
+                    if rng.random() < 0.3:
+                        live_pending.remove(pid)
+                    flags = (
+                        types.TransferFlags.POST_PENDING_TRANSFER
+                        if rng.random() < 0.6
+                        else types.TransferFlags.VOID_PENDING_TRANSFER
+                    )
+                    amount = 0 if rng.random() < 0.7 else int(rng.integers(1, 120))
+                    specs.append(dict(
+                        id=next_id, pending_id=pid, amount=amount,
+                        ledger=1, code=1, flags=flags,
+                    ))
+                    next_id += 1
+            if len(specs) > 4 and rng.random() < 0.6:
+                specs.insert(
+                    int(rng.integers(1, len(specs))),
+                    dict(specs[int(rng.integers(0, len(specs) - 1))]),
+                )
+            run_batch(dev, ref, transfers_array(specs))
+
+
+class TestCrossExecutorParity:
+    """The same batches through the device kernels and the host engine must
+    produce BIT-IDENTICAL ledgers (shared probe discipline => same slots)."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_digest_parity(self, seed):
+        rng = np.random.default_rng(300 + seed)
+        dev = TpuStateMachine(CFG, batch_lanes=256)
+        host = TpuStateMachine(CFG, batch_lanes=256, host_engine=True)
+        accounts = types.accounts_array([
+            types.account(
+                id=i + 1, ledger=1, code=10,
+                flags=types.AccountFlags.HISTORY if i == 0 and seed % 2 else 0,
+            )
+            for i in range(12)
+        ])
+        assert dev.create_accounts(accounts, 1) == host.create_accounts(accounts, 1)
+        next_id = 9000
+        pendings = []
+        for _ in range(4):
+            specs = []
+            for _ in range(int(rng.integers(15, 40))):
+                if pendings and rng.random() < 0.3:
+                    pid = int(rng.choice(pendings))
+                    specs.append(dict(
+                        id=next_id, pending_id=pid, ledger=1, code=1,
+                        flags=types.TransferFlags.POST_PENDING_TRANSFER
+                        if rng.random() < 0.5
+                        else types.TransferFlags.VOID_PENDING_TRANSFER,
+                    ))
+                else:
+                    dr = int(rng.integers(1, 13))
+                    flags = (
+                        types.TransferFlags.PENDING
+                        if rng.random() < 0.4 else 0
+                    )
+                    specs.append(dict(
+                        id=next_id, debit_account_id=dr,
+                        credit_account_id=dr % 12 + 1,
+                        amount=int(rng.integers(1, 90)), ledger=1, code=1,
+                        flags=flags,
+                    ))
+                    if flags:
+                        pendings.append(next_id)
+                next_id += 1
+            batch = transfers_array(specs)
+            assert dev.create_transfers(batch) == host.create_transfers(batch)
+        assert dev.digest() == host.digest(), "slot-level divergence"
+        assert dev.balances_snapshot() == host.balances_snapshot()
+
+    def test_conversion_round_trip(self):
+        from tigerbeetle_tpu.host_engine import HostLedger
+
+        host = TpuStateMachine(CFG, batch_lanes=256, host_engine=True)
+        accounts = types.accounts_array(
+            [types.account(id=i + 1, ledger=1, code=10) for i in range(8)]
+        )
+        host.create_accounts(accounts, 1)
+        host.create_transfers(transfers_array([
+            dict(id=50 + i, debit_account_id=1 + i % 8,
+                 credit_account_id=(1 + i) % 8 + 1, amount=2 + i,
+                 ledger=1, code=1)
+            for i in range(40)
+        ]))
+        d1 = host.digest()
+        led2 = HostLedger.from_device(host.ledger).to_device()
+        import tigerbeetle_tpu.ops.state_machine as sm
+
+        assert int(sm.ledger_digest(led2)) == d1
+
+    def test_lookup_parity(self):
+        dev = TpuStateMachine(CFG, batch_lanes=256)
+        host = TpuStateMachine(CFG, batch_lanes=256, host_engine=True)
+        accounts = types.accounts_array(
+            [types.account(id=i + 1, ledger=1, code=10) for i in range(8)]
+        )
+        dev.create_accounts(accounts, 1)
+        host.create_accounts(accounts, 1)
+        batch = transfers_array([
+            dict(id=70 + i, debit_account_id=1 + i % 8,
+                 credit_account_id=(1 + i) % 8 + 1, amount=2 + i,
+                 ledger=1, code=1, flags=types.TransferFlags.PENDING)
+            for i in range(16)
+        ])
+        dev.create_transfers(batch)
+        host.create_transfers(batch)
+        ids = [71, 999, 75, 70]
+        assert dev.lookup_transfers(ids).tobytes() == (
+            host.lookup_transfers(ids).tobytes()
+        )
+        assert dev.lookup_accounts([1, 5, 42]).tobytes() == (
+            host.lookup_accounts([1, 5, 42]).tobytes()
+        )
+
+
+class TestGrowthAndQueries:
+    def test_growth_under_pressure(self):
+        cfg = LedgerConfig(
+            accounts_capacity_log2=6, transfers_capacity_log2=7,
+            posted_capacity_log2=6,
+        )
+        host = TpuStateMachine(cfg, batch_lanes=512, host_engine=True)
+        ref = M.ReferenceStateMachine()
+        accounts = types.accounts_array(
+            [types.account(id=i + 1, ledger=1, code=10) for i in range(16)]
+        )
+        host.create_accounts(accounts, 1)
+        ref.create_accounts([M.account_from_row(r) for r in accounts], 1)
+        for b in range(4):
+            batch = transfers_array([
+                dict(id=10_000 + b * 128 + i, debit_account_id=1 + i % 16,
+                     credit_account_id=(1 + i) % 16 + 1, amount=1 + i,
+                     ledger=1, code=1,
+                     flags=types.TransferFlags.PENDING if i % 3 == 0 else 0)
+                for i in range(128)
+            ])
+            got = host.create_transfers(batch)
+            want = ref.create_transfers(
+                [M.transfer_from_row(r) for r in batch]
+            )
+            assert got == want
+        assert host._host_led.transfers.capacity > 1 << 7, "growth happened"
+        assert host.balances_snapshot() == ref.balances_snapshot()
+
+    def test_get_account_transfers_after_engine_commits(self):
+        host = TpuStateMachine(CFG, batch_lanes=256, host_engine=True)
+        dev = TpuStateMachine(CFG, batch_lanes=256)
+        accounts = types.accounts_array(
+            [types.account(id=i + 1, ledger=1, code=10) for i in range(4)]
+        )
+        host.create_accounts(accounts, 1)
+        dev.create_accounts(accounts, 1)
+        batch = transfers_array([
+            dict(id=80 + i, debit_account_id=1, credit_account_id=2 + i % 3,
+                 amount=5 + i, ledger=1, code=1)
+            for i in range(20)
+        ])
+        host.create_transfers(batch)
+        dev.create_transfers(batch)
+        filt = np.zeros((), dtype=types.ACCOUNT_FILTER_DTYPE)
+        filt["account_id_lo"] = 1
+        filt["limit"] = 100
+        filt["flags"] = (
+            types.AccountFilterFlags.DEBITS | types.AccountFilterFlags.CREDITS
+        )
+        assert host.get_account_transfers(filt).tobytes() == (
+            dev.get_account_transfers(filt).tobytes()
+        )

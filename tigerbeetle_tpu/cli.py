@@ -68,6 +68,9 @@ def main(argv=None) -> int:
                          help="cap the device-resident transfers window at "
                               "2^N slots; older transfers spill to a cold "
                               "host store (BASELINE config 4 tiering)")
+    p_start.add_argument("--no-engine", action="store_true",
+                         help="force the device-kernel commit path even "
+                              "when the native host engine is available")
 
     p_version = sub.add_parser("version")
     p_version.add_argument("--verbose", action="store_true")
@@ -258,9 +261,19 @@ def _cmd_start(args) -> int:
         1 << args.hot_transfers_log2_max
         if args.hot_transfers_log2_max is not None else None
     )
+    # Solo-server data plane: commits run in the native host engine when it
+    # builds (host_engine.py) — the latency-bound OLTP path doesn't round-
+    # trip the (possibly remote) accelerator per batch.  Tiering keeps the
+    # device path (the hot/cold window lives in device memory); --no-engine
+    # forces it for debugging.
+    from .host_engine import engine_available
+
+    use_engine = (
+        engine_available() and hot_max is None and not args.no_engine
+    )
     replica = Replica(args.path, ledger_config=ledger_config,
                       aof_path=args.aof, hot_transfers_capacity_max=hot_max,
-                      process_config=process_config)
+                      process_config=process_config, host_engine=use_engine)
     replica.open()
     if replica.replica_count != 1:
         # A multi-replica data file must never be served solo: commits
@@ -416,6 +429,9 @@ def _spawn_temp_replica(cluster: int):
     from .net.bus import run_server
     from .vsr.replica import Replica
 
+    from .config import ProcessConfig
+    from .host_engine import engine_available
+
     tmp = tempfile.mkdtemp(prefix="tb_bench_")
     path = os.path.join(tmp, "bench.tb")
     Replica.format(path, cluster=cluster)
@@ -425,6 +441,8 @@ def _spawn_temp_replica(cluster: int):
             accounts_capacity_log2=21, transfers_capacity_log2=23,
             posted_capacity_log2=16,
         ),
+        host_engine=engine_available(),
+        process_config=ProcessConfig(direct_io=True),
     )
     replica.open()
 

@@ -138,8 +138,27 @@ def current_platform() -> Optional[str]:
         return None
 
 
+def _reexec_argv() -> List[str]:
+    """argv for re-exec'ing this interpreter with the same program.
+
+    Launched via ``python -m mod``: argv[0] is the module FILE, which cannot
+    be re-run as a plain script (relative imports lose their package) —
+    re-exec with -m and the original name.  (spec.name == "__main__" means
+    zipapp/directory execution — argv already re-runs correctly as-is.)
+    """
+    argv = sys.argv
+    spec = getattr(sys.modules.get("__main__"), "__spec__", None)
+    if spec is not None and spec.name and spec.name != "__main__":
+        mod = spec.name
+        if mod.endswith(".__main__"):
+            mod = mod[: -len(".__main__")]
+        argv = ["-m", mod] + argv[1:]
+    return list(argv)
+
+
 def ensure_backend(
-    timeout_s: float = 240.0, announce=print, reexec: bool = True
+    timeout_s: float = 240.0, announce=print, reexec: bool = True,
+    retry_tpu: bool = False,
 ) -> str:
     """Initialize the default backend (accelerator if the env provides one),
     falling back to CPU loudly on failure or hang.  Returns the platform name.
@@ -149,7 +168,16 @@ def ensure_backend(
     recover in-process (the init thread holds jax's backend lock), so we
     re-exec the interpreter with a scrubbed environment: the sitecustomize
     relay dial is skipped and ``JAX_PLATFORMS=cpu`` pins the fallback.
+
+    ``retry_tpu``: give the accelerator ONE more chance before the CPU
+    fallback — the first hang re-execs with the tunnel env intact (a relay
+    dial racing interpreter start is transient more often than not), the
+    second re-execs to CPU as usual.  Benchmarks opt in (a TPU number is
+    worth one extra watchdog window); servers and tests do not.
     """
+    if os.environ.get("TB_TPU_RETRY"):
+        # Second attempt after a hang: don't spend another full window.
+        timeout_s = min(timeout_s, 120.0)
     result: dict = {}
 
     def probe():
@@ -198,25 +226,22 @@ def ensure_backend(
             )
         if os.environ.get("TB_TPU_REEXEC"):
             raise RuntimeError("backend init hung twice; giving up")
+        if retry_tpu and not os.environ.get("TB_TPU_RETRY"):
+            announce(
+                f"# backend init hung >{timeout_s:.0f}s; retrying the "
+                "accelerator once before CPU fallback",
+                file=sys.stderr,
+            )
+            env = dict(os.environ)  # tunnel env INTACT: retry the dial
+            env["TB_TPU_RETRY"] = "1"
+            os.execve(sys.executable, [sys.executable] + _reexec_argv(), env)
         announce(
             f"# backend init hung >{timeout_s:.0f}s; re-exec on CPU",
             file=sys.stderr,
         )
         env = child_env(cpu=True)
         env["TB_TPU_REEXEC"] = "1"
-        argv = sys.argv
-        spec = getattr(sys.modules.get("__main__"), "__spec__", None)
-        if spec is not None and spec.name and spec.name != "__main__":
-            # (spec.name == "__main__" means zipapp/directory execution —
-            # argv already re-runs correctly as-is.)
-            # Launched via ``python -m mod``: argv[0] is the module FILE,
-            # which cannot be re-run as a plain script (relative imports
-            # lose their package) — re-exec with -m and the original name.
-            mod = spec.name
-            if mod.endswith(".__main__"):
-                mod = mod[: -len(".__main__")]
-            argv = ["-m", mod] + argv[1:]
-        os.execve(sys.executable, [sys.executable] + argv, env)
+        os.execve(sys.executable, [sys.executable] + _reexec_argv(), env)
     if "error" in result:
         announce(
             f"# accelerator init failed ({type(result['error']).__name__}: "

@@ -53,17 +53,43 @@ class TpuStateMachine:
         force_sequential: bool = False,
         spill_dir: Optional[str] = None,
         hot_transfers_capacity_max: Optional[int] = None,
+        host_engine: bool = False,
     ) -> None:
         cfg = ledger_config or LedgerConfig()
         self.config = cfg
         self.batch_lanes = batch_lanes
         self.force_sequential = force_sequential
-        self.ledger = sm.make_ledger(
-            cfg.accounts_capacity,
-            cfg.transfers_capacity,
-            cfg.posted_capacity,
-            cfg.history_capacity,
-        )
+        # Host data-plane mode (host_engine.py): commits run in the native
+        # engine over a numpy mirror; the device ledger is materialized
+        # lazily for queries/checkpoints/digests.  The mirror is the
+        # authority between materializations.
+        self._engine = None
+        self._host_led = None
+        self._device_stale = False
+        self._index_stale = False
+        if host_engine:
+            from .host_engine import HostEngine, HostLedger
+
+            assert not force_sequential, (
+                "host engine is already sequential-exact"
+            )
+            assert hot_transfers_capacity_max is None, (
+                "tiering runs on the device path"
+            )
+            self._host_led = HostLedger(
+                cfg.accounts_capacity, cfg.transfers_capacity,
+                cfg.posted_capacity, cfg.history_capacity,
+            )
+            self._engine = HostEngine(self._host_led, cfg.max_probe)
+            self._device_stale = True
+            self._ledger = None
+        else:
+            self._ledger = sm.make_ledger(
+                cfg.accounts_capacity,
+                cfg.transfers_capacity,
+                cfg.posted_capacity,
+                cfg.history_capacity,
+            )
         self.prepare_timestamp = 0
         self.commit_timestamp = 0
         # Host-side upper bounds on live rows (for growth decisions without
@@ -107,11 +133,97 @@ class TpuStateMachine:
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
             self._bloom_dev = make_bloom(self._bloom_log2)
 
+    # -- host-engine mode (host_engine.py) -----------------------------------
+
+    @property
+    def ledger(self):
+        """The device (jnp) ledger.  In host-engine mode the numpy mirror is
+        the authority; the device view is materialized on first access after
+        engine commits (queries, checkpoints, digests, sharding)."""
+        if self._engine is not None and self._device_stale:
+            self._ledger = self._host_led.to_device()
+            self._device_stale = False
+        return self._ledger
+
+    @ledger.setter
+    def ledger(self, value) -> None:
+        self._ledger = value
+        if getattr(self, "_engine", None) is not None:
+            # External ledger swap (checkpoint restore, state sync): refresh
+            # the host mirror — it must mirror the new authority exactly.
+            from .host_engine import HostLedger
+
+            self._host_led = HostLedger.from_device(value)
+            self._engine.ledger = self._host_led
+            self._device_stale = False
+
+    def _engine_grow(
+        self, accounts: int = 0, transfers: int = 0, posted: int = 0,
+        history: int = 0,
+    ) -> None:
+        """Load-factor management for the host tables (mirror of
+        _grow_if_needed, same <= 0.5 policy, same host-side bounds)."""
+        led = self._host_led
+        for which, need in (
+            ("accounts", self._accounts_bound + accounts),
+            ("transfers", self._transfers_bound + transfers),
+            ("posted", self._posted_bound + posted),
+        ):
+            cap = self._target_capacity(getattr(led, which).capacity, need)
+            if cap != getattr(led, which).capacity:
+                self._engine.grow(which, cap)
+        if history and self._history_bound + history > led.history_capacity:
+            led.grow_history(self._history_bound + history)
+
+    def _engine_commit(
+        self, operation: str, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
+        count = len(batch)
+        if count == 0:
+            return []
+        # Invalidate derived views BEFORE dispatching: a partial application
+        # (EngineError after some events applied) must not leave queries
+        # serving the pre-commit device ledger.
+        self._device_stale = True
+        self._index_stale = True
+        if operation == "create_accounts":
+            if bool((batch["flags"] & types.AccountFlags.HISTORY).any()):
+                self._history_accounts_possible = True
+            self._engine_grow(accounts=count)
+            codes = self._engine.create_accounts(batch, timestamp)
+            self._accounts_bound += count
+        else:
+            pv_count, hist_count = self._transfer_growth_counts(batch)
+            self._engine_grow(
+                transfers=count, posted=pv_count, history=hist_count
+            )
+            codes = self._engine.create_transfers(batch, timestamp)
+            self._transfers_bound += count
+            self._posted_bound += pv_count
+            self._history_bound += hist_count
+        results = self._compress(codes, count)
+        self._update_commit_timestamp(codes, count, timestamp)
+        return results
+
+    def _index_fresh(self) -> None:
+        """Engine commits bypass the per-batch index append; rebuild the
+        derived index from the (refreshed) ledger before serving a query."""
+        if self._engine is not None and self._index_stale:
+            self.index.reset()
+            self._index_stale = False
+
     def warmup(self) -> None:
         """Force-compile the hot commit kernels with zero-count batches so
         the first client request doesn't pay tens of seconds of jit latency
         (the CLI calls this before announcing ``listening``).  The kernels
-        are functional — results are discarded, state is untouched."""
+        are functional — results are discarded, state is untouched.
+
+        In host-engine mode there is nothing to compile; instead pre-fault
+        the numpy tables (lazily-mapped pages would otherwise fault inside
+        the serving hot loop)."""
+        if self._engine is not None:
+            self._host_led.prefault()
+            return
         from .ops import transfer_full as tf
 
         # The kernels donate the ledger buffers: adopt the returned ledger
@@ -192,6 +304,8 @@ class TpuStateMachine:
         count = len(batch)
         if count == 0:
             return []
+        if self._engine is not None:
+            return self._engine_commit("create_accounts", batch, timestamp)
 
         any_linked = bool((batch["flags"] & types.AccountFlags.LINKED).any())
         if self.force_sequential or (
@@ -230,6 +344,8 @@ class TpuStateMachine:
         count = len(batch)
         if count == 0:
             return []
+        if self._engine is not None:
+            return self._engine_commit("create_transfers", batch, timestamp)
 
         if self.force_sequential:
             return self._sequential("create_transfers", batch, timestamp)
@@ -356,6 +472,7 @@ class TpuStateMachine:
         Returns the number of rows evicted."""
         from .ops import cold as cold_mod
 
+        assert self._engine is None, "tiering runs on the device path"
         if not self._tiering:
             self._tiering = True
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
@@ -543,6 +660,8 @@ class TpuStateMachine:
         state_machine.zig:1091-1107)."""
         if not ids:
             return np.zeros(0, dtype=types.ACCOUNT_DTYPE)
+        if self._engine is not None:
+            return self._engine.lookup_accounts(ids)
         lo = jnp.asarray([i & U64_MAX for i in ids], jnp.uint64)
         hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
         found, cols = sm.lookup_accounts(self.ledger, lo, hi)
@@ -555,6 +674,9 @@ class TpuStateMachine:
     def lookup_transfers(self, ids: List[int]) -> np.ndarray:
         if not ids:
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        if self._engine is not None:
+            found, rows = self._engine.lookup_transfers(ids)
+            return rows[found]  # no cold tier in host mode
         lo = jnp.asarray([i & U64_MAX for i in ids], jnp.uint64)
         hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
         found, cols = sm.lookup_transfers(self.ledger, lo, hi)
@@ -622,6 +744,7 @@ class TpuStateMachine:
         window = self._filter_window(filt)
         if window is None:
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        self._index_fresh()
         acct_lo, acct_hi, ts_min, ts_max, descending, limit = window
         flags = int(filt["flags"])
         # Static candidate cap: the next power of two covering the largest
@@ -760,6 +883,7 @@ class TpuStateMachine:
         # The ledger was just swapped underneath us (restart or state sync):
         # the derived index no longer matches and rebuilds on next use.
         self.index.reset()
+        self._index_stale = False
 
     # -- parity surface ------------------------------------------------------
 

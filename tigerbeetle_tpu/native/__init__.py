@@ -15,7 +15,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["aegis.cpp", "tb_client.cpp"]
+_SOURCES = ["aegis.cpp", "tb_client.cpp", "engine.cpp"]
 _HEADERS = ["tb_types.h", "tb_client.h"]
 _LIB_PATH = os.path.join(_DIR, "libtb.so")
 
@@ -87,6 +87,26 @@ def load():
             lib.tb_client_submit.restype = None
             lib.tb_client_deinit.argtypes = [ctypes.c_void_p]
             lib.tb_client_deinit.restype = None
+            # Host data-plane engine (engine.cpp); the view struct is bound
+            # in ../host_engine.py.
+            for fn in ("tb_engine_create_accounts", "tb_engine_create_transfers"):
+                f = getattr(lib, fn)
+                f.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                    ctypes.c_uint64, ctypes.c_void_p,
+                ]
+                f.restype = ctypes.c_int
+            for fn in ("tb_engine_lookup_accounts", "tb_engine_lookup_transfers"):
+                f = getattr(lib, fn)
+                f.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                    ctypes.c_void_p, ctypes.c_void_p,
+                ]
+                f.restype = ctypes.c_int
+            lib.tb_engine_rehash.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ]
+            lib.tb_engine_rehash.restype = ctypes.c_int
             _lib = lib
         except Exception:
             _build_failed = True

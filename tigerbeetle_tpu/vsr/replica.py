@@ -84,6 +84,7 @@ class Replica:
         hash_log=None,
         hot_transfers_capacity_max: Optional[int] = None,
         process_config=None,
+        host_engine: bool = False,
     ) -> None:
         self.data_path = data_path
         # Optional determinism oracle (utils/hash_log.OpHashLog): per-commit
@@ -124,6 +125,10 @@ class Replica:
             # reload a checkpoint whose cold_manifest references the spill.
             spill_dir=data_path + ".cold",
             hot_transfers_capacity_max=hot_transfers_capacity_max,
+            # Native host data plane (host_engine.py): the solo-server OLTP
+            # entry points opt in; sim/cluster replicas stay on the device
+            # path (per-commit digests + tiering live there).
+            host_engine=host_engine,
         )
 
         self.cluster = 0
@@ -137,6 +142,7 @@ class Replica:
         # checkpoint()).  _ckpt_thread holds the in-flight background write;
         # _ckpt_result its finished SuperBlockState until adopted.
         self.async_checkpoint = False
+        self._last_group_fsync = None  # latest group-commit WAL barrier
         self._ckpt_thread = None
         # (SuperBlockState, cold_garbage) of a finished background write.
         self._ckpt_result = None
@@ -354,6 +360,88 @@ class Replica:
         if self._checkpoint_due():
             self.checkpoint()
         return out
+
+    def on_request_group(
+        self, requests: List[Tuple[np.ndarray, bytes]]
+    ) -> List[List[bytes]]:
+        """Group commit: journal every admitted request, ONE fsync for the
+        group (overlapped with execution), replies withheld until both land.
+        Blocking variant of on_request_group_pipelined."""
+        out, fsync = self.on_request_group_pipelined(requests)
+        if fsync is not None:
+            fsync.result()
+        return out
+
+    def on_request_group_pipelined(self, requests):
+        """Group commit with the durability barrier EXPOSED: returns
+        (replies, fsync_future_or_None).  Replies must not be released to
+        clients until the future resolves — but the caller may start the
+        next group immediately, so a slow fsync (shared-disk latency spikes)
+        costs bandwidth, never pipeline stalls.
+
+        The reference's single-threaded data plane has the same shape:
+        io_uring submission batching (src/io/linux.zig:33-110) keeps N
+        prepares in flight sharing barriers, with replies gated on
+        completion (replica.zig commit pipeline).  Reply lists are
+        index-aligned with the input (empty list = dropped, client
+        retries)."""
+        out: List[List[bytes]] = [[] for _ in requests]
+        admitted: List[Tuple[int, np.ndarray, bytes]] = []
+        self._checkpoint_poll()
+        for i, (header, body) in enumerate(requests):
+            client = wire.u128(header, "client")
+            try:
+                operation = wire.Operation(int(header["operation"]))
+                self._validate_request(operation, body)
+            except (ValueError, InvalidRequest):
+                continue
+            request_n = int(header["request"])
+            session = self.sessions.get(client)
+            if operation != wire.Operation.register:
+                if session is None or int(header["session"]) != session.session:
+                    out[i] = [self._eviction(client)]
+                    continue
+                if request_n == session.request and session.reply_bytes:
+                    out[i] = [session.reply_bytes]
+                    continue
+                if request_n < session.request:
+                    continue
+                # A client pipelining into the same group twice (protocol
+                # violation: one in-flight request per session) would race
+                # its own session state; only the first is admitted.
+                if any(
+                    wire.u128(h, "client") == client for _, h, _ in admitted
+                ):
+                    continue
+            elif session is not None:
+                if session.reply_bytes:
+                    out[i] = [session.reply_bytes]
+                continue
+            if self.op + 1 > self.op_prepare_max:
+                continue  # WAL full: drop, client retries
+            prepare_h, prepare_body = self._prepare(
+                header, body, operation, sync=False
+            )
+            admitted.append((i, prepare_h, prepare_body))
+        if not admitted:
+            # No new commits — but duplicate-resend replies above may belong
+            # to a group whose fsync is still in flight; gate them on the
+            # latest barrier (>= their own group's, the IO pool is FIFO) so
+            # a reconnecting client cannot observe a reply ahead of its
+            # durability.
+            last = self._last_group_fsync
+            if last is not None and not last.done():
+                return out, last
+            return out, None
+        fsync = self._io_pool_submit(self.journal.sync)
+        self._last_group_fsync = fsync
+        for i, prepare_h, prepare_body in admitted:
+            reply = self._commit_prepare(prepare_h, prepare_body, replay=False)
+            assert reply is not None
+            out[i] = [reply]
+        if self._checkpoint_due():
+            self.checkpoint()
+        return out, fsync
 
     def _io_pool_submit(self, fn):
         if getattr(self, "_io_pool", None) is None:
@@ -579,6 +667,16 @@ class Replica:
             self.storage.layout.client_replies_offset
             + slot * self.config.message_size_max
         )
+        if self.async_checkpoint:
+            # Server mode: reply slots are repair state, not commit state —
+            # a torn write is re-served from a peer or retried by the client
+            # (_read_client_reply tolerates corruption).  The reference
+            # writes client_replies asynchronously for the same reason
+            # (client_replies.zig); keeping a small O_DIRECT RMW off the
+            # serving thread is worth ~0.5 ms/request.  The IO pool is one
+            # FIFO worker, so writes for a session stay ordered.
+            self._io_pool_submit(lambda: self.storage.write(off, reply))
+            return
         self.storage.write(off, reply)
 
     def _read_client_reply(self, slot: int, size: int) -> bytes:
