@@ -1,4 +1,4 @@
-"""Fully-vectorized create_transfers commit kernel (the round-2 fast path).
+"""Fully-vectorized create_transfers commit kernel (the round-2/3 fast path).
 
 Covers the COMPLETE order-dependent semantics that round 1 delegated to the
 sequential lax.scan path, in one data-parallel dispatch:
@@ -8,32 +8,45 @@ sequential lax.scan path, in one data-parallel dispatch:
   created EARLIER IN THE SAME BATCH, double-post/void detection within the
   batch (first ok fulfillment wins, later ones get already_posted/voided),
   and expiry (:1449-1453);
-- per-event-exact overflow checks (:1308-1322) via segmented prefix sums of
-  balance deltas — no host-side "amount bound" ratchet;
-- history rows (:1342-1364) with exact post-event balances per transfer from
-  the same prefix sums — history-flagged accounts no longer force the
-  sequential path;
+- balancing_debit / balancing_credit clamps (state_machine.zig:1286-1306)
+  evaluated per event against that event's EXACT running pre-balances;
+- balance-limit accounts (tigerbeetle.zig:31-39): exceeds_credits /
+  exceeds_debits evaluated per event, exactly;
+- per-event-exact overflow checks (:1308-1322) as first-class result codes
+  (47..52) — not a host re-route;
+- history rows (:1342-1364) with exact post-event balances of BOTH sides of
+  every recorded account, from the same running balances;
 - intra-batch duplicate ids and linked chains as in the v1 kernel.
 
-The cases whose acceptance is genuinely balance-order-dependent set a routing
-flag instead of being computed wrong: balancing_debit/credit clamps
-(:1286-1306), transfers touching balance-limit accounts (tigerbeetle.zig:31-39),
-u128 amounts, an overflow check actually firing, linked chains interacting
-with intra-batch references or post/void, and history snapshots whose
-opposite-side balances a later event would poison.  When any flag bit is set
-the kernel applies NOTHING (every scatter is masked off; the returned ledger
-equals the input) and the host dispatcher (machine.py) re-routes the batch to
-the sequential path or grows a table and retries.  The flags cost no extra
-sync in the server path (result codes are pulled per batch anyway).
+Running balances are reconstructed per event without a sequential scan: each
+event contributes a debit leg (2i) and a credit leg (2i+1); legs are sorted
+by (account slot, leg position) and segmented prefix sums over the slot runs
+of all four balance fields (debits_pending/posted, credits_pending/posted)
+yield every leg's exact pre- and post-event account state — leg position
+order IS event order, so the exclusive prefix at a leg includes precisely
+the effects of earlier accepted events, both sides.
 
-Intra-batch references are resolved by Jacobi iteration of a pure
-"one sequential pass" operator: references only point to earlier lanes, so
-pass k is exact for all lanes whose reference-chain depth is < k, and a
-fixpoint (pass k == pass k-1) is THE sequential answer by induction over
-lanes.  Three unrolled passes resolve depth <= 2 — which covers every
-realistic two-phase batch (pending created + posted in one batch is depth 1,
-a duplicate retry of that post is depth 2); deeper chains set FLAG_SEQ via
-the stability check.
+Because acceptance (and balancing-clamped amounts) feed back into later
+events' balances, the balance machinery lives INSIDE the Jacobi fixpoint
+iteration: pass k computes balances from pass k-1's (accepted, amount)
+vector, then re-evaluates every ladder.  References only point to earlier
+lanes and a stable pass (codes AND amounts unchanged) is a fixpoint of the
+exact "evaluate lane i given outcomes of lanes j<i" operator, whose fixpoint
+is unique and equal to the sequential answer (induction over lanes).  Three
+passes resolve every batch whose outcome-change cascade depth is <= 2 —
+which covers realistic workloads (uncontended limit accounts converge in 2;
+one clamp/rejection cascade adds 1); deeper cascades set FLAG_SEQ via the
+stability check and run sequentially.
+
+The remaining FLAG_SEQ routes are genuinely order-chaotic or out-of-scope
+for the u64-limb delta machinery: unconverged fixpoints, u128 amounts,
+linked chains interacting with intra-batch references/post-void, failed
+linked chains whose members' codes are balance-dependent (the sequential
+path sees the chain's transient effects; the fixpoint sees the rollback),
+and balance reconstructions that overflow u128.  When any flag bit is set
+the kernel applies NOTHING (every scatter is masked off; the returned ledger
+equals the input) and the host dispatcher (machine.py) re-routes the batch
+to the sequential path or grows a table and retries.
 """
 
 from __future__ import annotations
@@ -75,6 +88,16 @@ FLAG_GROW_POSTED = 8
 FLAG_COLD = 16  # an id/pending_id may live in the cold spill: host resolves
 
 _U32MASK = jnp.uint64(0xFFFFFFFF)
+_U64MAX = jnp.uint64(0xFFFF_FFFF_FFFF_FFFF)
+
+# Result codes whose value depends on account balances (clamps, overflow
+# ladder, limits). Used for the failed-linked-chain hazard route.
+_BALANCE_CODES = (47, 48, 49, 50, 51, 52, 54, 55)
+
+# Jacobi pass budget: pass k is exact for outcome-cascade depth < k, and a
+# stable pass is THE answer, so this bounds only how deep accept/reject
+# cascades may go before the batch routes to the sequential path.
+_MAX_PASSES = 8
 
 
 def _first_code(checks) -> jnp.ndarray:
@@ -138,21 +161,175 @@ def _group_winner(idx: IdIndex, ok: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return winner_g, winner_g[idx.group_of_lane]
 
 
-def _seg_prefix(values: jax.Array, head: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """(exclusive, inclusive) prefix sums within runs delimited by ``head``."""
-    c = jnp.cumsum(values)
-    idx = jnp.arange(values.shape[0], dtype=jnp.int32)
-    start_pos = jax.lax.cummax(jnp.where(head, idx, 0))
-    base = c[start_pos] - values[start_pos]
-    incl = c - base
-    return incl - values, incl
-
-
 def _limbs_to_u128(lo_limb: jax.Array, hi_limb: jax.Array) -> U128:
     """Recombine 32-bit limb sums (each < 2**46 for <=16k terms) into u128."""
     low = lo_limb + ((hi_limb & _U32MASK) << jnp.uint64(32))
     carry = (low < lo_limb).astype(jnp.uint64)
     return U128(low, (hi_limb >> jnp.uint64(32)) + carry)
+
+
+class _LegBalances(NamedTuple):
+    """Per-leg exact account state around each event (sorted leg domain),
+    plus the scatter set (final value of every touched slot)."""
+
+    leg_pos: jax.Array  # int32[2N]: leg index -> sorted position
+    # exclusive (pre-event) / inclusive (post-event) per field, U128 each:
+    dp_pre: U128
+    dp_incl: U128
+    dpo_pre: U128
+    dpo_incl: U128
+    cp_pre: U128
+    cp_incl: U128
+    cpo_pre: U128
+    cpo_incl: U128
+    s_slot: jax.Array  # uint64[2N] sorted slot (capacity = sentinel)
+    s_live: jax.Array  # bool[2N]
+    is_last: jax.Array  # bool[2N]: last leg of its slot run
+    arith_broken: jax.Array  # bool scalar: reconstruction over/underflowed
+
+
+def _leg_balances(
+    ledger: Ledger,
+    ok_lanes: jax.Array,
+    amt_lo: jax.Array,
+    pamt_lo: jax.Array,
+    dr_slot: jax.Array,
+    cr_slot: jax.Array,
+    dr_live: jax.Array,
+    cr_live: jax.Array,
+    pending_f: jax.Array,
+    post: jax.Array,
+    postvoid: jax.Array,
+) -> _LegBalances:
+    """Exact running balances of all four account fields at every leg.
+
+    Legs 2i (debit side) / 2i+1 (credit side) sorted by (slot, leg position);
+    leg position order is event order, so segmented prefix sums within slot
+    runs reconstruct each account's exact field values before/after every
+    event.  Deltas are gated by ``ok_lanes`` (the previous Jacobi iterate);
+    ``amt_lo``/``pamt_lo`` are the previous iterate's effective / pending
+    amounts (u64 — u128 amounts route to FLAG_SEQ)."""
+    n = ok_lanes.shape[0]
+    cap = ledger.accounts.capacity
+    cap_sentinel = jnp.uint64(cap)
+
+    leg_slot_raw = jnp.stack([dr_slot, cr_slot], axis=1).reshape(-1)
+    leg_live_raw = jnp.stack([dr_live, cr_live], axis=1).reshape(-1)
+    leg_ok = jnp.repeat(ok_lanes, 2)
+    leg_is_dr = (jnp.arange(2 * n, dtype=jnp.int32) & 1) == 0
+    leg_slot = jnp.where(leg_live_raw, leg_slot_raw, cap_sentinel)
+
+    amt2 = jnp.repeat(amt_lo, 2)
+    pamt2 = jnp.repeat(pamt_lo, 2)
+    pend2 = jnp.repeat(pending_f, 2)
+    post2 = jnp.repeat(post, 2)
+    pv2 = jnp.repeat(postvoid, 2)
+    reg2 = ~pend2 & ~pv2
+
+    on = leg_ok  # delta gate
+    zero = jnp.uint64(0)
+    dp_add = jnp.where(on & leg_is_dr & pend2, amt2, zero)
+    dp_sub = jnp.where(on & leg_is_dr & pv2, pamt2, zero)
+    dpo_add = jnp.where(on & leg_is_dr & (reg2 | post2), amt2, zero)
+    cp_add = jnp.where(on & ~leg_is_dr & pend2, amt2, zero)
+    cp_sub = jnp.where(on & ~leg_is_dr & pv2, pamt2, zero)
+    cpo_add = jnp.where(on & ~leg_is_dr & (reg2 | post2), amt2, zero)
+
+    # (slot, legpos) sort: n <= 2^14 so legpos < 2^15 fits under the slot.
+    leg_pos_id = jnp.arange(2 * n, dtype=jnp.uint64)
+    sort_key = (leg_slot << jnp.uint64(15)) | leg_pos_id
+    leg_order = jnp.argsort(sort_key)
+    s_slot = leg_slot[leg_order]
+    s_live = s_slot < cap_sentinel
+    s_head = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]])
+    is_last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.ones((1,), jnp.bool_)])
+    leg_pos = jnp.zeros((2 * n,), jnp.int32).at[leg_order].set(
+        jnp.arange(2 * n, dtype=jnp.int32)
+    )
+
+    # ONE stacked segmented prefix sum for all six delta streams, in pure
+    # u32: TPU emulates u64 scans as u32-pair reduce-windows whose scoped
+    # VMEM scratch blows the 16M budget inside the while_loop body (measured:
+    # 64M at 8192 lanes). Instead each u64 delta is split into four 16-bit
+    # parts — part sums over <= 2^15 legs stay < 2^31, so a single native
+    # (2N, 24) u32 cumsum + one shared run-start cummax computes everything,
+    # and the u64 limb sums are recombined per gathered leg afterwards.
+    m16 = jnp.uint64(0xFFFF)
+
+    def parts(d):
+        return [
+            (d & m16).astype(jnp.uint32),
+            ((d >> jnp.uint64(16)) & m16).astype(jnp.uint32),
+            ((d >> jnp.uint64(32)) & m16).astype(jnp.uint32),
+            (d >> jnp.uint64(48)).astype(jnp.uint32),
+        ]
+
+    # Permute each u64 stream in 1D BEFORE stacking (2D row gathers lower to
+    # per-row DMAs on TPU: measured ~70ms/batch; 1D gathers are free), then
+    # one native u32 cumsum over the (2N, 24) stack. Run bases come from a
+    # columnwise cummax — exclusive sums at run heads are nondecreasing down
+    # the array, so max-carry propagates each run's base with no gather.
+    v = jnp.stack(
+        parts(dp_add[leg_order]) + parts(dp_sub[leg_order])
+        + parts(dpo_add[leg_order]) + parts(cp_add[leg_order])
+        + parts(cp_sub[leg_order]) + parts(cpo_add[leg_order]),
+        axis=1,
+    )
+    c = jnp.cumsum(v, axis=0)
+    base = jax.lax.cummax(jnp.where(s_head[:, None], c - v, 0), axis=0)
+    incl_all = c - base
+    excl_all = incl_all - v
+
+    safe_slot = jnp.where(s_live, s_slot, 0)
+    acols = ledger.accounts.cols
+
+    def start(field):
+        return U128(
+            acols[field + "_lo"][safe_slot], acols[field + "_hi"][safe_slot]
+        )
+
+    zeros2n = jnp.zeros((2 * n,), jnp.uint64)
+
+    def recombine(limbs, col):
+        """u64 limb sum from two adjacent 16-bit part-sum columns."""
+        return limbs[:, col].astype(jnp.uint64) + (
+            limbs[:, col + 1].astype(jnp.uint64) << jnp.uint64(16)
+        )
+
+    def field_vals(start_bal, col, has_sub):
+        def at(limbs):
+            add = _limbs_to_u128(recombine(limbs, col), recombine(limbs, col + 2))
+            sub = (
+                _limbs_to_u128(recombine(limbs, col + 4), recombine(limbs, col + 6))
+                if has_sub else U128(zeros2n, zeros2n)
+            )
+            added, ov = u128.add(start_bal, add)
+            val, neg = u128.sub(added, sub)
+            return val, ov | neg
+
+        pre, bad_e = at(excl_all)
+        incl, bad_i = at(incl_all)
+        return pre, incl, bad_e | bad_i
+
+    dp_pre, dp_incl, bad1 = field_vals(start("debits_pending"), 0, True)
+    dpo_pre, dpo_incl, bad2 = field_vals(start("debits_posted"), 8, False)
+    cp_pre, cp_incl, bad3 = field_vals(start("credits_pending"), 12, True)
+    cpo_pre, cpo_incl, bad4 = field_vals(start("credits_posted"), 20, False)
+    arith_broken = jnp.any(s_live & (bad1 | bad2 | bad3 | bad4))
+
+    return _LegBalances(
+        leg_pos=leg_pos,
+        dp_pre=dp_pre, dp_incl=dp_incl,
+        dpo_pre=dpo_pre, dpo_incl=dpo_incl,
+        cp_pre=cp_pre, cp_incl=cp_incl,
+        cpo_pre=cpo_pre, cpo_incl=cpo_incl,
+        s_slot=s_slot, s_live=s_live, is_last=is_last,
+        arith_broken=arith_broken,
+    )
+
+
+def _at(val: U128, pos: jax.Array) -> U128:
+    return U128(val.lo[pos], val.hi[pos])
 
 
 def create_transfers_full_impl(
@@ -188,7 +365,9 @@ def create_transfers_full_impl(
     postvoid = post | void
     pending_f = ((flags & TF_PENDING) != 0) & valid
     linked = ((flags & TF_LINKED) != 0) & valid
-    balancing = ((flags & (TF_BALANCING_DEBIT | TF_BALANCING_CREDIT)) != 0) & valid
+    bal_dr = ((flags & TF_BALANCING_DEBIT) != 0) & valid
+    bal_cr = ((flags & TF_BALANCING_CREDIT) != 0) & valid
+    balancing = bal_dr | bal_cr
 
     # ---------------- table gathers (iteration-invariant) -----------------
     ex_look = ht.lookup(ledger.transfers, tid.lo, tid.hi, MAX_PROBE)
@@ -271,11 +450,16 @@ def create_transfers_full_impl(
     pj_hit = (idx.s_hi[pj_c] == pend_id.hi) & (idx.s_lo[pj_c] == pend_id.lo) & (pj < n)
     pj_group = idx.gid[pj_c]
 
+    timeout_ns = batch["timeout"].astype(jnp.uint64) * jnp.uint64(NS_PER_S)
+    ov_timeout = (ts + timeout_ns) < ts
+    dr_limf = ((drT["flags"] & AF_DEBITS_MUST_NOT_EXCEED_CREDITS) != 0) & drT_found
+    cr_limf = ((crT["flags"] & AF_CREDITS_MUST_NOT_EXCEED_DEBITS) != 0) & crT_found
+
     # ------------------------------------------------------------------
     # One Jacobi pass of the sequential semantics.
     # ------------------------------------------------------------------
 
-    def one_pass(ok_prev: jax.Array):
+    def one_pass(ok_prev: jax.Array, amt_prev: U128):
         inf = jnp.int32(n)
         winner_g, winner_of_lane = _group_winner(idx, ok_prev)
 
@@ -294,6 +478,13 @@ def create_transfers_full_impl(
         for name in TRANSFER_COLS:
             if name == "timestamp":
                 p[name] = jnp.where(in_batch_ref, ts[pwc], p_tab[name])
+            elif name == "amount_lo":
+                # The stored amount of an in-batch pending is its CLAMPED
+                # amount (balancing pending): the previous iterate's
+                # effective amount — exact at the fixpoint.
+                p[name] = jnp.where(in_batch_ref, amt_prev.lo[pwc], p_tab[name])
+            elif name == "amount_hi":
+                p[name] = jnp.where(in_batch_ref, amt_prev.hi[pwc], p_tab[name])
             else:
                 p[name] = jnp.where(in_batch_ref, batch[name][pwc], p_tab[name])
         p_is_pending = ((p["flags"] & TF_PENDING) != 0) & p_found
@@ -310,11 +501,66 @@ def create_transfers_full_impl(
             in_batch_ref, crT_look.slot[pwc],
             jnp.where(postvoid, pcr_look.slot, crT_look.slot),
         )
+        dr_live = jnp.where(
+            in_batch_ref, drT_found[pwc],
+            jnp.where(postvoid, pdr_look.found & p_tab_found, drT_found),
+        ) & valid
+        cr_live = jnp.where(
+            in_batch_ref, crT_found[pwc],
+            jnp.where(postvoid, pcr_look.found & p_tab_found, crT_found),
+        ) & valid
         acc_flags_dr = ledger.accounts.cols["flags"][dr_slot]
         acc_flags_cr = ledger.accounts.cols["flags"][cr_slot]
 
-        # --- composed insert rows (state_machine.zig:1326-1328, 1455-1469) -
-        amount = u128.select(postvoid & u128.is_zero(t_amt), p_amt, t_amt)
+        # --- exact running balances from the previous iterate -------------
+        legs = _leg_balances(
+            ledger, ok_prev, amt_prev.lo, p_amt.lo, dr_slot, cr_slot,
+            dr_live, cr_live, pending_f, post, postvoid,
+        )
+        dpos = legs.leg_pos[2 * lane]
+        cpos = legs.leg_pos[2 * lane + 1]
+        a_dp = _at(legs.dp_pre, dpos)      # dr account, pre-event
+        a_dpo = _at(legs.dpo_pre, dpos)
+        a_cpo = _at(legs.cpo_pre, dpos)
+        b_cp = _at(legs.cp_pre, cpos)      # cr account, pre-event
+        b_cpo = _at(legs.cpo_pre, cpos)
+        b_dpo = _at(legs.dpo_pre, cpos)
+
+        # --- balancing clamps (state_machine.zig:1286-1306) ----------------
+        zero = jnp.uint64(0)
+        amount0 = u128.select(
+            balancing & u128.is_zero(t_amt), U128(_U64MAX, zero), t_amt
+        )
+        dr_balance = u128.add_wrap(a_dpo, a_dp)
+        avail_dr = u128.sub_saturate(a_cpo, dr_balance)
+        amount1 = u128.select(bal_dr, u128.min_(amount0, avail_dr), amount0)
+        exceeds_credits_bal = bal_dr & u128.is_zero(amount1)
+        cr_balance = u128.add_wrap(b_cpo, b_cp)
+        avail_cr = u128.sub_saturate(b_dpo, cr_balance)
+        amount2 = u128.select(bal_cr, u128.min_(amount1, avail_cr), amount1)
+        exceeds_debits_bal = bal_cr & ~exceeds_credits_bal & u128.is_zero(amount2)
+        reg_amount = amount2
+
+        # --- overflow ladder (:1308-1322) ----------------------------------
+        _, ov_dp = u128.add(reg_amount, a_dp)
+        _, ov_cp = u128.add(reg_amount, b_cp)
+        _, ov_dpo = u128.add(reg_amount, a_dpo)
+        _, ov_cpo = u128.add(reg_amount, b_cpo)
+        dr_total, _ = u128.add(a_dp, a_dpo)
+        _, ov_d = u128.add(reg_amount, dr_total)
+        cr_total, _ = u128.add(b_cp, b_cpo)
+        _, ov_c = u128.add(reg_amount, cr_total)
+
+        # --- balance limits (tigerbeetle.zig:31-39) ------------------------
+        new_dr_tot, _ = u128.add(dr_total, reg_amount)
+        exceeds_credits_lim = dr_limf & u128.gt(new_dr_tot, a_cpo)
+        new_cr_tot, _ = u128.add(cr_total, reg_amount)
+        exceeds_debits_lim = cr_limf & u128.gt(new_cr_tot, b_dpo)
+
+        # --- effective amount + composed insert rows -----------------------
+        # (state_machine.zig:1326-1328, 1431, 1455-1469)
+        pv_amount = u128.select(u128.is_zero(t_amt), p_amt, t_amt)
+        amount = u128.select(postvoid, pv_amount, reg_amount)
         row = {name: batch[name] for name in TRANSFER_COLS}
         row["timestamp"] = ts
         row["amount_lo"] = amount.lo
@@ -334,11 +580,10 @@ def create_transfers_full_impl(
         row["code"] = jnp.where(postvoid, p["code"], batch["code"])
         row["timeout"] = jnp.where(postvoid, jnp.uint32(0), batch["timeout"])
 
-        # --- regular-path ladder (through the exists check + ov_timeout;
-        # the balance-dependent tail is handled by prefix sums / FLAG_SEQ) --
-        timeout_ns = batch["timeout"].astype(jnp.uint64) * jnp.uint64(NS_PER_S)
-        ov_timeout = (ts + timeout_ns) < ts
-        exists_tab_reg = _exists_regular(batch, e_tab, amount, n)
+        # --- regular-path ladder (state_machine.zig:1239-1368) -------------
+        # The exists check compares the RAW event amount against the stored
+        # (possibly clamped) amount (:1379).
+        exists_tab_reg = _exists_regular(batch, e_tab, t_amt, n)
         reg_code = _first_code([
             (((flags & TF_PADDING) != 0), 4),
             (u128.is_zero(tid), 5),
@@ -358,7 +603,17 @@ def create_transfers_full_impl(
             ((drT["ledger"] != crT["ledger"]), 23),
             ((batch["ledger"] != drT["ledger"]), 24),
             (ex_found, exists_tab_reg),
+            (exceeds_credits_bal, 54),
+            (exceeds_debits_bal, 55),
+            (pending_f & ov_dp, 47),
+            (pending_f & ov_cp, 48),
+            (ov_dpo, 49),
+            (ov_cpo, 50),
+            (ov_d, 51),
+            (ov_c, 52),
             (ov_timeout, 53),
+            (exceeds_credits_lim, 54),
+            (exceeds_debits_lim, 55),
         ])
 
         # --- post/void ladder (state_machine.zig:1391-1453) ----------------
@@ -394,19 +649,22 @@ def create_transfers_full_impl(
         code = jnp.where(batch["timestamp"] != 0, jnp.uint32(3), code)
 
         # --- intra-batch duplicate ids ------------------------------------
-        # In sequential order the exists check sits BEFORE the fulfillment/
-        # expiry checks (pv) and BEFORE ov_timeout (regular), so the in-batch
-        # override replaces exactly those post-exists codes.
+        # In sequential order the exists check sits BEFORE the balance-
+        # dependent tail (clamps/overflows/limits, pv fulfillment/expiry),
+        # so the in-batch override replaces exactly those post-exists codes.
         after_winner = (winner_of_lane < inf) & (lane > winner_of_lane)
         wc = jnp.minimum(winner_of_lane, n - 1).astype(jnp.int32)
         w_row = {k: v[wc] for k, v in row.items()}
-        intra_reg = _exists_regular(batch, w_row, amount, n)
+        intra_reg = _exists_regular(batch, w_row, t_amt, n)
         intra_pv = _exists_postvoid(batch, w_row, p, n)
         intra = jnp.where(postvoid, intra_pv, intra_reg)
+        balance_code = jnp.zeros((n,), jnp.bool_)
+        for bc in _BALANCE_CODES:
+            balance_code = balance_code | (code == bc)
         dup_overridable = jnp.where(
             postvoid,
             (code == 0) | (code == 33) | (code == 34) | (code == 35),
-            (code == 0) | (code == 53),
+            (code == 0) | (code == 53) | balance_code,
         )
         code = jnp.where(after_winner & dup_overridable, intra, code)
 
@@ -434,136 +692,77 @@ def create_transfers_full_impl(
 
         # --- linked chains -------------------------------------------------
         code = jnp.where(~valid, 0, code)
+        pre_chain_code = code
         code = _chain_codes(linked, code, count)
         ok = (code == 0) & valid
+
+        # Overflow checks of a lane inside a FAILED chain may depend on the
+        # chain's transient sibling effects (< n * 2^64 total): if any such
+        # lane's balances sit within that margin of 2^128, the sequential
+        # path could fire an overflow code the rolled-back fixpoint cannot
+        # see. Flag "near overflow" = any involved hi limb in the top 2^15
+        # values (margin 2^79 >= n * 2^64 for n <= 2^14).
+        near = jnp.uint64(0xFFFF_FFFF_FFFF_0000)
+        near_ov = (
+            (a_dp.hi >= near) | (a_dpo.hi >= near)
+            | (b_cp.hi >= near) | (b_cpo.hi >= near)
+        )
         aux = dict(
             in_batch_ref=in_batch_ref, p=p, p_found=p_found, p_amt=p_amt,
-            dr_slot=dr_slot, cr_slot=cr_slot, row=row, amount=amount,
+            dr_slot=dr_slot, cr_slot=cr_slot, row=row,
             acc_flags_dr=acc_flags_dr, acc_flags_cr=acc_flags_cr,
+            legs=legs, pre_chain_code=pre_chain_code, near_ov=near_ov,
         )
-        return ok, code, aux
+        return ok, code, amount, aux
 
+    # Jacobi iteration with early exit: a pass whose codes and accepted
+    # amounts equal the previous pass's is a fixpoint => THE sequential
+    # answer (induction over lanes). lax.while_loop traces one_pass exactly
+    # ONCE (the first pass runs inside the loop from a sentinel carry that
+    # can never read as stable) and runs 2 iterations for cascade-free
+    # batches, up to _MAX_PASSES for deep accept/reject cascades; exhausting
+    # the budget sets FLAG_SEQ.
     ok0 = jnp.zeros((n,), jnp.bool_)
-    ok1, code1, _ = one_pass(ok0)
-    ok2, code2, _ = one_pass(ok1)
-    ok, codes, aux = one_pass(ok2)
-    unconverged = jnp.any(code2 != codes)
+    aux0 = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: one_pass(ok0, t_amt)[3]),
+    )
+    code_sentinel = jnp.full((n,), 0xFFFFFFFF, jnp.uint32)
+
+    def loop_cond(carry):
+        k, stable, *_ = carry
+        return ~stable & (k < _MAX_PASSES)
+
+    def loop_body(carry):
+        k, _, ok_p, code_p, amt_p, _ = carry
+        ok_n, code_n, amt_n, aux_n = one_pass(ok_p, amt_p)
+        # The pass consumed (ok_p, amt_p); equality of codes and of accepted
+        # amounts makes the next pass a no-op. Amounts of rejected lanes are
+        # irrelevant downstream.
+        stable = ~(
+            jnp.any(code_n != code_p)
+            | jnp.any(ok_n & ((amt_n.lo != amt_p.lo) | (amt_n.hi != amt_p.hi)))
+        )
+        return (k + 1, stable, ok_n, code_n, amt_n, aux_n)
+
+    _, converged, ok, codes, amount, aux = jax.lax.while_loop(
+        loop_cond, loop_body,
+        (jnp.int32(0), jnp.bool_(False), ok0, code_sentinel, t_amt, aux0),
+    )
+    unconverged = ~converged
 
     dr_slot, cr_slot = aux["dr_slot"], aux["cr_slot"]
-    amount, p_amt = aux["amount"], aux["p_amt"]
+    p_amt = aux["p_amt"]
     row = aux["row"]
     in_batch_ref = aux["in_batch_ref"]
-
-    # ---------------- balance legs + exact prefix balances -----------------
-    # Leg 2i = debit side of event i, 2i+1 = credit side. Sorted by
-    # (account slot, SIDE, leg position): an account's debit-side fields are
-    # only touched by debit legs, so per-(slot, side) prefixes in event order
-    # reconstruct each field's exact running value.
-    cap = ledger.accounts.capacity
-    cap_sentinel = jnp.uint64(cap)
-    leg_slot_raw = jnp.stack([dr_slot, cr_slot], axis=1).reshape(-1)
-    leg_ok = jnp.repeat(ok, 2)
-    leg_pos_id = jnp.arange(2 * n, dtype=jnp.uint64)
-    leg_is_dr = (jnp.arange(2 * n, dtype=jnp.int32) & 1) == 0
-    leg_slot = jnp.where(leg_ok, leg_slot_raw, cap_sentinel)
-
-    amt_l = jnp.repeat(amount.lo, 2)
-    pamt_l = jnp.repeat(p_amt.lo, 2)
-    pend2 = jnp.repeat(pending_f, 2)
-    post2 = jnp.repeat(post, 2)
-    pv2 = jnp.repeat(postvoid, 2)
-
-    # u64 per-leg deltas (u128 amounts route to FLAG_SEQ below).
-    d_pending_add = jnp.where(leg_ok & pend2, amt_l, 0)
-    d_pending_sub = jnp.where(leg_ok & pv2, pamt_l, 0)
-    d_posted_add = jnp.where(leg_ok & ((~pend2 & ~pv2) | post2), amt_l, 0)
-
-    side_bit = jnp.where(leg_is_dr, jnp.uint64(0), jnp.uint64(1))
-    sort_key = (leg_slot << jnp.uint64(16)) | (side_bit << jnp.uint64(15)) | leg_pos_id
-    leg_order = jnp.argsort(sort_key)
-    s_key = sort_key[leg_order] >> jnp.uint64(15)  # (slot, side)
-    s_slot = leg_slot[leg_order]
-    s_live = s_slot < cap_sentinel
-    s_head = jnp.concatenate([jnp.ones((1,), jnp.bool_), s_key[1:] != s_key[:-1]])
-
-    def limb_prefix(vals):
-        v = vals[leg_order]
-        lo_e, lo_i = _seg_prefix(v & _U32MASK, s_head)
-        hi_e, hi_i = _seg_prefix(v >> jnp.uint64(32), s_head)
-        return (lo_e, hi_e), (lo_i, hi_i)
-
-    pa_e, pa_i = limb_prefix(d_pending_add)
-    ps_e, ps_i = limb_prefix(d_pending_sub)
-    oa_e, oa_i = limb_prefix(d_posted_add)
-
-    s_is_dr = leg_is_dr[leg_order]
-    safe_slot = jnp.where(s_live, s_slot, 0)
-    acols = ledger.accounts.cols
-
-    def start_bal(field_dr, field_cr):
-        lo = jnp.where(
-            s_is_dr, acols[field_dr + "_lo"][safe_slot],
-            acols[field_cr + "_lo"][safe_slot],
-        )
-        hi = jnp.where(
-            s_is_dr, acols[field_dr + "_hi"][safe_slot],
-            acols[field_cr + "_hi"][safe_slot],
-        )
-        return U128(lo, hi)
-
-    start_pend = start_bal("debits_pending", "credits_pending")
-    start_post = start_bal("debits_posted", "credits_posted")
-
-    def bal_at(start, add_limbs, sub_limbs):
-        added, ov1 = u128.add(start, _limbs_to_u128(*add_limbs))
-        val, neg = u128.sub(added, _limbs_to_u128(*sub_limbs))
-        return val, ov1, neg
-
-    zero2 = (jnp.zeros((2 * n,), jnp.uint64), jnp.zeros((2 * n,), jnp.uint64))
-    pend_pre, ovA, negA = bal_at(start_pend, pa_e, ps_e)
-    pend_post_, ovB, negB = bal_at(start_pend, pa_i, ps_i)
-    post_pre, ovC, _ = bal_at(start_post, oa_e, zero2)
-    post_post_, ovD, _ = bal_at(start_post, oa_i, zero2)
-    arith_broken = jnp.any(s_live & (ovA | ovB | ovC | ovD | negA | negB))
-
-    # Exact per-event overflow ladder (state_machine.zig:1308-1320): any
-    # firing means sequential execution would reject an event we accepted,
-    # changing later balances -> route the batch.
-    s_okleg = leg_ok[leg_order] & s_live
-    s_amt128 = U128(amt_l[leg_order], jnp.zeros((2 * n,), jnp.uint64))
-    s_pend2 = pend2[leg_order]
-    s_pv2 = pv2[leg_order]
-    _, ov_p = u128.add(s_amt128, pend_pre)
-    _, ov_o = u128.add(s_amt128, post_pre)
-    tot, ov_t1 = u128.add(pend_pre, post_pre)
-    _, ov_t2 = u128.add(s_amt128, tot)
-    overflow_fires = jnp.any(
-        s_okleg & ~s_pv2
-        & ((s_pend2 & ov_p) | ov_o | ov_t1 | ov_t2)
-    )
+    legs = aux["legs"]
 
     # ---------------- history (state_machine.zig:1342-1364) ----------------
     dr_hist = ((aux["acc_flags_dr"] & AF_HISTORY) != 0) & ok
     cr_hist = ((aux["acc_flags_cr"] & AF_HISTORY) != 0) & ok
     do_hist = (dr_hist | cr_hist) & ~postvoid
-    # The same-side balances per event are exact (prefix sums above); the
-    # OPPOSITE side of a recorded account is gathered from the post-batch
-    # table, which is only the correct per-event snapshot if no LATER ok
-    # event touches that account's opposite side.
-    hist_alias = jnp.any(do_hist) & _hist_cross_side_alias(
-        dr_slot, cr_slot, ok, do_hist & dr_hist, do_hist & cr_hist, cap
-    )
 
     # ---------------- routing flags ---------------------------------------
-    limit_flags = AF_DEBITS_MUST_NOT_EXCEED_CREDITS | AF_CREDITS_MUST_NOT_EXCEED_DEBITS
-    any_limit = jnp.any(
-        valid & (
-            (((drT["flags"] & limit_flags) != 0) & drT_found)
-            | (((crT["flags"] & limit_flags) != 0) & crT_found)
-            | (((aux["acc_flags_dr"] & limit_flags) != 0) & postvoid & aux["p_found"])
-            | (((aux["acc_flags_cr"] & limit_flags) != 0) & postvoid & aux["p_found"])
-        )
-    )
     any_u128_amount = jnp.any(
         valid & ((batch["amount_hi"] != 0) | (postvoid & (aux["p"]["amount_hi"] != 0)))
     )
@@ -571,6 +770,25 @@ def create_transfers_full_impl(
     linked_x_intra = any_linked & (
         idx.any_dup | jnp.any(in_batch_ref) | jnp.any(postvoid)
     )
+    # A FAILED linked chain rolls back members whose transient effects the
+    # sequential path's balance checks DID see; if any member of a failed
+    # chain carries a balance-dependent code (or the chain contains
+    # balancing/limit-sensitive members), the fixpoint's codes may differ
+    # from the sequential ones — route for exactness. Successful chains are
+    # exact (all members' contributions present at the fixpoint). Chain
+    # membership includes the terminator (linked flag false, previous lane
+    # linked) — mirroring _chain_codes.
+    prev_linked = jnp.concatenate([jnp.zeros((1,), jnp.bool_), linked[:-1]])
+    in_chain = linked | prev_linked
+    chain_failed = in_chain & (codes != 0)
+    failed_member_balance = jnp.zeros((n,), jnp.bool_)
+    for bc in _BALANCE_CODES:
+        failed_member_balance = failed_member_balance | (
+            chain_failed & (aux["pre_chain_code"] == bc)
+        )
+    chain_hazard = jnp.any(
+        chain_failed & (balancing | dr_limf | cr_limf | aux["near_ov"])
+    ) | jnp.any(failed_member_balance)
 
     # Insert slots are claimed (no writes) BEFORE the flags are finalized so
     # an insert-probe overflow also routes the batch with nothing applied.
@@ -587,29 +805,25 @@ def create_transfers_full_impl(
     )
 
     kflags = probe_grow | jnp.where(
-        unconverged | any_limit | jnp.any(balancing) | any_u128_amount
-        | linked_x_intra | arith_broken | overflow_fires | hist_alias,
+        unconverged | any_u128_amount | linked_x_intra | chain_hazard
+        | legs.arith_broken,
         jnp.uint32(FLAG_SEQ), jnp.uint32(0),
     )
     commit = kflags == jnp.uint32(0)
 
-    # ---------------- apply: balances (two scatters, one per side) ---------
-    is_last = jnp.concatenate([s_key[1:] != s_key[:-1], jnp.ones((1,), jnp.bool_)])
-    scat = is_last & s_live & commit
-    dr_scat = scat & s_is_dr
-    cr_scat = scat & ~s_is_dr
+    # ---------------- apply: balances (one scatter over slot runs) ---------
+    # The final pass's inclusive values were computed from (ok2, amt2) which
+    # equal (ok, amount) whenever the batch commits (stability), so the last
+    # leg of each slot run carries the slot's exact final field values.
+    scat = legs.is_last & legs.s_live & commit
+    cap_sentinel = jnp.uint64(ledger.accounts.capacity)
     accounts = ht.scatter_cols(
-        ledger.accounts, jnp.where(dr_scat, s_slot, cap_sentinel), dr_scat,
+        ledger.accounts, jnp.where(scat, legs.s_slot, cap_sentinel), scat,
         {
-            "debits_pending_lo": pend_post_.lo, "debits_pending_hi": pend_post_.hi,
-            "debits_posted_lo": post_post_.lo, "debits_posted_hi": post_post_.hi,
-        },
-    )
-    accounts = ht.scatter_cols(
-        accounts, jnp.where(cr_scat, s_slot, cap_sentinel), cr_scat,
-        {
-            "credits_pending_lo": pend_post_.lo, "credits_pending_hi": pend_post_.hi,
-            "credits_posted_lo": post_post_.lo, "credits_posted_hi": post_post_.hi,
+            "debits_pending_lo": legs.dp_incl.lo, "debits_pending_hi": legs.dp_incl.hi,
+            "debits_posted_lo": legs.dpo_incl.lo, "debits_posted_hi": legs.dpo_incl.hi,
+            "credits_pending_lo": legs.cp_incl.lo, "credits_pending_hi": legs.cp_incl.hi,
+            "credits_posted_lo": legs.cpo_incl.lo, "credits_posted_hi": legs.cpo_incl.hi,
         },
     )
 
@@ -628,46 +842,45 @@ def create_transfers_full_impl(
     )
 
     # ---------------- apply: history rows ---------------------------------
-    leg_pos = jnp.zeros((2 * n,), jnp.int32).at[leg_order].set(
-        jnp.arange(2 * n, dtype=jnp.int32)
-    )
-
-    def lane_bal(leg_index):
-        pos = leg_pos[leg_index]
-        return (
-            pend_post_.lo[pos], pend_post_.hi[pos],
-            post_post_.lo[pos], post_post_.hi[pos],
-        )
-
+    # Each recorded account's post-event snapshot of ALL FOUR fields is the
+    # inclusive value at that event's leg (leg order = event order within the
+    # slot run, and cross-side legs of the same account share the run).
     do_hist_c = do_hist & commit
     h = ledger.history
     h_off = jnp.cumsum(do_hist_c.astype(jnp.uint64)) - do_hist_c.astype(jnp.uint64)
     h_idx = jnp.where(do_hist_c, h.count + h_off, jnp.uint64(h.capacity))
 
-    dr_dp_lo, dr_dp_hi, dr_dpo_lo, dr_dpo_hi = lane_bal(2 * lane)
-    cr_cp_lo, cr_cp_hi, cr_cpo_lo, cr_cpo_hi = lane_bal(2 * lane + 1)
+    dpos = legs.leg_pos[2 * lane]
+    cpos = legs.leg_pos[2 * lane + 1]
+
+    def hv(val: U128, pos, mask):
+        return (
+            jnp.where(mask, val.lo[pos], 0),
+            jnp.where(mask, val.hi[pos], 0),
+        )
+
+    dr_dp_lo, dr_dp_hi = hv(legs.dp_incl, dpos, dr_hist)
+    dr_dpo_lo, dr_dpo_hi = hv(legs.dpo_incl, dpos, dr_hist)
+    dr_cp_lo, dr_cp_hi = hv(legs.cp_incl, dpos, dr_hist)
+    dr_cpo_lo, dr_cpo_hi = hv(legs.cpo_incl, dpos, dr_hist)
+    cr_cp_lo, cr_cp_hi = hv(legs.cp_incl, cpos, cr_hist)
+    cr_cpo_lo, cr_cpo_hi = hv(legs.cpo_incl, cpos, cr_hist)
+    cr_dp_lo, cr_dp_hi = hv(legs.dp_incl, cpos, cr_hist)
+    cr_dpo_lo, cr_dpo_hi = hv(legs.dpo_incl, cpos, cr_hist)
     hist_row = {
         "timestamp": ts,
         "dr_id_lo": jnp.where(dr_hist, row["debit_account_id_lo"], 0),
         "dr_id_hi": jnp.where(dr_hist, row["debit_account_id_hi"], 0),
-        "dr_dp_lo": jnp.where(dr_hist, dr_dp_lo, 0),
-        "dr_dp_hi": jnp.where(dr_hist, dr_dp_hi, 0),
-        "dr_dpo_lo": jnp.where(dr_hist, dr_dpo_lo, 0),
-        "dr_dpo_hi": jnp.where(dr_hist, dr_dpo_hi, 0),
-        "dr_cp_lo": jnp.where(dr_hist, accounts.cols["credits_pending_lo"][dr_slot], 0),
-        "dr_cp_hi": jnp.where(dr_hist, accounts.cols["credits_pending_hi"][dr_slot], 0),
-        "dr_cpo_lo": jnp.where(dr_hist, accounts.cols["credits_posted_lo"][dr_slot], 0),
-        "dr_cpo_hi": jnp.where(dr_hist, accounts.cols["credits_posted_hi"][dr_slot], 0),
+        "dr_dp_lo": dr_dp_lo, "dr_dp_hi": dr_dp_hi,
+        "dr_dpo_lo": dr_dpo_lo, "dr_dpo_hi": dr_dpo_hi,
+        "dr_cp_lo": dr_cp_lo, "dr_cp_hi": dr_cp_hi,
+        "dr_cpo_lo": dr_cpo_lo, "dr_cpo_hi": dr_cpo_hi,
         "cr_id_lo": jnp.where(cr_hist, row["credit_account_id_lo"], 0),
         "cr_id_hi": jnp.where(cr_hist, row["credit_account_id_hi"], 0),
-        "cr_cp_lo": jnp.where(cr_hist, cr_cp_lo, 0),
-        "cr_cp_hi": jnp.where(cr_hist, cr_cp_hi, 0),
-        "cr_cpo_lo": jnp.where(cr_hist, cr_cpo_lo, 0),
-        "cr_cpo_hi": jnp.where(cr_hist, cr_cpo_hi, 0),
-        "cr_dp_lo": jnp.where(cr_hist, accounts.cols["debits_pending_lo"][cr_slot], 0),
-        "cr_dp_hi": jnp.where(cr_hist, accounts.cols["debits_pending_hi"][cr_slot], 0),
-        "cr_dpo_lo": jnp.where(cr_hist, accounts.cols["debits_posted_lo"][cr_slot], 0),
-        "cr_dpo_hi": jnp.where(cr_hist, accounts.cols["debits_posted_hi"][cr_slot], 0),
+        "cr_cp_lo": cr_cp_lo, "cr_cp_hi": cr_cp_hi,
+        "cr_cpo_lo": cr_cpo_lo, "cr_cpo_hi": cr_cpo_hi,
+        "cr_dp_lo": cr_dp_lo, "cr_dp_hi": cr_dp_hi,
+        "cr_dpo_lo": cr_dpo_lo, "cr_dpo_hi": cr_dpo_hi,
     }
     history = h.replace(
         cols={
@@ -683,43 +896,10 @@ def create_transfers_full_impl(
     return out, codes, kflags
 
 
-def _hist_cross_side_alias(dr_slot, cr_slot, ok, rec_dr, rec_cr, cap):
-    """True if a history-recorded account is touched on its OPPOSITE side by
-    a LATER ok event (poisoning the gathered post-batch snapshot)."""
-    n = ok.shape[0]
-    lane = jnp.arange(n, dtype=jnp.int32)
-    sent = jnp.uint64(cap)
-
-    def violated(rec_slot, rec_mask, opp_slot, opp_mask):
-        key_all = jnp.concatenate([
-            jnp.where(rec_mask, rec_slot, sent),
-            jnp.where(opp_mask, opp_slot, sent),
-        ])
-        lane2 = jnp.concatenate([lane, lane])
-        is_opp = jnp.concatenate(
-            [jnp.zeros((n,), jnp.bool_), jnp.ones((n,), jnp.bool_)]
-        )
-        order = jnp.argsort(key_all)
-        s = key_all[order]
-        head = jnp.concatenate([jnp.ones((1,), jnp.bool_), s[1:] != s[:-1]])
-        gid = jnp.cumsum(head.astype(jnp.int32)) - 1
-        live = s < sent
-        opp_max = jax.ops.segment_max(
-            jnp.where(is_opp[order] & live, lane2[order], -1),
-            gid, num_segments=2 * n,
-        )
-        rec_is = ~is_opp[order] & live
-        return jnp.any(rec_is & (opp_max[gid] > lane2[order]))
-
-    # dr-account records: poisoned by later events using it as credit side.
-    v1 = violated(dr_slot, rec_dr, cr_slot, ok)
-    v2 = violated(cr_slot, rec_cr, dr_slot, ok)
-    return v1 | v2
-
-
 def _exists_regular(t, e, t_amount: U128, n) -> jax.Array:
     """create_transfer_exists (state_machine.zig:1370-1389): ``t`` the raw
-    event, ``e`` the stored/winner row, ``t_amount`` the event amount."""
+    event, ``e`` the stored/winner row, ``t_amount`` the RAW event amount
+    (the stored side may be clamped; the reference compares t.amount)."""
 
     def ne128(name):
         return (t[name + "_lo"] != e[name + "_lo"]) | (
