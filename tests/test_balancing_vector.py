@@ -464,3 +464,91 @@ class TestRandomizedBalancingDifferential:
                     ))
                     next_id += 1
             run_batch(dev, ref, specs)
+
+
+class TestFastPathDispatch:
+    """Plain batches take the round-1 fast kernel; any P1-P4 violation falls
+    back to the fully-general kernel (machine.py _fast_path_ok)."""
+
+    def _spy(self, dev):
+        calls = {"fast": 0, "full": 0}
+        orig_fast = dev._commit_fast
+
+        def fast(*a, **k):
+            calls["fast"] += 1
+            return orig_fast(*a, **k)
+
+        dev._commit_fast = fast
+        from tigerbeetle_tpu.ops import transfer_full as tf
+        orig_full = tf.create_transfers_full
+
+        def full(*a, **k):
+            calls["full"] += 1
+            return orig_full(*a, **k)
+
+        tf.create_transfers_full = full
+        return calls, (tf, orig_full)
+
+    def _unspy(self, handle):
+        tf, orig = handle
+        tf.create_transfers_full = orig
+
+    def test_plain_batches_take_fast_kernel(self):
+        dev, ref = make_pair()
+        calls, h = self._spy(dev)
+        try:
+            run_batch(dev, ref, [
+                dict(id=5000 + i, debit_account_id=1 + i % 8,
+                     credit_account_id=9 + i % 8, amount=5, ledger=1, code=1,
+                     flags=PENDING if i % 3 == 0 else 0)
+                for i in range(64)
+            ])
+        finally:
+            self._unspy(h)
+        assert calls == {"fast": 1, "full": 0}
+
+    def test_slow_flags_route_to_full_kernel(self):
+        dev, ref = make_pair()
+        run_batch(dev, ref, [
+            dict(id=6000, debit_account_id=1, credit_account_id=2, amount=9,
+                 ledger=1, code=1, flags=PENDING),
+        ])
+        calls, h = self._spy(dev)
+        try:
+            run_batch(dev, ref, [
+                dict(id=6001, pending_id=6000, ledger=1, code=1, flags=POST),
+            ])
+        finally:
+            self._unspy(h)
+        assert calls["fast"] == 0 and calls["full"] >= 1
+
+    def test_limit_account_disables_fast_path(self):
+        dev, ref = make_pair({0: DR_LIM})
+        calls, h = self._spy(dev)
+        try:
+            run_batch(dev, ref, [
+                dict(id=6100, debit_account_id=2, credit_account_id=3,
+                     amount=9, ledger=1, code=1),
+            ])
+        finally:
+            self._unspy(h)
+        assert calls["fast"] == 0 and calls["full"] >= 1
+
+    def test_extreme_amounts_disable_fast_path(self):
+        """A u128 amount blows the balance bound: later PLAIN batches lose
+        the fast path permanently (P3 can no longer be guaranteed)."""
+        dev, ref = make_pair()
+        run_batch(dev, ref, [
+            dict(id=6200, debit_account_id=1, credit_account_id=2,
+                 amount=(1 << 127), ledger=1, code=1),
+        ])
+        assert dev._balance_bound >= (1 << 126)
+        calls, h = self._spy(dev)
+        try:
+            run_batch(dev, ref, [
+                dict(id=6300, debit_account_id=3, credit_account_id=4,
+                     amount=1, ledger=1, code=1),
+            ])
+        finally:
+            self._unspy(h)
+        assert calls["fast"] == 0 and calls["full"] >= 1

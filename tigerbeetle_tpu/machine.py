@@ -39,6 +39,23 @@ from . import types
 from .config import LedgerConfig
 from .ops import state_machine as sm
 
+_LIMIT_FLAGS = (
+    types.AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
+    | types.AccountFlags.CREDITS_MUST_NOT_EXCEED_DEBITS
+)
+# Balance-bound saturation point: past this the fast path stays off and
+# further tracking is pointless (and giant Python ints are avoided).
+_BOUND_CLAMP = 1 << 127
+# Transfer flags that exclude the plain fast-path kernel (P2/P4: two-phase,
+# balancing, and linked chains run the fully-general kernel).
+_SLOW_TRANSFER_FLAGS = (
+    types.TransferFlags.POST_PENDING_TRANSFER
+    | types.TransferFlags.VOID_PENDING_TRANSFER
+    | types.TransferFlags.BALANCING_DEBIT
+    | types.TransferFlags.BALANCING_CREDIT
+    | types.TransferFlags.LINKED
+)
+
 U64_MAX = (1 << 64) - 1
 # Reply rows are 128 B; one 1 MiB message body holds at most this many
 # (constants.zig:203-204, state_machine.zig:70-75).
@@ -102,6 +119,12 @@ class TpuStateMachine:
         # Growth hint only (NOT a dispatch precondition): history rows can
         # only ever append if some create_accounts batch requested the flag.
         self._history_accounts_possible = False
+        # Fast-path preconditions (ops/state_machine.py P1/P3): once any
+        # account carries limit flags, plain batches must run the full
+        # kernel; _balance_bound over-approximates every balance field so
+        # the overflow ladder provably cannot fire on the fast path.
+        self._limit_accounts_possible = False
+        self._balance_bound = 0
         # Secondary index for get_account_transfers (ops/index.py): derived
         # state, rebuilt from the table after restore/state-sync.
         from .ops.index import TransferIndex
@@ -189,6 +212,8 @@ class TpuStateMachine:
         if operation == "create_accounts":
             if bool((batch["flags"] & types.AccountFlags.HISTORY).any()):
                 self._history_accounts_possible = True
+            if bool((batch["flags"] & _LIMIT_FLAGS).any()):
+                self._limit_accounts_possible = True
             self._engine_grow(accounts=count)
             codes = self._engine.create_accounts(batch, timestamp)
             self._accounts_bound += count
@@ -240,6 +265,14 @@ class TpuStateMachine:
             self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
             self._bloom_dev, cold_checked,
         )
+        if self._fast_path_ok(np.zeros(0, dtype=types.TRANSFER_DTYPE)):
+            # Only pay the extra compile when the fast path is reachable
+            # (tiering / restored limit flags / blown balance bound disable
+            # it for the process lifetime).
+            self.ledger, codes_f = sm.create_transfers_fast(
+                self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
+            )
+            np.asarray(codes_f)
         np.asarray(codes_a), np.asarray(codes_t), int(kflags)
 
     # -- prepare (state_machine.zig:503-512) --------------------------------
@@ -316,6 +349,8 @@ class TpuStateMachine:
         self._grow_if_needed(accounts=count)
         if bool((batch["flags"] & types.AccountFlags.HISTORY).any()):
             self._history_accounts_possible = True
+        if bool((batch["flags"] & _LIMIT_FLAGS).any()):
+            self._limit_accounts_possible = True
         soa = self._pad_soa(batch)
         self.ledger, codes = sm.create_accounts(
             self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
@@ -347,8 +382,12 @@ class TpuStateMachine:
         if self._engine is not None:
             return self._engine_commit("create_transfers", batch, timestamp)
 
+        self._note_balance_bound(batch)
         if self.force_sequential:
             return self._sequential("create_transfers", batch, timestamp)
+
+        if self._fast_path_ok(batch):
+            return self._commit_fast(batch, timestamp, count)
 
         from .ops import transfer_full as tf
 
@@ -401,6 +440,62 @@ class TpuStateMachine:
             if self._tiering and self._evictions != ev0 and cold_checked is not None:
                 cold_checked = jnp.zeros((self.batch_lanes,), jnp.bool_)
         raise RuntimeError("transfer kernel could not place batch after growth")
+
+    def _note_balance_bound(self, batch: np.ndarray) -> None:
+        """Over-approximate the largest possible single balance field after
+        this batch (fast-path precondition P3: the overflow ladder cannot
+        fire below 2^126). Non-balancing amounts add at most count * max;
+        each balancing lane can move at most the current bound (its clamp is
+        bounded by an existing balance). Ledgers that blow the bound just
+        lose the fast path — correctness never depends on it."""
+        if self._balance_bound >= _BOUND_CLAMP or len(batch) == 0:
+            return
+        mx = (int(batch["amount_hi"].max()) << 64) | int(batch["amount_lo"].max())
+        n_bal = int((
+            (batch["flags"]
+             & (types.TransferFlags.BALANCING_DEBIT
+                | types.TransferFlags.BALANCING_CREDIT)) != 0
+        ).sum())
+        self._balance_bound += len(batch) * mx + n_bal * self._balance_bound
+        if self._balance_bound > _BOUND_CLAMP:
+            self._balance_bound = _BOUND_CLAMP
+
+    def _fast_path_ok(self, batch: np.ndarray) -> bool:
+        """Plain-transfer batches run the round-1 fast kernel (one light
+        dispatch; the fully-general kernel costs ~20x more on TPU). The
+        preconditions are ops/state_machine.py's P1-P4, checked host-side in
+        a few vector ops over the batch."""
+        if (
+            self._tiering
+            or self._history_accounts_possible
+            or self._limit_accounts_possible
+            or self._balance_bound >= (1 << 126)
+        ):
+            return False
+        if bool((batch["flags"] & _SLOW_TRANSFER_FLAGS).any()):
+            return False
+        if bool(batch["amount_hi"].any()):
+            return False
+        return True
+
+    def _commit_fast(
+        self, batch: np.ndarray, timestamp: int, count: int
+    ) -> List[Tuple[int, int]]:
+        self._grow_if_needed(transfers=count)
+        soa = self._pad_soa(batch)
+        self.ledger, codes = sm.create_transfers_fast(
+            self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
+        )
+        codes = np.asarray(codes)
+        self._transfers_bound += count
+        if bool(np.asarray(self.ledger.transfers.probe_overflow)):
+            # Load-factor management keeps this unreachable; losing inserts
+            # silently is the one unacceptable outcome, so fail loud.
+            raise RuntimeError("transfers probe overflow during fast insert")
+        self._index_append(soa, codes, count)
+        results = self._compress(codes, count)
+        self._update_commit_timestamp(codes, count, timestamp)
+        return results
 
     def _maybe_evict_between_batches(self) -> None:
         hot_max = self.hot_transfers_capacity_max
@@ -607,6 +702,8 @@ class TpuStateMachine:
             self._grow_if_needed(accounts=count)
             if bool((batch["flags"] & types.AccountFlags.HISTORY).any()):
                 self._history_accounts_possible = True
+            if bool((batch["flags"] & _LIMIT_FLAGS).any()):
+                self._limit_accounts_possible = True
             pv_count = hist_count = 0
         else:
             if self.cold.count:
@@ -841,6 +938,8 @@ class TpuStateMachine:
             "posted_bound": self._posted_bound,
             "history_bound": self._history_bound,
             "history_accounts_possible": self._history_accounts_possible,
+            "limit_accounts_possible": self._limit_accounts_possible,
+            "balance_bound": min(self._balance_bound, _BOUND_CLAMP),
             "cold_manifest": self.cold.manifest(),
             "bloom_log2": self._bloom_log2,
         }
@@ -867,6 +966,12 @@ class TpuStateMachine:
         self._history_accounts_possible = bool(
             state.get("history_accounts_possible", True)
         )
+        # Absent fields (older checkpoints) default to "fast path off" —
+        # always safe.
+        self._limit_accounts_possible = bool(
+            state.get("limit_accounts_possible", True)
+        )
+        self._balance_bound = int(state.get("balance_bound", _BOUND_CLAMP))
         manifest = state.get("cold_manifest", [])
         if manifest:
             self._tiering = True

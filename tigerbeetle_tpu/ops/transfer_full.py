@@ -32,11 +32,12 @@ iteration: pass k computes balances from pass k-1's (accepted, amount)
 vector, then re-evaluates every ladder.  References only point to earlier
 lanes and a stable pass (codes AND amounts unchanged) is a fixpoint of the
 exact "evaluate lane i given outcomes of lanes j<i" operator, whose fixpoint
-is unique and equal to the sequential answer (induction over lanes).  Three
-passes resolve every batch whose outcome-change cascade depth is <= 2 —
-which covers realistic workloads (uncontended limit accounts converge in 2;
-one clamp/rejection cascade adds 1); deeper cascades set FLAG_SEQ via the
-stability check and run sequentially.
+is unique and equal to the sequential answer (induction over lanes).  The
+pass runs under a lax.while_loop with an early-exit stability check: pass
+k+1 resolves every batch whose outcome-change cascade depth is <= k
+(uncontended batches stabilize in 2 passes; each clamp/rejection cascade
+adds 1), up to _MAX_PASSES; deeper cascades set FLAG_SEQ and run
+sequentially.
 
 The remaining FLAG_SEQ routes are genuinely order-chaotic or out-of-scope
 for the u64-limb delta machinery: unconverged fixpoints, u128 amounts,
