@@ -48,6 +48,17 @@ and balance reconstructions that overflow u128.  When any flag bit is set
 the kernel applies NOTHING (every scatter is masked off; the returned ledger
 equals the input) and the host dispatcher (machine.py) re-routes the batch
 to the sequential path or grows a table and retries.
+
+Structure (round-3 refactor for the sharded path, parallel/sharded.py):
+
+    GatherCtx       every table-derived input, assembled either by local
+                    ht.lookup (single chip) or masked-probe + psum combine
+                    over a device mesh (every shard then holds the full,
+                    replicated context);
+    _kernel_core    the PURE batch semantics: Jacobi loop, ladders, balance
+                    legs — identical replicated math on every shard, no
+                    table access;
+    apply           claims + scatters, owner-local on a mesh.
 """
 
 from __future__ import annotations
@@ -99,6 +110,61 @@ _BALANCE_CODES = (47, 48, 49, 50, 51, 52, 54, 55)
 # stable pass is THE answer, so this bounds only how deep accept/reject
 # cascades may go before the batch routes to the sequential path.
 _MAX_PASSES = 8
+
+# Account balance fields carried through GatherCtx (limb pairs).
+_BAL_FIELDS = (
+    "debits_pending", "debits_posted", "credits_pending", "credits_posted",
+)
+
+
+class AccountView(NamedTuple):
+    """The slice of an account row the kernel core needs."""
+
+    found: jax.Array  # bool[N]
+    slot: jax.Array  # uint64[N] — GLOBAL slot id (mesh: owner-offset)
+    flags: jax.Array  # uint32[N]
+    ledger: jax.Array  # uint32[N]
+    bal: Dict[str, jax.Array]  # {field_lo/_hi: uint64[N]}
+
+
+class GatherCtx(NamedTuple):
+    """Every table-derived input of the pure kernel core.
+
+    Single-chip: built by local probes (build_gather_ctx). Mesh: every
+    shard probes its partition and psums the masked results, after which
+    the ctx is replicated (parallel/sharded.py)."""
+
+    ex_found: jax.Array
+    e_tab: Dict[str, jax.Array]
+    p_tab_found: jax.Array
+    p_tab: Dict[str, jax.Array]
+    drT: AccountView  # the event's own debit account
+    crT: AccountView
+    pdr: AccountView  # the TABLE pending's debit account
+    pcr: AccountView
+    postedT_found: jax.Array
+    postedT_val: jax.Array
+    probe_grow: jax.Array  # uint32 scalar: FLAG_GROW_*/FLAG_COLD bits
+    accounts_capacity: jax.Array  # uint64 scalar: GLOBAL slot-space bound
+
+
+class ApplyPlan(NamedTuple):
+    """Everything the (single-chip or owner-local) apply phase needs."""
+
+    codes: jax.Array  # uint32[N] final result codes
+    route: jax.Array  # uint32 scalar: FLAG_SEQ bit (pure routing only)
+    ok: jax.Array  # bool[N]
+    row: Dict[str, jax.Array]  # composed transfer rows to insert
+    post: jax.Array  # bool[N]
+    posted_key: jax.Array  # uint64[N] pending timestamps (0 = none)
+    pv_ok: jax.Array  # bool[N]
+    # Balance scatter set (sorted leg domain, 2N):
+    s_slot: jax.Array  # uint64[2N] global slots (capacity = sentinel)
+    scat: jax.Array  # bool[2N] last live leg of each slot run
+    bal_incl: Dict[str, jax.Array]  # {field_lo/_hi: uint64[2N]} final values
+    # History (single-chip only; sharded mode excludes history accounts):
+    do_hist: jax.Array  # bool[N]
+    hist_row: Dict[str, jax.Array]
 
 
 def _first_code(checks) -> jnp.ndarray:
@@ -163,7 +229,7 @@ def _group_winner(idx: IdIndex, ok: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 def _limbs_to_u128(lo_limb: jax.Array, hi_limb: jax.Array) -> U128:
-    """Recombine 32-bit limb sums (each < 2**46 for <=16k terms) into u128."""
+    """Recombine 32-bit limb sums (each < 2**47 for <=32k terms) into u128."""
     low = lo_limb + ((hi_limb & _U32MASK) << jnp.uint64(32))
     carry = (low < lo_limb).astype(jnp.uint64)
     return U128(low, (hi_limb >> jnp.uint64(32)) + carry)
@@ -190,7 +256,8 @@ class _LegBalances(NamedTuple):
 
 
 def _leg_balances(
-    ledger: Ledger,
+    start_bal: Dict[str, jax.Array],
+    cap_sentinel: jax.Array,
     ok_lanes: jax.Array,
     amt_lo: jax.Array,
     pamt_lo: jax.Array,
@@ -209,10 +276,12 @@ def _leg_balances(
     runs reconstruct each account's exact field values before/after every
     event.  Deltas are gated by ``ok_lanes`` (the previous Jacobi iterate);
     ``amt_lo``/``pamt_lo`` are the previous iterate's effective / pending
-    amounts (u64 — u128 amounts route to FLAG_SEQ)."""
+    amounts (u64 — u128 amounts route to FLAG_SEQ).  ``start_bal`` carries
+    each LEG's account start balances ({field_lo/_hi: uint64[2N]}, leg
+    domain, pre-sort), composed from the GatherCtx account views — every
+    leg of a slot run belongs to the same account, so each leg's own value
+    is its run's start."""
     n = ok_lanes.shape[0]
-    cap = ledger.accounts.capacity
-    cap_sentinel = jnp.uint64(cap)
 
     leg_slot_raw = jnp.stack([dr_slot, cr_slot], axis=1).reshape(-1)
     leg_live_raw = jnp.stack([dr_live, cr_live], axis=1).reshape(-1)
@@ -255,6 +324,10 @@ def _leg_balances(
     # parts — part sums over <= 2^15 legs stay < 2^31, so a single native
     # (2N, 24) u32 cumsum + one shared run-start cummax computes everything,
     # and the u64 limb sums are recombined per gathered leg afterwards.
+    # Streams are permuted 1D BEFORE stacking (2D row gathers lower to
+    # per-row DMAs on TPU); run bases come from a columnwise cummax —
+    # exclusive sums at run heads are nondecreasing down the array, so
+    # max-carry propagates each run's base with no gather.
     m16 = jnp.uint64(0xFFFF)
 
     def parts(d):
@@ -265,11 +338,6 @@ def _leg_balances(
             (d >> jnp.uint64(48)).astype(jnp.uint32),
         ]
 
-    # Permute each u64 stream in 1D BEFORE stacking (2D row gathers lower to
-    # per-row DMAs on TPU: measured ~70ms/batch; 1D gathers are free), then
-    # one native u32 cumsum over the (2N, 24) stack. Run bases come from a
-    # columnwise cummax — exclusive sums at run heads are nondecreasing down
-    # the array, so max-carry propagates each run's base with no gather.
     v = jnp.stack(
         parts(dp_add[leg_order]) + parts(dp_sub[leg_order])
         + parts(dpo_add[leg_order]) + parts(cp_add[leg_order])
@@ -281,14 +349,6 @@ def _leg_balances(
     incl_all = c - base
     excl_all = incl_all - v
 
-    safe_slot = jnp.where(s_live, s_slot, 0)
-    acols = ledger.accounts.cols
-
-    def start(field):
-        return U128(
-            acols[field + "_lo"][safe_slot], acols[field + "_hi"][safe_slot]
-        )
-
     zeros2n = jnp.zeros((2 * n,), jnp.uint64)
 
     def recombine(limbs, col):
@@ -297,14 +357,19 @@ def _leg_balances(
             limbs[:, col + 1].astype(jnp.uint64) << jnp.uint64(16)
         )
 
-    def field_vals(start_bal, col, has_sub):
+    def field_vals(field, col, has_sub):
+        start = U128(
+            start_bal[field + "_lo"][leg_order],
+            start_bal[field + "_hi"][leg_order],
+        )
+
         def at(limbs):
             add = _limbs_to_u128(recombine(limbs, col), recombine(limbs, col + 2))
             sub = (
                 _limbs_to_u128(recombine(limbs, col + 4), recombine(limbs, col + 6))
                 if has_sub else U128(zeros2n, zeros2n)
             )
-            added, ov = u128.add(start_bal, add)
+            added, ov = u128.add(start, add)
             val, neg = u128.sub(added, sub)
             return val, ov | neg
 
@@ -312,10 +377,10 @@ def _leg_balances(
         incl, bad_i = at(incl_all)
         return pre, incl, bad_e | bad_i
 
-    dp_pre, dp_incl, bad1 = field_vals(start("debits_pending"), 0, True)
-    dpo_pre, dpo_incl, bad2 = field_vals(start("debits_posted"), 8, False)
-    cp_pre, cp_incl, bad3 = field_vals(start("credits_pending"), 12, True)
-    cpo_pre, cpo_incl, bad4 = field_vals(start("credits_posted"), 20, False)
+    dp_pre, dp_incl, bad1 = field_vals("debits_pending", 0, True)
+    dpo_pre, dpo_incl, bad2 = field_vals("debits_posted", 8, False)
+    cp_pre, cp_incl, bad3 = field_vals("credits_pending", 12, True)
+    cpo_pre, cpo_incl, bad4 = field_vals("credits_posted", 20, False)
     arith_broken = jnp.any(s_live & (bad1 | bad2 | bad3 | bad4))
 
     return _LegBalances(
@@ -333,44 +398,34 @@ def _at(val: U128, pos: jax.Array) -> U128:
     return U128(val.lo[pos], val.hi[pos])
 
 
-def create_transfers_full_impl(
+def _account_view(table, look, found, rows=None) -> AccountView:
+    rows = rows if rows is not None else ht.gather_cols(table, look.slot, found)
+    return AccountView(
+        found=found,
+        slot=look.slot,
+        flags=rows["flags"],
+        ledger=rows["ledger"],
+        bal={
+            f + l: rows[f + l] for f in _BAL_FIELDS for l in ("_lo", "_hi")
+        },
+    )
+
+
+def build_gather_ctx(
     ledger: Ledger,
     batch: Dict[str, jax.Array],
-    count: jax.Array,
-    timestamp: jax.Array,
+    valid: jax.Array,
+    postvoid: jax.Array,
     bloom: jax.Array = None,
     cold_checked: jax.Array = None,
-) -> Tuple[Ledger, jax.Array, jax.Array]:
-    """Returns (ledger', codes uint32[N], flags uint32 scalar).
-
-    flags == 0: the batch was applied and ``codes`` are the final results.
-    flags != 0: NOTHING was applied (ledger' == ledger value-wise); the host
-    must grow the flagged tables, resolve cold ids (FLAG_COLD: ``bloom`` is
-    the cold-id filter, ``cold_checked`` marks lanes the host already
-    certified), and/or re-route to the sequential path.
-    """
+) -> GatherCtx:
+    """Single-chip GatherCtx: local probes of the ledger tables."""
     n = batch["id_lo"].shape[0]
-    assert n <= 1 << 14, "leg sort key packs (slot, legpos<2^15)"
-    lane = jnp.arange(n, dtype=jnp.int32)
-    valid = lane < count.astype(jnp.int32)
-    ts = _timestamps(count, timestamp, n)
-
     tid = _u128_col(batch, "id")
+    pend_id = _u128_col(batch, "pending_id")
     t_dr_id = _u128_col(batch, "debit_account_id")
     t_cr_id = _u128_col(batch, "credit_account_id")
-    t_amt = _u128_col(batch, "amount")
-    pend_id = _u128_col(batch, "pending_id")
-    flags = batch["flags"]
-    post = ((flags & TF_POST) != 0) & valid
-    void = ((flags & TF_VOID) != 0) & valid
-    postvoid = post | void
-    pending_f = ((flags & TF_PENDING) != 0) & valid
-    linked = ((flags & TF_LINKED) != 0) & valid
-    bal_dr = ((flags & TF_BALANCING_DEBIT) != 0) & valid
-    bal_cr = ((flags & TF_BALANCING_CREDIT) != 0) & valid
-    balancing = bal_dr | bal_cr
 
-    # ---------------- table gathers (iteration-invariant) -----------------
     ex_look = ht.lookup(ledger.transfers, tid.lo, tid.hi, MAX_PROBE)
     ex_found = ex_look.found & valid
     e_tab = ht.gather_cols(ledger.transfers, ex_look.slot, ex_found)
@@ -381,10 +436,8 @@ def create_transfers_full_impl(
 
     drT_look = ht.lookup(ledger.accounts, t_dr_id.lo, t_dr_id.hi, MAX_PROBE)
     crT_look = ht.lookup(ledger.accounts, t_cr_id.lo, t_cr_id.hi, MAX_PROBE)
-    drT_found = drT_look.found & valid
-    crT_found = crT_look.found & valid
-    drT = ht.gather_cols(ledger.accounts, drT_look.slot, drT_found)
-    crT = ht.gather_cols(ledger.accounts, crT_look.slot, crT_found)
+    drT = _account_view(ledger.accounts, drT_look, drT_look.found & valid)
+    crT = _account_view(ledger.accounts, crT_look, crT_look.found & valid)
 
     # Accounts of a TABLE pending (post/void operates on the pending's
     # accounts, state_machine.zig:1420-1423).
@@ -395,6 +448,12 @@ def create_transfers_full_impl(
     pcr_look = ht.lookup(
         ledger.accounts, p_tab["credit_account_id_lo"],
         p_tab["credit_account_id_hi"], MAX_PROBE,
+    )
+    pdr = _account_view(
+        ledger.accounts, pdr_look, pdr_look.found & p_tab_found
+    )
+    pcr = _account_view(
+        ledger.accounts, pcr_look, pcr_look.found & p_tab_found
     )
 
     # Posted-groove fulfillment for a TABLE pending (key: its timestamp).
@@ -443,6 +502,49 @@ def create_transfers_full_impl(
             jnp.any(cold_ids | cold_pend), jnp.uint32(FLAG_COLD), jnp.uint32(0)
         )
 
+    return GatherCtx(
+        ex_found=ex_found, e_tab=e_tab,
+        p_tab_found=p_tab_found, p_tab=p_tab,
+        drT=drT, crT=crT, pdr=pdr, pcr=pcr,
+        postedT_found=postedT_found, postedT_val=postedT_val,
+        probe_grow=probe_grow,
+        accounts_capacity=jnp.uint64(ledger.accounts.capacity),
+    )
+
+
+def _kernel_core(
+    ctx: GatherCtx,
+    batch: Dict[str, jax.Array],
+    count: jax.Array,
+    timestamp: jax.Array,
+) -> ApplyPlan:
+    """The pure batch semantics: no table access, replicable on a mesh."""
+    n = batch["id_lo"].shape[0]
+    assert n <= 1 << 14, "leg sort key packs (slot, legpos<2^15)"
+    lane = jnp.arange(n, dtype=jnp.int32)
+    valid = lane < count.astype(jnp.int32)
+    ts = _timestamps(count, timestamp, n)
+
+    tid = _u128_col(batch, "id")
+    t_dr_id = _u128_col(batch, "debit_account_id")
+    t_cr_id = _u128_col(batch, "credit_account_id")
+    t_amt = _u128_col(batch, "amount")
+    pend_id = _u128_col(batch, "pending_id")
+    flags = batch["flags"]
+    post = ((flags & TF_POST) != 0) & valid
+    void = ((flags & TF_VOID) != 0) & valid
+    postvoid = post | void
+    pending_f = ((flags & TF_PENDING) != 0) & valid
+    linked = ((flags & TF_LINKED) != 0) & valid
+    bal_dr = ((flags & TF_BALANCING_DEBIT) != 0) & valid
+    bal_cr = ((flags & TF_BALANCING_CREDIT) != 0) & valid
+    balancing = bal_dr | bal_cr
+
+    ex_found, e_tab = ctx.ex_found, ctx.e_tab
+    p_tab_found, p_tab = ctx.p_tab_found, ctx.p_tab
+    drT, crT, pdr, pcr = ctx.drT, ctx.crT, ctx.pdr, ctx.pcr
+    cap_sentinel = ctx.accounts_capacity
+
     idx = _build_id_index(tid.lo, tid.hi)
 
     # In-batch pending-create candidate group for each pv lane.
@@ -453,8 +555,8 @@ def create_transfers_full_impl(
 
     timeout_ns = batch["timeout"].astype(jnp.uint64) * jnp.uint64(NS_PER_S)
     ov_timeout = (ts + timeout_ns) < ts
-    dr_limf = ((drT["flags"] & AF_DEBITS_MUST_NOT_EXCEED_CREDITS) != 0) & drT_found
-    cr_limf = ((crT["flags"] & AF_CREDITS_MUST_NOT_EXCEED_DEBITS) != 0) & crT_found
+    dr_limf = ((drT.flags & AF_DEBITS_MUST_NOT_EXCEED_CREDITS) != 0) & drT.found
+    cr_limf = ((crT.flags & AF_CREDITS_MUST_NOT_EXCEED_DEBITS) != 0) & crT.found
 
     # ------------------------------------------------------------------
     # One Jacobi pass of the sequential semantics.
@@ -493,30 +595,30 @@ def create_transfers_full_impl(
         p_dr_id = U128(p["debit_account_id_lo"], p["debit_account_id_hi"])
         p_cr_id = U128(p["credit_account_id_lo"], p["credit_account_id_hi"])
 
-        # Effective account slots (regular: own; pv: the pending's).
-        dr_slot = jnp.where(
-            in_batch_ref, drT_look.slot[pwc],
-            jnp.where(postvoid, pdr_look.slot, drT_look.slot),
-        )
-        cr_slot = jnp.where(
-            in_batch_ref, crT_look.slot[pwc],
-            jnp.where(postvoid, pcr_look.slot, crT_look.slot),
-        )
-        dr_live = jnp.where(
-            in_batch_ref, drT_found[pwc],
-            jnp.where(postvoid, pdr_look.found & p_tab_found, drT_found),
-        ) & valid
-        cr_live = jnp.where(
-            in_batch_ref, crT_found[pwc],
-            jnp.where(postvoid, pcr_look.found & p_tab_found, crT_found),
-        ) & valid
-        acc_flags_dr = ledger.accounts.cols["flags"][dr_slot]
-        acc_flags_cr = ledger.accounts.cols["flags"][cr_slot]
+        # Effective accounts (regular: own; pv: the pending's), composed
+        # from the gathered views — no table access.
+        def compose(own: AccountView, pend_side: AccountView):
+            def pick(o, pv_):
+                return jnp.where(in_batch_ref, o[pwc], jnp.where(postvoid, pv_, o))
+
+            return (
+                pick(own.slot, pend_side.slot),
+                pick(own.found, pend_side.found) & valid,
+                pick(own.flags, pend_side.flags),
+                {k: pick(own.bal[k], pend_side.bal[k]) for k in own.bal},
+            )
+
+        dr_slot, dr_live, acc_flags_dr, dr_bal = compose(drT, pdr)
+        cr_slot, cr_live, acc_flags_cr, cr_bal = compose(crT, pcr)
 
         # --- exact running balances from the previous iterate -------------
+        start_bal = {
+            k: jnp.stack([dr_bal[k], cr_bal[k]], axis=1).reshape(-1)
+            for k in dr_bal
+        }
         legs = _leg_balances(
-            ledger, ok_prev, amt_prev.lo, p_amt.lo, dr_slot, cr_slot,
-            dr_live, cr_live, pending_f, post, postvoid,
+            start_bal, cap_sentinel, ok_prev, amt_prev.lo, p_amt.lo,
+            dr_slot, cr_slot, dr_live, cr_live, pending_f, post, postvoid,
         )
         dpos = legs.leg_pos[2 * lane]
         cpos = legs.leg_pos[2 * lane + 1]
@@ -599,10 +701,10 @@ def create_transfers_full_impl(
             (~balancing & u128.is_zero(t_amt), 18),
             ((batch["ledger"] == 0), 19),
             ((batch["code"] == 0), 20),
-            (~drT_found, 21),
-            (~crT_found, 22),
-            ((drT["ledger"] != crT["ledger"]), 23),
-            ((batch["ledger"] != drT["ledger"]), 24),
+            (~drT.found, 21),
+            (~crT.found, 22),
+            ((drT.ledger != crT.ledger), 23),
+            ((batch["ledger"] != drT.ledger), 24),
             (ex_found, exists_tab_reg),
             (exceeds_credits_bal, 54),
             (exceeds_debits_bal, 55),
@@ -641,8 +743,8 @@ def create_transfers_full_impl(
             (u128.gt(amount, p_amt), 31),
             (void & u128.lt(amount, p_amt), 32),
             (ex_found, exists_tab_pv),
-            (postedT_found & (postedT_val == 1), 33),
-            (postedT_found & (postedT_val == 2), 34),
+            (ctx.postedT_found & (ctx.postedT_val == 1), 33),
+            (ctx.postedT_found & (ctx.postedT_val == 2), 34),
             (expired, 35),
         ])
 
@@ -752,8 +854,6 @@ def create_transfers_full_impl(
     )
     unconverged = ~converged
 
-    dr_slot, cr_slot = aux["dr_slot"], aux["cr_slot"]
-    p_amt = aux["p_amt"]
     row = aux["row"]
     in_batch_ref = aux["in_batch_ref"]
     legs = aux["legs"]
@@ -791,66 +891,16 @@ def create_transfers_full_impl(
         chain_failed & (balancing | dr_limf | cr_limf | aux["near_ov"])
     ) | jnp.any(failed_member_balance)
 
-    # Insert slots are claimed (no writes) BEFORE the flags are finalized so
-    # an insert-probe overflow also routes the batch with nothing applied.
-    t_claim, t_ovf = ht.claim_slots(ledger.transfers, tid.lo, tid.hi, ok, MAX_PROBE)
-    pv_ok_pre = ok & postvoid
-    posted_key = jnp.where(pv_ok_pre, aux["p"]["timestamp"], 0)
-    p_claim, p_ovf = ht.claim_slots(
-        ledger.posted, posted_key, jnp.zeros((n,), jnp.uint64), pv_ok_pre, MAX_PROBE
-    )
-    probe_grow = (
-        probe_grow
-        | jnp.where(t_ovf, jnp.uint32(FLAG_GROW_TRANSFERS), jnp.uint32(0))
-        | jnp.where(p_ovf, jnp.uint32(FLAG_GROW_POSTED), jnp.uint32(0))
-    )
-
-    kflags = probe_grow | jnp.where(
+    route = jnp.where(
         unconverged | any_u128_amount | linked_x_intra | chain_hazard
         | legs.arith_broken,
         jnp.uint32(FLAG_SEQ), jnp.uint32(0),
     )
-    commit = kflags == jnp.uint32(0)
 
-    # ---------------- apply: balances (one scatter over slot runs) ---------
-    # The final pass's inclusive values were computed from (ok2, amt2) which
-    # equal (ok, amount) whenever the batch commits (stability), so the last
-    # leg of each slot run carries the slot's exact final field values.
-    scat = legs.is_last & legs.s_live & commit
-    cap_sentinel = jnp.uint64(ledger.accounts.capacity)
-    accounts = ht.scatter_cols(
-        ledger.accounts, jnp.where(scat, legs.s_slot, cap_sentinel), scat,
-        {
-            "debits_pending_lo": legs.dp_incl.lo, "debits_pending_hi": legs.dp_incl.hi,
-            "debits_posted_lo": legs.dpo_incl.lo, "debits_posted_hi": legs.dpo_incl.hi,
-            "credits_pending_lo": legs.cp_incl.lo, "credits_pending_hi": legs.cp_incl.hi,
-            "credits_posted_lo": legs.cpo_incl.lo, "credits_posted_hi": legs.cpo_incl.hi,
-        },
-    )
-
-    # ---------------- apply: transfer + posted inserts ---------------------
-    ins_rows = {name: row[name].astype(dt) for name, dt in TRANSFER_COLS.items()}
-    transfers = ht.write_rows(
-        ledger.transfers, tid.lo, tid.hi, t_claim, ok & commit, ins_rows
-    )
-    posted = ht.write_rows(
-        ledger.posted,
-        posted_key,
-        jnp.zeros((n,), jnp.uint64),
-        p_claim,
-        pv_ok_pre & commit,
-        {"fulfillment": jnp.where(post, jnp.uint32(1), jnp.uint32(2))},
-    )
-
-    # ---------------- apply: history rows ---------------------------------
+    # ---------------- history rows (values; apply decides placement) -------
     # Each recorded account's post-event snapshot of ALL FOUR fields is the
     # inclusive value at that event's leg (leg order = event order within the
     # slot run, and cross-side legs of the same account share the run).
-    do_hist_c = do_hist & commit
-    h = ledger.history
-    h_off = jnp.cumsum(do_hist_c.astype(jnp.uint64)) - do_hist_c.astype(jnp.uint64)
-    h_idx = jnp.where(do_hist_c, h.count + h_off, jnp.uint64(h.capacity))
-
     dpos = legs.leg_pos[2 * lane]
     cpos = legs.leg_pos[2 * lane + 1]
 
@@ -883,9 +933,102 @@ def create_transfers_full_impl(
         "cr_dp_lo": cr_dp_lo, "cr_dp_hi": cr_dp_hi,
         "cr_dpo_lo": cr_dpo_lo, "cr_dpo_hi": cr_dpo_hi,
     }
+
+    pv_ok = ok & postvoid
+    posted_key = jnp.where(pv_ok, aux["p"]["timestamp"], 0)
+    bal_incl = {
+        "debits_pending_lo": legs.dp_incl.lo, "debits_pending_hi": legs.dp_incl.hi,
+        "debits_posted_lo": legs.dpo_incl.lo, "debits_posted_hi": legs.dpo_incl.hi,
+        "credits_pending_lo": legs.cp_incl.lo, "credits_pending_hi": legs.cp_incl.hi,
+        "credits_posted_lo": legs.cpo_incl.lo, "credits_posted_hi": legs.cpo_incl.hi,
+    }
+    return ApplyPlan(
+        codes=codes, route=route, ok=ok, row=row, post=post,
+        posted_key=posted_key, pv_ok=pv_ok,
+        s_slot=legs.s_slot, scat=legs.is_last & legs.s_live,
+        bal_incl=bal_incl, do_hist=do_hist, hist_row=hist_row,
+    )
+
+
+def create_transfers_full_impl(
+    ledger: Ledger,
+    batch: Dict[str, jax.Array],
+    count: jax.Array,
+    timestamp: jax.Array,
+    bloom: jax.Array = None,
+    cold_checked: jax.Array = None,
+) -> Tuple[Ledger, jax.Array, jax.Array]:
+    """Returns (ledger', codes uint32[N], flags uint32 scalar).
+
+    flags == 0: the batch was applied and ``codes`` are the final results.
+    flags != 0: NOTHING was applied (ledger' == ledger value-wise); the host
+    must grow the flagged tables, resolve cold ids (FLAG_COLD: ``bloom`` is
+    the cold-id filter, ``cold_checked`` marks lanes the host already
+    certified), and/or re-route to the sequential path.
+    """
+    n = batch["id_lo"].shape[0]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    valid = lane < count.astype(jnp.int32)
+    flags = batch["flags"]
+    postvoid = (((flags & TF_POST) != 0) | ((flags & TF_VOID) != 0)) & valid
+    tid = _u128_col(batch, "id")
+
+    ctx = build_gather_ctx(ledger, batch, valid, postvoid, bloom, cold_checked)
+    plan = _kernel_core(ctx, batch, count, timestamp)
+
+    # Insert slots are claimed (no writes) BEFORE the flags are finalized so
+    # an insert-probe overflow also routes the batch with nothing applied.
+    t_claim, t_ovf = ht.claim_slots(
+        ledger.transfers, tid.lo, tid.hi, plan.ok, MAX_PROBE
+    )
+    p_claim, p_ovf = ht.claim_slots(
+        ledger.posted, plan.posted_key, jnp.zeros((n,), jnp.uint64),
+        plan.pv_ok, MAX_PROBE,
+    )
+    kflags = (
+        ctx.probe_grow
+        | plan.route
+        | jnp.where(t_ovf, jnp.uint32(FLAG_GROW_TRANSFERS), jnp.uint32(0))
+        | jnp.where(p_ovf, jnp.uint32(FLAG_GROW_POSTED), jnp.uint32(0))
+    )
+    commit = kflags == jnp.uint32(0)
+
+    # ---------------- apply: balances (one scatter over slot runs) ---------
+    # The final pass's inclusive values were computed from the second-to-
+    # last iterate, which equals the final (ok, amount) whenever the batch
+    # commits (stability), so the last leg of each slot run carries the
+    # slot's exact final field values.
+    scat = plan.scat & commit
+    cap_sentinel = jnp.uint64(ledger.accounts.capacity)
+    accounts = ht.scatter_cols(
+        ledger.accounts, jnp.where(scat, plan.s_slot, cap_sentinel), scat,
+        plan.bal_incl,
+    )
+
+    # ---------------- apply: transfer + posted inserts ---------------------
+    ins_rows = {
+        name: plan.row[name].astype(dt) for name, dt in TRANSFER_COLS.items()
+    }
+    transfers = ht.write_rows(
+        ledger.transfers, tid.lo, tid.hi, t_claim, plan.ok & commit, ins_rows
+    )
+    posted = ht.write_rows(
+        ledger.posted,
+        plan.posted_key,
+        jnp.zeros((n,), jnp.uint64),
+        p_claim,
+        plan.pv_ok & commit,
+        {"fulfillment": jnp.where(plan.post, jnp.uint32(1), jnp.uint32(2))},
+    )
+
+    # ---------------- apply: history rows ---------------------------------
+    do_hist_c = plan.do_hist & commit
+    h = ledger.history
+    h_off = jnp.cumsum(do_hist_c.astype(jnp.uint64)) - do_hist_c.astype(jnp.uint64)
+    h_idx = jnp.where(do_hist_c, h.count + h_off, jnp.uint64(h.capacity))
     history = h.replace(
         cols={
-            name: h.cols[name].at[h_idx].set(hist_row[name], mode="drop")
+            name: h.cols[name].at[h_idx].set(plan.hist_row[name], mode="drop")
             for name in h.cols
         },
         count=h.count + jnp.sum(do_hist_c.astype(jnp.uint64)),
@@ -894,7 +1037,7 @@ def create_transfers_full_impl(
     out = Ledger(
         accounts=accounts, transfers=transfers, posted=posted, history=history
     )
-    return out, codes, kflags
+    return out, plan.codes, kflags
 
 
 def _exists_regular(t, e, t_amount: U128, n) -> jax.Array:

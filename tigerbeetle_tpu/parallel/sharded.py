@@ -24,13 +24,16 @@ Determinism: every collective is a sum of disjoint (owner-masked) terms, and
 all apply-phase writes are owner-local — byte-identical to the single-chip
 kernels, which the tests check on a virtual 8-device CPU mesh.
 
-Scope: the sharded kernels cover the flagship workload — plain
-create_accounts/create_transfers (the benchmark shape) plus lookups.  The
-full two-phase/balancing kernel (ops/transfer_full.py) runs single-chip;
-its in-batch dependency machinery is pure/replicable, but its gathers and
-applies interleave with local tables, so sharding it is a planned refactor
-rather than a wrapper.  A cluster needing sharded capacity for two-phase
-flows today routes those batches to the owner shard's single-chip path.
+Scope: the sharded kernels cover plain create_accounts/create_transfers
+(the benchmark shape), point lookups, AND the fully-general two-phase/
+balancing kernel (sharded_create_transfers_full): ops/transfer_full.py's
+round-3 split into GatherCtx -> pure core -> apply means the mesh path
+builds the context with masked probes + psum combines, runs the identical
+Jacobi/ladder math replicated on every shard, and applies owner-locally.
+Admission: history-flagged accounts stay single-chip (history is an
+append-ordered log, not a hash-partitioned table) — the kernel routes such
+batches instead of applying; cold tiering is likewise a single-chip
+concern (no bloom on the mesh path).
 """
 
 from __future__ import annotations
@@ -127,6 +130,7 @@ class _ShardGather:
         local_cap = table.capacity
         self.found_l = look.found & self.owner_mask
         self.slot_l = look.slot
+        self.overflow_l = look.overflow  # local probe exhaustion (bool)
         gslot = my * jnp.uint64(local_cap) + look.slot
         self.found = (
             jax.lax.psum(self.found_l.astype(jnp.uint32), AXIS) > 0
@@ -212,7 +216,205 @@ def sharded_create_transfers(mesh: Mesh):
             mesh=mesh,
             in_specs=(_specs_like(ledger), _replicated_like(batch), P(), P()),
             out_specs=(_specs_like(ledger), P()),
+            # vma-checking is off because ht.lookup's probe while_loop mixes
+            # replicated (keys) and shard-varying (table) carry values; the
+            # library kernels are backend-agnostic and cannot pvary-annotate.
+            # Correctness is covered by byte-parity vs single-chip in
+            # tests/test_sharded.py instead.
             check_vma=False,
+        )(ledger, batch, count, timestamp)
+
+    return jax.jit(step, donate_argnames=("ledger",))
+
+
+def sharded_create_transfers_full(mesh: Mesh):
+    """The fully-general transfer kernel (two-phase/balancing/limits) over
+    the device mesh.
+
+    Context is gathered by masked probes + psum (after which every shard
+    holds the full replicated GatherCtx), the pure Jacobi/ladder core runs
+    replicated, and claims/scatters/inserts apply owner-locally — so the
+    result is byte-identical to the single-chip kernel. History-flagged
+    accounts route (FLAG_SEQ) instead of applying: history is an ordered
+    append log, which stays a single-chip structure.
+
+    Returns fn(ledger, batch, count, timestamp) -> (ledger, codes, kflags).
+    """
+    from ..ops import transfer_full as tf
+    from ..ops.state_machine import TF_POST, TF_VOID
+
+    n_shards = mesh.devices.size
+    shift = n_shards.bit_length() - 1
+
+    def _view(g: _ShardGather, table: ht.Table, found) -> tf.AccountView:
+        rows = g.rows(table)
+        return tf.AccountView(
+            found=found,
+            slot=g.gslot,
+            flags=rows["flags"],
+            ledger=rows["ledger"],
+            bal={
+                f + l: rows[f + l]
+                for f in ("debits_pending", "debits_posted",
+                          "credits_pending", "credits_posted")
+                for l in ("_lo", "_hi")
+            },
+        )
+
+    def local_step(ledger: Ledger, batch, count, timestamp):
+        acc, tr, posted_t = ledger.accounts, ledger.transfers, ledger.posted
+        n = batch["id_lo"].shape[0]
+        lane = jnp.arange(n, dtype=jnp.int32)
+        valid = lane < count.astype(jnp.int32)
+        postvoid = (
+            ((batch["flags"] & TF_POST) != 0) | ((batch["flags"] & TF_VOID) != 0)
+        ) & valid
+
+        ex_g = _ShardGather(tr, batch["id_lo"], batch["id_hi"], n_shards, shift)
+        e_tab = ex_g.rows(tr)
+        p_g = _ShardGather(
+            tr, batch["pending_id_lo"], batch["pending_id_hi"], n_shards, shift
+        )
+        p_tab_found = p_g.found & postvoid
+        # Zero-mask rows exactly like the single-chip gather (mask includes
+        # postvoid): the core treats zeros as "no row".
+        p_tab = {
+            k: jnp.where(p_tab_found, v, jnp.zeros_like(v))
+            for k, v in p_g.rows(tr).items()
+        }
+
+        drT_g = _ShardGather(
+            acc, batch["debit_account_id_lo"], batch["debit_account_id_hi"],
+            n_shards, shift,
+        )
+        crT_g = _ShardGather(
+            acc, batch["credit_account_id_lo"], batch["credit_account_id_hi"],
+            n_shards, shift,
+        )
+        pdr_g = _ShardGather(
+            acc, p_tab["debit_account_id_lo"], p_tab["debit_account_id_hi"],
+            n_shards, shift,
+        )
+        pcr_g = _ShardGather(
+            acc, p_tab["credit_account_id_lo"], p_tab["credit_account_id_hi"],
+            n_shards, shift,
+        )
+        postedT_g = _ShardGather(
+            posted_t, p_tab["timestamp"], jnp.zeros_like(p_tab["timestamp"]),
+            n_shards, shift,
+        )
+        postedT_found = postedT_g.found & p_tab_found
+        postedT_val = postedT_g.rows(posted_t)["fulfillment"]
+
+        def any_shard(local_bool):
+            return jax.lax.psum(local_bool.astype(jnp.uint32), AXIS) > 0
+
+        probe_grow = (
+            jnp.where(
+                any_shard(drT_g.overflow_l | crT_g.overflow_l
+                          | pdr_g.overflow_l | pcr_g.overflow_l),
+                jnp.uint32(tf.FLAG_GROW_ACCOUNTS), jnp.uint32(0),
+            )
+            | jnp.where(
+                any_shard(ex_g.overflow_l | p_g.overflow_l),
+                jnp.uint32(tf.FLAG_GROW_TRANSFERS), jnp.uint32(0),
+            )
+            | jnp.where(
+                any_shard(postedT_g.overflow_l),
+                jnp.uint32(tf.FLAG_GROW_POSTED), jnp.uint32(0),
+            )
+        )
+
+        ctx = tf.GatherCtx(
+            ex_found=ex_g.found & valid,
+            e_tab=e_tab,
+            p_tab_found=p_tab_found,
+            p_tab=p_tab,
+            drT=_view(drT_g, acc, drT_g.found & valid),
+            crT=_view(crT_g, acc, crT_g.found & valid),
+            pdr=_view(pdr_g, acc, pdr_g.found & p_tab_found),
+            pcr=_view(pcr_g, acc, pcr_g.found & p_tab_found),
+            postedT_found=postedT_found,
+            postedT_val=postedT_val,
+            probe_grow=probe_grow,
+            accounts_capacity=jnp.uint64(acc.capacity * n_shards),
+        )
+        plan = tf._kernel_core(ctx, batch, count, timestamp)
+
+        # History admission: the mesh ledger has no history log — route
+        # instead of silently dropping rows.
+        route = plan.route | jnp.where(
+            jnp.any(plan.do_hist), jnp.uint32(tf.FLAG_SEQ), jnp.uint32(0)
+        )
+
+        # Owner-local claims (insert-probe overflow routes with nothing
+        # applied, exactly like single-chip).
+        t_claim, t_ovf = ht.claim_slots(
+            tr, batch["id_lo"], batch["id_hi"],
+            plan.ok & ex_g.owner_mask, MAX_PROBE, hash_shift=shift,
+        )
+        my = jax.lax.axis_index(AXIS).astype(jnp.uint64)
+        pk_owner = (
+            mix64(plan.posted_key, jnp.zeros_like(plan.posted_key))
+            & jnp.uint64(n_shards - 1)
+        ) == my
+        p_claim, p_ovf = ht.claim_slots(
+            posted_t, plan.posted_key, jnp.zeros_like(plan.posted_key),
+            plan.pv_ok & pk_owner, MAX_PROBE, hash_shift=shift,
+        )
+        kflags = (
+            probe_grow
+            | route
+            | jnp.where(
+                any_shard(t_ovf), jnp.uint32(tf.FLAG_GROW_TRANSFERS),
+                jnp.uint32(0),
+            )
+            | jnp.where(
+                any_shard(p_ovf), jnp.uint32(tf.FLAG_GROW_POSTED),
+                jnp.uint32(0),
+            )
+        )
+        commit = kflags == jnp.uint32(0)
+
+        # Balance scatter: global slot runs, owner-local writes.
+        local_cap = acc.capacity
+        base = my * jnp.uint64(local_cap)
+        in_range = (plan.s_slot >= base) & (
+            plan.s_slot < base + jnp.uint64(local_cap)
+        )
+        scat = plan.scat & commit & in_range
+        sentinel = jnp.uint64(local_cap)
+        accounts = ht.scatter_cols(
+            acc, jnp.where(scat, plan.s_slot - base, sentinel), scat,
+            plan.bal_incl,
+        )
+
+        ins_rows = {
+            name: plan.row[name].astype(dt)
+            for name, dt in TRANSFER_COLS.items()
+        }
+        transfers = ht.write_rows(
+            tr, batch["id_lo"], batch["id_hi"], t_claim,
+            plan.ok & commit & ex_g.owner_mask, ins_rows,
+        )
+        posted_out = ht.write_rows(
+            posted_t, plan.posted_key, jnp.zeros_like(plan.posted_key),
+            p_claim, plan.pv_ok & commit & pk_owner,
+            {"fulfillment": jnp.where(plan.post, jnp.uint32(1), jnp.uint32(2))},
+        )
+
+        out = ledger.replace(
+            accounts=accounts, transfers=transfers, posted=posted_out
+        )
+        return out, plan.codes, kflags
+
+    def step(ledger, batch, count, timestamp):
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(_specs_like(ledger), _replicated_like(batch), P(), P()),
+            out_specs=(_specs_like(ledger), P(), P()),
+            check_vma=False,  # see sharded_create_transfers' justification
         )(ledger, batch, count, timestamp)
 
     return jax.jit(step, donate_argnames=("ledger",))
@@ -243,7 +445,7 @@ def sharded_lookup(mesh: Mesh, table_name: str):
             mesh=mesh,
             in_specs=(_specs_like(ledger), P(), P()),
             out_specs=(P(), P()),
-            check_vma=False,
+            check_vma=False,  # see sharded_create_transfers' justification
         )(ledger, id_lo, id_hi)
 
     return jax.jit(step)
@@ -275,6 +477,11 @@ def sharded_create_accounts(mesh: Mesh):
             mesh=mesh,
             in_specs=(_specs_like(ledger), _replicated_like(batch), P(), P()),
             out_specs=(_specs_like(ledger), P()),
+            # vma-checking is off because ht.lookup's probe while_loop mixes
+            # replicated (keys) and shard-varying (table) carry values; the
+            # library kernels are backend-agnostic and cannot pvary-annotate.
+            # Correctness is covered by byte-parity vs single-chip in
+            # tests/test_sharded.py instead.
             check_vma=False,
         )(ledger, batch, count, timestamp)
 
