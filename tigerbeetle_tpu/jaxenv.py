@@ -28,7 +28,23 @@ import sys
 import threading
 from typing import List, Optional
 
-__all__ = ["force_cpu", "ensure_backend", "child_env", "current_platform"]
+__all__ = [
+    "force_cpu", "ensure_backend", "child_env", "current_platform",
+    "COMPILE_CACHE_DIR", "enable_compile_cache",
+]
+
+# Persistent XLA compilation cache, shared by bench.py and tools/tpu_probe.py
+# so a recovered TPU tunnel never re-pays the 20-40 s first compile.  One
+# definition here — two independently-spelled paths would silently diverge.
+COMPILE_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+
+
+def enable_compile_cache() -> str:
+    """Point jax at the persistent cache (must run before jax init)."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]
 
 
 def _bridge():

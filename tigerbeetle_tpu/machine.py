@@ -444,10 +444,14 @@ class TpuStateMachine:
     def _note_balance_bound(self, batch: np.ndarray) -> None:
         """Over-approximate the largest possible single balance field after
         this batch (fast-path precondition P3: the overflow ladder cannot
-        fire below 2^126). Non-balancing amounts add at most count * max;
-        each balancing lane can move at most the current bound (its clamp is
-        bounded by an existing balance). Ledgers that blow the bound just
-        lose the fast path — correctness never depends on it."""
+        fire below 2^126). Non-balancing amounts add at most count * max.
+        A balancing lane's clamp is NOT bounded by the pre-batch balance
+        (chained balancing lanes in one batch compound against the running
+        balance), but it IS capped at u64-max per lane: a zero-amount
+        balancing transfer's ceiling is maxInt(u64) (transfer_full.py
+        amount0; state_machine.zig:1288), and a nonzero amount is already
+        counted under count * max. Ledgers that blow the bound just lose
+        the fast path — correctness never depends on it."""
         if self._balance_bound >= _BOUND_CLAMP or len(batch) == 0:
             return
         mx = (int(batch["amount_hi"].max()) << 64) | int(batch["amount_lo"].max())
@@ -456,7 +460,7 @@ class TpuStateMachine:
              & (types.TransferFlags.BALANCING_DEBIT
                 | types.TransferFlags.BALANCING_CREDIT)) != 0
         ).sum())
-        self._balance_bound += len(batch) * mx + n_bal * self._balance_bound
+        self._balance_bound += len(batch) * mx + n_bal * ((1 << 64) - 1)
         if self._balance_bound > _BOUND_CLAMP:
             self._balance_bound = _BOUND_CLAMP
 

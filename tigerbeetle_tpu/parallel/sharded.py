@@ -271,7 +271,14 @@ def sharded_create_transfers_full(mesh: Mesh):
         ) & valid
 
         ex_g = _ShardGather(tr, batch["id_lo"], batch["id_hi"], n_shards, shift)
-        e_tab = ex_g.rows(tr)
+        # Zero-mask by `valid` exactly like the single-chip gather
+        # (ex_found = found & valid there): every current consumer is gated
+        # on ex_found anyway, but an unmasked row would be a latent
+        # byte-parity divergence if e_tab ever gains another consumer.
+        e_tab = {
+            k: jnp.where(ex_g.found & valid, v, jnp.zeros_like(v))
+            for k, v in ex_g.rows(tr).items()
+        }
         p_g = _ShardGather(
             tr, batch["pending_id_lo"], batch["pending_id_hi"], n_shards, shift
         )
