@@ -71,6 +71,11 @@ def main(argv=None) -> int:
     p_start.add_argument("--no-engine", action="store_true",
                          help="force the device-kernel commit path even "
                               "when the native host engine is available")
+    p_start.add_argument("--engine", action="store_true",
+                         help="multi-replica only: commit through the native "
+                              "host engine (cluster replicas default to the "
+                              "device path, which carries per-commit digests "
+                              "and tiering)")
 
     p_version = sub.add_parser("version")
     p_version.add_argument("--verbose", action="store_true")
@@ -240,9 +245,24 @@ def _cmd_start(args) -> int:
         from .net.cluster_bus import run_cluster_server
         from .vsr.consensus import VsrReplica
 
+        if args.no_engine:
+            print("error: --no-engine applies to single-replica serving "
+                  "only (cluster replicas already default to the device "
+                  "path); did you mean to omit it?", file=sys.stderr)
+            return 1
+        if args.engine:
+            from .host_engine import engine_available as _engine_ok
+
+            if not _engine_ok():
+                # Dropping the flag silently would serve a different
+                # executor than the operator asked for.
+                print("error: --engine requested but the native host "
+                      "engine failed to build", file=sys.stderr)
+                return 1
+
         replica = VsrReplica(
             args.path, ledger_config=ledger_config, aof_path=args.aof,
-            process_config=process_config,
+            process_config=process_config, host_engine=bool(args.engine),
         )
         replica.open()
         replica.machine.warmup()  # compile before announcing readiness
@@ -268,6 +288,11 @@ def _cmd_start(args) -> int:
     # forces it for debugging.
     from .host_engine import engine_available
 
+    if args.engine:
+        print("error: --engine applies to multi-replica serving only (the "
+              "solo server already uses the host engine when it builds; "
+              "--no-engine forces the device path)", file=sys.stderr)
+        return 1
     use_engine = (
         engine_available() and hot_max is None and not args.no_engine
     )
