@@ -1,0 +1,273 @@
+"""Golden 256-byte wire frames, hand-derived from message_header.zig:17-99.
+
+Wire-format parity previously rested on wire.py's self-consistency plus two
+AEGIS checksum vectors: wire.py and native/tb_client.cpp each spell the
+offsets independently, but both could share one misreading and every
+round-trip test would still pass.  These fixtures are a third, independent
+spelling: every field offset below is copied BY HAND from the reference's
+extern-struct declarations (field order + sizes), frames are assembled with
+struct.pack_into at those absolute offsets, and the codec must agree
+byte-for-byte in both directions.
+
+Offset derivations (sizes straight from the Zig declarations):
+
+Shared frame prefix (message_header.zig:17-66):
+      0  checksum               u128
+     16  checksum_padding       u128
+     32  checksum_body          u128
+     48  checksum_body_padding  u128
+     64  nonce_reserved         u128
+     80  cluster                u128
+     96  size                   u32
+    100  epoch                  u32
+    104  view                   u32
+    108  version                u16
+    110  command                u8
+    111  replica                u8
+    112  reserved_frame         [16]u8
+    128  (command-specific area, 128 bytes)
+
+Request (message_header.zig:409-460):
+    128 parent u128, 144 parent_padding u128, 160 client u128,
+    176 session u64, 184 timestamp u64, 192 request u32,
+    196 operation u8, 197 reserved [59]u8.
+
+Prepare (message_header.zig:502-553):
+    128 parent u128, 144 parent_padding u128, 160 request_checksum u128,
+    176 request_checksum_padding u128, 192 checkpoint_id u128,
+    208 client u128, 224 op u64, 232 commit u64, 240 timestamp u64,
+    248 request u32, 252 operation u8, 253 reserved [3]u8.
+
+Reply (message_header.zig:724-758):
+    128 request_checksum u128, 144 request_checksum_padding u128,
+    160 context u128, 176 context_padding u128, 192 client u128,
+    208 op u64, 216 commit u64, 224 timestamp u64, 232 request u32,
+    236 operation u8, 237 reserved [19]u8.
+
+Checksums (message_header.zig:101-124): checksum_body = AEGIS(body);
+checksum = AEGIS(header_bytes[16:256]) — set AFTER checksum_body so the
+body checksum is covered.
+"""
+
+import struct
+
+import numpy as np
+
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.checksum import checksum
+
+HDR = 256
+
+
+def _put_u128(buf, off, value):
+    struct.pack_into("<QQ", buf, off, value & ((1 << 64) - 1), value >> 64)
+
+
+def _finish(buf, body=b""):
+    """Apply the dual checksums exactly as the reference computes them."""
+    _put_u128(buf, 32, checksum(body))
+    _put_u128(buf, 0, checksum(bytes(buf[16:HDR])))
+    return bytes(buf) + body
+
+
+def _frame_prefix(buf, *, cluster, size, view, command, replica, epoch=0,
+                  version=0):
+    _put_u128(buf, 80, cluster)
+    struct.pack_into("<I", buf, 96, size)
+    struct.pack_into("<I", buf, 100, epoch)
+    struct.pack_into("<I", buf, 104, view)
+    struct.pack_into("<H", buf, 108, version)
+    struct.pack_into("B", buf, 110, command)
+    struct.pack_into("B", buf, 111, replica)
+
+
+def golden_request(body=b"\xAB" * 128):
+    buf = bytearray(HDR)
+    _frame_prefix(buf, cluster=0xDEADBEEF_CAFEBABE_0123456789ABCDEF,
+                  size=HDR + len(body), view=7,
+                  command=int(wire.Command.request), replica=0)
+    _put_u128(buf, 128, 0x1111_2222)                      # parent
+    _put_u128(buf, 160, 0xC11E17)                         # client
+    struct.pack_into("<Q", buf, 176, 42)                  # session
+    struct.pack_into("<Q", buf, 184, 0)                   # timestamp
+    struct.pack_into("<I", buf, 192, 9)                   # request
+    struct.pack_into("B", buf, 196,
+                     int(wire.Operation.create_transfers))  # operation
+    return _finish(buf, body)
+
+
+def golden_prepare(body=b"\x5A" * 64):
+    buf = bytearray(HDR)
+    _frame_prefix(buf, cluster=0xBE, size=HDR + len(body), view=3,
+                  command=int(wire.Command.prepare), replica=1)
+    _put_u128(buf, 128, 0xFEED_0001)                      # parent
+    _put_u128(buf, 160, 0xFACE_0002)                      # request_checksum
+    _put_u128(buf, 192, 0xC0DE_0003)                      # checkpoint_id
+    _put_u128(buf, 208, 0xC11E17)                         # client
+    struct.pack_into("<Q", buf, 224, 11)                  # op
+    struct.pack_into("<Q", buf, 232, 10)                  # commit
+    struct.pack_into("<Q", buf, 240, 123456789)           # timestamp
+    struct.pack_into("<I", buf, 248, 9)                   # request
+    struct.pack_into("B", buf, 252,
+                     int(wire.Operation.create_transfers))
+    return _finish(buf, body)
+
+
+def golden_reply(body=b"\x11" * 8):
+    buf = bytearray(HDR)
+    _frame_prefix(buf, cluster=0xBE, size=HDR + len(body), view=3,
+                  command=int(wire.Command.reply), replica=2)
+    _put_u128(buf, 128, 0xFACE_0002)                      # request_checksum
+    _put_u128(buf, 160, 0x5EED_0004)                      # context
+    _put_u128(buf, 192, 0xC11E17)                         # client
+    struct.pack_into("<Q", buf, 208, 11)                  # op
+    struct.pack_into("<Q", buf, 216, 11)                  # commit
+    struct.pack_into("<Q", buf, 224, 123456789)           # timestamp
+    struct.pack_into("<I", buf, 232, 9)                   # request
+    struct.pack_into("B", buf, 236,
+                     int(wire.Operation.create_transfers))
+    return _finish(buf, body)
+
+
+def test_dtype_offsets_match_reference_layout():
+    """Every numpy field offset equals the hand-derived reference offset."""
+    frame_offsets = {
+        "checksum_lo": 0, "checksum_hi": 8, "checksum_padding": 16,
+        "checksum_body_lo": 32, "checksum_body_hi": 40,
+        "checksum_body_padding": 48, "nonce_reserved": 64,
+        "cluster_lo": 80, "cluster_hi": 88, "size": 96, "epoch": 100,
+        "view": 104, "version": 108, "command": 110, "replica": 111,
+        "reserved_frame": 112,
+    }
+    request_offsets = dict(frame_offsets, **{
+        "parent_lo": 128, "parent_hi": 136, "parent_padding": 144,
+        "client_lo": 160, "client_hi": 168, "session": 176,
+        "timestamp": 184, "request": 192, "operation": 196, "reserved": 197,
+    })
+    prepare_offsets = dict(frame_offsets, **{
+        "parent_lo": 128, "parent_hi": 136, "parent_padding": 144,
+        "request_checksum_lo": 160, "request_checksum_hi": 168,
+        "request_checksum_padding": 176, "checkpoint_id_lo": 192,
+        "checkpoint_id_hi": 200, "client_lo": 208, "client_hi": 216,
+        "op": 224, "commit": 232, "timestamp": 240, "request": 248,
+        "operation": 252, "reserved": 253,
+    })
+    reply_offsets = dict(frame_offsets, **{
+        "request_checksum_lo": 128, "request_checksum_hi": 136,
+        "request_checksum_padding": 144, "context_lo": 160,
+        "context_hi": 168, "context_padding": 176, "client_lo": 192,
+        "client_hi": 200, "op": 208, "commit": 216, "timestamp": 224,
+        "request": 232, "operation": 236, "reserved": 237,
+    })
+    for dtype, want in (
+        (wire.REQUEST_DTYPE, request_offsets),
+        (wire.PREPARE_DTYPE, prepare_offsets),
+        (wire.REPLY_DTYPE, reply_offsets),
+    ):
+        assert dtype.itemsize == HDR
+        got = {name: dtype.fields[name][1] for name in dtype.names}
+        assert got == want
+
+
+def _codec_frame(command, body, **fields):
+    h = wire.new_header(command, **fields)
+    return wire.encode(h, body)
+
+
+def test_golden_request_frame():
+    body = b"\xAB" * 128
+    golden = golden_request(body)
+    assert len(golden) == HDR + len(body)
+    made = _codec_frame(
+        wire.Command.request, body,
+        cluster=0xDEADBEEF_CAFEBABE_0123456789ABCDEF, view=7,
+        parent=0x1111_2222, client=0xC11E17, session=42, request=9,
+        operation=int(wire.Operation.create_transfers),
+        size=HDR + len(body),
+    )
+    assert made == golden
+
+
+def test_golden_prepare_frame():
+    body = b"\x5A" * 64
+    golden = golden_prepare(body)
+    made = _codec_frame(
+        wire.Command.prepare, body,
+        cluster=0xBE, view=3, replica=1, parent=0xFEED_0001,
+        request_checksum=0xFACE_0002, checkpoint_id=0xC0DE_0003,
+        client=0xC11E17, op=11, commit=10, timestamp=123456789, request=9,
+        operation=int(wire.Operation.create_transfers),
+        size=HDR + len(body),
+    )
+    assert made == golden
+
+
+def test_golden_reply_frame():
+    body = b"\x11" * 8
+    golden = golden_reply(body)
+    made = _codec_frame(
+        wire.Command.reply, body,
+        cluster=0xBE, view=3, replica=2, request_checksum=0xFACE_0002,
+        context=0x5EED_0004, client=0xC11E17, op=11, commit=11,
+        timestamp=123456789, request=9,
+        operation=int(wire.Operation.create_transfers),
+        size=HDR + len(body),
+    )
+    assert made == golden
+
+
+def test_golden_decode_fields():
+    """decode() recovers every field value from the hand-built frames."""
+    h, cmd, body = wire.decode(golden_prepare())
+    assert cmd == wire.Command.prepare
+    assert body == b"\x5A" * 64
+    assert int(h["cluster_lo"]) == 0xBE and int(h["cluster_hi"]) == 0
+    assert int(h["view"]) == 3 and int(h["replica"]) == 1
+    assert int(h["parent_lo"]) == 0xFEED_0001
+    assert int(h["request_checksum_lo"]) == 0xFACE_0002
+    assert int(h["checkpoint_id_lo"]) == 0xC0DE_0003
+    assert int(h["client_lo"]) == 0xC11E17
+    assert int(h["op"]) == 11 and int(h["commit"]) == 10
+    assert int(h["timestamp"]) == 123456789
+    assert int(h["request"]) == 9
+    assert int(h["operation"]) == int(wire.Operation.create_transfers)
+
+    h, cmd, body = wire.decode(golden_request())
+    assert cmd == wire.Command.request
+    assert int(h["session"]) == 42
+    assert int(h["client_lo"]) == 0xC11E17
+    assert int(h["parent_lo"]) == 0x1111_2222
+
+    h, cmd, body = wire.decode(golden_reply())
+    assert cmd == wire.Command.reply
+    assert int(h["context_lo"]) == 0x5EED_0004
+    assert int(h["op"]) == int(h["commit"]) == 11
+
+
+def test_native_client_header_offsets():
+    """The C side (native/tb_client.cpp) spells the offsets a third time as
+    kOff* constants; pin their values against the same hand-derived table so
+    a shared misreading cannot hide.  (The native library's live wire
+    behavior is exercised against a real server in test_native_client.py.)"""
+    import os
+    import re
+
+    src = open(os.path.join(os.path.dirname(__file__), "..",
+                            "tigerbeetle_tpu", "native", "tb_client.cpp")).read()
+    want = {
+        "kOffChecksum": 0, "kOffChecksumBody": 32, "kOffCluster": 80,
+        "kOffSize": 96, "kOffCommand": 110,
+        # Request (message_header.zig:409-460)
+        "kOffReqParent": 128, "kOffReqClient": 160, "kOffReqSession": 176,
+        "kOffReqRequest": 192, "kOffReqOperation": 196,
+        # Reply (message_header.zig:724-758)
+        "kOffRepRequestChecksum": 128, "kOffRepOp": 208,
+    }
+    got = {
+        m.group(1): int(m.group(2))
+        for m in re.finditer(
+            r"constexpr\s+size_t\s+(kOff\w+)\s*=\s*(\d+)\s*;", src
+        )
+    }
+    for name, off in want.items():
+        assert got.get(name) == off, (name, got.get(name), off)
