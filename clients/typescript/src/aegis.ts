@@ -1,0 +1,156 @@
+// AEGIS-128L in MAC mode: the universal 128-bit checksum.
+//
+// Behavior contract (reference: src/vsr/checksum.zig — behavior only):
+// AEGIS-128L (draft-irtf-cfrg-aegis-aead) specialized to a checksum — zero
+// key, zero nonce, empty secret message, the input bytes as associated
+// data; the checksum is the 128-bit tag read little-endian.  Pure
+// TypeScript (no native addon): a Node client should be zero-install.
+// Structure mirrors the Python fallback (tigerbeetle_tpu/vsr/checksum.py),
+// which passes the reference's published test vectors; the offline test
+// (test/offline.mjs) checks this port against fixtures generated from it.
+
+const C0 = new Uint8Array([
+  0x00, 0x01, 0x01, 0x02, 0x03, 0x05, 0x08, 0x0d,
+  0x15, 0x22, 0x37, 0x59, 0x90, 0xe9, 0x79, 0x62,
+]);
+const C1 = new Uint8Array([
+  0xdb, 0x3d, 0x18, 0x55, 0x6d, 0xc2, 0x2f, 0xf1,
+  0x20, 0x11, 0x31, 0x42, 0x73, 0xb5, 0x28, 0xdd,
+]);
+
+// --- AES round tables (generated at load, not copied) ----------------------
+
+function makeTables(): Uint32Array[] {
+  const sbox = new Uint8Array(256);
+  sbox[0] = 0x63;
+  let p = 1;
+  let q = 1;
+  const rot = (x: number, r: number) => ((x << r) | (x >>> (8 - r))) & 0xff;
+  for (;;) {
+    p = (p ^ ((p << 1) & 0xff) ^ (p & 0x80 ? 0x1b : 0)) & 0xff;
+    q ^= (q << 1) & 0xff;
+    q ^= (q << 2) & 0xff;
+    q ^= (q << 4) & 0xff;
+    if (q & 0x80) q ^= 0x09;
+    sbox[p] = (q ^ rot(q, 1) ^ rot(q, 2) ^ rot(q, 3) ^ rot(q, 4) ^ 0x63) & 0xff;
+    if (p === 1) break;
+  }
+  const t0 = new Uint32Array(256);
+  for (let i = 0; i < 256; i++) {
+    const s = sbox[i];
+    const s2 = ((s << 1) ^ (s & 0x80 ? 0x1b : 0)) & 0xff;
+    const s3 = s2 ^ s;
+    t0[i] = (s2 | (s << 8) | (s << 16) | (s3 << 24)) >>> 0;
+  }
+  const rot8 = (x: number) => ((x << 8) | (x >>> 24)) >>> 0;
+  const t1 = Uint32Array.from(t0, rot8);
+  const t2 = Uint32Array.from(t1, rot8);
+  const t3 = Uint32Array.from(t2, rot8);
+  return [t0, t1, t2, t3];
+}
+
+const [T0, T1, T2, T3] = makeTables();
+
+// One AES round (SubBytes+ShiftRows+MixColumns+AddRoundKey) on 4 LE words;
+// writes into `out` (which may alias a state row).
+function aesRound(a: Uint32Array, rk: Uint32Array, out: Uint32Array): void {
+  const a0 = a[0], a1 = a[1], a2 = a[2], a3 = a[3];
+  out[0] = (T0[a0 & 0xff] ^ T1[(a1 >>> 8) & 0xff] ^ T2[(a2 >>> 16) & 0xff]
+    ^ T3[(a3 >>> 24) & 0xff] ^ rk[0]) >>> 0;
+  out[1] = (T0[a1 & 0xff] ^ T1[(a2 >>> 8) & 0xff] ^ T2[(a3 >>> 16) & 0xff]
+    ^ T3[(a0 >>> 24) & 0xff] ^ rk[1]) >>> 0;
+  out[2] = (T0[a2 & 0xff] ^ T1[(a3 >>> 8) & 0xff] ^ T2[(a0 >>> 16) & 0xff]
+    ^ T3[(a1 >>> 24) & 0xff] ^ rk[2]) >>> 0;
+  out[3] = (T0[a3 & 0xff] ^ T1[(a0 >>> 8) & 0xff] ^ T2[(a1 >>> 16) & 0xff]
+    ^ T3[(a2 >>> 24) & 0xff] ^ rk[3]) >>> 0;
+}
+
+function words(b: Uint8Array, off: number, out?: Uint32Array): Uint32Array {
+  const w = out ?? new Uint32Array(4);
+  const dv = new DataView(b.buffer, b.byteOffset + off, 16);
+  for (let i = 0; i < 4; i++) w[i] = dv.getUint32(4 * i, true);
+  return w;
+}
+
+class State {
+  s: Uint32Array[];
+  private tmp = new Uint32Array(4);
+  private k0 = new Uint32Array(4);
+  private k4 = new Uint32Array(4);
+
+  constructor() {
+    const zero = new Uint32Array(4);
+    const c0 = words(C0, 0);
+    const c1 = words(C1, 0);
+    // init with key=0, nonce=0 (S0=K^N, S5=K^C0, S6=K^C1, S7=K^C0).
+    this.s = [
+      Uint32Array.from(zero), Uint32Array.from(c1), Uint32Array.from(c0),
+      Uint32Array.from(c1), Uint32Array.from(zero), Uint32Array.from(c0),
+      Uint32Array.from(c1), Uint32Array.from(c0),
+    ];
+    for (let i = 0; i < 10; i++) this.update(zero, zero);
+  }
+
+  // S'i = AESRound(S[i-1], S[i]); messages XOR into the key operand:
+  // S'0 = AESRound(S7, S0 ^ M0), S'4 = AESRound(S3, S4 ^ M1).
+  update(m0: Uint32Array, m1: Uint32Array): void {
+    const s = this.s;
+    const t7 = this.tmp;
+    const k0 = this.k0;  // preallocated scratch: this runs once per
+    const k4 = this.k4;  // 32 input bytes (~32k times per 1 MiB message)
+    t7.set(s[7]);
+    aesRound(s[6], s[7], s[7]);
+    aesRound(s[5], s[6], s[6]);
+    aesRound(s[4], s[5], s[5]);
+    for (let i = 0; i < 4; i++) k4[i] = (s[4][i] ^ m1[i]) >>> 0;
+    aesRound(s[3], k4, s[4]);
+    aesRound(s[2], s[3], s[3]);
+    aesRound(s[1], s[2], s[2]);
+    aesRound(s[0], s[1], s[1]);
+    for (let i = 0; i < 4; i++) k0[i] = (s[0][i] ^ m0[i]) >>> 0;
+    aesRound(t7, k0, s[0]);
+  }
+}
+
+/** 128-bit AEGIS-128L MAC of `data`, as a 16-byte little-endian tag. */
+export function checksumBytes(data: Uint8Array): Uint8Array {
+  const st = new State();
+  const n = data.length;
+  const full = Math.floor(n / 32);
+  const m0 = new Uint32Array(4);  // reusable word buffers for the hot loop
+  const m1 = new Uint32Array(4);
+  for (let i = 0; i < full; i++) {
+    st.update(words(data, 32 * i, m0), words(data, 32 * i + 16, m1));
+  }
+  const rem = n % 32;
+  if (rem) {
+    const pad = new Uint8Array(32);
+    pad.set(data.subarray(32 * full));
+    st.update(words(pad, 0, m0), words(pad, 16, m1));
+  }
+  // Finalize: tmp = S2 ^ (LE64(ad_len_bits) || LE64(0)); 7 updates;
+  // tag = S0^..^S6.
+  const lenBlock = new Uint8Array(16);
+  const dv = new DataView(lenBlock.buffer);
+  // 8*n as u64 little-endian (safe: message sizes are < 2^50 bits).
+  dv.setBigUint64(0, BigInt(n) * 8n, true);
+  const tmp = new Uint32Array(4);
+  const lw = words(lenBlock, 0);
+  for (let i = 0; i < 4; i++) tmp[i] = (st.s[2][i] ^ lw[i]) >>> 0;
+  for (let i = 0; i < 7; i++) st.update(tmp, tmp);
+  const tag = new Uint32Array(4);
+  for (let i = 0; i < 7; i++) {
+    for (let j = 0; j < 4; j++) tag[j] = (tag[j] ^ st.s[i][j]) >>> 0;
+  }
+  const out = new Uint8Array(16);
+  const odv = new DataView(out.buffer);
+  for (let i = 0; i < 4; i++) odv.setUint32(4 * i, tag[i], true);
+  return out;
+}
+
+/** The checksum as a bigint (little-endian tag), matching the Python side. */
+export function checksum(data: Uint8Array): bigint {
+  const tag = checksumBytes(data);
+  const dv = new DataView(tag.buffer);
+  return dv.getBigUint64(0, true) | (dv.getBigUint64(8, true) << 64n);
+}

@@ -1,0 +1,358 @@
+// Node/TypeScript client: session registration, hash-chained requests,
+// retries and failover over raw TCP.
+//
+// Same protocol as the repo's Python client (tigerbeetle_tpu/client.py) and
+// the reference's client (src/vsr/client.zig): an ephemeral random u128
+// client id, a register op whose reply's commit number becomes the session,
+// then at most ONE hash-chained request in flight — `parent` is the
+// checksum of the preceding request.  Replies are matched by request
+// checksum; duplicate/stale replies are discarded; an eviction message
+// fails every future call.  Unlike the Go/Java/C# clients (FFI over the
+// native tb_client ABI), this client is pure TypeScript: a Node consumer
+// should be zero-install (same trade the reference's Node client makes by
+// bundling a prebuilt addon; we go one step further and need no addon).
+
+import * as net from "node:net";
+import { randomBytes } from "node:crypto";
+
+import * as wire from "./wire";
+import {
+  Account, AccountSize, decodeAccount, encodeAccount,
+  Transfer, TransferSize, decodeTransfer, encodeTransfer,
+  EventResult, decodeEventResult, EventResultSize,
+  AccountFilter, AccountFilterSize, encodeAccountFilter,
+  Operation,
+} from "./types";
+
+export class ClientEvictedError extends Error {
+  constructor() {
+    super("tigerbeetle: session evicted");
+  }
+}
+
+/**
+ * The request's deadline expired with no matching reply.  The request MAY
+ * still commit server-side: the session's request number was not advanced,
+ * so the caller must either retry the IDENTICAL batch (an identical
+ * message has an identical checksum, and a committed duplicate is answered
+ * from the reply cache) or close the client — submitting a DIFFERENT batch
+ * after a timeout would reuse the request number and can never be acked.
+ */
+export class RequestTimeoutError extends Error {
+  constructor() {
+    super("tigerbeetle: request timed out (retry the same batch or close)");
+  }
+}
+
+export interface ClientOptions {
+  /** "host:port" strings, one per replica (cli --addresses grammar). */
+  addresses: string[];
+  /** u128 cluster id. */
+  cluster: bigint;
+  timeoutMs?: number;
+  /** Batch ceiling: (1 MiB - 256 B) / 128 B (state_machine.zig:70-75). */
+  maxBatch?: number;
+}
+
+interface Pending {
+  message: Uint8Array;
+  requestChecksum: bigint;
+  resolve: (r: { view: DataView; body: Uint8Array }) => void;
+  reject: (err: Error) => void;
+  deadline: number;
+}
+
+const BATCH_MAX = Math.floor((wire.MESSAGE_SIZE_MAX - wire.HEADER_SIZE) / 128);
+
+export class Client {
+  private addresses: Array<{ host: string; port: number }>;
+  private cluster: bigint;
+  private clientId: bigint;
+  private timeoutMs: number;
+  private maxBatch: number;
+
+  private session = 0n;
+  private requestNumber = 0;
+  private parent = 0n;
+
+  private sock: net.Socket | null = null;
+  private addrIndex = 0;
+  private recvBuf: Buffer = Buffer.alloc(0);
+  private pending: Pending | null = null;
+  private evicted = false;
+  private closed = false;
+  private registering: Promise<void> | null = null;
+  /** Serializes calls: the protocol allows one in-flight request. */
+  private chain: Promise<unknown> = Promise.resolve();
+
+  constructor(opts: ClientOptions) {
+    if (opts.addresses.length === 0) throw new Error("no addresses");
+    this.addresses = opts.addresses.map((a) => {
+      const i = a.lastIndexOf(":");
+      if (i < 0) return { host: a, port: 3000 };
+      return { host: a.slice(0, i), port: Number(a.slice(i + 1)) };
+    });
+    this.cluster = opts.cluster;
+    this.timeoutMs = opts.timeoutMs ?? 30_000;
+    this.maxBatch = opts.maxBatch ?? BATCH_MAX;
+    // Ephemeral random client id (client.zig: nonzero u128).
+    const id = randomBytes(16);
+    id[0] |= 1;
+    this.clientId = bufToU128(id);
+  }
+
+  close(): void {
+    this.closed = true;
+    this.dropSocket(new Error("tigerbeetle: client closed"));
+  }
+
+  // -- tb_client-style batch API --------------------------------------------
+
+  async createAccounts(accounts: Account[]): Promise<EventResult[]> {
+    if (accounts.length > this.maxBatch) throw new Error("batch too large");
+    const body = new Uint8Array(accounts.length * AccountSize);
+    const view = new DataView(body.buffer);
+    accounts.forEach((a, i) => encodeAccount(a, view, i * AccountSize));
+    return decodeResults(await this.request(Operation.createAccounts, body));
+  }
+
+  async createTransfers(transfers: Transfer[]): Promise<EventResult[]> {
+    if (transfers.length > this.maxBatch) throw new Error("batch too large");
+    const body = new Uint8Array(transfers.length * TransferSize);
+    const view = new DataView(body.buffer);
+    transfers.forEach((t, i) => encodeTransfer(t, view, i * TransferSize));
+    return decodeResults(await this.request(Operation.createTransfers, body));
+  }
+
+  async lookupAccounts(ids: bigint[]): Promise<Account[]> {
+    const body = await this.request(Operation.lookupAccounts, encodeIds(ids));
+    return decodeRows(body, AccountSize, decodeAccount);
+  }
+
+  async lookupTransfers(ids: bigint[]): Promise<Transfer[]> {
+    const body = await this.request(Operation.lookupTransfers, encodeIds(ids));
+    return decodeRows(body, TransferSize, decodeTransfer);
+  }
+
+  async getAccountTransfers(filter: AccountFilter): Promise<Transfer[]> {
+    const body = new Uint8Array(AccountFilterSize);
+    encodeAccountFilter(filter, new DataView(body.buffer), 0);
+    const reply = await this.request(Operation.getAccountTransfers, body);
+    return decodeRows(reply, TransferSize, decodeTransfer);
+  }
+
+  // -- session protocol -----------------------------------------------------
+
+  /** One request at a time: queue behind any in-flight call. */
+  request(operation: number, body: Uint8Array): Promise<Uint8Array> {
+    const run = this.chain.then(async () => {
+      if (this.evicted) throw new ClientEvictedError();
+      if (this.closed) throw new Error("tigerbeetle: client closed");
+      if (this.session === 0n) await this.register();
+      return this.requestLocked(operation, body);
+    });
+    // Keep the chain alive through failures (next caller still runs).
+    this.chain = run.catch(() => undefined);
+    return run;
+  }
+
+  private async register(): Promise<void> {
+    if (this.registering) return this.registering;
+    this.registering = (async () => {
+      const message = wire.encodeRequest(
+        {
+          cluster: this.cluster, client: this.clientId, parent: 0n,
+          session: 0n, request: 0, operation: wire.OPERATION_REGISTER,
+        },
+        new Uint8Array(0),
+      );
+      const requestChecksum = wire.headerChecksum(message);
+      const { view } = await this.roundtrip(message, requestChecksum);
+      // The register reply's op (== commit) is the session number.
+      this.session = view.getBigUint64(wire.OFF_REP_OP, true);
+      this.parent = requestChecksum;
+      this.requestNumber = 1;
+    })();
+    try {
+      await this.registering;
+    } finally {
+      this.registering = null;
+    }
+  }
+
+  private async requestLocked(
+    operation: number, body: Uint8Array,
+  ): Promise<Uint8Array> {
+    const message = wire.encodeRequest(
+      {
+        cluster: this.cluster, client: this.clientId, parent: this.parent,
+        session: this.session, request: this.requestNumber, operation,
+      },
+      body,
+    );
+    const requestChecksum = wire.headerChecksum(message);
+    const { body: replyBody } = await this.roundtrip(message, requestChecksum);
+    this.parent = requestChecksum;
+    this.requestNumber += 1;
+    return replyBody;
+  }
+
+  // -- transport ------------------------------------------------------------
+
+  private roundtrip(
+    message: Uint8Array, requestChecksum: bigint,
+  ): Promise<{ view: DataView; body: Uint8Array }> {
+    return new Promise((resolve, reject) => {
+      const pending: Pending = {
+        message, requestChecksum, resolve, reject,
+        deadline: Date.now() + this.timeoutMs,
+      };
+      this.pending = pending;
+      // Hard deadline even if the socket stays open but silent.  Rotate
+      // the preferred replica and drop the socket: a connected-but-silent
+      // backup (replies come only from the primary) must not wedge every
+      // subsequent request on the same dead-end connection.
+      const timer = setTimeout(() => {
+        if (this.pending === pending) {
+          this.pending = null;
+          this.addrIndex = (this.addrIndex + 1) % this.addresses.length;
+          const sock = this.sock;
+          this.sock = null;
+          sock?.destroy();
+          reject(new RequestTimeoutError());
+        }
+      }, this.timeoutMs);
+      timer.unref?.();
+      const done = (fn: typeof resolve | typeof reject) =>
+        (arg: never) => {
+          clearTimeout(timer);
+          fn(arg);
+        };
+      pending.resolve = done(resolve) as Pending["resolve"];
+      pending.reject = done(reject) as Pending["reject"];
+      this.trySend();
+    });
+  }
+
+  /** (Re)connect and (re)send the pending request; called on every socket
+   * failure until the deadline expires (failover rotates addresses). */
+  private trySend(): void {
+    const p = this.pending;
+    if (!p) return;
+    if (Date.now() > p.deadline) {
+      this.pending = null;
+      p.reject(new RequestTimeoutError());
+      return;
+    }
+    if (this.sock && !this.sock.destroyed) {
+      this.sock.write(p.message);
+      return;
+    }
+    const { host, port } = this.addresses[this.addrIndex];
+    const sock = net.createConnection({ host, port, noDelay: true });
+    this.sock = sock;
+    this.recvBuf = Buffer.alloc(0);
+    sock.on("connect", () => {
+      if (this.pending) sock.write(this.pending.message);
+    });
+    sock.on("data", (chunk) => this.onData(sock, chunk));
+    const onGone = () => {
+      if (this.sock !== sock) return;
+      this.sock = null;
+      // Rotate the preferred replica before retrying (failover).
+      this.addrIndex = (this.addrIndex + 1) % this.addresses.length;
+      if (this.pending) setTimeout(() => this.trySend(), 50);
+    };
+    sock.on("error", onGone);
+    sock.on("close", onGone);
+  }
+
+  private onData(sock: net.Socket, chunk: Buffer): void {
+    if (this.sock !== sock) return;
+    this.recvBuf = this.recvBuf.length
+      ? Buffer.concat([this.recvBuf, chunk]) : chunk;
+    for (;;) {
+      if (this.recvBuf.length < wire.HEADER_SIZE) return;
+      let h: wire.DecodedHeader;
+      try {
+        h = wire.decodeHeader(
+          new Uint8Array(this.recvBuf.buffer, this.recvBuf.byteOffset,
+                         wire.HEADER_SIZE),
+        );
+      } catch {
+        sock.destroy(new Error("bad frame"));
+        return;
+      }
+      if (this.recvBuf.length < h.size) return;
+      const frame = this.recvBuf.subarray(0, h.size);
+      this.recvBuf = this.recvBuf.subarray(h.size);
+      this.onFrame(h, new Uint8Array(
+        frame.buffer, frame.byteOffset + wire.HEADER_SIZE,
+        h.size - wire.HEADER_SIZE,
+      ));
+    }
+  }
+
+  private onFrame(h: wire.DecodedHeader, body: Uint8Array): void {
+    if (h.command === wire.Command.eviction) {
+      const who = wire.getU128(h.view, wire.OFF_EVICT_CLIENT);
+      if (who === this.clientId) {
+        this.evicted = true;
+        this.dropSocket(new ClientEvictedError());
+      }
+      return;
+    }
+    if (h.command !== wire.Command.reply) return; // e.g. pong
+    const p = this.pending;
+    if (!p) return;
+    const requestChecksum = wire.getU128(h.view, wire.OFF_REP_REQUEST_CHECKSUM);
+    if (requestChecksum !== p.requestChecksum) return; // stale/duplicate
+    try {
+      wire.verifyBody(h, body);
+    } catch (err) {
+      this.sock?.destroy(err as Error);
+      return;
+    }
+    this.pending = null;
+    p.resolve({ view: h.view, body });
+  }
+
+  private dropSocket(err: Error): void {
+    const sock = this.sock;
+    this.sock = null;
+    sock?.destroy();
+    const p = this.pending;
+    this.pending = null;
+    p?.reject(err);
+  }
+}
+
+// -- helpers ----------------------------------------------------------------
+
+function bufToU128(b: Uint8Array): bigint {
+  const dv = new DataView(b.buffer, b.byteOffset, 16);
+  return dv.getBigUint64(0, true) | (dv.getBigUint64(8, true) << 64n);
+}
+
+function encodeIds(ids: bigint[]): Uint8Array {
+  const out = new Uint8Array(16 * ids.length);
+  const view = new DataView(out.buffer);
+  ids.forEach((id, i) => wire.putU128(view, 16 * i, id));
+  return out;
+}
+
+function decodeRows<T>(
+  body: Uint8Array, size: number,
+  decode: (view: DataView, offset: number) => T,
+): T[] {
+  const view = new DataView(body.buffer, body.byteOffset, body.byteLength);
+  const out: T[] = [];
+  for (let off = 0; off + size <= body.byteLength; off += size) {
+    out.push(decode(view, off));
+  }
+  return out;
+}
+
+function decodeResults(body: Uint8Array): EventResult[] {
+  return decodeRows(body, EventResultSize, decodeEventResult);
+}

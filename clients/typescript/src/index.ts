@@ -1,0 +1,4 @@
+export * from "./types";
+export * from "./client";
+export { checksum, checksumBytes } from "./aegis";
+export * as wire from "./wire";

@@ -1,0 +1,130 @@
+// 256-byte message header codec, byte-compatible with the reference
+// (src/vsr/message_header.zig:17-99).  Offsets hand-derived from the
+// extern-struct declarations — the same table pinned by the repo's
+// tests/test_wire_golden.py — and cross-checked against fixtures generated
+// from the Python codec (test/offline.mjs).
+
+import { checksum, checksumBytes } from "./aegis";
+
+export const HEADER_SIZE = 256;
+export const MESSAGE_SIZE_MAX = 1 << 20;
+
+// Shared frame prefix (message_header.zig:17-66).
+export const OFF_CHECKSUM = 0;
+export const OFF_CHECKSUM_BODY = 32;
+export const OFF_CLUSTER = 80;
+export const OFF_SIZE = 96;
+export const OFF_EPOCH = 100;
+export const OFF_VIEW = 104;
+export const OFF_VERSION = 108;
+export const OFF_COMMAND = 110;
+export const OFF_REPLICA = 111;
+
+// Request (message_header.zig:409-460).
+export const OFF_REQ_PARENT = 128;
+export const OFF_REQ_CLIENT = 160;
+export const OFF_REQ_SESSION = 176;
+export const OFF_REQ_TIMESTAMP = 184;
+export const OFF_REQ_REQUEST = 192;
+export const OFF_REQ_OPERATION = 196;
+
+// Reply (message_header.zig:724-758).
+export const OFF_REP_REQUEST_CHECKSUM = 128;
+export const OFF_REP_CONTEXT = 160;
+export const OFF_REP_CLIENT = 192;
+export const OFF_REP_OP = 208;
+export const OFF_REP_COMMIT = 216;
+export const OFF_REP_TIMESTAMP = 224;
+export const OFF_REP_REQUEST = 232;
+export const OFF_REP_OPERATION = 236;
+
+// Eviction (message_header.zig Eviction: client u128 at the command area).
+export const OFF_EVICT_CLIENT = 128;
+
+export enum Command {
+  reserved = 0,
+  ping = 1,
+  pong = 2,
+  pingClient = 3,
+  pongClient = 4,
+  request = 5,
+  prepare = 6,
+  prepareOk = 7,
+  reply = 8,
+  commit = 9,
+  eviction = 18,
+}
+
+export const OPERATION_REGISTER = 2;
+
+const U64_MASK = 0xffffffffffffffffn;
+
+export function putU128(view: DataView, off: number, value: bigint): void {
+  view.setBigUint64(off, value & U64_MASK, true);
+  view.setBigUint64(off + 8, value >> 64n, true);
+}
+
+export function getU128(view: DataView, off: number): bigint {
+  return view.getBigUint64(off, true) | (view.getBigUint64(off + 8, true) << 64n);
+}
+
+export interface RequestFields {
+  cluster: bigint;
+  client: bigint;
+  parent: bigint;
+  session: bigint;
+  request: number;
+  operation: number;
+}
+
+/** Build a complete request message (header + body) with both checksums. */
+export function encodeRequest(f: RequestFields, body: Uint8Array): Uint8Array {
+  const msg = new Uint8Array(HEADER_SIZE + body.length);
+  const view = new DataView(msg.buffer);
+  putU128(view, OFF_CLUSTER, f.cluster);
+  view.setUint32(OFF_SIZE, HEADER_SIZE + body.length, true);
+  view.setUint8(OFF_COMMAND, Command.request);
+  putU128(view, OFF_REQ_PARENT, f.parent);
+  putU128(view, OFF_REQ_CLIENT, f.client);
+  view.setBigUint64(OFF_REQ_SESSION, f.session, true);
+  view.setUint32(OFF_REQ_REQUEST, f.request, true);
+  view.setUint8(OFF_REQ_OPERATION, f.operation);
+  msg.set(body, HEADER_SIZE);
+  // checksum_body first, then checksum over header[16:] (so it is covered).
+  msg.set(checksumBytes(body), OFF_CHECKSUM_BODY);
+  msg.set(checksumBytes(msg.subarray(16, HEADER_SIZE)), OFF_CHECKSUM);
+  return msg;
+}
+
+/** The header checksum of an encoded message (its wire identity). */
+export function headerChecksum(message: Uint8Array): bigint {
+  return getU128(new DataView(message.buffer, message.byteOffset), OFF_CHECKSUM);
+}
+
+export interface DecodedHeader {
+  view: DataView;
+  command: number;
+  size: number;
+}
+
+/** Verify and split a 256-byte header; throws on checksum mismatch. */
+export function decodeHeader(head: Uint8Array): DecodedHeader {
+  if (head.length !== HEADER_SIZE) {
+    throw new Error(`header must be ${HEADER_SIZE} bytes, got ${head.length}`);
+  }
+  const view = new DataView(head.buffer, head.byteOffset, HEADER_SIZE);
+  const want = getU128(view, OFF_CHECKSUM);
+  const got = checksum(head.subarray(16, HEADER_SIZE));
+  if (want !== got) throw new Error("header checksum mismatch");
+  const size = view.getUint32(OFF_SIZE, true);
+  if (size < HEADER_SIZE || size > MESSAGE_SIZE_MAX) {
+    throw new Error(`invalid message size ${size}`);
+  }
+  return { view, command: view.getUint8(OFF_COMMAND), size };
+}
+
+/** Verify a reply body against the header's checksum_body; throws on mismatch. */
+export function verifyBody(h: DecodedHeader, body: Uint8Array): void {
+  const want = getU128(h.view, OFF_CHECKSUM_BODY);
+  if (want !== checksum(body)) throw new Error("body checksum mismatch");
+}

@@ -1,0 +1,88 @@
+// Live-server test: drives a running tigerbeetle-tpu replica over TCP and
+// validates replies, including byte-for-byte lookup rows.
+//
+//   python -m tigerbeetle_tpu format /tmp/ts.tb --cluster 0xA1
+//   python -m tigerbeetle_tpu start /tmp/ts.tb --addresses 127.0.0.1:3001 &
+//   TB_ADDRESS=127.0.0.1:3001 TB_CLUSTER=0xA1 npm run test:live
+
+import { Client } from "../src/client";
+import { AccountFlags, CreateTransferResult, TransferFlags } from "../src/types";
+
+function assertEq(got: unknown, want: unknown, what: string): void {
+  const g = typeof got === "bigint" ? got.toString() : JSON.stringify(got);
+  const w = typeof want === "bigint" ? want.toString() : JSON.stringify(want);
+  if (g !== w) throw new Error(`${what}: got ${g}, want ${w}`);
+}
+
+async function main(): Promise<void> {
+  const address = process.env.TB_ADDRESS ?? "127.0.0.1:3000";
+  const cluster = BigInt(process.env.TB_CLUSTER ?? "0xA1");
+  const c = new Client({ addresses: [address], cluster, timeoutMs: 60_000 });
+
+  const A = (id: bigint, flags = 0) => ({
+    id, debitsPending: 0n, debitsPosted: 0n, creditsPending: 0n,
+    creditsPosted: 0n, userData128: 7n, userData64: 8n, userData32: 9,
+    reserved: 0, ledger: 1, code: 10, flags, timestamp: 0n,
+  });
+  const T = (id: bigint, dr: bigint, cr: bigint, amount: bigint, flags = 0,
+             pendingId = 0n) => ({
+    id, debitAccountId: dr, creditAccountId: cr, amount, pendingId,
+    userData128: 0n, userData64: 0n, userData32: 0, timeout: 0, ledger: 1,
+    code: 10, flags, timestamp: 0n,
+  });
+
+  // Unique id space per run so the test is idempotent against a warm server.
+  const base = (BigInt(Date.now()) << 16n) | (1n << 62n);
+
+  // create_accounts: all succeed (empty result list).
+  const accErrs = await c.createAccounts([
+    A(base + 1n), A(base + 2n),
+    A(base + 3n, AccountFlags.debitsMustNotExceedCredits),
+  ]);
+  assertEq(accErrs, [], "create_accounts errors");
+
+  // create_transfers: plain + two-phase pending/post + an expected failure.
+  const t1 = base + 101n;
+  const tPend = base + 102n;
+  const tPost = base + 103n;
+  const errs = await c.createTransfers([
+    T(t1, base + 1n, base + 2n, 500n),
+    T(tPend, base + 1n, base + 2n, 200n, TransferFlags.pending),
+    T(tPost, 0n, 0n, 0n, TransferFlags.postPendingTransfer, tPend),
+    T(base + 104n, base + 1n, base + 1n, 1n), // accounts_must_be_different
+  ]);
+  assertEq(errs.length, 1, "one failing transfer");
+  assertEq(errs[0].index, 3, "failure index");
+  assertEq(errs[0].result, CreateTransferResult.accountsMustBeDifferent,
+           "failure code");
+
+  // lookup_accounts: balances reflect 500 posted + 200 posted via two-phase.
+  const accounts = await c.lookupAccounts([base + 1n, base + 2n]);
+  assertEq(accounts.length, 2, "lookup count");
+  assertEq(accounts[0].debitsPosted, 700n, "debits_posted");
+  assertEq(accounts[0].userData128, 7n, "user_data_128 round-trip");
+  assertEq(accounts[1].creditsPosted, 700n, "credits_posted");
+
+  // lookup_transfers: the posted amount is resolved from the pending.
+  const transfers = await c.lookupTransfers([t1, tPost]);
+  assertEq(transfers.length, 2, "transfer lookup count");
+  assertEq(transfers[0].amount, 500n, "plain amount");
+  assertEq(transfers[1].amount, 200n, "post amount resolved");
+  assertEq(transfers[1].pendingId, tPend, "pending id");
+  if (transfers[0].timestamp === 0n) throw new Error("timestamp not assigned");
+
+  // get_account_transfers: both sides, chronological.
+  const page = await c.getAccountTransfers({
+    accountId: base + 1n, timestampMin: 0n, timestampMax: 0n, limit: 10,
+    flags: 1 | 2, // debits | credits (AccountFilterFlags)
+  });
+  assertEq(page.length >= 3, true, "account transfers page");
+
+  c.close();
+  console.log("live OK");
+}
+
+main().catch((err) => {
+  console.error(err);
+  process.exit(1);
+});

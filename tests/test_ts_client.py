@@ -1,0 +1,219 @@
+"""TypeScript client: fixture generation + parity pinning (+ live test
+under node when available).
+
+No JS runtime ships in this image, so confidence in the pure-TS client
+(clients/typescript/src/{aegis,wire,client}.ts) is built from three sides:
+
+1. ``golden.json`` fixtures — AEGIS tags, full request frames, row codecs,
+   and a server-built reply frame — are GENERATED HERE from the Python
+   implementation (which passes the reference's published vectors) and kept
+   in sync by this test; ``npm test`` replays them against the TS port.
+2. The TS wire offsets are parsed out of wire.ts and pinned to the same
+   hand-derived table as tests/test_wire_golden.py.
+3. When a node >= 18 toolchain IS present (developer machines, CI), the
+   offline suite and the live-server suite run for real.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.checksum import checksum
+
+TS_DIR = os.path.join(os.path.dirname(__file__), "..", "clients", "typescript")
+GOLDEN = os.path.join(TS_DIR, "test", "golden.json")
+
+
+def _tag_hex(data: bytes) -> str:
+    return checksum(data).to_bytes(16, "little").hex()
+
+
+def _request_frame(name, *, cluster, client, parent, session, request,
+                   operation, body):
+    h = wire.new_header(
+        wire.Command.request, cluster=cluster, client=client, parent=parent,
+        session=session, request=request, operation=operation,
+        size=wire.HEADER_SIZE + len(body),
+    )
+    return {
+        "name": name, "cluster": str(cluster), "client": str(client),
+        "parent": str(parent), "session": str(session), "request": request,
+        "operation": operation, "body_hex": body.hex(),
+        "frame_hex": wire.encode(h, body).hex(),
+    }
+
+
+def build_golden() -> dict:
+    aegis = []
+    for n in (0, 1, 15, 16, 31, 32, 33, 64, 100, 256):
+        data = bytes(i & 0xFF for i in range(n))
+        aegis.append({"data_hex": data.hex(), "tag_hex": _tag_hex(data)})
+
+    account = types.account(
+        id=(0xDEAD << 64) | 0xBEEF, ledger=7, code=11,
+        flags=int(types.AccountFlags.HISTORY), user_data_128=(1 << 100) | 5,
+        user_data_64=17, user_data_32=23,
+    )
+    account_row = types.accounts_array([account])[0]
+    transfer = types.transfer(
+        id=(0xFEED << 64) | 2, debit_account_id=3, credit_account_id=4,
+        amount=(1 << 70) | 9, pending_id=12, ledger=7, code=11,
+        flags=int(types.TransferFlags.PENDING), timeout=3600,
+        user_data_128=2, user_data_64=3, user_data_32=4,
+    )
+    transfer_row = types.transfers_array([transfer])[0]
+
+    register = _request_frame(
+        "register", cluster=0xA1, client=0xC11E17, parent=0, session=0,
+        request=0, operation=int(wire.Operation.register), body=b"",
+    )
+    register_checksum = wire.u128(
+        wire.decode_header(bytes.fromhex(register["frame_hex"]))[0],
+        "checksum",
+    )
+    create = _request_frame(
+        "create_transfers", cluster=0xA1, client=0xC11E17,
+        parent=register_checksum, session=3, request=1,
+        operation=int(wire.Operation.create_transfers),
+        body=bytes(transfer_row.tobytes()),
+    )
+
+    # A reply frame as the server would build it.
+    results = np.zeros(2, dtype=types.EVENT_RESULT_DTYPE)
+    results[0] = (0, 21)
+    results[1] = (1, 46)
+    body = results.tobytes()
+    reply_h = wire.new_header(
+        wire.Command.reply, cluster=0xA1, view=2, replica=0,
+        request_checksum=0xABCDEF, context=1, client=0xC11E17, op=9,
+        commit=9, timestamp=1234, request=1,
+        operation=int(wire.Operation.create_transfers),
+        size=wire.HEADER_SIZE + len(body),
+    )
+    reply = {
+        "frame_hex": wire.encode(reply_h, body).hex(),
+        "request_checksum": str(0xABCDEF), "op": 9,
+        "results": [[0, 21], [1, 46]],
+    }
+
+    def field(row, lo, hi=None):
+        v = int(row[lo])
+        if hi is not None:
+            v |= int(row[hi]) << 64
+        return str(v)
+
+    return {
+        "aegis": aegis,
+        "request_frames": [register, create],
+        "reply_frames": [reply],
+        "account": {
+            "id": field(account_row, "id_lo", "id_hi"),
+            "debitsPending": "0", "debitsPosted": "0",
+            "creditsPending": "0", "creditsPosted": "0",
+            "userData128": field(account_row, "user_data_128_lo",
+                                 "user_data_128_hi"),
+            "userData64": field(account_row, "user_data_64"),
+            "userData32": int(account_row["user_data_32"]),
+            "ledger": int(account_row["ledger"]),
+            "code": int(account_row["code"]),
+            "flags": int(account_row["flags"]),
+            "timestamp": "0",
+            "row_hex": bytes(account_row.tobytes()).hex(),
+        },
+        "transfer": {
+            "id": field(transfer_row, "id_lo", "id_hi"),
+            "debitAccountId": field(transfer_row, "debit_account_id_lo",
+                                    "debit_account_id_hi"),
+            "creditAccountId": field(transfer_row, "credit_account_id_lo",
+                                     "credit_account_id_hi"),
+            "amount": field(transfer_row, "amount_lo", "amount_hi"),
+            "pendingId": field(transfer_row, "pending_id_lo",
+                               "pending_id_hi"),
+            "userData128": field(transfer_row, "user_data_128_lo",
+                                 "user_data_128_hi"),
+            "userData64": field(transfer_row, "user_data_64"),
+            "userData32": int(transfer_row["user_data_32"]),
+            "timeout": int(transfer_row["timeout"]),
+            "ledger": int(transfer_row["ledger"]),
+            "code": int(transfer_row["code"]),
+            "flags": int(transfer_row["flags"]),
+            "timestamp": "0",
+            "row_hex": bytes(transfer_row.tobytes()).hex(),
+        },
+    }
+
+
+def test_golden_fixtures_current():
+    """golden.json must match what the Python implementation generates —
+    regenerate-on-drift keeps the TS test vectors honest."""
+    want = build_golden()
+    if not os.path.exists(GOLDEN):
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            json.dump(want, f, indent=1, sort_keys=True)
+    with open(GOLDEN) as f:
+        got = json.load(f)
+    if got != want:
+        with open(GOLDEN, "w") as f:
+            json.dump(want, f, indent=1, sort_keys=True)
+        pytest.fail("golden.json was stale; regenerated — rerun")
+
+
+def test_ts_wire_offsets_match_python():
+    """The OFF_* constants in wire.ts pin to the same hand-derived table as
+    wire.py's dtypes (tests/test_wire_golden.py)."""
+    src = open(os.path.join(TS_DIR, "src", "wire.ts")).read()
+    got = {
+        m.group(1): int(m.group(2))
+        for m in re.finditer(
+            r"export const (OFF_\w+|HEADER_SIZE)\s*=\s*(\d+);", src
+        )
+    }
+    req = {n: wire.REQUEST_DTYPE.fields[n][1] for n in wire.REQUEST_DTYPE.names}
+    rep = {n: wire.REPLY_DTYPE.fields[n][1] for n in wire.REPLY_DTYPE.names}
+    want = {
+        "HEADER_SIZE": wire.HEADER_SIZE,
+        "OFF_CHECKSUM": req["checksum_lo"],
+        "OFF_CHECKSUM_BODY": req["checksum_body_lo"],
+        "OFF_CLUSTER": req["cluster_lo"],
+        "OFF_SIZE": req["size"],
+        "OFF_EPOCH": req["epoch"],
+        "OFF_VIEW": req["view"],
+        "OFF_VERSION": req["version"],
+        "OFF_COMMAND": req["command"],
+        "OFF_REPLICA": req["replica"],
+        "OFF_REQ_PARENT": req["parent_lo"],
+        "OFF_REQ_CLIENT": req["client_lo"],
+        "OFF_REQ_SESSION": req["session"],
+        "OFF_REQ_TIMESTAMP": req["timestamp"],
+        "OFF_REQ_REQUEST": req["request"],
+        "OFF_REQ_OPERATION": req["operation"],
+        "OFF_REP_REQUEST_CHECKSUM": rep["request_checksum_lo"],
+        "OFF_REP_CONTEXT": rep["context_lo"],
+        "OFF_REP_CLIENT": rep["client_lo"],
+        "OFF_REP_OP": rep["op"],
+        "OFF_REP_COMMIT": rep["commit"],
+        "OFF_REP_TIMESTAMP": rep["timestamp"],
+        "OFF_REP_REQUEST": rep["request"],
+        "OFF_REP_OPERATION": rep["operation"],
+        "OFF_EVICT_CLIENT": 128,
+    }
+    for name, off in want.items():
+        assert got.get(name) == off, (name, got.get(name), off)
+
+
+def _node():
+    return shutil.which("node")
+
+
+@pytest.mark.skipif(_node() is None, reason="no node runtime in this image")
+def test_ts_offline_under_node():
+    subprocess.run(["npm", "install"], cwd=TS_DIR, check=True, timeout=300)
+    subprocess.run(["npm", "test"], cwd=TS_DIR, check=True, timeout=300)
