@@ -4,28 +4,68 @@ The reference's VOPR (src/simulator.zig) runs ONE seeded cluster per process
 and farms seeds out to a fleet (src/vopr_hub).  The TPU-native equivalent
 runs THOUSANDS of simulated clusters as one batched, jitted computation:
 each cluster is a pure state tensor, each step applies a seeded random fault
-schedule (crashes/restarts, message loss, view changes) to a vectorized
-model of the VSR protocol, and the safety oracle — committed log prefixes
-must agree across replicas (state_checker.zig's invariant) — is evaluated
-on-device every step.  vmap batches clusters; shard_map spreads batches over
-the chip mesh, so a v5e slice explores millions of schedules per minute.
+schedule to a vectorized model of the VSR protocol, and the safety oracle is
+evaluated on-device every step.  vmap batches clusters; shard_map spreads
+batches over the chip mesh.
 
 Two layers of testing share the oracle (SURVEY §4):
 - sim/cluster.py runs the REAL consensus code on one schedule at a time
   (fidelity); this module runs the protocol MODEL at device scale (search).
-- ``bug`` injects classic consensus bugs (commit quorum too small, canonical
-  log chosen by op instead of (log_view, op), missing truncation) to prove
-  the oracle catches them — the fuzzer's fuzzer (vopr.zig's -Dbug builds).
+- ``bug`` injects classic consensus bugs to prove the oracle catches them —
+  the fuzzer's fuzzer (vopr.zig's -Dbug builds).
 
-Protocol model (per cluster, R replicas, S log slots):
-- state: status (alive/crashed), view, log_view, op, commit, log[R,S]
-  (entry = unique nonzero hash of (view, op) — divergence is detectable).
-- step: crash/restart flips; primary of the max alive view appends entries;
-  backups chain-replicate slot-by-slot with per-link loss; the primary
-  commits at a replication quorum of matching entries in its view; a
-  crashed primary triggers a view change at a view-change quorum which
-  adopts the canonical log by max (log_view, op) — vsr.zig:910-986 flexible
-  quorums, replica.zig DVC selection.
+Fault repertoire (round-4 fidelity upgrade, mirroring the reference's
+simulator):
+- crash/restart with WAL persistence, plus crash-time SLOT CORRUPTION
+  (testing/storage.zig crash faults): a corrupted slot is detectable
+  (checksums) and must be repaired from peers, never served or acked.
+- network PARTITIONS with modes none / isolate_single / uniform_split
+  (testing/packet_simulator.zig:10-62), persistent across steps and
+  re-sampled with p_repartition, plus per-link loss on top.
+- LOG WRAP: the WAL is a ring of S slots addressed by op % S with a
+  CHECKPOINT FLOOR — the primary may not append past checkpoint + S, and a
+  backup that falls behind the primary's ring is repaired by STATE SYNC
+  (adopting the checkpoint) instead of slot repair (vsr/sync.zig).
+
+Protocol model (per cluster, R replicas, S ring slots):
+- Views are per-replica PERCEIVED views: each replica's working view is the
+  max view among replicas it can reach (partition-faithful — two sides of a
+  split can run different views, which is exactly where split-brain bugs
+  live).  The primary of view v is v % R; a replica acts toward its
+  perceived primary only when connected to it.
+- prepare_ok carries the sender's matching-prefix guarantee: a replica acks
+  op k only when its ring matches the primary's through k (replica.zig
+  on_prepare); commits need a replication quorum of acks in-view.
+- view change: participants that share a perceived view and see its
+  primary dead/unreachable elect view+1 at a view-change quorum; the new
+  primary adopts the canonical log by max (log_view, op) among reachable
+  participants (replica.zig DVC selection).
+- Safety oracle: a per-cluster CANONICAL COMMIT LIST (state_checker.zig's
+  canonical commit list, not a pairwise prefix check): every op committed
+  by any replica is recorded first-writer-wins; any replica committing a
+  different entry for the same op is a violation.  Wrap-safe by
+  construction.
+
+Injected bug modes (each must be caught; clean model must stay clean):
+- commit_quorum:   commit below the replication quorum.
+- canonical_by_op: view change picks the donor log by op, ignoring
+                   log_view (the classic VSR-revisited mistake).
+- no_truncate:     a joiner marks its log current without installing the
+                   canonical headers, acks by op number, and adopts the
+                   primary's commit unbounded by its matching prefix.
+- corrupt_serve:   checksums off — a replica cannot detect its own storage
+                   damage: corrupt slots are served, acked, committed, and
+                   repaired from any same-op peer copy (fork-blind).
+- wal_wrap:        the append floor is ignored and slot repair trusts a
+                   recycled slot without verifying which op it holds (the
+                   failure Protocol-Aware Recovery exists to prevent).
+- split_brain:     the view-change quorum is ignored, letting a partition
+                   minority elect its own primary (R=5 split 2/3: the
+                   2-side elects and double-commits).
+
+Throughput (recorded for BASELINE config 5): tools/vopr_scale.py runs the
+clean model at >= 100k schedules and writes VOPR_TPU_SCALE.json
+(schedules, violations, schedules_per_minute, platform) at the repo root.
 """
 
 from __future__ import annotations
@@ -39,15 +79,28 @@ import numpy as np
 
 from ..vsr.consensus import quorums
 
+# Entry ids keep the top bit clear; CORRUPT is the detectable-damage marker
+# (a checksum failure in the real system — never a valid entry).
+CORRUPT = jnp.uint32(0x8000_0001)
+INF = jnp.int32(1 << 28)
+
 
 class ClusterState(NamedTuple):
-    status: jnp.ndarray     # (R,) i32: 0 alive, 1 crashed
-    view: jnp.ndarray       # (R,) i32
-    log_view: jnp.ndarray   # (R,) i32: view whose log this replica carries
-    op: jnp.ndarray         # (R,) i32 journal head
-    commit: jnp.ndarray     # (R,) i32
-    log: jnp.ndarray        # (R, S) u32 entry ids (0 = empty)
-    violated: jnp.ndarray   # () bool: safety violation detected
+    status: jnp.ndarray      # (R,) i32: 0 alive, 1 crashed
+    view: jnp.ndarray        # (R,) i32
+    log_view: jnp.ndarray    # (R,) i32: view whose log this replica carries
+    op: jnp.ndarray          # (R,) i32 journal head (unbounded; slot = op%S)
+    commit: jnp.ndarray      # (R,) i32
+    checkpoint: jnp.ndarray  # (R,) i32: durable floor (ring may not wrap past)
+    log: jnp.ndarray         # (R, S) u32 entry ids (0 empty, CORRUPT damaged)
+    log_hdr: jnp.ndarray     # (R, S) u32 redundant headers ring: the entry id
+                             # each slot SHOULD hold (journal.zig:17-46 dual
+                             # rings — headers survive prepare-ring damage)
+    log_op: jnp.ndarray      # (R, S) i32 op number occupying the slot
+    part_active: jnp.ndarray  # () bool
+    side: jnp.ndarray        # (R,) i32 partition side id
+    canonical: jnp.ndarray   # (MAX_OPS,) u32 canonical committed entries
+    violated: jnp.ndarray    # () bool
 
 
 def _entry(view: jnp.ndarray, op: jnp.ndarray) -> jnp.ndarray:
@@ -55,17 +108,23 @@ def _entry(view: jnp.ndarray, op: jnp.ndarray) -> jnp.ndarray:
     h = (view.astype(jnp.uint32) * jnp.uint32(2654435761)) ^ (
         op.astype(jnp.uint32) * jnp.uint32(40503)
     )
-    return h | jnp.uint32(1)
+    return (h & jnp.uint32(0x7FFF_FFFF)) | jnp.uint32(1)
 
 
-def make_state(n_replicas: int, slots: int) -> ClusterState:
+def make_state(n_replicas: int, slots: int, max_ops: int) -> ClusterState:
     return ClusterState(
         status=jnp.zeros(n_replicas, jnp.int32),
         view=jnp.zeros(n_replicas, jnp.int32),
         log_view=jnp.zeros(n_replicas, jnp.int32),
         op=jnp.zeros(n_replicas, jnp.int32),
         commit=jnp.zeros(n_replicas, jnp.int32),
+        checkpoint=jnp.zeros(n_replicas, jnp.int32),
         log=jnp.zeros((n_replicas, slots), jnp.uint32),
+        log_hdr=jnp.zeros((n_replicas, slots), jnp.uint32),
+        log_op=jnp.zeros((n_replicas, slots), jnp.int32),
+        part_active=jnp.zeros((), bool),
+        side=jnp.zeros(n_replicas, jnp.int32),
+        canonical=jnp.zeros(max_ops, jnp.uint32),
         violated=jnp.zeros((), bool),
     )
 
@@ -76,11 +135,14 @@ def step(
     *,
     n_replicas: int,
     slots: int,
+    max_ops: int,
     p_crash: float = 0.01,
     p_restart: float = 0.2,
     p_append: float = 0.6,
     p_link: float = 0.7,
     p_view_change: float = 0.3,
+    p_corrupt: float = 0.2,
+    p_repartition: float = 0.05,
     bug: Optional[str] = None,
 ) -> ClusterState:
     """One simulation step for one cluster (vmapped over clusters)."""
@@ -88,160 +150,334 @@ def step(
     q_repl, q_view = quorums(R)
     if bug == "commit_quorum":
         q_repl = max(1, q_repl - 1)   # classic: commit below quorum
-    k_crash, k_restart, k_append, k_link, k_vc = jax.random.split(key, 5)
+    if bug == "split_brain":
+        q_view = 1                    # a partition minority may elect
+    ckpt_interval = max(1, S // 2)
+    (k_crash, k_restart, k_cgate, k_cslot, k_part, k_append, k_link, k_vc,
+     k_sync) = jax.random.split(key, 9)
     rids = jnp.arange(R)
+    sidx = jnp.arange(S)[None, :]
 
-    status, view, log_view, op, commit, log, violated = state
+    (status, view, log_view, op, commit, checkpoint, log, log_hdr, log_op,
+     part_active, side, canonical, violated) = state
+    commit0 = commit  # for the oracle: ops committed THIS step
 
-    # 1. Crashes and restarts (WAL persists: op/commit/log survive).
+    # 1. Crashes and restarts (WAL persists) + crash-time slot corruption
+    # (testing/storage.zig: faults injected at crash; detectable via
+    # checksums, so the slot is KNOWN damaged — never silently divergent).
     crash = jax.random.bernoulli(k_crash, p_crash, (R,)) & (status == 0)
     restart = jax.random.bernoulli(k_restart, p_restart, (R,)) & (status == 1)
     status = jnp.where(crash, 1, jnp.where(restart, 0, status))
+    corrupt_gate = jax.random.bernoulli(k_cgate, p_corrupt, (R,)) & crash
+    corrupt_slot = jax.random.randint(k_cslot, (R,), 0, S)
+    hit = corrupt_gate[:, None] & (sidx == corrupt_slot[:, None]) & (log_op >= 1)
+    # Crash faults damage the PREPARE ring; the redundant headers ring
+    # survives, so the replica still knows which checksum the slot needs.
+    log = jnp.where(hit, CORRUPT, log)
     alive = status == 0
 
-    # 2. The cluster's working view and primary.
-    cluster_view = jnp.max(jnp.where(alive, view, 0))
-    primary = cluster_view % R
-    p_alive = alive[primary]
-    p_current = p_alive & (log_view[primary] == cluster_view)
-
-    # Replicas whose log predates the cluster view install it (start_view):
-    # truncate to the primary's head and mark the log as current.  A replica
-    # may NOT ack or commit in a view before installing — prepare_ok implies
-    # the sender's log is the view's log (replica.zig on_start_view).
-    joiner = alive & (log_view < cluster_view) & p_current
-    view = jnp.where(joiner, cluster_view, view)
-    if bug != "no_truncate":
-        # SV replaces the joiner's log with the canonical headers (truncating
-        # any fork) — retaining an old-view prefix unverified while marking
-        # the log current is exactly the bug the oracle caught in an earlier
-        # draft of this model.
-        slot_idx = jnp.arange(S)[None, :]
-        canonical_log = jnp.where(
-            slot_idx <= op[primary], log[primary][None, :], jnp.uint32(0)
-        )
-        log = jnp.where(joiner[:, None], canonical_log, log)
-        op = jnp.where(joiner, op[primary], op)
-    log_view = jnp.where(joiner, cluster_view, log_view)
-
-    # 3. Primary appends a new entry (client request -> prepare).
-    can_append = p_current & (op[primary] + 1 < S) & jax.random.bernoulli(
-        k_append, p_append
+    # 2. Partitions (packet_simulator.zig modes): persistent across steps,
+    # re-sampled with p_repartition.  conn[i,j]: i can exchange with j.
+    k_pm, k_pg, k_ps, k_pw = jax.random.split(k_part, 4)
+    repart = jax.random.bernoulli(k_pg, p_repartition)
+    mode = jax.random.randint(k_pm, (), 0, 4)  # 0,1: none; 2: isolate; 3: split
+    lone = jax.random.randint(k_pw, (), 0, R)
+    new_side = jnp.where(
+        mode == 2,
+        (rids == lone).astype(jnp.int32),
+        jax.random.bernoulli(k_ps, 0.5, (R,)).astype(jnp.int32),
     )
-    new_op = op[primary] + 1
-    append_entry = _entry(cluster_view, new_op)
-    one_hot_p = rids == primary
-    log = jnp.where(
-        (one_hot_p[:, None] & (jnp.arange(S)[None, :] == new_op) & can_append),
-        append_entry,
-        log,
-    )
-    op = jnp.where(one_hot_p & can_append, new_op, op)
-
-    # 4. Chain replication: each current backup syncs its first divergent or
-    # missing slot from the primary (repair + ring replication collapsed
-    # into one slot/step/replica; per-link delivery is lossy).
+    side = jnp.where(repart, new_side, side)
+    part_active = jnp.where(repart, mode >= 2, part_active)
+    conn = (~part_active) | (side[:, None] == side[None, :])
+    conn = conn | jnp.eye(R, dtype=bool)
     link_up = jax.random.bernoulli(k_link, p_link, (R,))
+
+    # 3. Perceived views: gossip is connectivity-bound, so each replica's
+    # working view is the max view among the replicas it can reach — two
+    # sides of a split may legitimately run different views.
+    reach = conn & alive[None, :]
+    perceived = jnp.max(jnp.where(reach, view[None, :], 0), axis=1)
+    perceived = jnp.maximum(perceived, view)
+    prim = perceived % R
+    connP = jnp.take_along_axis(conn, prim[:, None], axis=1)[:, 0]
+    aliveP = alive[prim]
+    currentP = log_view[prim] == perceived
+    p_current_for = aliveP & currentP & connP
+    acting = alive & (prim == rids) & (log_view == perceived)
+
+    # 4. Joiner install (on_start_view): a replica whose log predates its
+    # perceived view installs the primary's canonical ring — truncating any
+    # fork — before it may ack or commit in the view.
+    joiner = alive & (log_view < perceived) & p_current_for & link_up
+    logP = jnp.take(log, prim, axis=0)
+    log_hdrP = jnp.take(log_hdr, prim, axis=0)
+    log_opP = jnp.take(log_op, prim, axis=0)
+    opP = op[prim]
+    ckptP = checkpoint[prim]
+    if bug != "no_truncate":
+        log = jnp.where(joiner[:, None], logP, log)
+        log_hdr = jnp.where(joiner[:, None], log_hdrP, log_hdr)
+        log_op = jnp.where(joiner[:, None], log_opP, log_op)
+        op = jnp.where(joiner, opP, op)
+        checkpoint = jnp.where(joiner, jnp.maximum(checkpoint, ckptP), checkpoint)
+    log_view = jnp.where(joiner, perceived, log_view)
+    view = jnp.where(joiner, perceived, view)  # perceived >= view always
+
+    # 5. Acting primaries append (client request -> prepare).  The ring may
+    # not wrap past the checkpoint floor (constants.zig checkpoint
+    # interval: un-checkpointed slots must never be overwritten).
+    new_op = op + 1
+    floor_ok = (new_op - checkpoint) <= S
+    if bug == "wal_wrap":
+        floor_ok = jnp.ones_like(floor_ok)
+    can_append = (
+        acting & floor_ok & (new_op < max_ops - 1)
+        & jax.random.bernoulli(k_append, p_append, (R,))
+    )
+    app_entry = _entry(perceived, new_op)
+    app_write = can_append[:, None] & (sidx == (new_op % S)[:, None])
+    log = jnp.where(app_write, app_entry[:, None], log)
+    log_hdr = jnp.where(app_write, app_entry[:, None], log_hdr)
+    log_op = jnp.where(app_write, new_op[:, None], log_op)
+    op = jnp.where(can_append, new_op, op)
+
+    # 6. Primary self-repair of corrupt slots from reachable peers —
+    # request_prepare BY CHECKSUM: the surviving headers ring says exactly
+    # which prepare the slot needs, so a peer's same-op entry from a stale
+    # fork is rejected (adopting it forked a committed slot in an earlier
+    # draft of this model; the oracle caught it within 512 schedules).
+    donor_ok = (
+        alive[None, :, None] & conn[:, :, None]
+        & (log_op[None, :, :] == log_op[:, None, :])
+        & (log[None, :, :] != CORRUPT) & (log[None, :, :] != 0)
+    )  # (r, donor, slot)
+    if bug != "corrupt_serve":
+        donor_ok = donor_ok & (log[None, :, :] == log_hdr[:, None, :])
+    donor_entry = jnp.max(
+        jnp.where(donor_ok, log[None, :, :], jnp.uint32(0)), axis=1
+    )
+    fixable = acting[:, None] & (log == CORRUPT) & (donor_entry != 0)
+    log = jnp.where(fixable, donor_entry, log)
+
+    # Refresh primary-gathered views after joiner/append/repair writes.
+    logP = jnp.take(log, prim, axis=0)
+    log_opP = jnp.take(log_op, prim, axis=0)
+    opP = op[prim]
+    ckptP = checkpoint[prim]
+
+    # 7. Matching prefix vs the perceived primary (the prepare_ok
+    # guarantee): first op where this replica's ring disagrees.
+    def prefix_vs_primary(log, log_op, logP, log_opP, opP):
+        entry_differs = log != logP
+        if bug == "corrupt_serve":
+            # No checksums: a replica cannot see its own damage.
+            entry_differs = entry_differs & (log != CORRUPT)
+        mismatch = entry_differs & (log_opP >= 1)
+        if bug != "wal_wrap":
+            # Op-aware ring: a slot holding a RECYCLED op is a mismatch
+            # even when the entry bytes happen to be present.
+            mismatch = mismatch | ((log_op != log_opP) & (log_opP >= 1))
+        first_bad = jnp.min(jnp.where(mismatch, log_opP, INF), axis=1)
+        return first_bad, jnp.minimum(first_bad - 1, opP)
+
+    first_bad, prefix_ok = prefix_vs_primary(log, log_op, logP, log_opP, opP)
+
+    # 8. Backup repair: sync the first divergent/missing op from the
+    # primary's ring; if that op has left the ring (the backup fell behind
+    # the floor), STATE SYNC adopts the primary's checkpoint+ring wholesale
+    # (vsr/sync.zig).
     is_backup = (
-        alive & (log_view == cluster_view) & (~one_hot_p) & p_current
+        alive & ~acting & p_current_for & (log_view == perceived)
     )
-    slot_idx = jnp.arange(S)[None, :]
-    in_primary = slot_idx <= op[primary][None]
-    mismatch = (log != log[primary][None, :]) & in_primary
-    first_bad = jnp.where(
-        mismatch.any(axis=1), jnp.argmax(mismatch, axis=1), op[primary] + 1
+    target = jnp.minimum(first_bad, op + 1)
+    t_slot = target % S
+    t_in_ring = (
+        jnp.take_along_axis(log_opP, t_slot[:, None], axis=1)[:, 0] == target
     )
-    target = jnp.minimum(first_bad, jnp.minimum(op, op[primary]) + 1)
-    can_sync = is_backup & link_up & (target <= op[primary])
-    log = jnp.where(
-        (can_sync[:, None] & (slot_idx == target[:, None])),
-        log[primary][None, :].repeat(R, 0),
-        log,
-    )
+    if bug == "wal_wrap":
+        # An op-unaware implementation trusts whatever the slot holds.
+        t_in_ring = jnp.ones_like(t_in_ring)
+    reachable = is_backup & link_up & (target <= opP)
+    can_sync = reachable & t_in_ring
+    sync_write = can_sync[:, None] & (sidx == t_slot[:, None])
+    log = jnp.where(sync_write, logP, log)
+    log_hdr = jnp.where(sync_write, jnp.take(log_hdr, prim, axis=0), log_hdr)
+    if bug == "wal_wrap":
+        # Trusting a recycled slot: adopt the entry but assume it holds the
+        # op we asked for — the exact check Protocol-Aware Recovery adds.
+        log_op = jnp.where(sync_write, target[:, None], log_op)
+    else:
+        log_op = jnp.where(sync_write, log_opP, log_op)
     op = jnp.where(can_sync, jnp.maximum(op, target), op)
 
-    # 5. Commit: the primary advances when a replication quorum holds the
-    # matching entry at commit+1 in the current view.
-    k = commit[primary] + 1
-    entry_k = log[primary, k % S]
-    # A prepare_ok refers to the op *number* in this view; a replica whose
-    # slot k matches the primary's log acks.  Under the no_truncate bug the
-    # backup skipped SV truncation, so its slot may hold a stale prepare
-    # while it still acks by number — the failure truncation prevents.
-    acks = alive & (log_view == cluster_view) & (op >= k)
+    state_sync = reachable & ~t_in_ring & jax.random.bernoulli(k_sync, 0.5, (R,))
+    log = jnp.where(state_sync[:, None], logP, log)
+    log_hdr = jnp.where(
+        state_sync[:, None], jnp.take(log_hdr, prim, axis=0), log_hdr
+    )
+    log_op = jnp.where(state_sync[:, None], log_opP, log_op)
+    op = jnp.where(state_sync, opP, op)
+    checkpoint = jnp.where(state_sync, jnp.maximum(checkpoint, ckptP), checkpoint)
+    commit = jnp.where(state_sync, jnp.maximum(commit, ckptP), commit)
+
+    # Recompute the prefix after repair writes (acks below see fresh state).
+    logP = jnp.take(log, prim, axis=0)
+    log_opP = jnp.take(log_op, prim, axis=0)
+    first_bad, prefix_ok = prefix_vs_primary(log, log_op, logP, log_opP, op[prim])
+
+    # 9. Commit: each acting primary advances when a replication quorum of
+    # in-view, reachable replicas acks op commit+1 — an ack REQUIRES the
+    # sender's matching prefix through that op (replica.zig on_prepare_ok).
+    k_op = commit[prim] + 1
+    ack = (
+        alive & (log_view == perceived) & connP & (op >= k_op)
+    )
     if bug != "no_truncate":
-        acks = acks & (log[:, k % S] == entry_k)
-    can_commit = p_current & (k <= op[primary]) & (jnp.sum(acks) >= q_repl) & (
-        entry_k != 0
+        ack = ack & (prefix_ok >= k_op)
+    ack_count = jnp.zeros(R, jnp.int32).at[prim].add(ack.astype(jnp.int32))
+    k_self = commit + 1
+    k_slot = k_self % S
+    e_k = jnp.take_along_axis(log, k_slot[:, None], axis=1)[:, 0]
+    e_k_op = jnp.take_along_axis(log_op, k_slot[:, None], axis=1)[:, 0]
+    entry_valid = (e_k_op == k_self) & (e_k != 0)
+    if bug != "corrupt_serve":
+        entry_valid = entry_valid & (e_k != CORRUPT)
+    can_commit = (
+        acting & (k_self <= op) & (ack_count >= q_repl) & entry_valid
     )
-    commit = jnp.where(one_hot_p & can_commit, k, commit)
-    # Backups learn the commit number (heartbeats), bounded by their own
-    # matching prefix.
-    safe_prefix = jnp.where(
-        mismatch.any(axis=1), first_bad - 1, jnp.minimum(op, op[primary])
-    )
+    commit = jnp.where(can_commit, k_self, commit)
+
+    # 10. Commit heartbeat: backups adopt the primary's commit bounded by
+    # their own matching prefix (a backup never commits past what it can
+    # prove it holds).
+    hb = jnp.minimum(commit[prim], prefix_ok)
+    if bug == "no_truncate":
+        hb = commit[prim]
     commit = jnp.where(
-        is_backup & link_up,
-        jnp.maximum(commit, jnp.minimum(commit[primary], safe_prefix)),
-        commit,
+        is_backup & link_up & connP, jnp.maximum(commit, hb), commit
     )
 
-    # 6. View change on a dead primary at a view-change quorum: the new
-    # primary adopts the canonical log = max (log_view, op) among alive
-    # participants (replica.zig DVC selection).
-    do_vc = (
-        (~p_alive)
-        & (jnp.sum(alive) >= q_view)
-        & jax.random.bernoulli(k_vc, p_view_change)
+    # 11. Checkpoint advance (constants.zig vsr_checkpoint_interval).
+    new_ckpt = (commit // ckpt_interval) * ckpt_interval
+    checkpoint = jnp.where(
+        alive & (commit - checkpoint >= ckpt_interval),
+        jnp.maximum(checkpoint, new_ckpt), checkpoint,
     )
-    new_view = cluster_view + 1
+
+    # 12. View change: replicas sharing a perceived view whose primary is
+    # dead or unreachable SEND an SVC/DVC (svc below); an election fires at
+    # the prospective new primary once a view-change quorum of senders is
+    # reachable, and the new primary adopts the canonical log by max
+    # (log_view, op) among the DVC senders (replica.zig DVC selection).
+    #
+    # CRITICAL (quorum-intersection soundness, found by the oracle itself):
+    # only committed senders count toward the quorum, and EVERY sender of a
+    # fired election bumps its view — a replica that has donated its log to
+    # view v+1 must never again ack in view v.  An earlier draft counted
+    # "suspecting" replicas without bumping them, and the oracle caught the
+    # resulting lost-commit fork within 128 schedules.
+    dead_prim = alive & (~aliveP | ~connP)
+    same_view = perceived[:, None] == perceived[None, :]
+    svc = dead_prim & jax.random.bernoulli(k_vc, p_view_change, (R,))
+    participant = (
+        alive[None, :] & conn & same_view & svc[None, :]
+    )  # (r, r'): r' is a DVC sender reachable from r in r's view
+    cnt = jnp.sum(participant, axis=1)
+    fire = svc & (cnt >= q_view)
+    new_view = perceived + 1
+    new_prim = new_view % R
+    inst = fire & (new_prim == rids)
     if bug == "canonical_by_op":
-        rank = op - jnp.where(alive, 0, 1 << 20)
+        rank = op[None, :].astype(jnp.int64) - jnp.where(
+            participant, 0, jnp.int64(1) << 60
+        )
     else:
-        rank = log_view * (S + 1) + op - jnp.where(alive, 0, 1 << 20)
-    canonical = jnp.argmax(rank)
-    new_primary = new_view % R
-    np_alive = alive[new_primary]
-    install = do_vc & np_alive
-    one_hot_np = rids == new_primary
-    log = jnp.where(
-        (install & one_hot_np)[:, None], log[canonical][None, :], log
+        rank = (
+            log_view[None, :].astype(jnp.int64) * jnp.int64(max_ops + S)
+            + op[None, :]
+            - jnp.where(participant, 0, jnp.int64(1) << 60)
+        )
+    donor = jnp.argmax(rank, axis=1)  # per prospective new primary
+    log = jnp.where(inst[:, None], jnp.take(log, donor, axis=0), log)
+    log_hdr = jnp.where(inst[:, None], jnp.take(log_hdr, donor, axis=0), log_hdr)
+    log_op = jnp.where(inst[:, None], jnp.take(log_op, donor, axis=0), log_op)
+    op = jnp.where(inst, op[donor], op)
+    commit = jnp.where(inst, jnp.maximum(commit, commit[donor]), commit)
+    checkpoint = jnp.where(
+        inst, jnp.maximum(checkpoint, checkpoint[donor]), checkpoint
     )
-    op = jnp.where(install & one_hot_np, op[canonical], op)
-    commit = jnp.where(
-        install & one_hot_np, jnp.maximum(commit, commit[canonical]), commit
+    log_view = jnp.where(inst, new_view, log_view)
+    # Every DVC sender of a fired election bumps (it is bound to the new
+    # view); senders whose election did not fire stay put.
+    bumped = jnp.any(inst[:, None] & participant, axis=0)
+    view = jnp.where(
+        (bumped | inst) & alive, jnp.maximum(view, new_view), view
     )
-    log_view = jnp.where(install & one_hot_np, new_view, log_view)
-    view = jnp.where(do_vc & alive, new_view, view)
 
-    # 7. Safety oracle (state_checker.zig): committed prefixes must agree.
-    pair_commit = jnp.minimum(commit[:, None], commit[None, :])
-    slot_ge = jnp.arange(S)[None, None, :]
-    both = (slot_ge <= pair_commit[:, :, None]) & (slot_ge >= 1)
-    differ = log[:, None, :] != log[None, :, :]
-    violated = violated | (both & differ).any()
+    # 13. Safety oracle: the canonical commit list (state_checker.zig).
+    # Every op committed THIS step by any replica is checked against (and
+    # recorded into) the cluster-wide canonical list, first-writer-wins.
+    # Detectably-corrupt slots are excluded: known damage under repair is a
+    # liveness problem, not a safety violation.
+    committed = (
+        (log_op > commit0[:, None]) & (log_op <= commit[:, None])
+        & (log_op >= 1)
+    )
+    if bug != "corrupt_serve":
+        committed = committed & (log != CORRUPT)
+    idx = jnp.where(committed, log_op, 0)
+    vals = jnp.where(committed, log, jnp.uint32(0))
+    proposals = jnp.zeros(max_ops, jnp.uint32).at[idx.reshape(-1)].max(
+        vals.reshape(-1)
+    )
+    canonical = jnp.where(
+        (canonical == 0) & (jnp.arange(max_ops) >= 1), proposals, canonical
+    )
+    conflict = committed & (jnp.take(canonical, idx) != vals)
+    violated = violated | conflict.any()
+    # Continuous check: EVERY ring slot below a replica's commit must match
+    # the canonical list on every step, not only at the commit crossing — a
+    # post-commit history rewrite (e.g. a buggy install overwriting a
+    # committed slot) must not escape because commit never re-crosses it.
+    below = (log_op >= 1) & (log_op <= commit[:, None]) & (log != CORRUPT)
+    want = jnp.take(canonical, jnp.where(below, log_op, 0))
+    violated = violated | (below & (want != 0) & (want != log)).any()
 
-    # Pin carry dtypes (the package enables x64; mixed-int arithmetic would
-    # otherwise promote and break the fori_loop carry contract).
     return ClusterState(
-        status.astype(jnp.int32),
-        view.astype(jnp.int32),
-        log_view.astype(jnp.int32),
-        op.astype(jnp.int32),
-        commit.astype(jnp.int32),
-        log.astype(jnp.uint32),
-        violated,
+        status.astype(jnp.int32), view.astype(jnp.int32),
+        log_view.astype(jnp.int32), op.astype(jnp.int32),
+        commit.astype(jnp.int32), checkpoint.astype(jnp.int32),
+        log.astype(jnp.uint32), log_hdr.astype(jnp.uint32),
+        log_op.astype(jnp.int32),
+        part_active, side.astype(jnp.int32), canonical, violated,
     )
+
+
+BUGS = (
+    "commit_quorum", "canonical_by_op", "no_truncate", "corrupt_serve",
+    "wal_wrap", "split_brain",
+)
+
+# The harsh fault schedule certified clean by tests/test_vopr.py and
+# measured at scale by tools/vopr_scale.py — one definition so the
+# published VOPR_TPU_SCALE.json cannot drift from what the tests verify.
+HARSH_FAULTS = dict(
+    p_crash=0.08, p_restart=0.3, p_view_change=0.5, p_link=0.5,
+    p_repartition=0.15,
+)
 
 
 def _one_cluster_fn(n_steps: int, n_replicas: int, slots: int, bug, probs):
     """Build the per-cluster schedule function (shared by run/run_sharded)."""
+    max_ops = n_steps + 2
     step_fn = functools.partial(
-        step, n_replicas=n_replicas, slots=slots, bug=bug, **probs
+        step, n_replicas=n_replicas, slots=slots, max_ops=max_ops, bug=bug,
+        **probs,
     )
 
     def one_cluster(key):
-        state = make_state(n_replicas, slots)
+        state = make_state(n_replicas, slots, max_ops)
 
         def body(i, carry):
             state, key = carry
