@@ -1,0 +1,228 @@
+"""Standby replicas: non-voting prepare-stream consumers, promotable.
+
+Reference: constants.zig:31-35 (up to 6 standbys), replica.zig:4874-4878
+(standbys receive and replicate prepares but never send prepare_oks),
+replica.zig:6065-6101 (ring replication jumps off the active ring to the
+standby ring).  The promotion path rewrites a standby data file's identity
+to a retired voter's index: the promoted voter rejoins warm, keeping the
+WAL it accumulated from the stream.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.client import Client
+from tigerbeetle_tpu.config import LEDGER_TEST, TEST_MIN
+from tigerbeetle_tpu.net.cluster_bus import ClusterServer
+from tigerbeetle_tpu.vsr.consensus import VsrReplica
+
+CLUSTER = 0x57A
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class StandbyCluster:
+    """3 voters + 1 standby on localhost TCP."""
+
+    VOTERS = 3
+    STANDBYS = 1
+
+    def __init__(self, tmp_path):
+        self.n = self.VOTERS + self.STANDBYS
+        self.tmp_path = tmp_path
+        self.addresses = [("127.0.0.1", p) for p in free_ports(self.n)]
+        self.replicas = [None] * self.n
+        self.servers = [None] * self.n
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self.loop.run_forever, daemon=True)
+        self.thread.start()
+        for i in range(self.n):
+            VsrReplica.format(
+                self._path(i), cluster=CLUSTER, replica=i,
+                replica_count=self.VOTERS, standby_count=self.STANDBYS,
+                cluster_config=TEST_MIN,
+            )
+            self.start(i)
+
+    def _path(self, i):
+        return str(self.tmp_path / f"r{i}.data")
+
+    def _run(self, coro, timeout=15):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def start(self, i):
+        assert self.servers[i] is None
+        r = VsrReplica(
+            self._path(i), cluster_config=TEST_MIN, ledger_config=LEDGER_TEST,
+            batch_lanes=64, seed=i,
+        )
+        r.open()
+        self.replicas[i] = r
+
+        async def boot():
+            server = ClusterServer(r, self.addresses, tick_interval=0.005)
+            await server.start()
+            return server
+
+        self.servers[i] = self._run(boot())
+
+    def stop(self, i):
+        server, self.servers[i] = self.servers[i], None
+        replica, self.replicas[i] = self.replicas[i], None
+
+        async def down():
+            await server.close()
+
+        self._run(down())
+        replica.close()
+
+    def close(self):
+        for i in range(self.n):
+            if self.servers[i] is not None:
+                self.stop(i)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+def _wait_commit(replica, target, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if replica is not None and replica.commit_min >= target:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = StandbyCluster(tmp_path)
+    yield c
+    c.close()
+
+
+def test_standby_consumes_stream_without_voting(cluster):
+    standby = cluster.replicas[3]
+    assert standby.is_standby
+    assert not standby.is_primary
+    assert standby.node_count == 4
+
+    client = Client(cluster.addresses[:3], cluster=CLUSTER, timeout_s=30.0)
+    try:
+        accounts = types.accounts_array(
+            [types.account(id=i + 1, ledger=1, code=10) for i in range(8)]
+        )
+        assert client.create_accounts(accounts) == []
+        for b in range(3):
+            trs = types.transfers_array([
+                types.transfer(id=100 + 10 * b + j, debit_account_id=1 + j % 4,
+                               credit_account_id=5 + j % 4, amount=7,
+                               ledger=1, code=10)
+                for j in range(8)
+            ])
+            assert client.create_transfers(trs) == []
+    finally:
+        client.close()
+
+    primary = cluster.replicas[0]
+    # The standby consumed the prepare stream: its journal head and commit
+    # track the cluster's (commits arrive via heartbeats).
+    assert _wait_commit(standby, primary.commit_min), (
+        standby.commit_min, primary.commit_min,
+    )
+    assert standby.op >= primary.commit_min
+    # It never entered any voter's ack quorum bookkeeping: with 3 voters
+    # the quorum is 2 and pipeline entries record ok_from ⊆ {0,1,2}.
+    for r in cluster.replicas[:3]:
+        for entry in r.pipeline.values():
+            assert all(peer < 3 for peer in entry.ok_from)
+
+
+def test_standby_promotion_recovers_retired_voter(cluster):
+    client = Client(cluster.addresses[:3], cluster=CLUSTER, timeout_s=30.0)
+    accounts = types.accounts_array(
+        [types.account(id=i + 1, ledger=1, code=10) for i in range(8)]
+    )
+    assert client.create_accounts(accounts) == []
+    trs = types.transfers_array([
+        types.transfer(id=200 + j, debit_account_id=1 + j % 4,
+                       credit_account_id=5 + j % 4, amount=3, ledger=1,
+                       code=10)
+        for j in range(8)
+    ])
+    assert client.create_transfers(trs) == []
+    client.close()
+
+    committed = cluster.replicas[0].commit_min
+    assert _wait_commit(cluster.replicas[3], committed)
+
+    # Retire voter 2; promote the standby's data file into its slot.
+    cluster.stop(2)
+    cluster.stop(3)
+    VsrReplica.promote(cluster._path(3), 2, cluster_config=TEST_MIN)
+
+    # The promoted file serves from voter 2's ADDRESS slot (a real operator
+    # points the retired voter's address at the new machine).
+    import shutil
+
+    shutil.move(cluster._path(3), cluster._path(2) + ".promoted")
+
+    r = VsrReplica(
+        cluster._path(2) + ".promoted", cluster_config=TEST_MIN,
+        ledger_config=LEDGER_TEST, batch_lanes=64, seed=7,
+    )
+    r.open()
+    assert r.replica == 2 and not r.is_standby
+    cluster.replicas[2] = r
+
+    async def boot():
+        server = ClusterServer(r, cluster.addresses, tick_interval=0.005)
+        await server.start()
+        return server
+
+    cluster.servers[2] = cluster._run(boot())
+
+    # The cluster (voters 0, 1, promoted 2) serves new writes...
+    client = Client(cluster.addresses[:3], cluster=CLUSTER, timeout_s=30.0)
+    try:
+        trs = types.transfers_array([
+            types.transfer(id=300 + j, debit_account_id=1 + j % 4,
+                           credit_account_id=5 + j % 4, amount=2, ledger=1,
+                           code=10)
+            for j in range(8)
+        ])
+        assert client.create_transfers(trs) == []
+        # ...and the promoted voter catches up and holds ALL the data —
+        # including what it learned only via the standby prepare stream.
+        assert _wait_commit(r, committed + 1)
+        rows = client.lookup_transfers([201, 301])
+        assert len(rows) == 2 and int(rows[0]["amount_lo"]) == 3
+        assert int(rows[1]["amount_lo"]) == 2
+    finally:
+        client.close()
+
+    # No data loss: balances conserve across the promotion.
+    rows = None
+    client = Client(cluster.addresses[:3], cluster=CLUSTER, timeout_s=30.0)
+    try:
+        rows = client.lookup_accounts(list(range(1, 9)))
+    finally:
+        client.close()
+    dpo = sum(int(r["debits_posted_lo"]) for r in rows)
+    cpo = sum(int(r["credits_posted_lo"]) for r in rows)
+    assert dpo == cpo and dpo == 8 * 3 + 8 * 2

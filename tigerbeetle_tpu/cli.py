@@ -44,6 +44,16 @@ def main(argv=None) -> int:
     p_format.add_argument("--cluster", type=lambda s: int(s, 0), required=True)
     p_format.add_argument("--replica", type=int, default=0)
     p_format.add_argument("--replica-count", type=int, default=1)
+    p_format.add_argument("--standby-count", type=int, default=0,
+                          help="non-voting members that consume the prepare "
+                               "stream (indexes replica_count..)")
+
+    p_promote = sub.add_parser(
+        "promote", help="promote a standby data file to a voting index"
+    )
+    p_promote.add_argument("path")
+    p_promote.add_argument("--replica", type=int, required=True,
+                           help="target voting index (the retired voter's)")
 
     p_start = sub.add_parser("start", help="serve a formatted data file")
     p_start.add_argument("path")
@@ -100,9 +110,11 @@ def main(argv=None) -> int:
     p_vopr.add_argument("--clusters", type=int, default=4096,
                         help="(--tpu) simulated clusters in the batch")
     p_vopr.add_argument("--steps", type=int, default=400)
-    p_vopr.add_argument("--bug", default=None,
-                        choices=["commit_quorum", "canonical_by_op",
-                                 "no_truncate"],
+    # Keep in sync with sim.vopr_tpu.BUGS (asserted in _cmd_vopr; a
+    # module import here would pull jax into every CLI invocation).
+    vopr_bugs = ["commit_quorum", "canonical_by_op", "no_truncate",
+                 "corrupt_serve", "wal_wrap", "split_brain"]
+    p_vopr.add_argument("--bug", default=None, choices=vopr_bugs,
                         help="(--tpu) inject a known consensus bug to "
                              "validate the oracle")
 
@@ -122,7 +134,7 @@ def main(argv=None) -> int:
     # accelerator, with a loud CPU fallback.
     from . import jaxenv
 
-    if args.subcommand in ("format", "repl") or (
+    if args.subcommand in ("format", "promote", "repl") or (
         args.subcommand == "vopr" and not args.tpu
     ):
         jaxenv.force_cpu()
@@ -136,6 +148,7 @@ def main(argv=None) -> int:
 
     return {
         "format": _cmd_format,
+        "promote": _cmd_promote,
         "start": _cmd_start,
         "version": _cmd_version,
         "repl": _cmd_repl,
@@ -152,6 +165,10 @@ def _cmd_vopr(args) -> int:
     if args.tpu:
         from .sim import vopr_tpu
 
+        assert set(vopr_tpu.BUGS) == {
+            "commit_quorum", "canonical_by_op", "no_truncate",
+            "corrupt_serve", "wal_wrap", "split_brain",
+        }, "cli --bug choices drifted from sim.vopr_tpu.BUGS"
         if args.count != 1 or args.ticks != 6_000:
             print("error: --count/--ticks apply only without --tpu",
                   file=sys.stderr)
@@ -195,12 +212,33 @@ def _cmd_vopr(args) -> int:
 def _cmd_format(args) -> int:
     from .vsr.replica import Replica
 
-    Replica.format(
-        args.path, cluster=args.cluster, replica=args.replica,
-        replica_count=args.replica_count,
+    try:
+        Replica.format(
+            args.path, cluster=args.cluster, replica=args.replica,
+            replica_count=args.replica_count,
+            standby_count=args.standby_count,
+        )
+    except ValueError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    role = (
+        "standby" if args.replica >= args.replica_count else "replica"
     )
     print(f"formatted {args.path} (cluster {args.cluster:#x}, "
-          f"replica {args.replica}/{args.replica_count})")
+          f"{role} {args.replica}/{args.replica_count}"
+          + (f"+{args.standby_count}" if args.standby_count else "") + ")")
+    return 0
+
+
+def _cmd_promote(args) -> int:
+    from .vsr.replica import Replica
+
+    try:
+        Replica.promote(args.path, args.replica)
+    except ValueError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    print(f"promoted {args.path} to voting replica {args.replica}")
     return 0
 
 

@@ -43,8 +43,10 @@ class ClusterServer:
         statsd=None,
         process_config=None,
     ) -> None:
-        assert replica.replica_count == len(addresses), (
-            replica.replica_count, addresses
+        # Addresses cover ALL nodes: voters [0, replica_count) followed by
+        # standbys [replica_count, node_count) (cli.zig --addresses order).
+        assert replica.node_count == len(addresses), (
+            replica.node_count, addresses
         )
         from ..config import PROCESS_DEFAULT
 
@@ -80,8 +82,8 @@ class ClusterServer:
         )
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("replica %d listening on %s:%d", self.index, host, self.port)
-        # Dial higher-indexed replicas (message_bus.zig connection rule).
-        for j in range(self.index + 1, self.replica.replica_count):
+        # Dial higher-indexed nodes (message_bus.zig connection rule).
+        for j in range(self.index + 1, self.replica.node_count):
             self._tasks.append(asyncio.ensure_future(self._dial_loop(j)))
         self._tasks.append(asyncio.ensure_future(self._tick_loop()))
         return self.port
@@ -221,7 +223,7 @@ class ClusterServer:
                                 del self.client_writers[key]
                         is_client = False
                         sender = int(h["replica"])
-                        if 0 <= sender < self.replica.replica_count:
+                        if 0 <= sender < self.replica.node_count:
                             self.peer_writers.setdefault(sender, writer)
                 if is_client and command in CLIENT_COMMANDS:
                     client = wire.u128(h, "client")

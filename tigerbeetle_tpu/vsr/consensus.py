@@ -220,6 +220,17 @@ class VsrReplica(Replica):
     def primary_index(self, view: Optional[int] = None) -> int:
         return (self.view if view is None else view) % self.replica_count
 
+    @property
+    def is_standby(self) -> bool:
+        """Non-voting member (replica index >= replica_count,
+        constants.zig:31-35): consumes the prepare stream, never acks,
+        never votes, never becomes primary (replica.zig:4874-4878)."""
+        return self.replica >= self.replica_count
+
+    @property
+    def node_count(self) -> int:
+        return self.replica_count + self.standby_count
+
     def _init_clock(self) -> None:
         self.clock = Clock(
             self.replica_count, self.replica, self._monotonic, self._realtime
@@ -396,6 +407,16 @@ class VsrReplica(Replica):
         h["replica"] = self.replica
         return h
 
+    def _broadcast_nodes(self, message: bytes) -> List[Msg]:
+        """To every node incl. standbys (the reference's
+        send_header_to_other_replicas_and_standbys: pings, commit
+        heartbeats, start_view)."""
+        return [
+            (("replica", r), message)
+            for r in range(self.node_count)
+            if r != self.replica
+        ]
+
     def _broadcast(self, message: bytes) -> List[Msg]:
         return [
             (("replica", r), message)
@@ -408,7 +429,9 @@ class VsrReplica(Replica):
     def on_request_msg(self, h: np.ndarray, body: bytes) -> List[Msg]:
         """Client request: primary prepares + replicates; backups forward to
         the primary (replica.zig on_request :1308-1337)."""
-        if self.status != NORMAL:
+        if self.status != NORMAL or self.is_standby:
+            # Standbys never serve clients (replica.zig:4315 misdirected);
+            # dropping (not forwarding) matches the reference.
             return []
         if not self.is_primary:
             return [(("replica", self.primary_index()), wire.encode(h, body))]
@@ -484,13 +507,28 @@ class VsrReplica(Replica):
 
     def _ring_successor(self) -> Optional[int]:
         """Next replica in the replication ring (replica.zig:1339-1363);
-        None when the ring would return to the primary."""
+        the last active backup jumps off to the standby ring
+        (replica.zig:6067-6101); None when the chain completes."""
         if self.replica_count == 1:
             return None
-        nxt = (self.replica + 1) % self.replica_count
-        if nxt == self.primary_index():
+        if not self.is_standby:
+            nxt = (self.replica + 1) % self.replica_count
+            if nxt != self.primary_index():
+                return nxt
+        if self.standby_count == 0:
             return None
-        return nxt
+        # Standby ring rotates with the view so no standby is permanently
+        # last (standby_index_to_replica).
+        first_standby = self.replica_count + (self.view % self.standby_count)
+        if not self.is_standby:
+            return first_standby
+        my_index = self.replica - self.replica_count
+        next_standby = self.replica_count + (
+            (my_index + 1) % self.standby_count
+        )
+        if next_standby != first_standby:
+            return next_standby
+        return None
 
     # -- normal operation: replication ---------------------------------------
 
@@ -552,7 +590,7 @@ class VsrReplica(Replica):
         if op in self.missing and self.missing[op] == checksum:
             self._fill_missing(h, body)
             if self.status == NORMAL:
-                out.append(self._send_prepare_ok(h))
+                self._append_ok(out, h)
                 if self.is_primary:
                     # The primary may already hold ack quorums for this and
                     # later pipeline entries (the commit stalled on OUR
@@ -580,7 +618,7 @@ class VsrReplica(Replica):
                     # Duplicate of an adopted prepare (e.g. the new primary's
                     # resend of a re-certified old-view suffix): re-ack in
                     # the CURRENT view.
-                    out.append(self._send_prepare_ok(h))
+                    self._append_ok(out, h)
                 elif existing is None and op > self.commit_min:
                     self.stash[op] = (h, body)
                     self._fill_gaps(out)
@@ -600,7 +638,7 @@ class VsrReplica(Replica):
         if op <= self.op:
             existing = self.headers.get(op)
             if existing is not None and wire.header_checksum(existing) == checksum:
-                out.append(self._send_prepare_ok(h))
+                self._append_ok(out, h)
             elif existing is None and op > self.commit_min:
                 # Header-gap fill (e.g. a start_view whose header window did
                 # not reach back to our commit_min): verify DOWNWARD via the
@@ -611,7 +649,7 @@ class VsrReplica(Replica):
 
         if op == self.op + 1 and wire.u128(h, "parent") == self.parent_checksum:
             self._journal_prepare(h, body)
-            out.append(self._send_prepare_ok(h))
+            self._append_ok(out, h)
             successor = self._ring_successor()
             if successor is not None and successor != int(h["replica"]):
                 out.append((("replica", successor), wire.encode(h, body)))
@@ -628,6 +666,13 @@ class VsrReplica(Replica):
         self.headers[int(h["op"])] = h
         self.op = int(h["op"])
         self.parent_checksum = wire.header_checksum(h)
+
+    def _append_ok(self, out: List[Msg], prepare_h: np.ndarray) -> None:
+        """Queue a prepare_ok — unless we are a standby (standbys receive
+        and replicate prepares but NEVER ack: they must not count toward
+        commit quorums, replica.zig:4877)."""
+        if not self.is_standby:
+            out.append(self._send_prepare_ok(prepare_h))
 
     def _send_prepare_ok(self, prepare_h: np.ndarray) -> Msg:
         ok = self._hdr(
@@ -650,7 +695,7 @@ class VsrReplica(Replica):
             if wire.u128(h, "parent") != self.parent_checksum:
                 break
             self._journal_prepare(h, body)
-            out.append(self._send_prepare_ok(h))
+            self._append_ok(out, h)
         # Prune committed stash entries (gap fills for ops <= self.op with
         # unknown headers stay until _fill_gaps verifies them).
         for op in [o for o in self.stash if o <= self.commit_min]:
@@ -676,7 +721,7 @@ class VsrReplica(Replica):
                     self.journal.write_prepare(wire.encode(h, body))
                     self.headers[op] = h
                     del self.stash[op]
-                    out.append(self._send_prepare_ok(h))
+                    self._append_ok(out, h)
                     self._repipeline(op, h)
                     changed = True
         self._commit_journal(out)
@@ -694,6 +739,8 @@ class VsrReplica(Replica):
         return gaps[-limit:]
 
     def on_prepare_ok(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if int(h["replica"]) >= self.replica_count:
+            return []  # a standby's ack must never count (defense in depth)
         if self.status != NORMAL or not self.is_primary:
             return []
         if int(h["view"]) != self.view:
@@ -843,6 +890,8 @@ class VsrReplica(Replica):
     def _begin_view_change(self, new_view: int) -> List[Msg]:
         """Move to view_change status for new_view and broadcast SVC
         (replica.zig on view-change timeout)."""
+        if self.is_standby:
+            return []  # standbys never campaign
         assert new_view > self.view or (
             new_view == self.view and self.status != NORMAL
         )
@@ -863,6 +912,10 @@ class VsrReplica(Replica):
     def on_start_view_change(self, h: np.ndarray, body: bytes) -> List[Msg]:
         view = int(h["view"])
         if view < self.view or self.replica_count == 1:
+            return []
+        if self.is_standby or int(h["replica"]) >= self.replica_count:
+            # Standbys neither vote nor count (replica.zig:4613); a standby
+            # tracks new views via prepares/commits/request_start_view.
             return []
         if self.sync_target is not None:
             # A syncing replica has no log to vote with; joining the view
@@ -929,6 +982,8 @@ class VsrReplica(Replica):
         view = int(h["view"])
         if view < self.view:
             return []
+        if self.is_standby or int(h["replica"]) >= self.replica_count:
+            return []  # standbys neither gather nor donate DVCs
         if self.sync_target is not None:
             return []  # syncing: see on_start_view_change
         out: List[Msg] = []
@@ -1121,7 +1176,7 @@ class VsrReplica(Replica):
             checkpoint_op=self.op_checkpoint,
         )
         body = wire.pack_headers(self._suffix_headers())
-        out = self._broadcast(wire.encode(sv, body))
+        out = self._broadcast_nodes(wire.encode(sv, body))
         self._maybe_commit_pipeline(out)
         return out
 
@@ -1184,7 +1239,7 @@ class VsrReplica(Replica):
         for op in range(self.commit_min + 1, self.op + 1):
             hh = self.headers.get(op)
             if hh is not None and op not in self.missing:
-                out.append(self._send_prepare_ok(hh))
+                self._append_ok(out, hh)
         out.extend(self._request_missing())
         self._commit_journal(out)
         return out
@@ -1789,7 +1844,11 @@ class VsrReplica(Replica):
 
     def on_pong(self, h: np.ndarray, body: bytes) -> List[Msg]:
         ping_mono = int(h["ping_timestamp_monotonic"])
-        self.clock.learn(int(h["replica"]), ping_mono, int(h["pong_timestamp_wall"]))
+        if int(h["replica"]) < self.replica_count:
+            # Standby clocks never affect cluster time (replica.zig:1274).
+            self.clock.learn(
+                int(h["replica"]), ping_mono, int(h["pong_timestamp_wall"])
+            )
         # Feed the retry timeouts' RTT estimate (vsr.zig:593-634).
         self.rtt.sample(
             (self._monotonic() - ping_mono) / getattr(self, "tick_ns", TICK_NS)
@@ -1820,7 +1879,7 @@ class VsrReplica(Replica):
                 checkpoint_op=self.op_checkpoint,
                 ping_timestamp_monotonic=self.clock.ping_timestamp(),
             )
-            out.extend(self._broadcast(wire.encode(ping)))
+            out.extend(self._broadcast_nodes(wire.encode(ping)))
 
         if self._block_repair is not None:
             out.extend(self._tick_block_repair())
@@ -1868,7 +1927,7 @@ class VsrReplica(Replica):
                     checkpoint_op=self.op_checkpoint,
                     timestamp_monotonic=self.clock.ping_timestamp(),
                 )
-                out.extend(self._broadcast(wire.encode(commit)))
+                out.extend(self._broadcast_nodes(wire.encode(commit)))
             if self.pipeline and self._prepare_timeout.fired(self._ticks):
                 # Quorumed-but-uncommitted entries can linger if the commit
                 # attempt at ack time stalled on a repairable local fault;
@@ -1925,9 +1984,11 @@ class VsrReplica(Replica):
                     out.extend(self._broadcast(wire.encode(req)))
 
         elif self.status == NORMAL:
-            # Backup: watch for a dead primary.
-            if self._ticks - max(self._last_primary_word, 0) >= (
-                NORMAL_HEARTBEAT + self._heartbeat_jitter
+            # Backup: watch for a dead primary.  Standbys observe but never
+            # call elections (they are not in the view-change quorum).
+            if not self.is_standby and (
+                self._ticks - max(self._last_primary_word, 0)
+                >= NORMAL_HEARTBEAT + self._heartbeat_jitter
             ):
                 self._last_primary_word = self._ticks
                 out.extend(self._begin_view_change(self.view + 1))
@@ -1987,8 +2048,9 @@ class VsrReplica(Replica):
                 # entry into RECOVERING, not process age — a replica that
                 # re-enters late (post-sync) must give the live primary a
                 # chance to answer first.
-                if self._ticks - self._recovering_since >= (
-                    NORMAL_HEARTBEAT + self._heartbeat_jitter
+                if not self.is_standby and (
+                    self._ticks - self._recovering_since
+                    >= NORMAL_HEARTBEAT + self._heartbeat_jitter
                 ):
                     out.extend(self._begin_view_change(self.view + 1))
 

@@ -134,6 +134,7 @@ class Replica:
         self.cluster = 0
         self.replica = 0
         self.replica_count = 1
+        self.standby_count = 0
         # Optional commit observer (testing/auditor.py): called with every
         # committed op's (op, operation, timestamp, body, results, replay)
         # — the simulator's op-ordered reply auditor hooks in here.
@@ -164,17 +165,21 @@ class Replica:
         cluster: int,
         replica: int = 0,
         replica_count: int = 1,
+        standby_count: int = 0,
         cluster_config: Optional[ClusterConfig] = None,
         storage: Optional[Storage] = None,
     ) -> None:
         """Create + initialize a data file (main.zig format path; the root
         prepare op=0 anchors the hash chain, message_header.zig Prepare.root)."""
+        from .superblock import validate_membership
+
         config = cluster_config or ClusterConfig()
+        validate_membership(replica, replica_count, standby_count)
         if storage is None:
             storage = Storage.format(data_path, config)
         try:
             superblock = SuperBlock(storage)
-            superblock.format(cluster, replica, replica_count)
+            superblock.format(cluster, replica, replica_count, standby_count)
             root = wire.new_header(
                 wire.Command.prepare,
                 cluster=cluster,
@@ -183,6 +188,36 @@ class Replica:
             )
             journal = Journal(storage)
             journal.write_prepare(wire.encode(root, b""))
+        finally:
+            storage.close()
+
+    @classmethod
+    def promote(cls, data_path: str, new_replica: int,
+                cluster_config: Optional[ClusterConfig] = None) -> None:
+        """Promote a STANDBY data file to voting index ``new_replica``.
+
+        Rewrites the superblock identity in place, keeping the WAL and
+        checkpoint the standby accumulated from the prepare stream — the
+        promoted voter rejoins warm and repairs only the tail (the
+        reference reserves standby promotion for operator reconfiguration,
+        constants.zig:31-35; the operator must first retire any live
+        replica that holds the target index)."""
+        config = cluster_config or ClusterConfig()
+        storage = Storage(data_path, config)
+        try:
+            superblock = SuperBlock(storage)
+            state = superblock.open()
+            if state.replica < state.replica_count:
+                raise ValueError(
+                    f"replica {state.replica} is already a voter"
+                )
+            if not (0 <= new_replica < state.replica_count):
+                raise ValueError(
+                    f"target index {new_replica} is not a voting slot "
+                    f"(replica_count={state.replica_count})"
+                )
+            state.replica = new_replica
+            superblock.checkpoint(state)
         finally:
             storage.close()
 
@@ -201,6 +236,7 @@ class Replica:
         self.cluster = sb.cluster
         self.replica = sb.replica
         self.replica_count = sb.replica_count
+        self.standby_count = sb.standby_count
         self.view = sb.view
         self.op_checkpoint = sb.op_checkpoint
         self.commit_min = sb.op_checkpoint

@@ -39,7 +39,8 @@ SUPERBLOCK_DTYPE = np.dtype(
         ("cluster_lo", "<u8"), ("cluster_hi", "<u8"),
         ("replica", "u1"),
         ("replica_count", "u1"),
-        ("_pad2", "V6"),
+        ("standby_count", "u1"),
+        ("_pad2", "V5"),
         ("sequence", "<u8"),
         # -- VSRState (superblock.zig CheckpointState analogue) --
         ("view", "<u4"),
@@ -67,6 +68,10 @@ class SuperBlockState:
     cluster: int = 0
     replica: int = 0
     replica_count: int = 1
+    # Non-voting members with indexes [replica_count, replica_count +
+    # standby_count) — they consume the prepare stream but never ack or
+    # vote (constants.zig:31-35).
+    standby_count: int = 0
     sequence: int = 0
     view: int = 0
     log_view: int = 0
@@ -89,6 +94,7 @@ def _encode_copy(state: SuperBlockState, copy: int) -> bytes:
     rec["cluster_hi"] = state.cluster >> 64
     rec["replica"] = state.replica
     rec["replica_count"] = state.replica_count
+    rec["standby_count"] = state.standby_count
     rec["sequence"] = state.sequence
     rec["view"] = state.view
     rec["log_view"] = state.log_view
@@ -132,6 +138,7 @@ def _decode_copy(buf: bytes) -> Optional[Tuple[SuperBlockState, int]]:
         cluster=(int(rec["cluster_hi"]) << 64) | int(rec["cluster_lo"]),
         replica=int(rec["replica"]),
         replica_count=int(rec["replica_count"]),
+        standby_count=int(rec["standby_count"]),
         sequence=int(rec["sequence"]),
         view=int(rec["view"]),
         log_view=int(rec["log_view"]),
@@ -153,15 +160,37 @@ def _decode_copy(buf: bytes) -> Optional[Tuple[SuperBlockState, int]]:
     return state, int(rec["copy"])
 
 
+def validate_membership(replica: int, replica_count: int,
+                        standby_count: int) -> None:
+    """Operator-reachable validation (CLI format): real errors, not
+    asserts (stripped under -O).  Called BEFORE any file is created so a
+    rejected format leaves no debris."""
+    if not 0 <= replica < replica_count + standby_count:
+        raise ValueError(
+            f"replica index {replica} outside [0, "
+            f"{replica_count + standby_count}) "
+            f"(replica_count={replica_count}, standby_count={standby_count})"
+        )
+    if replica_count == 1 and standby_count > 0:
+        # The solo serving path has no consensus tick loop — a standby
+        # would be silently starved of the prepare stream; reject rather
+        # than format a node that can never catch up.
+        raise ValueError(
+            "standbys require a multi-replica cluster (replica_count >= 2)"
+        )
+
+
 class SuperBlock:
     def __init__(self, storage: Storage) -> None:
         self.storage = storage
         self.state = SuperBlockState()
 
-    def format(self, cluster: int, replica: int, replica_count: int = 1) -> None:
+    def format(self, cluster: int, replica: int, replica_count: int = 1,
+               standby_count: int = 0) -> None:
+        validate_membership(replica, replica_count, standby_count)
         self.state = SuperBlockState(
             cluster=cluster, replica=replica, replica_count=replica_count,
-            sequence=1,
+            standby_count=standby_count, sequence=1,
         )
         self._write_all()
 
