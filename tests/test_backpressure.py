@@ -1,0 +1,209 @@
+"""Backpressure budget: slow clients and pipelining violators cannot
+deadlock the server or grow its memory unboundedly.
+
+The reference computes a static message budget at comptime that provably
+avoids deadlock (message_pool.zig:17-58).  The asyncio server's equivalent
+is the memory-budget invariant in net/bus.py (bounded request queue +
+FLUSH_MAX in-flight groups + drain_timeout eviction of slow consumers);
+these tests are the adversarial check that the budget composes: a client
+that stops reading is evicted while other clients keep committing, and a
+protocol-violating pipeliner stalls only itself.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.client import Client
+from tigerbeetle_tpu.config import LEDGER_TEST, TEST_MIN, ProcessConfig
+from tigerbeetle_tpu.net.bus import ReplicaServer
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.replica import Replica
+
+CLUSTER = 0xB9
+BATCH = TEST_MIN.batch_max_create_transfers  # 63 under the 8 KiB messages
+
+
+@pytest.fixture
+def server(tmp_path):
+    path = str(tmp_path / "bp.tb")
+    Replica.format(path, cluster=CLUSTER, cluster_config=TEST_MIN)
+    replica = Replica(
+        path, cluster_config=TEST_MIN, ledger_config=LEDGER_TEST,
+        batch_lanes=64,
+        # Short drain budget so the eviction path runs inside the test.
+        process_config=ProcessConfig(drain_timeout_ms=1500),
+    )
+    replica.open()
+    box = {}
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+
+    async def boot():
+        srv = ReplicaServer(replica, "127.0.0.1", 0)
+        box["port"] = await srv.start()
+        return srv
+
+    srv = asyncio.run_coroutine_threadsafe(boot(), loop).result(30)
+    yield ("127.0.0.1", box["port"])
+
+    async def down():
+        await srv.close()
+
+    asyncio.run_coroutine_threadsafe(down(), loop).result(15)
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=5)
+    replica.close()
+
+
+def test_batch_lanes_must_fit_wire_batch(tmp_path):
+    """Misconfigured lanes < batch_max fails at startup, not as a runtime
+    wedge (an oversized wire batch would assert inside the commit path,
+    drop the connection, and loop forever on the client's resend)."""
+    path = str(tmp_path / "cfg.tb")
+    Replica.format(path, cluster=CLUSTER)
+    with pytest.raises(ValueError, match="batch_lanes"):
+        Replica(path, batch_lanes=1024)  # PRODUCTION batch_max is 8190
+
+
+def _register_raw(sock, client_id):
+    """Minimal wire-level session registration on a raw socket."""
+    h = wire.new_header(
+        wire.Command.request, cluster=CLUSTER, client=client_id,
+        request=0, parent=0, session=0,
+        operation=int(wire.Operation.register),
+    )
+    msg = wire.encode(h, b"")
+    sock.sendall(msg)
+    head = b""
+    while len(head) < wire.HEADER_SIZE:
+        head += sock.recv(wire.HEADER_SIZE - len(head))
+    rh, cmd = wire.decode_header(head)
+    assert cmd == wire.Command.reply
+    return int(rh["op"]), wire.header_checksum(wire.decode_header(msg)[0])
+
+
+def _seed_accounts(server, n):
+    good = Client([server], cluster=CLUSTER, config=TEST_MIN, timeout_s=60.0)
+    try:
+        done = 0
+        while done < n:
+            k = min(BATCH, n - done)
+            accounts = types.accounts_array(
+                [types.account(id=done + i + 1, ledger=1, code=10)
+                 for i in range(k)]
+            )
+            assert good.create_accounts(accounts) == []
+            done += k
+    finally:
+        good.close()
+
+
+def _pipeline_lookups(sock, client_id, session, parent, n_requests, ids):
+    """Send n_requests hash-chained lookups without reading any reply;
+    returns how many were accepted by the socket (non-blocking)."""
+    body = b"".join(
+        i.to_bytes(8, "little") + (0).to_bytes(8, "little") for i in ids
+    )
+    sock.setblocking(False)
+    sent = 0
+    for req in range(1, n_requests + 1):
+        h = wire.new_header(
+            wire.Command.request, cluster=CLUSTER, client=client_id,
+            request=req, parent=parent, session=session,
+            operation=int(wire.Operation.lookup_accounts),
+        )
+        msg = wire.encode(h, body)
+        parent = wire.header_checksum(wire.decode_header(msg)[0])
+        try:
+            sock.sendall(msg)
+            sent += 1
+        except (BlockingIOError, OSError):
+            break
+    return sent
+
+
+def test_slow_consumer_is_evicted_and_others_progress(server):
+    _seed_accounts(server, 126)
+
+    # The adversary: registers, then pipelines hundreds of lookups WITHOUT
+    # ever reading a reply, with a tiny receive buffer so the server's
+    # write buffer (not the kernel's) absorbs the reply bytes.
+    evil = socket.create_connection(server, timeout=30)
+    evil.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    session, parent = _register_raw(evil, 0xEE11)
+    sent = _pipeline_lookups(
+        evil, 0xEE11, session, parent, 600, list(range(1, 1 + BATCH))
+    )
+    assert sent > 50  # enough replies (~8 KiB each) to swamp any watermark
+
+    # Meanwhile, honest clients keep committing the whole time.
+    good = Client([server], cluster=CLUSTER, config=TEST_MIN, timeout_s=20.0)
+    batches = 0
+    tid = 1 << 33
+    t_end = time.time() + 6.0
+    try:
+        while time.time() < t_end:
+            trs = types.transfers_array([
+                types.transfer(id=tid + j, debit_account_id=1 + j % 63,
+                               credit_account_id=64 + j % 62, amount=1,
+                               ledger=1, code=10)
+                for j in range(BATCH)
+            ])
+            assert good.create_transfers(trs) == []
+            tid += BATCH
+            batches += 1
+    finally:
+        good.close()
+    assert batches >= 10, "honest client starved behind the slow consumer"
+
+    # The slow consumer was evicted: the server closed its connection (recv
+    # sees EOF/reset once the buffered bytes drain).
+    evil.setblocking(True)
+    evil.settimeout(15.0)
+    evicted = False
+    try:
+        drained = 0
+        while drained < (1 << 26):  # 64 MiB cap: past this, no eviction
+            chunk = evil.recv(1 << 16)
+            if not chunk:
+                evicted = True
+                break
+            drained += len(chunk)
+    except (ConnectionResetError, socket.timeout, OSError):
+        evicted = True
+    evil.close()
+    assert evicted, "slow consumer was never evicted"
+
+
+def test_pipelining_violator_stalls_only_itself(server):
+    """A flood of unacknowledged requests backpressures its own connection
+    reader (bounded request queue); honest clients on other connections
+    keep getting service with sane latency."""
+    _seed_accounts(server, 63)
+    flood = socket.create_connection(server, timeout=30)
+    session, parent = _register_raw(flood, 0xF100D0)
+    sent = _pipeline_lookups(
+        flood, 0xF100D0, session, parent, 2000, list(range(1, 33))
+    )
+    assert sent > 0
+
+    good = Client([server], cluster=CLUSTER, config=TEST_MIN, timeout_s=20.0)
+    try:
+        accounts = types.accounts_array(
+            [types.account(id=90_000 + i, ledger=1, code=10)
+             for i in range(16)]
+        )
+        t0 = time.time()
+        assert good.create_accounts(accounts) == []
+        assert time.time() - t0 < 10.0, "honest request starved by flood"
+        rows = good.lookup_accounts([90_000])
+        assert len(rows) == 1
+    finally:
+        good.close()
+        flood.close()

@@ -105,6 +105,10 @@ class ProcessConfig:
     connection_delay_max_ms: int = 1000
     tcp_backlog: int = 64
     tcp_nodelay: bool = True
+    # Reply-flush drain budget: a client that stops reading has this long
+    # before its connection is evicted (message_bus.zig bounded send queue +
+    # terminate discipline; see net/bus.py "Memory budget" invariant).
+    drain_timeout_ms: int = 5000
     # O_DIRECT for the zoned data file (direct_io / direct_io_required):
     # page-cache writeback lies about durability; required=True refuses to
     # run on filesystems without it instead of silently degrading.

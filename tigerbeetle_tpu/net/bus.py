@@ -67,6 +67,27 @@ class ReplicaServer:
     # amortizing the group's single WAL fsync (vsr.zig pipeline_prepare_
     # queue_max spirit: enough overlap to hide the barrier, no more).
     GROUP_MAX = 32
+    # Concurrent reply-flush tasks (groups whose fsync/drain is still in
+    # flight) before the processor must wait for one to finish.
+    FLUSH_MAX = 8
+
+    # MEMORY BUDGET INVARIANT (message_pool.zig:17-58's role — the
+    # reference proves at comptime that its static message pool can never
+    # deadlock; this is the asyncio equivalent, enforced at runtime):
+    #
+    #   bodies resident <= queue (2*GROUP_MAX)            [put() backpressure]
+    #                    + (FLUSH_MAX + 1) * GROUP_MAX    [in-flight groups]
+    #
+    # i.e. <= 352 message bodies regardless of client behavior, because:
+    #   1. connection readers await queue.put() (a pipelining protocol
+    #      violator stalls its OWN reader, never the server);
+    #   2. the processor admits at most FLUSH_MAX concurrent flush tasks;
+    #   3. every flush completes in bounded time: each drain() is capped by
+    #      drain_timeout_ms, after which the slow consumer is EVICTED
+    #      (connection closed) — so no client can hold a flush task, and
+    #      therefore the processor, hostage.
+    # Deadlock-freedom: the processor never awaits anything a client
+    # controls beyond that bounded drain.
 
     def __init__(self, replica: Replica, host: Optional[str] = None,
                  port: Optional[int] = None, statsd=None) -> None:
@@ -180,6 +201,13 @@ class ReplicaServer:
             else:
                 # Reply release rides the durability barrier; the processor
                 # moves on.  (Tracked so close() can cancel stragglers.)
+                # FLUSH_MAX caps concurrent in-flight groups (see the
+                # memory-budget invariant above).
+                while len(self._flushes) >= self.FLUSH_MAX:
+                    await asyncio.wait(
+                        list(self._flushes),
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
                 task = asyncio.get_running_loop().create_task(flush)
                 self._flushes.add(task)
                 task.add_done_callback(self._flushes.discard)
@@ -199,14 +227,31 @@ class ReplicaServer:
                 continue
             for out in outs:
                 writer.write(out)
-        # One drain per group keeps write buffers bounded without a
-        # per-reply await.
-        for _h, _b, writer in group:
-            if not writer.is_closing():
-                try:
-                    await writer.drain()
-                except (ConnectionResetError, BrokenPipeError):
-                    pass
+        # Parallel bounded drains: one slow client must not serialize the
+        # group, and a client that stops reading is evicted after
+        # drain_timeout_ms (the bounded-send-queue discipline; a stuck
+        # drain here would hold the flush task — and under fsync=None the
+        # processor itself — hostage).
+        timeout = self.process.drain_timeout_ms / 1000.0
+        await asyncio.gather(*(
+            self._drain_or_evict(writer, timeout)
+            for _h, _b, writer in group
+            if not writer.is_closing()
+        ))
+
+    async def _drain_or_evict(self, writer, timeout: float) -> None:
+        try:
+            await asyncio.wait_for(writer.drain(), timeout)
+        except asyncio.TimeoutError:
+            peer = writer.get_extra_info("peername")
+            log.warning("evicting slow consumer %s (drain > %.1fs)",
+                        peer, timeout)
+            # abort(), not close(): close() flushes the buffer first, which
+            # for a zero-window peer never completes — the buffered replies
+            # would stay resident forever and the eviction would be a lie.
+            writer.transport.abort()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
 
     def _emit_stats(self, group, elapsed_s: float) -> None:
         self.statsd.count("requests", len(group))

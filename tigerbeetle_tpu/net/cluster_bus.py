@@ -45,9 +45,15 @@ class ClusterServer:
     ) -> None:
         # Addresses cover ALL nodes: voters [0, replica_count) followed by
         # standbys [replica_count, node_count) (cli.zig --addresses order).
-        assert replica.node_count == len(addresses), (
-            replica.node_count, addresses
-        )
+        # Operator-reachable (start --addresses): a real error, not an
+        # assert (stripped under -O; misrouting would surface later).
+        if replica.node_count != len(addresses):
+            raise ValueError(
+                f"--addresses lists {len(addresses)} entries but the data "
+                f"file's cluster has {replica.node_count} nodes "
+                f"({replica.replica_count} voters + {replica.standby_count} "
+                "standbys; standbys extend the address list)"
+            )
         from ..config import PROCESS_DEFAULT
 
         self.process = process_config or getattr(

@@ -92,6 +92,16 @@ class Replica:
         self.hash_log = hash_log
         self.config = cluster_config or ClusterConfig()
         self.ledger_config = ledger_config or LedgerConfig()
+        if batch_lanes < self.config.batch_max_create_transfers:
+            # A wire-legal batch (bounded only by message_size_max) larger
+            # than the kernel's lane count would assert inside the commit
+            # path at runtime — the server would drop the connection, the
+            # client would resend, forever.  Fail fast at startup instead.
+            raise ValueError(
+                f"batch_lanes={batch_lanes} < batch_max="
+                f"{self.config.batch_max_create_transfers}: the commit "
+                "kernel could not fit a maximum wire batch"
+            )
         self.batch_lanes = batch_lanes
         self.time_ns = time_ns
 

@@ -160,11 +160,25 @@ def _decode_copy(buf: bytes) -> Optional[Tuple[SuperBlockState, int]]:
     return state, int(rec["copy"])
 
 
+# Cluster membership ceilings (constants.zig:31-35); also the u8 storage
+# bound in SUPERBLOCK_DTYPE.
+REPLICAS_MAX = 6
+STANDBYS_MAX = 6
+
+
 def validate_membership(replica: int, replica_count: int,
                         standby_count: int) -> None:
     """Operator-reachable validation (CLI format): real errors, not
     asserts (stripped under -O).  Called BEFORE any file is created so a
     rejected format leaves no debris."""
+    if not 1 <= replica_count <= REPLICAS_MAX:
+        raise ValueError(
+            f"replica_count {replica_count} outside [1, {REPLICAS_MAX}]"
+        )
+    if not 0 <= standby_count <= STANDBYS_MAX:
+        raise ValueError(
+            f"standby_count {standby_count} outside [0, {STANDBYS_MAX}]"
+        )
     if not 0 <= replica < replica_count + standby_count:
         raise ValueError(
             f"replica index {replica} outside [0, "
