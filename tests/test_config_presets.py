@@ -1,0 +1,58 @@
+"""Config presets: the two-level matrix (config.zig:206-303) and the
+tunables it must actually drive."""
+
+from tigerbeetle_tpu.config import PRESETS, LEDGER_TEST, TEST_MIN
+
+
+def test_preset_matrix_shape():
+    assert set(PRESETS) == {"production", "development", "test_min"}
+    for preset in PRESETS.values():
+        # Every preset carries all three levels with the tunables present.
+        assert preset.cluster.batch_max_create_transfers >= 1
+        assert preset.cluster.vsr_checkpoint_interval > 0
+        assert 10 <= preset.ledger.bloom_bits_log2 <= 32
+        assert 0.0 < preset.ledger.eviction_fraction < 1.0
+        assert preset.ledger.jacobi_max_passes >= 2
+    # Wire compatibility: dev and prod share the message format.
+    assert (PRESETS["production"].cluster.message_size_max
+            == PRESETS["development"].cluster.message_size_max)
+    assert PRESETS["test_min"].cluster is TEST_MIN
+    assert PRESETS["test_min"].ledger is LEDGER_TEST
+
+
+def test_tunables_reach_the_machine():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tigerbeetle_tpu.config import LedgerConfig
+    from tigerbeetle_tpu.machine import TpuStateMachine
+
+    m = TpuStateMachine(
+        LedgerConfig(
+            accounts_capacity_log2=10, transfers_capacity_log2=11,
+            posted_capacity_log2=10, bloom_bits_log2=15,
+            eviction_fraction=0.25, jacobi_max_passes=4,
+        ),
+        batch_lanes=64,
+    )
+    assert m._bloom_log2 == 15
+    assert m.config.jacobi_max_passes == 4
+    assert m.config.eviction_fraction == 0.25
+
+
+def test_version_verbose_dumps_presets(capsys):
+    from tigerbeetle_tpu import jaxenv
+
+    jaxenv.force_cpu()
+    from tigerbeetle_tpu import cli
+
+    assert cli.main(["version", "--verbose"]) == 0
+    out = capsys.readouterr().out
+    for needle in (
+        "production.cluster.message_size_max",
+        "development.ledger.bloom_bits_log2",
+        "test_min.cluster.journal_slot_count",
+        "production.ledger.jacobi_max_passes",
+        "production.process.drain_timeout_ms",
+    ):
+        assert needle in out, needle

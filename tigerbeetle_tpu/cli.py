@@ -365,14 +365,18 @@ def _cmd_start(args) -> int:
 
 
 def _cmd_version(args) -> int:
-    from .config import PRODUCTION
+    from .config import PRESETS
 
     print("tigerbeetle-tpu 0.1.0")
     if args.verbose:
+        # Full two-level preset matrix (main.zig:272-310 version --verbose
+        # dumps every config constant; config.zig:206-303 preset split).
         import jax
 
-        for key, value in vars(PRODUCTION).items():
-            print(f"  config.{key}={value}")
+        for preset in PRESETS.values():
+            for level in ("cluster", "process", "ledger"):
+                for key, value in vars(getattr(preset, level)).items():
+                    print(f"  {preset.name}.{level}.{key}={value}")
         print(f"  jax.devices={[str(d) for d in jax.devices()]}")
     return 0
 

@@ -69,6 +69,16 @@ class LedgerConfig:
     # Upper bound on linear-probe distance before the kernel reports the table
     # as over-full (host must grow/rebuild; analogous to cache eviction limits).
     max_probe: int = 64
+    # Cold-tier Bloom filter size (machine.py tiering): 2^N bits; sized so
+    # the false-positive rate stays low as spilled-id counts grow (the
+    # filter doubles on saturation either way — this is the floor).
+    bloom_bits_log2: int = 20
+    # Fraction of live hot transfers spilled per eviction (machine.evict_cold).
+    eviction_fraction: float = 0.5
+    # Jacobi fixpoint budget for the general transfer kernel: pass k is
+    # exact for outcome-cascade depth < k; deeper cascades route to the
+    # sequential path (ops/transfer_full.py loop_cond).
+    jacobi_max_passes: int = 8
 
     @property
     def accounts_capacity(self) -> int:
@@ -124,7 +134,42 @@ PROCESS_DEFAULT = ProcessConfig()
 LEDGER_TEST = LedgerConfig(
     accounts_capacity_log2=10, transfers_capacity_log2=12, posted_capacity_log2=10,
     history_capacity_log2=10, max_probe=1 << 10,
+    bloom_bits_log2=14,
 )
+
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    """A named (cluster, process, ledger) bundle — the two-level preset
+    matrix of config.zig:206-303 (default_production / default_development /
+    test_min), extended with the TPU build's ledger level."""
+
+    name: str
+    cluster: ClusterConfig
+    process: "ProcessConfig"
+    ledger: LedgerConfig
+
+
+PRESETS = {
+    # Production: 1 MiB messages, full WAL ring, HBM-scale tables.
+    "production": Preset(
+        "production", PRODUCTION, ProcessConfig(direct_io=True),
+        LedgerConfig(),
+    ),
+    # Development: same wire format (a dev client talks to a prod cluster)
+    # but laptop-sized tables, buffered IO, smaller bloom.
+    "development": Preset(
+        "development", PRODUCTION, PROCESS_DEFAULT,
+        LedgerConfig(
+            accounts_capacity_log2=14, transfers_capacity_log2=16,
+            posted_capacity_log2=14, history_capacity_log2=14,
+            bloom_bits_log2=16,
+        ),
+    ),
+    # test_min: tiny everything (8 KiB messages, 64-slot WAL) so unit and
+    # sim rings run thousands of schedules (config.zig:241-269).
+    "test_min": Preset("test_min", TEST_MIN, PROCESS_DEFAULT, LEDGER_TEST),
+}
 # Benchmark sizing: 10M+ accounts, tens of millions of transfers resident.
 LEDGER_BENCH = LedgerConfig(
     accounts_capacity_log2=21, transfers_capacity_log2=25, posted_capacity_log2=21

@@ -148,7 +148,7 @@ class TpuStateMachine:
         # live — restore_host_state turns tiering on when a checkpoint's
         # cold_manifest says evictions already happened.
         self._tiering = hot_transfers_capacity_max is not None
-        self._bloom_log2 = 20
+        self._bloom_log2 = cfg.bloom_bits_log2
         self._bloom_np = None
         self._bloom_dev = None
         self._evictions = 0
@@ -264,6 +264,7 @@ class TpuStateMachine:
         self.ledger, codes_t, kflags = tf.create_transfers_full(
             self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
             self._bloom_dev, cold_checked,
+            max_passes=self.config.jacobi_max_passes,
         )
         if self._fast_path_ok(np.zeros(0, dtype=types.TRANSFER_DTYPE)):
             # Only pay the extra compile when the fast path is reachable
@@ -401,6 +402,7 @@ class TpuStateMachine:
             self.ledger, codes, kflags = tf.create_transfers_full(
                 self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp),
                 self._bloom_dev, cold_checked,
+                max_passes=self.config.jacobi_max_passes,
             )
             kflags = int(kflags)
             if kflags == 0:
@@ -506,7 +508,7 @@ class TpuStateMachine:
         if hot_max is not None and self._transfers_bound * 2 > hot_max and (
             self.ledger.transfers.capacity >= hot_max
         ):
-            self.evict_cold(0.5)
+            self.evict_cold()
 
     # -- cold tier (ops/cold.py) --------------------------------------------
 
@@ -564,7 +566,7 @@ class TpuStateMachine:
         self.ledger = self.ledger.replace(transfers=transfers)
         self._transfers_bound += n
 
-    def evict_cold(self, frac: float = 0.5) -> int:
+    def evict_cold(self, frac: Optional[float] = None) -> int:
         """Spill the oldest ~frac of live hot transfers to the cold store.
         Deterministic given the ledger state; called at checkpoint
         boundaries by the replica, or directly under memory pressure.
@@ -575,6 +577,8 @@ class TpuStateMachine:
         if not self._tiering:
             self._tiering = True
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
+        if frac is None:
+            frac = self.config.eviction_fraction
         num = max(1, min(999, int(frac * 1000)))
         threshold = cold_mod.eviction_threshold(self.ledger.transfers, num, 1000)
         k = self.ledger.transfers.capacity
@@ -654,7 +658,7 @@ class TpuStateMachine:
                     # At the hot ceiling: spill the old half to the cold
                     # store instead of growing (BASELINE config 4 tiering).
                     self.ledger = led
-                    self.evict_cold(0.5)
+                    self.evict_cold()
                     led = self.ledger
                 # else: accept elevated load until the between-batches
                 # rebalance (MAX_PROBE absorbs it).
@@ -686,7 +690,7 @@ class TpuStateMachine:
                 # make room by spilling instead (certification is reset by
                 # the caller via the eviction counter).
                 self.ledger = led
-                self.evict_cold(0.5)
+                self.evict_cold()
                 led = self.ledger
             else:
                 led = led.replace(

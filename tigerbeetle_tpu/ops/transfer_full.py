@@ -517,6 +517,7 @@ def _kernel_core(
     batch: Dict[str, jax.Array],
     count: jax.Array,
     timestamp: jax.Array,
+    max_passes: int = _MAX_PASSES,
 ) -> ApplyPlan:
     """The pure batch semantics: no table access, replicable on a mesh."""
     n = batch["id_lo"].shape[0]
@@ -834,7 +835,7 @@ def _kernel_core(
 
     def loop_cond(carry):
         k, stable, *_ = carry
-        return ~stable & (k < _MAX_PASSES)
+        return ~stable & (k < max_passes)
 
     def loop_body(carry):
         k, _, ok_p, code_p, amt_p, _ = carry
@@ -957,6 +958,7 @@ def create_transfers_full_impl(
     timestamp: jax.Array,
     bloom: jax.Array = None,
     cold_checked: jax.Array = None,
+    max_passes: int = _MAX_PASSES,
 ) -> Tuple[Ledger, jax.Array, jax.Array]:
     """Returns (ledger', codes uint32[N], flags uint32 scalar).
 
@@ -974,7 +976,7 @@ def create_transfers_full_impl(
     tid = _u128_col(batch, "id")
 
     ctx = build_gather_ctx(ledger, batch, valid, postvoid, bloom, cold_checked)
-    plan = _kernel_core(ctx, batch, count, timestamp)
+    plan = _kernel_core(ctx, batch, count, timestamp, max_passes)
 
     # Insert slots are claimed (no writes) BEFORE the flags are finalized so
     # an insert-probe overflow also routes the batch with nothing applied.
@@ -1100,5 +1102,6 @@ def _exists_postvoid(t, e, p, n) -> jax.Array:
 
 
 create_transfers_full = jax.jit(
-    create_transfers_full_impl, donate_argnames=("ledger",)
+    create_transfers_full_impl, donate_argnames=("ledger",),
+    static_argnames=("max_passes",),
 )

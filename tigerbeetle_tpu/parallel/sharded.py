@@ -227,9 +227,10 @@ def sharded_create_transfers(mesh: Mesh):
     return jax.jit(step, donate_argnames=("ledger",))
 
 
-def sharded_create_transfers_full(mesh: Mesh):
+def sharded_create_transfers_full(mesh: Mesh, max_passes: int = None):
     """The fully-general transfer kernel (two-phase/balancing/limits) over
-    the device mesh.
+    the device mesh.  ``max_passes`` mirrors LedgerConfig.jacobi_max_passes
+    (defaults to the kernel's budget) so both serving paths honor the knob.
 
     Context is gathered by masked probes + psum (after which every shard
     holds the full replicated GatherCtx), the pure Jacobi/ladder core runs
@@ -240,6 +241,10 @@ def sharded_create_transfers_full(mesh: Mesh):
 
     Returns fn(ledger, batch, count, timestamp) -> (ledger, codes, kflags).
     """
+    from ..ops import transfer_full as _tf
+
+    if max_passes is None:
+        max_passes = _tf._MAX_PASSES
     from ..ops import transfer_full as tf
     from ..ops.state_machine import TF_POST, TF_VOID
 
@@ -346,7 +351,7 @@ def sharded_create_transfers_full(mesh: Mesh):
             probe_grow=probe_grow,
             accounts_capacity=jnp.uint64(acc.capacity * n_shards),
         )
-        plan = tf._kernel_core(ctx, batch, count, timestamp)
+        plan = tf._kernel_core(ctx, batch, count, timestamp, max_passes)
 
         # History admission: the mesh ledger has no history log — route
         # instead of silently dropping rows.
