@@ -261,10 +261,20 @@ class TpuStateMachine:
         cold_checked = (
             jnp.zeros((self.batch_lanes,), jnp.bool_) if self._tiering else None
         )
+        # Warm BOTH serving variants: the gated one plain batches hit, and
+        # the full one the first post/void (or history) batch hits — a
+        # client must never pay a kernel compile inside the serving path.
         self.ledger, codes_t, kflags = tf.create_transfers_full(
             self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
             self._bloom_dev, cold_checked,
             max_passes=self.config.jacobi_max_passes,
+            has_postvoid=False, has_history=self._history_accounts_possible,
+        )
+        self.ledger, codes_t, kflags = tf.create_transfers_full(
+            self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
+            self._bloom_dev, cold_checked,
+            max_passes=self.config.jacobi_max_passes,
+            has_postvoid=True, has_history=True,
         )
         if self._fast_path_ok(np.zeros(0, dtype=types.TRANSFER_DTYPE)):
             # Only pay the extra compile when the fast path is reachable
@@ -398,11 +408,18 @@ class TpuStateMachine:
         cold_checked = (
             jnp.zeros((self.batch_lanes,), jnp.bool_) if self._tiering else None
         )
+        # STATIC phase hints: a batch with no post/void lanes skips the
+        # four pending-side probe loops and the posted write; a ledger that
+        # provably holds no HISTORY-flagged account skips the 21-column
+        # history append.  Each (hint, hint) pair is its own jit variant.
+        has_postvoid = pv_count > 0
+        has_history = self._history_accounts_possible
         for _attempt in range(8):
             self.ledger, codes, kflags = tf.create_transfers_full(
                 self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp),
                 self._bloom_dev, cold_checked,
                 max_passes=self.config.jacobi_max_passes,
+                has_postvoid=has_postvoid, has_history=has_history,
             )
             kflags = int(kflags)
             if kflags == 0:
@@ -467,10 +484,14 @@ class TpuStateMachine:
             self._balance_bound = _BOUND_CLAMP
 
     def _fast_path_ok(self, batch: np.ndarray) -> bool:
-        """Plain-transfer batches run the round-1 fast kernel (one light
-        dispatch; the fully-general kernel costs ~20x more on TPU). The
-        preconditions are ops/state_machine.py's P1-P4, checked host-side in
-        a few vector ops over the batch."""
+        """Plain-transfer batches run the round-1 fast kernel.  Measured
+        cost ratio (bench.py run_kernel_profile, XLA-CPU): the general
+        kernel is ~2-3x the fast kernel per batch; on TPU the gap is
+        expected to widen toward the op-count ratio (the general kernel's
+        sorted ladders + Jacobi fixpoint are launch-overhead-bound at 8192
+        lanes — see utils/roofline.py OVERHEAD_US).  The preconditions are
+        ops/state_machine.py's P1-P4, checked host-side in a few vector ops
+        over the batch."""
         if (
             self._tiering
             or self._history_accounts_possible

@@ -588,24 +588,28 @@ def balance_plan(
     a0 = s_amt & jnp.uint64(0xFFFFFFFF)
     a1 = s_amt >> jnp.uint64(32)
 
-    def limb_sums(mask):
+    # ONE fused segment-sum over a (2N, 8) matrix — (field, limb) pairs as
+    # columns — instead of eight independent passes over the leg arrays
+    # (each limb column is a u64 sum of <= 2*8190 u32 terms: exact).
+    fields = (
+        ("debits_pending", s_is_dr & s_pending),
+        ("debits_posted", s_is_dr & ~s_pending),
+        ("credits_pending", ~s_is_dr & s_pending),
+        ("credits_posted", ~s_is_dr & ~s_pending),
+    )
+    cols = []
+    for _name, mask in fields:
         m = mask & s_live
-        return (
-            jax.ops.segment_sum(jnp.where(m, a0, 0), gid, num_segments=2 * n + 1),
-            jax.ops.segment_sum(jnp.where(m, a1, 0), gid, num_segments=2 * n + 1),
-        )
-
-    sums = {
-        "debits_pending": limb_sums(s_is_dr & s_pending),
-        "debits_posted": limb_sums(s_is_dr & ~s_pending),
-        "credits_pending": limb_sums(~s_is_dr & s_pending),
-        "credits_posted": limb_sums(~s_is_dr & ~s_pending),
-    }
+        cols.append(jnp.where(m, a0, 0))
+        cols.append(jnp.where(m, a1, 0))
+    stacked = jnp.stack(cols, axis=1)  # (2N, 8)
+    summed = jax.ops.segment_sum(stacked, gid, num_segments=2 * n + 1)
+    per_leg = summed[gid]  # (2N, 8) gathered back to leg domain
 
     deltas = {}
-    for field, (sa0, sa1) in sums.items():
-        sa0_l = sa0[gid]
-        sa1_l = sa1[gid]
+    for i, (field, _mask) in enumerate(fields):
+        sa0_l = per_leg[:, 2 * i]
+        sa1_l = per_leg[:, 2 * i + 1]
         low_part = (sa1_l & jnp.uint64(0xFFFFFFFF)) << jnp.uint64(32)
         d_lo = sa0_l + low_part
         carry = (d_lo < low_part).astype(jnp.uint64)

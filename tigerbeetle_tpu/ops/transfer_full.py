@@ -165,6 +165,8 @@ class ApplyPlan(NamedTuple):
     # History (single-chip only; sharded mode excludes history accounts):
     do_hist: jax.Array  # bool[N]
     hist_row: Dict[str, jax.Array]
+    # Jacobi iterations the fixpoint actually took (instrumentation).
+    passes: jax.Array  # int32 scalar
 
 
 def _first_code(checks) -> jnp.ndarray:
@@ -418,8 +420,15 @@ def build_gather_ctx(
     postvoid: jax.Array,
     bloom: jax.Array = None,
     cold_checked: jax.Array = None,
+    has_postvoid: bool = True,
 ) -> GatherCtx:
-    """Single-chip GatherCtx: local probes of the ledger tables."""
+    """Single-chip GatherCtx: local probes of the ledger tables.
+
+    ``has_postvoid`` is a STATIC host hint: False means the host proved the
+    batch carries no post/void flags, so the four pending-side probe loops
+    and gathers (pending row, its two accounts, its fulfillment) compile
+    away entirely — the flagship plain-batch shape pays only its own three
+    probes."""
     n = batch["id_lo"].shape[0]
     tid = _u128_col(batch, "id")
     pend_id = _u128_col(batch, "pending_id")
@@ -430,53 +439,80 @@ def build_gather_ctx(
     ex_found = ex_look.found & valid
     e_tab = ht.gather_cols(ledger.transfers, ex_look.slot, ex_found)
 
-    p_look = ht.lookup(ledger.transfers, pend_id.lo, pend_id.hi, MAX_PROBE)
-    p_tab_found = p_look.found & postvoid
-    p_tab = ht.gather_cols(ledger.transfers, p_look.slot, p_tab_found)
-
     drT_look = ht.lookup(ledger.accounts, t_dr_id.lo, t_dr_id.hi, MAX_PROBE)
     crT_look = ht.lookup(ledger.accounts, t_cr_id.lo, t_cr_id.hi, MAX_PROBE)
     drT = _account_view(ledger.accounts, drT_look, drT_look.found & valid)
     crT = _account_view(ledger.accounts, crT_look, crT_look.found & valid)
 
-    # Accounts of a TABLE pending (post/void operates on the pending's
-    # accounts, state_machine.zig:1420-1423).
-    pdr_look = ht.lookup(
-        ledger.accounts, p_tab["debit_account_id_lo"],
-        p_tab["debit_account_id_hi"], MAX_PROBE,
-    )
-    pcr_look = ht.lookup(
-        ledger.accounts, p_tab["credit_account_id_lo"],
-        p_tab["credit_account_id_hi"], MAX_PROBE,
-    )
-    pdr = _account_view(
-        ledger.accounts, pdr_look, pdr_look.found & p_tab_found
-    )
-    pcr = _account_view(
-        ledger.accounts, pcr_look, pcr_look.found & p_tab_found
-    )
+    if has_postvoid:
+        p_look = ht.lookup(ledger.transfers, pend_id.lo, pend_id.hi, MAX_PROBE)
+        p_tab_found = p_look.found & postvoid
+        p_tab = ht.gather_cols(ledger.transfers, p_look.slot, p_tab_found)
 
-    # Posted-groove fulfillment for a TABLE pending (key: its timestamp).
-    postedT_look = ht.lookup(
-        ledger.posted, p_tab["timestamp"], jnp.zeros_like(p_tab["timestamp"]),
-        MAX_PROBE,
-    )
-    postedT_found = postedT_look.found & p_tab_found
-    postedT_val = ht.gather_cols(
-        ledger.posted, postedT_look.slot, postedT_found
-    )["fulfillment"]
+        # Accounts of a TABLE pending (post/void operates on the pending's
+        # accounts, state_machine.zig:1420-1423).
+        pdr_look = ht.lookup(
+            ledger.accounts, p_tab["debit_account_id_lo"],
+            p_tab["debit_account_id_hi"], MAX_PROBE,
+        )
+        pcr_look = ht.lookup(
+            ledger.accounts, p_tab["credit_account_id_lo"],
+            p_tab["credit_account_id_hi"], MAX_PROBE,
+        )
+        pdr = _account_view(
+            ledger.accounts, pdr_look, pdr_look.found & p_tab_found
+        )
+        pcr = _account_view(
+            ledger.accounts, pcr_look, pcr_look.found & p_tab_found
+        )
+
+        # Posted-groove fulfillment for a TABLE pending (key: its timestamp).
+        postedT_look = ht.lookup(
+            ledger.posted, p_tab["timestamp"],
+            jnp.zeros_like(p_tab["timestamp"]), MAX_PROBE,
+        )
+        postedT_found = postedT_look.found & p_tab_found
+        postedT_val = ht.gather_cols(
+            ledger.posted, postedT_look.slot, postedT_found
+        )["fulfillment"]
+        pv_overflow = (
+            jnp.where(
+                pdr_look.overflow | pcr_look.overflow,
+                jnp.uint32(FLAG_GROW_ACCOUNTS), jnp.uint32(0),
+            )
+            | jnp.where(p_look.overflow, jnp.uint32(FLAG_GROW_TRANSFERS),
+                        jnp.uint32(0))
+            | jnp.where(postedT_look.overflow, jnp.uint32(FLAG_GROW_POSTED),
+                        jnp.uint32(0))
+        )
+        p_found_for_cold = p_look.found
+    else:
+        zero64 = jnp.zeros((n,), jnp.uint64)
+        p_tab_found = jnp.zeros((n,), jnp.bool_)
+        p_tab = {
+            name: jnp.zeros((n,), dt) for name, dt in TRANSFER_COLS.items()
+        }
+        pdr = pcr = AccountView(
+            found=p_tab_found, slot=zero64,
+            flags=jnp.zeros((n,), jnp.uint32),
+            ledger=jnp.zeros((n,), jnp.uint32),
+            bal={f + l: zero64 for f in _BAL_FIELDS for l in ("_lo", "_hi")},
+        )
+        postedT_found = p_tab_found
+        postedT_val = jnp.zeros((n,), jnp.uint32)
+        pv_overflow = jnp.uint32(0)
+        p_found_for_cold = p_tab_found
 
     probe_grow = (
         jnp.where(
-            drT_look.overflow | crT_look.overflow | pdr_look.overflow
-            | pcr_look.overflow,
+            drT_look.overflow | crT_look.overflow,
             jnp.uint32(FLAG_GROW_ACCOUNTS), jnp.uint32(0),
         )
         | jnp.where(
-            ex_look.overflow | p_look.overflow,
+            ex_look.overflow,
             jnp.uint32(FLAG_GROW_TRANSFERS), jnp.uint32(0),
         )
-        | jnp.where(postedT_look.overflow, jnp.uint32(FLAG_GROW_POSTED), jnp.uint32(0))
+        | pv_overflow
     )
 
     # Cold-tier membership (ops/cold.py): an id or pending_id missing from
@@ -495,7 +531,7 @@ def build_gather_ctx(
             & bloom_check_impl(bloom, tid.lo, tid.hi)
         )
         cold_pend = (
-            postvoid & ~p_look.found & ~checked
+            postvoid & ~p_found_for_cold & ~checked
             & bloom_check_impl(bloom, pend_id.lo, pend_id.hi)
         )
         probe_grow = probe_grow | jnp.where(
@@ -849,7 +885,7 @@ def _kernel_core(
         )
         return (k + 1, stable, ok_n, code_n, amt_n, aux_n)
 
-    _, converged, ok, codes, amount, aux = jax.lax.while_loop(
+    k_passes, converged, ok, codes, amount, aux = jax.lax.while_loop(
         loop_cond, loop_body,
         (jnp.int32(0), jnp.bool_(False), ok0, code_sentinel, t_amt, aux0),
     )
@@ -948,6 +984,7 @@ def _kernel_core(
         posted_key=posted_key, pv_ok=pv_ok,
         s_slot=legs.s_slot, scat=legs.is_last & legs.s_live,
         bal_incl=bal_incl, do_hist=do_hist, hist_row=hist_row,
+        passes=k_passes,
     )
 
 
@@ -959,6 +996,8 @@ def create_transfers_full_impl(
     bloom: jax.Array = None,
     cold_checked: jax.Array = None,
     max_passes: int = _MAX_PASSES,
+    has_postvoid: bool = True,
+    has_history: bool = True,
 ) -> Tuple[Ledger, jax.Array, jax.Array]:
     """Returns (ledger', codes uint32[N], flags uint32 scalar).
 
@@ -975,7 +1014,10 @@ def create_transfers_full_impl(
     postvoid = (((flags & TF_POST) != 0) | ((flags & TF_VOID) != 0)) & valid
     tid = _u128_col(batch, "id")
 
-    ctx = build_gather_ctx(ledger, batch, valid, postvoid, bloom, cold_checked)
+    ctx = build_gather_ctx(
+        ledger, batch, valid, postvoid, bloom, cold_checked,
+        has_postvoid=has_postvoid,
+    )
     plan = _kernel_core(ctx, batch, count, timestamp, max_passes)
 
     # Insert slots are claimed (no writes) BEFORE the flags are finalized so
@@ -983,10 +1025,16 @@ def create_transfers_full_impl(
     t_claim, t_ovf = ht.claim_slots(
         ledger.transfers, tid.lo, tid.hi, plan.ok, MAX_PROBE
     )
-    p_claim, p_ovf = ht.claim_slots(
-        ledger.posted, plan.posted_key, jnp.zeros((n,), jnp.uint64),
-        plan.pv_ok, MAX_PROBE,
-    )
+    if has_postvoid:
+        p_claim, p_ovf = ht.claim_slots(
+            ledger.posted, plan.posted_key, jnp.zeros((n,), jnp.uint64),
+            plan.pv_ok, MAX_PROBE,
+        )
+    else:
+        # Host proved no post/void lanes: plan.pv_ok is all-False, so the
+        # probe loop and the fulfillment write below compile away.
+        p_claim = jnp.zeros((n,), jnp.uint64)
+        p_ovf = jnp.bool_(False)
     kflags = (
         ctx.probe_grow
         | plan.route
@@ -1014,27 +1062,40 @@ def create_transfers_full_impl(
     transfers = ht.write_rows(
         ledger.transfers, tid.lo, tid.hi, t_claim, plan.ok & commit, ins_rows
     )
-    posted = ht.write_rows(
-        ledger.posted,
-        plan.posted_key,
-        jnp.zeros((n,), jnp.uint64),
-        p_claim,
-        plan.pv_ok & commit,
-        {"fulfillment": jnp.where(plan.post, jnp.uint32(1), jnp.uint32(2))},
-    )
+    if has_postvoid:
+        posted = ht.write_rows(
+            ledger.posted,
+            plan.posted_key,
+            jnp.zeros((n,), jnp.uint64),
+            p_claim,
+            plan.pv_ok & commit,
+            {"fulfillment": jnp.where(plan.post, jnp.uint32(1), jnp.uint32(2))},
+        )
+    else:
+        posted = ledger.posted
 
     # ---------------- apply: history rows ---------------------------------
-    do_hist_c = plan.do_hist & commit
-    h = ledger.history
-    h_off = jnp.cumsum(do_hist_c.astype(jnp.uint64)) - do_hist_c.astype(jnp.uint64)
-    h_idx = jnp.where(do_hist_c, h.count + h_off, jnp.uint64(h.capacity))
-    history = h.replace(
-        cols={
-            name: h.cols[name].at[h_idx].set(plan.hist_row[name], mode="drop")
-            for name in h.cols
-        },
-        count=h.count + jnp.sum(do_hist_c.astype(jnp.uint64)),
-    )
+    if has_history:
+        do_hist_c = plan.do_hist & commit
+        h = ledger.history
+        h_off = (
+            jnp.cumsum(do_hist_c.astype(jnp.uint64))
+            - do_hist_c.astype(jnp.uint64)
+        )
+        h_idx = jnp.where(do_hist_c, h.count + h_off, jnp.uint64(h.capacity))
+        history = h.replace(
+            cols={
+                name: h.cols[name].at[h_idx].set(
+                    plan.hist_row[name], mode="drop"
+                )
+                for name in h.cols
+            },
+            count=h.count + jnp.sum(do_hist_c.astype(jnp.uint64)),
+        )
+    else:
+        # Host proved no account carries the HISTORY flag: the 21-column
+        # append scatter compiles away.
+        history = ledger.history
 
     out = Ledger(
         accounts=accounts, transfers=transfers, posted=posted, history=history
@@ -1103,5 +1164,5 @@ def _exists_postvoid(t, e, p, n) -> jax.Array:
 
 create_transfers_full = jax.jit(
     create_transfers_full_impl, donate_argnames=("ledger",),
-    static_argnames=("max_passes",),
+    static_argnames=("max_passes", "has_postvoid", "has_history"),
 )
