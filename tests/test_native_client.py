@@ -11,7 +11,11 @@ from tigerbeetle_tpu.net.bus import run_server
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.replica import Replica
 
-TEST_CONFIG = ClusterConfig(message_size_max=1 << 20, journal_slot_count=64)
+# message_size_max must keep batch_max <= the server's 64 batch lanes
+# (replica.py fails fast otherwise); 8192 matches test_net/test_storage's
+# servers.  Full 1 MiB frames are exercised by the production-config bench
+# paths, not here.
+TEST_CONFIG = ClusterConfig(message_size_max=8192, journal_slot_count=64)
 TEST_LEDGER = LedgerConfig(
     accounts_capacity_log2=10, transfers_capacity_log2=12,
     posted_capacity_log2=10, max_probe=1 << 10,

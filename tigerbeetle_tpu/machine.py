@@ -136,6 +136,20 @@ class TpuStateMachine:
         self.index.extra_rows_provider = (
             lambda: [np.asarray(r) for r in self.cold.runs]
         )
+        # General scan composition (ops/scan_builder.py): lazily-built
+        # per-field indexes serving union/intersection/difference scans
+        # (scan_builder.zig / scan_merge.zig generality).
+        from .ops import scan_builder as sb
+
+        self.scans_transfers = sb.ScanSet(
+            "transfers", sb.TRANSFER_FIELDS, base=batch_lanes
+        )
+        self.scans_transfers.extra_rows_provider = (
+            lambda: [np.asarray(r) for r in self.cold.runs]
+        )
+        self.scans_accounts = sb.ScanSet(
+            "accounts", sb.ACCOUNT_FIELDS, base=batch_lanes
+        )
         # Tiered transfers store (ops/cold.py): hot device window + cold
         # host spill; None spill_dir with no cap = tiering off (everything
         # stays hot).
@@ -235,6 +249,8 @@ class TpuStateMachine:
         derived index from the (refreshed) ledger before serving a query."""
         if self._engine is not None and self._index_stale:
             self.index.reset()
+            self.scans_transfers.reset()
+            self.scans_accounts.reset()
             self._index_stale = False
 
     def warmup(self) -> None:
@@ -261,21 +277,22 @@ class TpuStateMachine:
         cold_checked = (
             jnp.zeros((self.batch_lanes,), jnp.bool_) if self._tiering else None
         )
-        # Warm BOTH serving variants: the gated one plain batches hit, and
-        # the full one the first post/void (or history) batch hits — a
+        # Warm BOTH reachable serving variants for the CURRENT history
+        # flag: dispatch selects (has_postvoid=pv_count>0,
+        # has_history=self._history_accounts_possible), so a plain batch
+        # and a post/void batch must both find their kernel compiled — a
         # client must never pay a kernel compile inside the serving path.
-        self.ledger, codes_t, kflags = tf.create_transfers_full(
-            self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
-            self._bloom_dev, cold_checked,
-            max_passes=self.config.jacobi_max_passes,
-            has_postvoid=False, has_history=self._history_accounts_possible,
-        )
-        self.ledger, codes_t, kflags = tf.create_transfers_full(
-            self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
-            self._bloom_dev, cold_checked,
-            max_passes=self.config.jacobi_max_passes,
-            has_postvoid=True, has_history=True,
-        )
+        # (If a HISTORY account is created later the flag flips and the
+        # True-history variants compile on first use; warming them here
+        # would charge every history-free server two extra compiles.)
+        for has_postvoid in (False, True):
+            self.ledger, codes_t, kflags = tf.create_transfers_full(
+                self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
+                self._bloom_dev, cold_checked,
+                max_passes=self.config.jacobi_max_passes,
+                has_postvoid=has_postvoid,
+                has_history=self._history_accounts_possible,
+            )
         if self._fast_path_ok(np.zeros(0, dtype=types.TRANSFER_DTYPE)):
             # Only pay the extra compile when the fast path is reachable
             # (tiering / restored limit flags / blown balance bound disable
@@ -372,6 +389,7 @@ class TpuStateMachine:
             # Load-factor management keeps this unreachable; losing inserts
             # silently is the one unacceptable outcome, so fail loud.
             raise RuntimeError("accounts probe overflow during insert")
+        self._scan_append_accounts(soa, codes, count)
         results = self._compress(codes, count)
         self._update_commit_timestamp(codes, count, timestamp)
         return results
@@ -756,6 +774,7 @@ class TpuStateMachine:
         codes = np.asarray(codes)
         if operation == "create_accounts":
             self._accounts_bound += count
+            self._scan_append_accounts(soa, codes, count)
         else:
             self._transfers_bound += count
             self._posted_bound += pv_count
@@ -768,7 +787,23 @@ class TpuStateMachine:
     def _index_append(self, soa: dict, codes: np.ndarray, count: int) -> None:
         ok = np.zeros(self.batch_lanes, dtype=bool)
         ok[:count] = codes[:count] == 0
+        ok_dev = jnp.asarray(ok)
         self.index.append_batch(
+            self.ledger, soa["id_lo"], soa["id_hi"], ok_dev
+        )
+        if self.scans_transfers.indexes:
+            self.scans_transfers.append_batch(
+                self.ledger, soa["id_lo"], soa["id_hi"], ok_dev
+            )
+
+    def _scan_append_accounts(
+        self, soa: dict, codes: np.ndarray, count: int
+    ) -> None:
+        if not self.scans_accounts.indexes:
+            return
+        ok = np.zeros(self.batch_lanes, dtype=bool)
+        ok[:count] = codes[:count] == 0
+        self.scans_accounts.append_batch(
             self.ledger, soa["id_lo"], soa["id_hi"], jnp.asarray(ok)
         )
 
@@ -885,7 +920,17 @@ class TpuStateMachine:
             k,
             bool(descending),
         )
-        found, cols = sm.lookup_transfers(self.ledger, tid_lo, tid_hi)
+        return self._resolve_transfer_rows(tid_lo, tid_hi, valid, limit)
+
+    def _resolve_transfer_rows(
+        self, tid_lo, tid_hi, valid, limit: int
+    ) -> np.ndarray:
+        """Resolve timestamp-ordered index hits (transfer ids) to wire rows:
+        hot-table batch lookup, adjacent-duplicate dedup, cold-spill merge
+        (the ScanLookup role, lsm/scan_lookup.zig)."""
+        found, cols = sm.lookup_transfers(
+            self.ledger, jnp.asarray(tid_lo), jnp.asarray(tid_hi)
+        )
         idx_valid = np.asarray(valid)
         found = np.asarray(found)
         # Dedupe repeated index entries for one transfer id (a rebuild can
@@ -904,7 +949,6 @@ class TpuStateMachine:
         if self.cold.count and bool((idx_valid & ~found).any()):
             # Index hits whose rows were evicted: resolve from the spill,
             # preserving timestamp order.
-            tl, th = np.asarray(tid_lo), np.asarray(tid_hi)
             merged = []
             for i in range(len(idx_valid)):
                 if not idx_valid[i]:
@@ -912,7 +956,7 @@ class TpuStateMachine:
                 if found[i]:
                     merged.append(out[i])
                 else:
-                    row = self.cold.lookup(int(tl[i]), int(th[i]))
+                    row = self.cold.lookup(int(tl_np[i]), int(th_np[i]))
                     if row is not None:
                         merged.append(row)
             rows_np = (
@@ -921,6 +965,99 @@ class TpuStateMachine:
             )
             return rows_np[: min(limit, QUERY_ROWS_MAX)]
         return out[idx_valid & found][: min(limit, QUERY_ROWS_MAX)]
+
+    # -- general composed scans (ops/scan_builder.py) ------------------------
+
+    @staticmethod
+    def _scan_window(timestamp_min: int, timestamp_max: int) -> Tuple[int, int]:
+        # TimestampRange defaults (lsm/timestamp_range.zig:4-5).
+        return (
+            timestamp_min if timestamp_min else 1,
+            timestamp_max if timestamp_max else U64_MAX - 1,
+        )
+
+    def scan_transfers(
+        self, expr, timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = QUERY_ROWS_MAX, reversed: bool = False,
+    ) -> np.ndarray:
+        """Composed index scan over transfers: any ops/scan_builder.py
+        expression (prefix conditions on any indexed field, union /
+        intersection / difference to any depth), results timestamp-ordered.
+        Strictly more general than the reference's implemented surface
+        (scan_builder.zig stubs merge_intersection/merge_difference)."""
+        self._index_fresh()
+        ts_min, ts_max = self._scan_window(timestamp_min, timestamp_max)
+        limit = min(limit, QUERY_ROWS_MAX)
+        tid_lo, tid_hi = self.scans_transfers.evaluate(
+            expr, self.ledger, ts_min, ts_max, limit, bool(reversed)
+        )
+        if len(tid_lo) == 0:
+            return np.zeros(0, dtype=types.TRANSFER_DTYPE)
+        # Pad ids to a power of two so the lookup kernel compiles per size
+        # class, not per result count.
+        n = len(tid_lo)
+        cap = 1 << (n - 1).bit_length()
+        pad_lo = np.zeros(cap, np.uint64)
+        pad_hi = np.zeros(cap, np.uint64)
+        pad_lo[:n], pad_hi[:n] = tid_lo, tid_hi
+        valid = np.zeros(cap, bool)
+        valid[:n] = True
+        return self._resolve_transfer_rows(pad_lo, pad_hi, valid, limit)
+
+    def scan_accounts(
+        self, expr, timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = QUERY_ROWS_MAX, reversed: bool = False,
+    ) -> np.ndarray:
+        """Composed index scan over accounts (accounts are never evicted, so
+        resolution is one batched hot-table lookup)."""
+        self._index_fresh()
+        ts_min, ts_max = self._scan_window(timestamp_min, timestamp_max)
+        limit = min(limit, QUERY_ROWS_MAX)
+        tid_lo, tid_hi = self.scans_accounts.evaluate(
+            expr, self.ledger, ts_min, ts_max, limit, bool(reversed)
+        )
+        ids = [int(lo) | (int(hi) << 64) for lo, hi in zip(tid_lo, tid_hi)]
+        if not ids:
+            return np.zeros(0, dtype=types.ACCOUNT_DTYPE)
+        # Pad to a power of two so the lookup kernel compiles per size
+        # class, not per result count; id 0 can never exist, so the pad
+        # lanes drop out as misses.
+        cap = 1 << (len(ids) - 1).bit_length()
+        return self.lookup_accounts(ids + [0] * (cap - len(ids)))
+
+    def query_transfers_where(
+        self, timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = QUERY_ROWS_MAX, reversed: bool = False, **conditions,
+    ) -> np.ndarray:
+        """QueryFilter-style multi-field query: the intersection of
+        equality conditions on indexed fields (e.g. ``ledger=1, code=5``) —
+        the semantics newer upstream exposes as ``query_transfers`` and
+        this reference declares but stubs (scan_builder.zig:184-205)."""
+        from .ops import scan_builder as sb
+
+        if not conditions:
+            raise ValueError("query_transfers_where needs >=1 condition")
+        expr = sb.merge_intersection(
+            *(sb.scan_prefix(f, v) for f, v in sorted(conditions.items()))
+        )
+        return self.scan_transfers(
+            expr, timestamp_min, timestamp_max, limit, reversed
+        )
+
+    def query_accounts_where(
+        self, timestamp_min: int = 0, timestamp_max: int = 0,
+        limit: int = QUERY_ROWS_MAX, reversed: bool = False, **conditions,
+    ) -> np.ndarray:
+        from .ops import scan_builder as sb
+
+        if not conditions:
+            raise ValueError("query_accounts_where needs >=1 condition")
+        expr = sb.merge_intersection(
+            *(sb.scan_prefix(f, v) for f, v in sorted(conditions.items()))
+        )
+        return self.scan_accounts(
+            expr, timestamp_min, timestamp_max, limit, reversed
+        )
 
     def get_account_history(self, filt: np.void) -> np.ndarray:
         """Balance history of a HISTORY-flagged account
@@ -1017,6 +1154,8 @@ class TpuStateMachine:
         # The ledger was just swapped underneath us (restart or state sync):
         # the derived index no longer matches and rebuilds on next use.
         self.index.reset()
+        self.scans_transfers.reset()
+        self.scans_accounts.reset()
         self._index_stale = False
 
     # -- parity surface ------------------------------------------------------
