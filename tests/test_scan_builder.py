@@ -363,6 +363,47 @@ class TestMaintenance:
             sb.scan_prefix("code", 10), sb.scan_prefix("code", 20)
         ))
 
+    def test_lazy_index_mode(self):
+        """lazy_index defers maintenance (bulk-ingest serving mode): commits
+        mark derived indexes stale instead of appending; the next query
+        rebuilds and stays exact."""
+        cfg = CFG.__class__(**{**CFG.__dict__, "lazy_index": True})
+        m = TpuStateMachine(cfg, batch_lanes=LANES)
+        accounts = types.accounts_array([
+            types.account(id=i + 1, ledger=1, code=10) for i in range(6)
+        ])
+        assert m.create_accounts(accounts, wall_clock_ns=1000) == []
+        for k in range(3):
+            batch = types.transfers_array([
+                types.transfer(
+                    id=100 + 30 * k + i, debit_account_id=1 + i % 6,
+                    credit_account_id=1 + (i + 1) % 6, amount=2,
+                    ledger=1, code=10 + 10 * (i % 2),
+                )
+                for i in range(30)
+            ])
+            assert m.create_transfers(batch) == []
+        assert m.index.stale, "lazy mode must defer index maintenance"
+        f = np.zeros((), dtype=types.ACCOUNT_FILTER_DTYPE)
+        f["account_id_lo"] = 1
+        f["limit"] = 8190
+        f["flags"] = 3
+        per_batch = sum(
+            1 for i in range(30) if 1 + i % 6 == 1 or 1 + (i + 1) % 6 == 1
+        )
+        assert len(m.get_account_transfers(f[()])) == 3 * per_batch
+        rows = m.lookup_transfers(list(range(100, 190)))
+        check(m, rows, sb.scan_prefix("code", 20))
+        # Post-query commits re-invalidate; a second query is again exact.
+        batch = types.transfers_array([
+            types.transfer(id=500 + i, debit_account_id=1,
+                           credit_account_id=2, amount=1, ledger=1, code=20)
+            for i in range(10)
+        ])
+        assert m.create_transfers(batch) == []
+        rows = m.lookup_transfers(list(range(100, 190)) + list(range(500, 510)))
+        check(m, rows, sb.scan_prefix("code", 20))
+
     def test_account_scans(self, populated):
         m, _, stale = populated
         # Re-fetch: the fixture's transfers mutated balances since creation.

@@ -79,6 +79,12 @@ class LedgerConfig:
     # exact for outcome-cascade depth < k; deeper cascades route to the
     # sequential path (ops/transfer_full.py loop_cond).
     jacobi_max_passes: int = 8
+    # Defer secondary-index maintenance to first query (bulk-ingest mode):
+    # the sorted-runs indexes are DERIVED state either way; eager appends
+    # cost one sorted run per commit plus periodic level-merge compiles,
+    # which a write-only burst never amortizes.  Queries stay exact — the
+    # first one pays one full-table rebuild.
+    lazy_index: bool = False
 
     @property
     def accounts_capacity(self) -> int:

@@ -785,6 +785,13 @@ class TpuStateMachine:
         return results
 
     def _index_append(self, soa: dict, codes: np.ndarray, count: int) -> None:
+        if self.config.lazy_index:
+            # Bulk-ingest mode: invalidate instead of maintaining; the next
+            # query rebuilds from the table (+cold runs) in one shot.
+            if not self.index.stale:
+                self.index.reset()
+            self.scans_transfers.reset()
+            return
         ok = np.zeros(self.batch_lanes, dtype=bool)
         ok[:count] = codes[:count] == 0
         ok_dev = jnp.asarray(ok)
@@ -800,6 +807,9 @@ class TpuStateMachine:
         self, soa: dict, codes: np.ndarray, count: int
     ) -> None:
         if not self.scans_accounts.indexes:
+            return
+        if self.config.lazy_index:
+            self.scans_accounts.reset()
             return
         ok = np.zeros(self.batch_lanes, dtype=bool)
         ok[:count] = codes[:count] == 0

@@ -120,7 +120,16 @@ def _safe_basename(name: str) -> bool:
 class ColdStore:
     """Append-only spill of evicted transfer rows: each run is an id-sorted
     TRANSFER_DTYPE array in a .npy file (memmap-read); lookups binary-search
-    every run, newest first; small runs merge when the count grows."""
+    every run, newest first; small runs merge when the count grows.
+
+    Deterministic reservation (the FreeSet role, lsm/free_set.zig): run
+    sequence numbers, row membership (timestamp-threshold eviction), row
+    order (id sort), and merge points (MAX_RUNS) are all pure functions of
+    the committed op stream and the ledger config — so replicas executing
+    the same history materialize byte-identical run files under identical
+    names, the property the reference gets from deterministically reserving
+    grid blocks ahead of compaction.  Pinned by
+    tests/test_cold_tier.py::TestDeterministicReservation."""
 
     MAX_RUNS = 8
 

@@ -203,7 +203,11 @@ def query_transfers(
 
 class TransferIndex:
     """Host driver: owns the device level arrays and the (host-side) level
-    occupancy that decides the Bentley–Saxe carry chain per append."""
+    occupancy that decides the Bentley–Saxe carry chain per append.
+
+    NOTE: ops/scan_builder.py FieldIndex is this pyramid's single-side
+    generic twin — a fix to either's level logic almost certainly applies
+    to both."""
 
     def __init__(self, base: int) -> None:
         assert base & (base - 1) == 0

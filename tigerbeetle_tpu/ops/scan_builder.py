@@ -223,7 +223,11 @@ def _leaf_window(
 
 class FieldIndex:
     """Single-field sorted-runs pyramid (one side of ops/index.py's
-    TransferIndex, generalized to any key column pair)."""
+    TransferIndex, generalized to any key column pair).
+
+    NOTE: the carry-chain/rebuild/host-rows machinery here is the
+    single-side twin of TransferIndex's (ops/index.py) — a fix to either
+    pyramid's level logic almost certainly applies to both."""
 
     def __init__(
         self, base: int, table_name: str, lo_col: str, hi_col: Optional[str]

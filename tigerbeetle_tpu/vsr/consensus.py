@@ -192,6 +192,16 @@ class VsrReplica(Replica):
         self._last_sync_req = 0
         self._heartbeat_jitter = 0
         self._recovering_since = 0
+        # Event-loop starvation guard state (tick() liveness fairness).
+        self._last_tick_mono = None
+        # Env-gated replica event log (the reference's log.zig role): one
+        # JSONL file per replica, cheap enough to leave on in benchmarks.
+        self._debug_file = None
+        dbg = os.environ.get("TB_DEBUG_LOG")
+        if dbg:
+            self._debug_file = open(
+                f"{dbg}.r{self.replica}", "a", buffering=1
+            )
 
         # Adaptive retry timeouts (vsr.zig:543-712): RTT-tracked base +
         # exponential backoff + jitter, reset on progress (vsr/timeout.py).
@@ -887,11 +897,25 @@ class VsrReplica(Replica):
 
     # -- view change ---------------------------------------------------------
 
+    def _debug(self, event: str, **kw) -> None:
+        if self._debug_file is None:
+            return
+        import json as _json
+
+        rec = {
+            "ms": round(self._monotonic() / 1e6, 1),
+            "r": self.replica, "view": self.view,
+            "status": self.status, "ev": event,
+        }
+        rec.update(kw)
+        self._debug_file.write(_json.dumps(rec) + "\n")
+
     def _begin_view_change(self, new_view: int) -> List[Msg]:
         """Move to view_change status for new_view and broadcast SVC
         (replica.zig on view-change timeout)."""
         if self.is_standby:
             return []  # standbys never campaign
+        self._debug("begin_view_change", new_view=new_view)
         assert new_view > self.view or (
             new_view == self.view and self.status != NORMAL
         )
@@ -1154,6 +1178,7 @@ class VsrReplica(Replica):
         self.view = view
         self.log_view = view
         self._new_view_pending = None
+        self._debug("view_normal_primary", new_view=view)
         self._log_suspect = False  # the canonical quorum log is ours now
         self._persist_view()
         self.svc_from.pop(view, None)
@@ -1228,6 +1253,7 @@ class VsrReplica(Replica):
                 return sync
 
         self.status = NORMAL
+        self._debug("view_normal_backup", new_view=int(h["view"]))
         # WAL bound: adopt at most a ring's worth beyond our checkpoint;
         # commits advance the checkpoint and repair fetches the rest.
         self._install_headers(min(target_op, self.op_prepare_max), by_op)
@@ -1865,6 +1891,27 @@ class VsrReplica(Replica):
         if self.replica_count == 1:
             return out
 
+        # Event-loop starvation guard: if OUR tick loop just slept through
+        # several tick periods (host overload, GC, scheduler preemption on a
+        # shared core), every liveness observation in that gap is stale —
+        # the primary may have spoken while we weren't listening.  Refresh
+        # the primary-liveness clock instead of campaigning on evidence
+        # gathered while we ourselves were asleep (the reference's clock
+        # code treats monotonic jumps with the same suspicion,
+        # clock.zig monotonic discipline).  tick_ns is stamped by the TCP
+        # bus (net/cluster_bus.py); the VOPR virtual clock leaves it unset
+        # and keeps full control of liveness timing.
+        tick_ns = getattr(self, "tick_ns", None)
+        if tick_ns:
+            now = self._monotonic()
+            last = self._last_tick_mono
+            self._last_tick_mono = now
+            if last is not None and now - last > 4 * tick_ns:
+                self._last_primary_word = self._ticks
+                self._debug(
+                    "tick_starved", gap_ms=round((now - last) / 1e6, 1)
+                )
+
         # Deferred view-change completion after repairs.
         if getattr(self, "_pending_finish", None) is not None:
             view = self._pending_finish
@@ -1990,6 +2037,10 @@ class VsrReplica(Replica):
                 self._ticks - max(self._last_primary_word, 0)
                 >= NORMAL_HEARTBEAT + self._heartbeat_jitter
             ):
+                self._debug(
+                    "primary_timeout",
+                    silent_ticks=self._ticks - self._last_primary_word,
+                )
                 self._last_primary_word = self._ticks
                 out.extend(self._begin_view_change(self.view + 1))
             elif (

@@ -909,6 +909,10 @@ class Replica:
             pool.shutdown(wait=True)
         if self.aof is not None:
             self.aof.close()
+        dbg = getattr(self, "_debug_file", None)
+        if dbg is not None:
+            dbg.close()
+            self._debug_file = None
         self.storage.close()
 
 

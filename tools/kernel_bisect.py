@@ -1,0 +1,283 @@
+"""On-device cost bisect for the general transfer kernel.
+
+`TPU_EVIDENCE.json` (round 4) showed the fast kernel at ~5.6 us/batch —
+1.4x off the HBM roofline — while the fully-general kernel measured ~131
+ms/batch on the same chip, ~13,000x off ITS roofline, yet only 2.3x the
+fast kernel on XLA-CPU.  Something in the general kernel hits a TPU-specific
+pathological lowering.  This tool times each candidate primitive ON DEVICE
+(fori_loop with a threaded data dependence so XLA cannot hoist the body)
+and the three kernel variants, printing one JSON line for the forensic
+record.  Run it first in a tunnel window: ~1 minute of device time buys
+the bisect that directs the optimization work.
+
+Usage: python tools/kernel_bisect.py [--reps 32] [--out KERNEL_BISECT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--reps", type=int, default=32)
+    p.add_argument("--force-cpu", action="store_true")
+    p.add_argument("--out", default=os.path.join(REPO, "KERNEL_BISECT.json"))
+    args = p.parse_args()
+
+    from tigerbeetle_tpu import jaxenv
+
+    jaxenv.enable_compile_cache()
+    if args.force_cpu:
+        jaxenv.force_cpu()
+        platform = "cpu"
+    else:
+        platform = jaxenv.ensure_backend(retry_tpu=False)
+    print(f"# platform={platform}", file=sys.stderr)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.ops import hash_table as ht
+    from tigerbeetle_tpu.ops import state_machine as sm
+    from tigerbeetle_tpu.ops import transfer_full as tf
+
+    N = 8192          # batch lanes
+    L = 2 * N         # leg domain
+    TABLE = 1 << 22   # representative transfers-table capacity
+
+    results = {"platform": platform, "reps": args.reps, "lanes": N}
+
+    def timed(name, make_carry, body):
+        """Median-of-3 of (reps inside one jitted fori_loop dispatch).
+
+        body(carry, i) -> carry must THREAD the data (the result feeds the
+        next iteration) or XLA hoists the loop body as invariant and the
+        measurement is fiction."""
+        @jax.jit
+        def run(carry):
+            def f(i, c):
+                return body(c, i)
+
+            return jax.lax.fori_loop(0, args.reps, f, carry)
+
+        carry = make_carry()
+        out = run(carry)                      # compile + warm
+        jax.block_until_ready(out)
+        best = None
+        for _ in range(3):
+            carry = make_carry()
+            t0 = time.time()
+            out = run(carry)
+            jax.block_until_ready(out)
+            dt = (time.time() - t0) / args.reps * 1e6
+            best = dt if best is None else min(best, dt)
+        results[name] = round(best, 1)
+        print(f"# {name}: {best:.1f} us/op", file=sys.stderr)
+
+    rng = np.random.default_rng(7)
+    u64v = jnp.asarray(rng.integers(0, 1 << 63, size=L, dtype=np.uint64))
+    u32v = jnp.asarray(rng.integers(0, 1 << 31, size=L, dtype=np.uint32))
+    permL = jnp.asarray(rng.permutation(L).astype(np.int32))
+    idxT = jnp.asarray(rng.integers(0, TABLE, size=N, dtype=np.int64))
+    big = jnp.zeros((TABLE,), jnp.uint64)
+
+    # --- primitives --------------------------------------------------------
+    timed("sort_u32_16k", lambda: u32v,
+          lambda c, i: jnp.sort(c ^ i.astype(jnp.uint32)))
+    timed("sort_u64_16k", lambda: u64v,
+          lambda c, i: jnp.sort(c ^ i.astype(jnp.uint64)))
+    timed("argsort_u64_16k", lambda: u64v,
+          lambda c, i: c[jnp.argsort(c ^ i.astype(jnp.uint64))])
+    timed("argsort_u32_16k", lambda: u32v,
+          lambda c, i: c[jnp.argsort(c ^ i.astype(jnp.uint32))])
+    timed(
+        "lexsort_3xu64_8k",
+        lambda: (u64v[:N], u64v[N:]),
+        lambda c, i: (
+            c[0][jnp.lexsort((
+                jnp.arange(N, dtype=jnp.uint64),
+                c[0] ^ i.astype(jnp.uint64), c[1],
+            ))],
+            c[1],
+        ),
+    )
+    timed(
+        "scatter_set_perm_16k",
+        lambda: (jnp.zeros((L,), jnp.int32), permL),
+        lambda c, i: (
+            c[0].at[c[1]].set(jnp.arange(L, dtype=jnp.int32) + i), c[1]
+        ),
+    )
+    timed(
+        "scatter_set_perm_16k_unique",
+        lambda: (jnp.zeros((L,), jnp.int32), permL),
+        lambda c, i: (
+            c[0]
+            .at[c[1]]
+            .set(jnp.arange(L, dtype=jnp.int32) + i, unique_indices=True),
+            c[1],
+        ),
+    )
+    timed(
+        "scatter_add_16k",
+        lambda: (jnp.zeros((L,), jnp.uint32), permL),
+        lambda c, i: (
+            c[0].at[c[1] // 4].add(jnp.uint32(1) + i.astype(jnp.uint32)),
+            c[1],
+        ),
+    )
+    timed(
+        "gather_8k_from_4m",
+        lambda: (big, idxT),
+        lambda c, i: (c[0], (c[1] + c[0][c[1]].astype(jnp.int64)) % TABLE),
+    )
+    timed(
+        "cumsum_16kx24_u32",
+        lambda: jnp.ones((L, 24), jnp.uint32),
+        lambda c, i: jnp.cumsum(c, axis=0) & jnp.uint32(0xFFFF),
+    )
+    timed(
+        "while3_trivial",
+        lambda: u64v,
+        lambda c, i: jax.lax.while_loop(
+            lambda s: s[0] < 3,
+            lambda s: (s[0] + 1, s[1] + s[0].astype(jnp.uint64)),
+            (jnp.int32(0), c),
+        )[1],
+    )
+
+    # --- hash-table probe --------------------------------------------------
+    table = ht.make_table(TABLE, {"timestamp": jnp.uint64})
+    key = jnp.asarray(
+        rng.integers(1, 1 << 62, size=N, dtype=np.uint64)
+    )
+    timed(
+        "ht_lookup_8k_in_4m",
+        lambda: (table, key),
+        lambda c, i: (
+            c[0],
+            c[1] ^ ht.lookup(
+                c[0], c[1], jnp.zeros_like(c[1]), sm.MAX_PROBE
+            ).slot,
+        ),
+    )
+
+    # --- kernel variants (ledger state threads the dependence) -------------
+    n_accounts = 1024
+    led = sm.make_ledger(1 << 12, TABLE, 1 << 20)
+    acc = np.zeros(N, dtype=types.ACCOUNT_DTYPE)
+    acc["id_lo"][:n_accounts] = 1 + np.arange(n_accounts, dtype=np.uint64)
+    acc["ledger"][:n_accounts] = 1
+    acc["code"][:n_accounts] = 10
+    soa_a = {k: jnp.asarray(v) for k, v in types.to_soa(acc).items()}
+    led, codes = sm.create_accounts(
+        led, soa_a, jnp.uint64(n_accounts), jnp.uint64(n_accounts)
+    )
+    assert int(np.asarray(codes)[:n_accounts].sum()) == 0
+
+    count = N - 2
+    lane = np.arange(N, dtype=np.uint64)
+
+    def batch_cols(first_tid, two_phase):
+        b = np.zeros(N, dtype=types.TRANSFER_DTYPE)
+        half = count // 2
+        act = lane < count
+        dr = 1 + (lane * 7) % n_accounts
+        cr = 1 + (dr + 3) % n_accounts
+        b["id_lo"] = np.where(act, first_tid + lane, 0)
+        if two_phase:
+            is_post = (lane >= half) & act
+            b["flags"] = np.where(
+                act,
+                np.where(is_post, np.uint16(types.TransferFlags.POST_PENDING_TRANSFER),
+                         np.uint16(types.TransferFlags.PENDING)),
+                0,
+            ).astype(np.uint16)
+            b["pending_id_lo"] = np.where(is_post, first_tid + lane - half, 0)
+            act = act & ~is_post
+        b["debit_account_id_lo"] = np.where(act, dr, 0)
+        b["credit_account_id_lo"] = np.where(act, cr, 0)
+        b["amount_lo"] = np.where(act, 1 + lane % 100, 0)
+        b["ledger"] = np.where(act, 1, 0).astype(np.uint32)
+        b["code"] = np.where(act, 10, 0).astype(np.uint16)
+        return {k: jnp.asarray(v) for k, v in types.to_soa(b).items()}
+
+    def kernel_timer(name, step):
+        """reps sequential batches inside one dispatch.  The ledger AND a
+        batch-epoch counter thread through warm and timed runs, so every
+        iteration of BOTH dispatches inserts fresh ids at fresh timestamps
+        (a repeat id would take the 'exists' path and skip the apply
+        work)."""
+        @jax.jit
+        def run(carry):
+            def f(i, c):
+                led_, e = c
+                return step(led_, e), e + jnp.uint64(1)
+
+            return jax.lax.fori_loop(0, args.reps, f, carry)
+
+        out = run((led, jnp.uint64(0)))     # compile + warm
+        jax.block_until_ready(out[0].accounts.count)
+        t0 = time.time()
+        out = run(out)
+        jax.block_until_ready(out[0].accounts.count)
+        results[name] = round((time.time() - t0) / args.reps * 1e6, 1)
+        print(f"# {name}: {results[name]} us/batch", file=sys.stderr)
+
+    plain = batch_cols(1 << 33, two_phase=False)
+    twop = batch_cols(1 << 34, two_phase=True)
+    base_ts = jnp.uint64(1 << 20)
+
+    def shift_ids(cols, epoch):
+        # Fresh ids per epoch (N lanes apart; per-kernel bases are 2^33
+        # apart, far beyond reps * N) and strictly-advancing timestamps.
+        off = epoch * jnp.uint64(N)
+        out = dict(cols)
+        out["id_lo"] = jnp.where(cols["id_lo"] != 0, cols["id_lo"] + off, 0)
+        out["pending_id_lo"] = jnp.where(
+            cols["pending_id_lo"] != 0, cols["pending_id_lo"] + off, 0
+        )
+        return out, base_ts + (epoch + jnp.uint64(1)) * jnp.uint64(count)
+
+    def fast_step(led_, e):
+        cols, ts = shift_ids(plain, e)
+        led_, _ = sm.create_transfers_impl(led_, cols, jnp.uint64(count), ts)
+        return led_
+
+    def gated_step(led_, e):
+        cols, ts = shift_ids(plain, e)
+        led_, _, _ = tf.create_transfers_full_impl(
+            led_, cols, jnp.uint64(count), ts,
+            has_postvoid=False, has_history=False,
+        )
+        return led_
+
+    def full_step(led_, e):
+        cols, ts = shift_ids(twop, e)
+        led_, _, _ = tf.create_transfers_full_impl(
+            led_, cols, jnp.uint64(count), ts,
+            has_postvoid=True, has_history=False,
+        )
+        return led_
+
+    kernel_timer("kernel_fast_us", fast_step)
+    kernel_timer("kernel_general_gated_us", gated_step)
+    kernel_timer("kernel_general_full_us", full_step)
+
+    print(json.dumps(results))
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,9 @@ def run_bench(timeout_s: float = 3600.0) -> dict:
     env["JAX_COMPILATION_CACHE_DIR"] = CACHE_DIR
     results = {}
     variants = [
+        # The bisect first: ~2 min of device time that directs the kernel
+        # optimization work — tunnel windows have died mid-suite before.
+        ("bisect", [sys.executable, "tools/kernel_bisect.py"]),
         ("flagship", [sys.executable, "bench.py"]),
         ("two_phase", [sys.executable, "bench.py", "--two-phase",
                        "--skip-e2e", "--skip-parity"]),
