@@ -233,3 +233,31 @@ def test_backup_restart_rejoins_over_tcp(cluster):
         assert len(digests) == 1, "restarted backup diverged"
     finally:
         client.close()
+
+
+def test_async_checkpoint_adopted_without_traffic(cluster):
+    """A landed background checkpoint must be adopted by the serving loop
+    itself (the bus tick polls _checkpoint_poll), not only by the next due
+    boundary's checkpoint() call.  With the production config the next
+    boundary NEVER arrives (2 * vsr_checkpoint_interval=983 exceeds
+    journal_slot_count=1024's WAL-full cap at op_checkpoint + 1023), so
+    boundary-only adoption freezes op_checkpoint and permanently wedges the
+    cluster at WAL-full; TEST_MIN's small shape (2*23 < 64) masks that, so
+    this asserts the mechanism directly: adoption with zero further
+    traffic."""
+    client = Client(cluster.addresses, cluster=CLUSTER, timeout_s=30.0)
+    try:
+        make_accounts(client)
+        interval = TEST_MIN.vsr_checkpoint_interval
+        for b in range(interval + 4):
+            assert client.create_transfers(
+                transfer_batch(3000 + b * 8, 8)
+            ) == []
+        # No further requests: only the tick loop can adopt the write.
+        cluster.wait(
+            lambda: all(r.op_checkpoint >= interval for r in cluster.live()),
+            timeout=20,
+            what="async checkpoint adoption without traffic",
+        )
+    finally:
+        client.close()

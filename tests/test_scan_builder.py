@@ -9,6 +9,8 @@ see evicted transfers).  Reference: lsm/scan_builder.zig, lsm/scan_merge.zig
 (the reference implements 2-condition union only; intersection/difference are
 stubbed there, so the oracle here is the spec)."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -367,7 +369,7 @@ class TestMaintenance:
         """lazy_index defers maintenance (bulk-ingest serving mode): commits
         mark derived indexes stale instead of appending; the next query
         rebuilds and stays exact."""
-        cfg = CFG.__class__(**{**CFG.__dict__, "lazy_index": True})
+        cfg = dataclasses.replace(CFG, lazy_index=True)
         m = TpuStateMachine(cfg, batch_lanes=LANES)
         accounts = types.accounts_array([
             types.account(id=i + 1, ledger=1, code=10) for i in range(6)

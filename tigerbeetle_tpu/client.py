@@ -50,6 +50,7 @@ class Client:
         self.parent = 0          # checksum of the previous request
         self._sock: Optional[socket.socket] = None
         self._addr_index = 0     # preferred replica (rotates on failure)
+        self.failover_count = 0  # lifetime rotations (latency forensics)
 
     # -- connection management ----------------------------------------------
 
@@ -166,6 +167,7 @@ class Client:
                 self.close()
                 # Rotate the preferred replica before retrying (failover).
                 self._addr_index = (self._addr_index + 1) % len(self.addresses)
+                self.failover_count += 1
                 time.sleep(0.05)
 
     # -- session protocol -----------------------------------------------------

@@ -125,6 +125,10 @@ class ProcessConfig:
     # before its connection is evicted (message_bus.zig bounded send queue +
     # terminate discipline; see net/bus.py "Memory budget" invariant).
     drain_timeout_ms: int = 5000
+    # Max ops executed per commit dispatch on the TCP bus (replica.zig's
+    # async commit_dispatch never monopolizes its IO loop); the remainder
+    # drains via the bus commit pump, yielding to the loop between chunks.
+    commit_budget_ops: int = 4
     # O_DIRECT for the zoned data file (direct_io / direct_io_required):
     # page-cache writeback lies about durability; required=True refuses to
     # run on filesystems without it instead of silently degrading.

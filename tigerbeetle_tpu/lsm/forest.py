@@ -42,8 +42,8 @@ from ..vsr import checkpoint as checkpoint_mod
 from ..vsr.checksum import checksum
 from ..utils import ewah
 
-TABLES = ("accounts", "transfers", "posted")
-_SCALARS = {"count", "probe_overflow"}
+TABLES = checkpoint_mod.TABLE_NAMES
+_SCALARS = set(checkpoint_mod.TABLE_SCALARS)
 
 
 @dataclasses.dataclass
@@ -194,8 +194,10 @@ class Forest:
         )
 
     def _write_base(self, cur: Dict[str, np.ndarray], meta: dict, op: int) -> int:
+        # Sparse base (occupied rows only): base-write cost scales with
+        # data, not preallocated capacity (see checkpoint.sparsify_arrays).
         _, file_checksum = checkpoint_mod.save_arrays(
-            self.data_path, op, cur, meta
+            self.data_path, op, checkpoint_mod.sparsify_arrays(cur), meta
         )
         occupied = ~cur["accounts/tombstone"] & (
             (cur["accounts/key_lo"] != 0) | (cur["accounts/key_hi"] != 0)
@@ -361,7 +363,11 @@ class Forest:
                 f"base snapshot {path}: checksum mismatch"
             )
         z = np.load(io.BytesIO(blob))
-        arrays = {k: np.array(z[k]) for k in z.files if k != "meta"}
+        arrays = {
+            k: v
+            for k, v in checkpoint_mod.densify_arrays(z).items()
+            if k != "meta"
+        }
         meta = json.loads(bytes(z["meta"]).decode()) if "meta" in z.files else {}
         return arrays, meta
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -78,6 +79,22 @@ class ClusterServer:
         # RTT-adaptive timeouts convert monotonic ns to consensus ticks;
         # keep the conversion in lockstep with the actual tick cadence.
         replica.tick_ns = int(self.tick_interval * 1e9)
+        # Bounded commit execution per dispatch (replica.zig's async
+        # commit_dispatch chain never monopolizes its IO loop): the
+        # remainder drains through _commit_pump, which yields to the loop
+        # between chunks so heartbeats/pongs/prepares interleave.
+        replica.commit_budget = self.process.commit_budget_ops
+        self._pump_task: Optional[asyncio.Task] = None
+        self._pump_backoff_until = 0.0
+        # Overlap checkpoints with serving (replica.zig:3153-3169).  Safe
+        # under view changes: all superblock writes funnel through the
+        # replica's _superblock_install merge-point, so the background
+        # checkpoint and _persist_view serialize and never regress each
+        # other.  Without this, a checkpoint writes the full (growing)
+        # ledger snapshot inside one dispatch — measured 57→913 ms stalls
+        # doubling with table capacity, each one a cluster-wide
+        # primary-liveness probe and a client latency spike.
+        replica.async_checkpoint = True
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -102,6 +119,11 @@ class ClusterServer:
     async def close(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._pump_task is not None:
+            # A pump left running would keep committing against a replica
+            # mid-teardown (storage closing under it) and die noisily.
+            self._pump_task.cancel()
+            self._pump_task = None
         if self._server is not None:
             self._server.close()
         # Close every transport we know of — outbound writers AND accepted
@@ -254,8 +276,19 @@ class ClusterServer:
                             self.statsd.count("events", len(body) // 128)
                     except ValueError:
                         pass
+                t0 = time.monotonic()
                 out = self.replica.on_message(h, command, body)
+                dt = time.monotonic() - t0
+                if dt > 0.05:
+                    # Loop-stall forensics: a synchronous dispatch that
+                    # blocks the IO loop starves heartbeats AND pongs, and
+                    # shows up cluster-wide as a primary-liveness probe.
+                    self.replica._debug(
+                        "slow_dispatch", cmd=command.name,
+                        ms=round(dt * 1e3, 1),
+                    )
                 await self._route(out)
+                self._ensure_pump()
                 await writer.drain()
         except FrameError as err:
             log.warning("dropping connection: %s", err)
@@ -302,8 +335,53 @@ class ClusterServer:
             await asyncio.sleep(self.tick_interval)
             try:
                 await self._route(self.replica.tick())
+                self._ensure_pump()
+                # Adopt any landed background checkpoint.  checkpoint() only
+                # runs at due boundaries (measured from the last capture),
+                # so the tick loop is the cluster's poll path — without it
+                # the finished write is never adopted, op_checkpoint never
+                # advances, and the WAL fills permanently at
+                # op_checkpoint + journal_slot_count.
+                self.replica._checkpoint_poll()
             except Exception:
                 log.exception("tick failure")
+
+    # -- bounded commit pump --------------------------------------------------
+
+    def _ensure_pump(self) -> None:
+        """Schedule the commit pump if a dispatch stopped on its commit
+        budget with backlog remaining."""
+        if self._pump_task is not None or not (
+            self.replica.commit_budget_stopped
+            and self.replica.commit_backlog
+        ):
+            return
+        if asyncio.get_event_loop().time() < self._pump_backoff_until:
+            return  # last pump crashed; don't respawn into a retry storm
+        self._pump_task = asyncio.ensure_future(self._commit_pump())
+
+    async def _commit_pump(self) -> None:
+        try:
+            while True:
+                out: List = []
+                more = self.replica._commit_journal(out)
+                await self._route(out)
+                if not more:
+                    return
+                # The yield that justifies the budget: pings, pongs, and
+                # prepares get the loop between commit chunks.
+                await asyncio.sleep(0)
+        except Exception:
+            # A persistent failure (e.g. checkpoint write on a full disk)
+            # would otherwise respawn from the 2 ms tick loop into a
+            # traceback-per-tick storm; back off instead — commits stay
+            # wedged either way, but the replica remains diagnosable.
+            self._pump_backoff_until = (
+                asyncio.get_event_loop().time() + 5.0
+            )
+            log.exception("commit pump failure (backing off 5s)")
+        finally:
+            self._pump_task = None
 
 
 def run_cluster_server(

@@ -29,6 +29,10 @@ from ..ops import state_machine as sm
 from ..utils.fs import atomic_write
 
 TABLE_NAMES = ("accounts", "transfers", "posted")
+# Per-table fields that are NOT per-slot columns (scalars) — shared with the
+# LSM forest's delta computation and the sparse base encoder: the two must
+# agree or a scalar gets treated as a (capacity,)-shaped column.
+TABLE_SCALARS = ("count", "probe_overflow")
 
 
 def _table_arrays(prefix: str, table: ht.Table, out: Dict[str, np.ndarray]) -> None:
@@ -94,6 +98,95 @@ def arrays_to_ledger(arrays) -> sm.Ledger:
     )
 
 
+# Marker key identifying a sparse base snapshot (occupied rows only).
+SPARSE_MARKER = "sparse_base_v1"
+
+
+def sparsify_arrays(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Occupied-rows-only encoding of a snapshot dict.
+
+    With preallocated tables (config.zig-style static allocation) the hash
+    arrays are mostly empty; a dense base write costs O(capacity) — measured
+    as a multi-second, cluster-wide stall when three replicas hit their
+    aligned first checkpoint on 2^22-slot tables.  Sparse encoding makes
+    checkpoint cost scale with data instead: a row is kept iff any of its
+    columns holds a nonzero byte (zero rows are empty hash slots by
+    construction — key 0 is the empty sentinel and tombstones are flagged),
+    so expansion is bit-exact."""
+    out: Dict[str, np.ndarray] = {SPARSE_MARKER: np.uint64(1)}
+    for t in TABLE_NAMES:
+        prefix = f"{t}/"
+        per_slot = [
+            k for k in arrays
+            if k.startswith(prefix)
+            and k.split("/")[-1] not in TABLE_SCALARS
+        ]
+        cap = arrays[f"{t}/key_lo"].shape[0]
+        occ = np.zeros(cap, dtype=bool)
+        for k in per_slot:
+            occ |= arrays[k] != 0
+        (slots,) = np.nonzero(occ)
+        out[f"{t}/capacity"] = np.uint64(cap)
+        out[f"{t}/slots"] = slots.astype(np.uint64)
+        for k in per_slot:
+            out[f"sp/{k}"] = arrays[k][slots]
+        out[f"{t}/count"] = arrays[f"{t}/count"]
+        out[f"{t}/probe_overflow"] = arrays[f"{t}/probe_overflow"]
+    hcount = int(arrays["history/count"])
+    hcap = 0
+    for k in arrays:
+        if k.startswith("history/cols/"):
+            hcap = arrays[k].shape[0]
+            out[f"sp/{k}"] = arrays[k][:hcount]
+    out["history/capacity"] = np.uint64(hcap)
+    out["history/count"] = arrays["history/count"]
+    return out
+
+
+def densify_arrays(arrays) -> Dict[str, np.ndarray]:
+    """Inverse of sparsify_arrays; passes dense snapshots through unchanged
+    (old checkpoints stay loadable)."""
+    keys = list(arrays.files if hasattr(arrays, "files") else arrays.keys())
+    if SPARSE_MARKER not in keys:
+        return {k: arrays[k] for k in keys}
+    out: Dict[str, np.ndarray] = {}
+    for t in TABLE_NAMES:
+        cap = int(arrays[f"{t}/capacity"])
+        slots = np.asarray(arrays[f"{t}/slots"]).astype(np.int64)
+        prefix = f"sp/{t}/"
+        for k in keys:
+            if k.startswith(prefix):
+                rows = np.asarray(arrays[k])
+                full = np.zeros((cap,) + rows.shape[1:], dtype=rows.dtype)
+                full[slots] = rows
+                out[k[3:]] = full
+        out[f"{t}/count"] = np.asarray(arrays[f"{t}/count"])
+        out[f"{t}/probe_overflow"] = np.asarray(
+            arrays[f"{t}/probe_overflow"]
+        )
+    hcap = int(arrays["history/capacity"])
+    hcount = int(arrays["history/count"])
+    for k in keys:
+        if k.startswith("sp/history/cols/"):
+            rows = np.asarray(arrays[k])
+            full = np.zeros((hcap,) + rows.shape[1:], dtype=rows.dtype)
+            full[:hcount] = rows
+            out[k[3:]] = full
+    out["history/count"] = np.asarray(arrays["history/count"])
+    for k in keys:
+        # Passthrough for non-table payloads (meta, op, ...).
+        if (
+            k not in out
+            and k != SPARSE_MARKER
+            and not k.startswith("sp/")
+            and not any(
+                k.startswith(f"{t}/") for t in TABLE_NAMES + ("history",)
+            )
+        ):
+            out[k] = arrays[k]
+    return out
+
+
 def save(
     data_path: str, op: int, ledger: sm.Ledger, meta: Optional[dict] = None
 ) -> Tuple[str, int]:
@@ -138,7 +231,7 @@ def load(
             f"(got {actual:#x}, superblock says {expected_checksum:#x})"
         )
     z = np.load(io.BytesIO(blob))
-    ledger = arrays_to_ledger(z)
+    ledger = arrays_to_ledger(densify_arrays(z))
     meta = json.loads(bytes(z["meta"]).decode()) if "meta" in z.files else {}
     return ledger, meta
 

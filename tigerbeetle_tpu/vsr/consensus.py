@@ -72,7 +72,10 @@ SYNCING = "syncing"
 PING_INTERVAL = 25
 COMMIT_HEARTBEAT = 10
 PREPARE_RESEND = 15
-NORMAL_HEARTBEAT = 100       # backup: primary presumed dead after this
+NORMAL_HEARTBEAT = 100       # backup: primary presumed SUSPECT after this
+PROBE_GRACE = 50             # direct-ping grace before campaigning
+PRIMARY_GAP_MULT = 8         # silence budget: x the EWMA inter-word gap
+PRIMARY_BUDGET_CAP = 600     # bounded failover: budget never exceeds this
 VIEW_CHANGE_RESEND = 25      # SVC/DVC re-broadcast while in view change
 VIEW_CHANGE_ESCALATE = 200   # stuck view change: try the next view
 RECOVERING_RESEND = 30       # request_start_view cadence while recovering
@@ -188,6 +191,24 @@ class VsrReplica(Replica):
         self._last_ping = -PING_INTERVAL
         self._last_commit_sent = 0
         self._last_primary_word = 0
+        # Primary-liveness suspicion (reference: RTT-adaptive timeouts,
+        # vsr.zig:543-712).  A busy-but-alive primary (long fsync, scheduler
+        # preemption on a shared host) must not trigger elections: the
+        # silence budget adapts to the observed inter-word gap, and a
+        # suspecting backup first probes the primary directly (ping) and
+        # campaigns only when the probe too goes unanswered.
+        self._primary_gap_ewma = 0.0
+        self._probe_sent_at: Optional[int] = None
+        self._pong_standdowns = 0
+        # Max ops executed per _commit_journal call (None = unlimited).
+        # The TCP bus sets this and drains the remainder via its commit
+        # pump; the sim/VOPR leaves it unset (single-dispatch determinism).
+        self.commit_budget: Optional[int] = None
+        # True iff the last _commit_journal call stopped ON BUDGET (vs
+        # blocked on repair): the bus spawns its pump only for this case —
+        # a repair-blocked backlog would otherwise respawn a no-op task
+        # every tick for the whole repair window.
+        self.commit_budget_stopped = False
         self._vc_started = 0
         self._last_sync_req = 0
         self._heartbeat_jitter = 0
@@ -251,6 +272,12 @@ class VsrReplica(Replica):
     @property
     def is_primary(self) -> bool:
         return self.status == NORMAL and self.primary_index() == self.replica
+
+    @property
+    def commit_backlog(self) -> bool:
+        """Journaled ops known-committed but not yet executed (the bus
+        commit pump drains these between dispatches)."""
+        return self.commit_min < min(self.commit_max, self.op)
 
     @property
     def quorum_replication(self) -> int:
@@ -367,7 +394,9 @@ class VsrReplica(Replica):
             commit_min=max(self._sb_state.commit_min, self.commit_min),
             commit_max=max(self._sb_state.commit_max, self.commit_max),
         )
-        self.superblock.checkpoint(state)
+        # Through the single merge-point: a concurrent background
+        # checkpoint (async_checkpoint) must not be reverted or raced.
+        state = self._superblock_install(state)
         self._sb_state = state
 
     # -- message dispatch ----------------------------------------------------
@@ -642,7 +671,7 @@ class VsrReplica(Replica):
             self.stash[op] = (h, body)
             return []
 
-        self._last_primary_word = self._ticks
+        self._primary_spoke()
         self.commit_max = max(self.commit_max, int(h["commit"]))
 
         if op <= self.op:
@@ -804,7 +833,7 @@ class VsrReplica(Replica):
             return self._request_start_view(view)
         if self.status != NORMAL or self.is_primary:
             return []
-        self._last_primary_word = self._ticks
+        self._primary_spoke()
         self.commit_max = max(self.commit_max, int(h["commit"]))
         out: List[Msg] = []
         self._commit_journal(out)
@@ -854,11 +883,26 @@ class VsrReplica(Replica):
                 client=wire.u128(h, "client"), ok_from={self.replica},
             )
 
-    def _commit_journal(self, out: List[Msg]) -> None:
+    def _commit_journal(self, out: List[Msg]) -> bool:
         """Execute journaled ops up to min(commit_max, op), in order
-        (replica.zig commit_journal :3176)."""
+        (replica.zig commit_journal :3176).
+
+        ``commit_budget`` (set by the TCP bus; None = unlimited for the
+        sim/VOPR) bounds the ops executed per call: the reference commits
+        through an async IO chain that never monopolizes its event loop
+        (replica.zig commit_dispatch stages), and a Python replica must
+        match that or a large commit backlog blocks heartbeats AND pongs
+        for hundreds of ms — measured cluster-wide as primary-liveness
+        probes and client failover spikes.  Returns True iff the call
+        stopped on budget with backlog remaining (the bus's commit pump
+        resumes on the next loop iteration)."""
         self._extend_verification()
+        done = 0
+        self.commit_budget_stopped = False
         while self.commit_min < min(self.commit_max, self.op):
+            if self.commit_budget is not None and done >= self.commit_budget:
+                self.commit_budget_stopped = True
+                return True
             op = self.commit_min + 1
             if self.replica_count > 1 and op < self._verify_floor:
                 # Suspect suffix (restart before the canonical chain was
@@ -889,6 +933,8 @@ class VsrReplica(Replica):
                 # Checkpoint.checkpoint_after's fixed schedule).
                 self.checkpoint()
                 self._prune_headers()
+            done += 1
+        return False
 
     def _prune_headers(self) -> None:
         floor = self.op_checkpoint - 1
@@ -909,6 +955,24 @@ class VsrReplica(Replica):
         }
         rec.update(kw)
         self._debug_file.write(_json.dumps(rec) + "\n")
+
+    def _primary_spoke(self, real: bool = True) -> None:
+        """Record primary-liveness evidence: fold the silence gap into the
+        EWMA (feeds the adaptive suspicion budget) and stand down any
+        pending probe.  ``real=False`` marks pong-only evidence — a wedged
+        primary whose IO loop still answers pings must not defer elections
+        forever, so pong-only stand-downs are capped between real words."""
+        if real:
+            self._pong_standdowns = 0
+        else:
+            self._pong_standdowns += 1
+            if self._pong_standdowns > 3:
+                return  # wedged, not busy: let the election proceed
+        gap = self._ticks - self._last_primary_word
+        if 0 < gap <= PRIMARY_BUDGET_CAP:
+            self._primary_gap_ewma += 0.125 * (gap - self._primary_gap_ewma)
+        self._last_primary_word = self._ticks
+        self._probe_sent_at = None
 
     def _begin_view_change(self, new_view: int) -> List[Msg]:
         """Move to view_change status for new_view and broadcast SVC
@@ -1235,7 +1299,7 @@ class VsrReplica(Replica):
         self.view = view
         self.log_view = view
         self.commit_max = max(self.commit_max, int(h["commit"]))
-        self._last_primary_word = self._ticks
+        self._primary_spoke()
         self.pipeline.clear()
         self._dvc_sent_for = None
         self.svc_from = {v: s for v, s in self.svc_from.items() if v > view}
@@ -1805,6 +1869,10 @@ class VsrReplica(Replica):
                 self._last_sync_req = self._ticks
                 return self._request_cold_chunk()
         self._cold_fetch = None
+        # A background checkpoint still in flight refers to the pre-sync
+        # ledger; land it BEFORE the snapshot replaces machine/forest state
+        # (its anchor then loses the _superblock_install merge below).
+        self._checkpoint_drain()
         self.machine.ledger = ledger
         self.machine.restore_host_state(meta["machine"])
         self.sessions = {
@@ -1845,7 +1913,7 @@ class VsrReplica(Replica):
             commit_timestamp=self.machine.commit_timestamp,
             manifest_checksum=manifest_checksum,
         )
-        self.superblock.checkpoint(state)
+        state = self._superblock_install(state)
         self._sb_state = state
         self.forest.gc()
         self.sync_target = None
@@ -1879,6 +1947,16 @@ class VsrReplica(Replica):
         self.rtt.sample(
             (self._monotonic() - ping_mono) / getattr(self, "tick_ns", TICK_NS)
         )
+        # A pong from the current primary is liveness evidence — this is
+        # what stands down a suspicion probe (see tick()'s two-stage
+        # primary timeout).
+        if (
+            self.status == NORMAL
+            and not self.is_primary
+            and self._probe_sent_at is not None
+            and int(h["replica"]) == self.primary_index()
+        ):
+            self._primary_spoke(real=False)
         return []
 
     # -- tick (timeouts; vsr.zig:543-712) -------------------------------------
@@ -1907,7 +1985,19 @@ class VsrReplica(Replica):
             last = self._last_tick_mono
             self._last_tick_mono = now
             if last is not None and now - last > 4 * tick_ns:
-                self._last_primary_word = self._ticks
+                # Stale evidence: discount exactly the slept-through gap
+                # from the silence clock (WITHOUT feeding the gap EWMA —
+                # the gap was ours, not the primary's) and stand down any
+                # probe raised on pre-sleep observations.  Advancing by the
+                # gap, not resetting to now, keeps failover live: a backup
+                # with RECURRING stalls (commit chunks, GC) would otherwise
+                # re-arm the full budget on every stall and never elect a
+                # replacement for a genuinely dead primary.
+                slept = int((now - last) / tick_ns)
+                self._last_primary_word = min(
+                    self._ticks, self._last_primary_word + slept
+                )
+                self._probe_sent_at = None
                 self._debug(
                     "tick_starved", gap_ms=round((now - last) / 1e6, 1)
                 )
@@ -2033,17 +2123,46 @@ class VsrReplica(Replica):
         elif self.status == NORMAL:
             # Backup: watch for a dead primary.  Standbys observe but never
             # call elections (they are not in the view-change quorum).
-            if not self.is_standby and (
-                self._ticks - max(self._last_primary_word, 0)
-                >= NORMAL_HEARTBEAT + self._heartbeat_jitter
-            ):
-                self._debug(
-                    "primary_timeout",
-                    silent_ticks=self._ticks - self._last_primary_word,
-                )
-                self._last_primary_word = self._ticks
-                out.extend(self._begin_view_change(self.view + 1))
-            elif (
+            # Two-stage suspicion (reference: RTT-adaptive timeouts,
+            # vsr.zig:543-712): the silence budget adapts to the observed
+            # inter-word gap, and the first firing sends a direct ping —
+            # a busy-but-alive primary (long fsync, scheduler preemption)
+            # answers from its IO loop and the election is avoided.  Only
+            # a probe that ALSO goes unanswered starts the view change.
+            silent = self._ticks - max(self._last_primary_word, 0)
+            budget = min(
+                max(NORMAL_HEARTBEAT,
+                    int(self._primary_gap_ewma * PRIMARY_GAP_MULT)),
+                PRIMARY_BUDGET_CAP,
+            ) + self._heartbeat_jitter
+            if not self.is_standby and silent >= budget:
+                if self._probe_sent_at is None:
+                    self._probe_sent_at = self._ticks
+                    self._debug("primary_probe", silent_ticks=silent)
+                    probe = self._hdr(
+                        wire.Command.ping,
+                        checkpoint_op=self.op_checkpoint,
+                        ping_timestamp_monotonic=self.clock.ping_timestamp(),
+                    )
+                    out.append(
+                        (("replica", self.primary_index()),
+                         wire.encode(probe))
+                    )
+                elif self._ticks - self._probe_sent_at >= PROBE_GRACE:
+                    self._debug(
+                        "primary_timeout",
+                        silent_ticks=silent,
+                        probe_ticks=self._ticks - self._probe_sent_at,
+                    )
+                    self._last_primary_word = self._ticks
+                    self._probe_sent_at = None
+                    out.extend(self._begin_view_change(self.view + 1))
+            # Repair runs INDEPENDENTLY of the suspicion state machine (its
+            # own timeout, vsr.zig repair_timeout): a pending probe must not
+            # starve gap fill — repairs may be exactly what un-wedges the
+            # commit path.  (Re-check NORMAL: the campaign above may have
+            # moved us to VIEW_CHANGE this tick.)
+            if self.status == NORMAL and (
                 self.missing or self.stash or self._header_gaps()
                 or self.commit_max > self.op
             ) and self._repair_timeout.fired(self._ticks):

@@ -158,6 +158,11 @@ class Replica:
         # (SuperBlockState, cold_garbage) of a finished background write.
         self._ckpt_result = None
         self._ckpt_error: Optional[BaseException] = None
+        # Captures taken at their aligned op while a write was still in
+        # flight, awaiting their own background write (in order).
+        self._ckpt_queue: List[tuple] = []
+        # commit_min of the newest capture (see _checkpoint_due).
+        self._ckpt_captured_op = 0
         self.view = 0
         self.op = 0                 # latest journaled op
         self.commit_min = 0         # latest committed (executed) op
@@ -165,6 +170,11 @@ class Replica:
         self.parent_checksum = 0    # checksum of prepare at self.op
         self.sessions: Dict[int, Session] = {}
         self._sb_state: Optional[SuperBlockState] = None
+        # Serializes superblock writers: the serving thread (_persist_view)
+        # vs the background checkpoint thread.  See _superblock_install.
+        import threading
+
+        self._sb_lock = threading.Lock()
 
     # -- format / open -------------------------------------------------------
 
@@ -753,26 +763,52 @@ class Replica:
         return self.op_checkpoint + self.config.journal_slot_count - 1
 
     def _checkpoint_due(self) -> bool:
+        # Measured from the last CAPTURE, not the last adopted checkpoint:
+        # under async_checkpoint the adoption (op_checkpoint) lags the
+        # in-flight write, and measuring from op_checkpoint would re-trigger
+        # a capture on EVERY op after a boundary until adoption — misaligned
+        # captures (breaking cross-replica forest determinism) and a
+        # synchronous drain two ops later.
         return (
-            self.commit_min - self.op_checkpoint
+            self.commit_min
+            - max(self.op_checkpoint, self._ckpt_captured_op)
             >= self.config.vsr_checkpoint_interval
         )
 
     def checkpoint(self) -> None:
         """Durably snapshot ledger + sessions + superblock at commit_min.
 
-        With ``async_checkpoint`` on (the single-replica TCP server), the
-        expensive half — forest delta + file writes + fsync + superblock —
-        runs on a background thread while the replica keeps serving
-        (replica.zig:3153-3169 overlaps checkpoint with the pipeline the
-        same way); only the device→host snapshot is taken inline.  The sim
-        and cluster mode stay synchronous: the sim for determinism, the
-        cluster because a concurrent view change's superblock write
-        (_persist_view) would race the background one."""
+        With ``async_checkpoint`` on (both TCP servers — single-replica and
+        cluster), the expensive half — forest delta + file writes + fsync +
+        superblock — runs on a background thread while the replica keeps
+        serving (replica.zig:3153-3169 overlaps checkpoint with the
+        pipeline the same way); only the device→host snapshot is taken
+        inline.  Cluster safety: every superblock write (this thread's
+        _persist_view AND the background write) goes through the
+        _superblock_install merge-point, which serializes them and merges
+        monotonically.  The sim keeps checkpoints synchronous for
+        determinism.
+
+        Alignment: the CAPTURE always happens here, at the exact
+        op_checkpoint+interval boundary the commit loop invokes us on —
+        even when a previous write is still in flight (the capture is then
+        queued and written after it).  Cross-replica forest determinism
+        (peer block repair matches files by checksum) depends on every
+        replica capturing at identical ops."""
         if self.async_checkpoint:
             self._checkpoint_poll()
             if self._ckpt_thread is not None:
-                return  # one in flight; re-triggered when due after it lands
+                if len(self._ckpt_queue) >= 1:
+                    # Writes persistently slower than the checkpoint
+                    # interval: block.  Backpressure must not skip the
+                    # aligned capture — skipping would desynchronize this
+                    # replica's forest files from its peers' — and the
+                    # queue is bounded at one so peak host memory stays at
+                    # two captures (in-flight + queued), not unbounded.
+                    self._checkpoint_drain()
+                self._ckpt_queue.append(self._checkpoint_capture())
+                self._checkpoint_poll()  # start it if the write just landed
+                return
             self._checkpoint_async_start()
             return
         with tracer.span("checkpoint", op=self.commit_min):
@@ -792,6 +828,7 @@ class Replica:
         # runs written here become durable with this checkpoint's manifest).
         m = self.machine
         m._maybe_evict_between_batches()
+        self._ckpt_captured_op = self.commit_min
         meta = {
             "machine": m.host_state(),
             "sessions": {
@@ -848,10 +885,56 @@ class Replica:
             commit_timestamp=fields["commit_timestamp"],
             manifest_checksum=manifest_checksum,
         )
-        self.superblock.checkpoint(state)
+        state = self._superblock_install(state)
         return state
 
+    def _superblock_install(self, state: SuperBlockState) -> SuperBlockState:
+        """The ONLY superblock write path: serializes the serving thread
+        (_persist_view on view changes) against the background checkpoint
+        thread and monotonically merges their fields so neither writer can
+        regress the other's progress (the reference sequences superblock
+        updates through a single-owner write queue, superblock.zig
+        view_change/checkpoint staging):
+
+        - view/log_view/commit bounds only move forward (a checkpoint
+          captured before a view bump must not durably regress the view —
+          a restarted replica could then ack in the old view: split brain).
+        - The checkpoint anchor group (op_checkpoint + file checksums +
+          digest + timestamps) moves forward as a UNIT: a view persist
+          racing a landed background checkpoint must not revert the
+          superblock to a manifest whose files the adopt step is about to
+          GC — restart would anchor on deleted files."""
+        with self._sb_lock:
+            cur = self.superblock.state
+            if state.op_checkpoint < cur.op_checkpoint:
+                state = dataclasses.replace(
+                    state,
+                    op_checkpoint=cur.op_checkpoint,
+                    checkpoint_file_checksum=cur.checkpoint_file_checksum,
+                    manifest_checksum=cur.manifest_checksum,
+                    ledger_digest=cur.ledger_digest,
+                    prepare_timestamp=cur.prepare_timestamp,
+                    commit_timestamp=cur.commit_timestamp,
+                )
+            state = dataclasses.replace(
+                state,
+                view=max(state.view, cur.view),
+                log_view=max(state.log_view, cur.log_view),
+                commit_min=max(state.commit_min, cur.commit_min),
+                commit_max=max(state.commit_max, cur.commit_max),
+            )
+            self.superblock.checkpoint(state)
+            return state
+
     def _checkpoint_adopt(self, state: SuperBlockState, cold_garbage) -> None:
+        # The background write merged in the view as of ITS write moment; a
+        # view change since then is already durable via _persist_view —
+        # fold it into the serving thread's view of the superblock too.
+        state = dataclasses.replace(
+            state,
+            view=max(state.view, self.view),
+            log_view=max(state.log_view, getattr(self, "log_view", self.view)),
+        )
         self._sb_state = state
         self.op_checkpoint = state.op_checkpoint
         # GC only after the superblock referencing the new manifest is
@@ -864,9 +947,19 @@ class Replica:
     # -- overlapped checkpoint (async_checkpoint; replica.zig:3153-3169) ------
 
     def _checkpoint_async_start(self) -> None:
+        t0 = time.monotonic()
+        arrays, meta, fields = self._checkpoint_capture()
+        dt = time.monotonic() - t0
+        if dt > 0.05:
+            dbg = getattr(self, "_debug", None)
+            if dbg is not None:
+                dbg("slow_ckpt_capture", ms=round(dt * 1e3, 1),
+                    op=self.commit_min)
+        self._checkpoint_write_start(arrays, meta, fields)
+
+    def _checkpoint_write_start(self, arrays, meta, fields) -> None:
         import threading
 
-        arrays, meta, fields = self._checkpoint_capture()
         self._ckpt_error = None
 
         def work():
@@ -884,23 +977,43 @@ class Replica:
             t.start()
 
     def _checkpoint_poll(self) -> None:
-        """Adopt a finished background checkpoint (serving thread only)."""
+        """Adopt a finished background checkpoint and start the next queued
+        write, if any (serving thread only)."""
         t = self._ckpt_thread
-        if t is None or t.is_alive():
+        if t is not None and t.is_alive():
             return
-        self._ckpt_thread = None
-        if self._ckpt_error is not None:
-            raise RuntimeError("background checkpoint failed") from (
-                self._ckpt_error
-            )
-        (state, cold_garbage), self._ckpt_result = self._ckpt_result, None
-        self._checkpoint_adopt(state, cold_garbage)
+        if t is not None:
+            self._ckpt_thread = None
+            if self._ckpt_error is not None:
+                err, self._ckpt_error = self._ckpt_error, None
+                # Retry path: re-arm the due trigger at the next commit
+                # (measured-from-capture would otherwise suppress the next
+                # checkpoint until commit_min reaches captured_op+interval —
+                # with the production config that is beyond the WAL cap, so
+                # one transient EIO would wedge the replica at WAL-full
+                # forever).  Queued captures are discarded with it: the
+                # fresh capture supersedes them.
+                self._ckpt_captured_op = self.op_checkpoint
+                self._ckpt_queue.clear()
+                raise RuntimeError("background checkpoint failed") from err
+            (state, cold_garbage), self._ckpt_result = self._ckpt_result, None
+            if state.op_checkpoint >= self.op_checkpoint:
+                self._checkpoint_adopt(state, cold_garbage)
+            else:
+                # Superseded while in flight (state sync adopted a newer
+                # anchor) — adopting would regress op_checkpoint.  Still
+                # GC the capture's cold garbage (gc() intersects with the
+                # CURRENT garbage list, so anything the new state tracks
+                # or references survives) or those files leak until
+                # restart.
+                self.machine.cold.gc(cold_garbage)
+        if self._ckpt_thread is None and self._ckpt_queue:
+            self._checkpoint_write_start(*self._ckpt_queue.pop(0))
 
     def _checkpoint_drain(self) -> None:
-        t = self._ckpt_thread
-        if t is not None:
-            t.join()
-            self._checkpoint_poll()
+        while self._ckpt_thread is not None:
+            self._ckpt_thread.join()
+            self._checkpoint_poll()  # adopts; starts the next queued write
 
     def close(self) -> None:
         self._checkpoint_drain()
