@@ -329,3 +329,99 @@ class TestGrowth:
             next_id += n
             done += n
         assert not bool(np.asarray(dev.ledger.transfers.probe_overflow))
+
+
+class TestStaticTripParity:
+    def test_scan_and_while_paths_identical(self):
+        """The TPU path runs the Jacobi fixpoint as a STATIC-trip lax.scan
+        (data-independent trip count; see _kernel_core), other backends as
+        the early-exit while_loop.  The fixpoint is absorbing, so the two
+        must agree bit-for-bit — this pins the scan path on CPU, where the
+        auto-gate would otherwise leave it untested."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from tigerbeetle_tpu.ops import state_machine as sm
+        from tigerbeetle_tpu.ops import transfer_full as tf
+
+        lanes, n_accounts = 64, 8
+        count = 40
+
+        def fresh_ledger():
+            led = sm.make_ledger(1 << 8, 1 << 10, 1 << 8)
+            acc = np.zeros(lanes, dtype=types.ACCOUNT_DTYPE)
+            acc["id_lo"][:n_accounts] = 1 + np.arange(
+                n_accounts, dtype=np.uint64
+            )
+            acc["ledger"][:n_accounts] = 1
+            acc["code"][:n_accounts] = 10
+            soa = {
+                k: jnp.asarray(v) for k, v in types.to_soa(acc).items()
+            }
+            led, codes = sm.create_accounts(
+                led, soa, jnp.uint64(n_accounts), jnp.uint64(n_accounts)
+            )
+            assert int(np.asarray(codes)[:n_accounts].sum()) == 0
+            return led
+
+        # Mixed batch: pendings, same-batch posts of those pendings, a
+        # balancing-style zero-amount lane, and a plain chain — exercises
+        # multi-pass convergence (the two-phase/balancing classes measure
+        # 3 Jacobi passes).
+        b = np.zeros(lanes, dtype=types.TRANSFER_DTYPE)
+        half = count // 2
+        lane = np.arange(lanes, dtype=np.uint64)
+        act = lane < count
+        is_post = (lane >= half) & act
+        b["id_lo"] = np.where(act, 1000 + lane, 0)
+        b["flags"] = np.where(
+            act,
+            np.where(
+                is_post,
+                np.uint16(types.TransferFlags.POST_PENDING_TRANSFER),
+                np.uint16(types.TransferFlags.PENDING),
+            ),
+            0,
+        ).astype(np.uint16)
+        b["pending_id_lo"] = np.where(is_post, 1000 + lane - half, 0)
+        pend = act & ~is_post
+        b["debit_account_id_lo"] = np.where(pend, 1 + lane % n_accounts, 0)
+        b["credit_account_id_lo"] = np.where(
+            pend, 1 + (lane + 1) % n_accounts, 0
+        )
+        b["amount_lo"] = np.where(pend, 7 + lane % 13, 0)
+        b["ledger"] = np.where(pend, 1, 0).astype(np.uint32)
+        b["code"] = np.where(pend, 10, 0).astype(np.uint16)
+        soa = {k: jnp.asarray(v) for k, v in types.to_soa(b).items()}
+
+        outs = {}
+        for static in (False, True):
+            fn = functools.partial(
+                tf.create_transfers_full_impl, static_trip=static
+            )
+            led, codes, kflags = jax.jit(fn)(
+                fresh_ledger(), soa, jnp.uint64(count), jnp.uint64(10_000)
+            )
+            outs[static] = (
+                np.asarray(codes),
+                int(kflags),
+                {
+                    k: np.asarray(v)
+                    for k, v in {
+                        "t_keys": led.transfers.key_lo,
+                        "t_count": led.transfers.count,
+                        "a_dr": led.accounts.cols["debits_posted_lo"],
+                        "a_cr": led.accounts.cols["credits_posted_lo"],
+                        "a_dp": led.accounts.cols["debits_pending_lo"],
+                        "p_keys": led.posted.key_lo,
+                    }.items()
+                },
+            )
+        codes_w, kf_w, tabs_w = outs[False]
+        codes_s, kf_s, tabs_s = outs[True]
+        np.testing.assert_array_equal(codes_w, codes_s)
+        assert kf_w == kf_s
+        for k in tabs_w:
+            np.testing.assert_array_equal(tabs_w[k], tabs_s[k], err_msg=k)
