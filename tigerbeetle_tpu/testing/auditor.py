@@ -78,10 +78,20 @@ class Auditor:
         prev = self.records.get(op)
         if prev is not None:
             if prev != rec:
+                diffs = [
+                    name
+                    for name, a, b in zip(
+                        ("operation", "timestamp", "body", "results"),
+                        prev, rec,
+                    )
+                    if a != b
+                ]
                 raise AuditError(
                     f"op {op}: replica {replica} (replay={replay}) committed "
                     f"{operation} with diverging body/results vs the first "
-                    f"commit of this op"
+                    f"commit of this op (diverging: {', '.join(diffs)}; "
+                    f"first ts={prev[1]} vs ts={rec[1]}, "
+                    f"first results={prev[3][:64]!r} vs {rec[3][:64]!r})"
                 )
             return
         self.records[op] = rec

@@ -76,6 +76,8 @@ NORMAL_HEARTBEAT = 100       # backup: primary presumed SUSPECT after this
 PROBE_GRACE = 50             # direct-ping grace before campaigning
 PRIMARY_GAP_MULT = 8         # silence budget: x the EWMA inter-word gap
 PRIMARY_BUDGET_CAP = 600     # bounded failover: budget never exceeds this
+PRIMARY_ABDICATE = 800       # primary commit-stall ticks before stepping down
+_FLOOR_STALL_SYNC = 30       # commit-floor-starved heartbeats before syncing
 VIEW_CHANGE_RESEND = 25      # SVC/DVC re-broadcast while in view change
 VIEW_CHANGE_ESCALATE = 200   # stuck view change: try the next view
 RECOVERING_RESEND = 30       # request_start_view cadence while recovering
@@ -200,6 +202,11 @@ class VsrReplica(Replica):
         self._primary_gap_ewma = 0.0
         self._probe_sent_at: Optional[int] = None
         self._pong_standdowns = 0
+        # Commit-floor starvation / primary commit-stall tracking (see
+        # _maybe_start_sync and the abdication branch in tick()).
+        self._floor_stall = 0
+        self._abdicate_commit_mark = -1
+        self._abdicate_ticks = 0
         # Max ops executed per _commit_journal call (None = unlimited).
         # The TCP bus sets this and drains the remainder via its commit
         # pump; the sim/VOPR leaves it unset (single-dispatch determinism).
@@ -365,10 +372,40 @@ class VsrReplica(Replica):
         # beat the intact backup's (log_view=0, op=28)).
         beyond_head = any(op > self.op for op in recovery.entries)
         persisted_commit = getattr(self._sb_state, "commit_min", 0)
+        # The DVC invariant behind (log_view, op) canonical selection: a
+        # durable log_view asserts the journal holds that view's canonical
+        # log through self.op.  The durable commit_max (written by
+        # _persist_view during the adoption) records how far that log was
+        # KNOWN to extend — a recovered head below it means the adopted
+        # suffix died with the crash (bodies never journaled), and a DVC
+        # claiming (log_view, short-op) would OUT-RANK an intact older-view
+        # log and truncate committed history (VOPR seed 500285: a restarted
+        # backup's (log_view=2, op=22) beat the intact (log_view=0, op=29)
+        # log and ops 24-28, committed, were refilled with new requests).
+        persisted_cm = getattr(self._sb_state, "commit_max", 0)
+        # The slot of op+1 is the ONE slot a write could have been mid-
+        # flight to at crash time (prepares journal serially, synced per
+        # write): nonzero-undecodable content THERE is an ordinary torn
+        # tail — never acked (acks follow the sync) — not amputation.
+        torn_tail_slot = self.journal.slot(self.op + 1)
+        corrupt_slots = [
+            s for s in getattr(recovery, "corrupt_slots", ())
+            if s != torn_tail_slot
+        ]
         self._log_suspect = self.replica_count > 1 and (
             bool(recovery.foreign_slots)
+            or bool(corrupt_slots)
             or beyond_head
             or persisted_commit > self.op
+            or persisted_cm > self.op
+        )
+        self._debug(
+            "recovered", op=self.op, commit_min=self.commit_min,
+            persisted=persisted_commit, suspect=self._log_suspect,
+            entries=len(recovery.entries),
+            faulty=len(recovery.faulty_slots),
+            corrupt=len(corrupt_slots),
+            log_view=self.log_view, view=self.view,
         )
 
     def _replay_solo(self) -> None:
@@ -852,6 +889,12 @@ class VsrReplica(Replica):
             h = self.headers.get(f)
             below = self.headers.get(f - 1)
             if h is None or below is None:
+                if self._debug_file is not None:
+                    self._debug(
+                        "verify_walk_gap", floor=f,
+                        have_f=h is not None, have_below=below is not None,
+                        commit_min=self.commit_min,
+                    )
                 return  # a gap: repair must fetch headers first
             if wire.u128(h, "parent") == wire.header_checksum(below):
                 self._verify_floor = f - 1
@@ -918,6 +961,13 @@ class VsrReplica(Replica):
             ):
                 self.missing[op] = wire.header_checksum(h)
                 break
+            if self._debug_file is not None:
+                self._debug(
+                    "commit_op", op=op,
+                    operation=int(read[0]["operation"]),
+                    prep_view=int(read[0]["view"]),
+                    ts=int(read[0]["timestamp"]),
+                )
             reply = self._commit_prepare(read[0], read[1], replay=False)
             entry = self.pipeline.pop(op, None)
             if self.is_primary and reply is not None:
@@ -956,6 +1006,37 @@ class VsrReplica(Replica):
         rec.update(kw)
         self._debug_file.write(_json.dumps(rec) + "\n")
 
+    def _maybe_clear_log_suspect(self) -> None:
+        """A recovering-head replica whose log is REPAIRED may rejoin view
+        changes: every byte of amputation evidence has been resolved —
+        commits caught up to the durable floor, the hash chain verified
+        down to it, no missing bodies, no header gaps.  At that point the
+        log provably matches committed history and the suspicion (which
+        exists because an amputated WAL cannot prove what it acked) no
+        longer applies: anything it once acked and lost was either
+        committed (now repaired back in) or nack-truncated (provably never
+        committed)."""
+        if not getattr(self, "_log_suspect", False):
+            return
+        persisted = getattr(self._sb_state, "commit_min", 0)
+        persisted_cm = getattr(self._sb_state, "commit_max", 0)
+        if (
+            self.commit_min >= persisted
+            # The head must be restored through EVERY durable watermark:
+            # persisted commit_max records how far the log was known to
+            # extend under the durable log_view — clearing with a shorter
+            # head re-arms the seed-500285 truncation (a clean-voting
+            # (log_view, short-op) DVC out-ranking an intact log).
+            and self.op >= max(persisted, persisted_cm)
+            and self._verify_floor <= self.commit_min + 1
+            and not self.missing
+            and not self._header_gaps()
+        ):
+            self._log_suspect = False
+            self._debug(
+                "log_suspect_cleared", op=self.op, commit=self.commit_min
+            )
+
     def _primary_spoke(self, real: bool = True) -> None:
         """Record primary-liveness evidence: fold the silence gap into the
         EWMA (feeds the adaptive suspicion budget) and stand down any
@@ -989,6 +1070,12 @@ class VsrReplica(Replica):
         self._vc_timeout.reset(self._ticks)
         self._dvc_sent_for = None
         self._nacks.clear()
+        # A candidacy for an OLDER view is abandoned here: finishing it
+        # later (deferred-finish paths) would regress self.view — and
+        # durably, via _persist_view — leaving a phantom primary of a dead
+        # view.
+        self._new_view_pending = None
+        self._pending_finish = None
         self.pipeline.clear()
         self._persist_view()
         self.svc_from.setdefault(new_view, set()).add(self.replica)
@@ -1026,17 +1113,14 @@ class VsrReplica(Replica):
         (replica.zig send_do_view_change)."""
         if self.status != VIEW_CHANGE:
             return []
-        if getattr(self, "_log_suspect", False):
-            # Recovering-head (replica.zig status.recovering_head): a log
-            # with amputation evidence neither counts toward the DVC
-            # quorum nor donates its log — the view change completes from
-            # clean replicas, and we rejoin via their start_view.  The
-            # predicate is narrow (foreign slots / recovered headers with
-            # lost bodies beyond the head / persisted commit above the
-            # head): a benign torn tail leaves no recovered header (the
-            # headers ring is written last), so ordinary crash-restarts
-            # do not abstain.
-            return []
+        # Recovering-head replicas (replica.zig status.recovering_head)
+        # SEND their DVC too, flagged log_suspect: the receiver excludes
+        # it from the quorum and the donor set unless every replica is
+        # present (see on_do_view_change).  The suspicion predicate is
+        # narrow (foreign/corrupt slots, recovered headers beyond the
+        # head, persisted commit bounds above the head): a benign torn
+        # tail leaves no recovered header (the headers ring is written
+        # last), so ordinary crash-restarts are not suspect.
         if len(self.svc_from.get(self.view, ())) < self.quorum_view_change:
             return []
         return self._send_dvc()
@@ -1083,24 +1167,41 @@ class VsrReplica(Replica):
             headers = wire.unpack_headers(body)
         except ValueError:
             return out
-        if int(h["log_suspect"]):
-            return out  # recovering-head: neither quorum vote nor log donor
+        # Recovering-head (log_suspect) DVCs are stored but normally
+        # neither count toward the quorum nor donate: an amputated WAL
+        # cannot prove what it once acked, so counting its vote breaks the
+        # commit-quorum/view-change-quorum intersection argument (VOPR
+        # seed 500285: a suspect vote let a view change truncate an op a
+        # partitioned member had committed).  The way out of suspicion is
+        # repair (_maybe_clear_log_suspect).
+        #
+        # ONE exception (VOPR seed 400396): when EVERY replica's DVC is
+        # present, suspect votes are safe — every possible acker of every
+        # op is inside the quorum, and a committed op (quorum-journaled,
+        # synced writes survive crashes, the fault atlas forbids corrupting
+        # a quorum's copies) cannot have vanished from all of them — so the
+        # max-(log_view, op) log still contains all committed history.
+        # Without this valve an f=0 pair whose both logs are suspect
+        # escalates views forever.
         self.dvc_from.setdefault(view, {})[int(h["replica"])] = {
             "log_view": int(h["log_view"]),
             "op": int(h["op"]),
             "commit": int(h["commit"]),
             "headers": headers,
+            "suspect": bool(int(h["log_suspect"])),
         }
-        # Our own state counts toward the DVC quorum — unless recovering-
-        # head (see _maybe_send_dvc): then only clean logs may select.
-        if not getattr(self, "_log_suspect", False):
-            self.dvc_from[view][self.replica] = {
-                "log_view": self.log_view,
-                "op": self.op,
-                "commit": self.commit_min,
-                "headers": self._suffix_headers(),
-            }
-        if len(self.dvc_from[view]) >= self.quorum_view_change:
+        self.dvc_from[view][self.replica] = {
+            "log_view": self.log_view,
+            "op": self.op,
+            "commit": self.commit_min,
+            "headers": self._suffix_headers(),
+            "suspect": bool(getattr(self, "_log_suspect", False)),
+        }
+        dvcs = self.dvc_from[view]
+        clean_n = sum(1 for d in dvcs.values() if not d.get("suspect"))
+        if clean_n >= self.quorum_view_change or (
+            len(dvcs) == self.replica_count
+        ):
             out.extend(self._install_canonical_log(view))
         return out
 
@@ -1108,7 +1209,18 @@ class VsrReplica(Replica):
         """New primary: adopt the log of the DVC with max (log_view, op)
         (replica.zig primary_set_log_from_do_view_change_messages)."""
         dvcs = self.dvc_from[view]
-        canonical = max(dvcs.values(), key=lambda d: (d["log_view"], d["op"]))
+        clean = {r: d for r, d in dvcs.items() if not d.get("suspect")}
+        if len(clean) >= self.quorum_view_change:
+            # Normal case: only clean logs select (see on_do_view_change).
+            donors = clean
+        else:
+            # All-replicas-present fallback: every acker is in the quorum,
+            # so the best log over ALL DVCs still holds committed history.
+            assert len(dvcs) == self.replica_count
+            donors = dvcs
+        canonical = max(
+            donors.values(), key=lambda d: (d["log_view"], d["op"])
+        )
         self.commit_max = max(
             [d["commit"] for d in dvcs.values()] + [self.commit_max]
         )
@@ -1128,10 +1240,21 @@ class VsrReplica(Replica):
             # syncing replica receives an SVC.
             return self._start_full_sync()
         by_op = {int(ch["op"]): ch for ch in canonical["headers"]}
-        self._install_headers(target_op, by_op)
+        # Same below-window suspicion as the backup's SV install: the new
+        # primary's OWN uncommitted headers under the canonical window may
+        # be forks of a discarded view.
+        self._install_headers(
+            target_op, by_op, suspect_below=view > self.log_view
+        )
 
         if self.missing:
             # Stay in view_change; repair bodies then finish (tick retries).
+            if self._debug_file is not None:
+                self._debug(
+                    "vc_missing_bodies", new_view=view,
+                    missing=sorted(self.missing)[:12],
+                    commit_max=self.commit_max, target=int(target_op),
+                )
             self._new_view_pending = view
             out.extend(self._request_missing(dvcs))
             return out
@@ -1141,11 +1264,32 @@ class VsrReplica(Replica):
         read = self.journal.read_prepare(op)
         return read is not None and wire.header_checksum(read[0]) == checksum
 
-    def _install_headers(self, target_op: int, by_op: Dict[int, np.ndarray]) -> None:
+    def _install_headers(
+        self, target_op: int, by_op: Dict[int, np.ndarray],
+        suspect_below: bool = False,
+    ) -> None:
         """Adopt a canonical log suffix (shared by the new primary's DVC
         install and the backup's start_view install): truncate uncommitted
         forks beyond ``target_op``, install the canonical headers, journal
-        any matching stashed bodies, and record missing bodies for repair."""
+        any matching stashed bodies, and record missing bodies for repair.
+
+        ``suspect_below``: the caller is adopting a log for an ADVANCED
+        log_view.  Local uncommitted headers BELOW the installed window
+        were certified under the old log and may be forks the view change
+        discarded — a stale never-quorumed prepare there chains perfectly
+        onto the replica's own old suffix and would commit as soon as
+        commit_max catches up (VOPR seed 401021: replica joins view 8 with
+        a view-0 register at op 4 that view 1 replaced with a transfer,
+        SV window starts above 4, stale register commits => diverging
+        op 4 across the cluster).  Raising the verification floor to the
+        window start makes the range suspect; the chain walk
+        (_extend_verification) evicts non-linking headers and repair
+        refetches the canonical ones."""
+        # Local invariant: NEVER truncate below our own committed prefix —
+        # those ops are executed state; deleting their headers and letting
+        # the new view refill the slots would re-commit different ops over
+        # an already-applied ledger (nondeterministic divergence).
+        target_op = max(target_op, self.commit_min)
         if self.op > target_op:
             for op in [o for o in self.headers if o > target_op]:
                 del self.headers[op]
@@ -1193,9 +1337,12 @@ class VsrReplica(Replica):
             w = target_op
             while w - 1 in by_op and w - 1 > self.commit_min:
                 w -= 1
-            self._verify_floor = min(
-                self._verify_floor, max(self.commit_min + 1, w)
-            )
+            w = max(self.commit_min + 1, w)
+            self._verify_floor = min(self._verify_floor, w)
+            if suspect_below and w > self.commit_min + 1:
+                # Log ADVANCED and the window does not reach the commit
+                # floor: the uncovered range is suspect (see docstring).
+                self._verify_floor = max(self._verify_floor, w)
         self._verify_floor = min(self._verify_floor, self.op + 1)
 
     def _request_missing(self, dvcs=None) -> List[Msg]:
@@ -1283,6 +1430,7 @@ class VsrReplica(Replica):
         view = int(h["view"])
         if view < self.view or (view == self.view and self.status == NORMAL):
             return []
+        log_advanced = view > getattr(self, "log_view", 0)
         if self.sync_target is not None:
             # Keep fetching; a view change only moves where chunks come from.
             if view > self.view:
@@ -1320,15 +1468,23 @@ class VsrReplica(Replica):
         self._debug("view_normal_backup", new_view=int(h["view"]))
         # WAL bound: adopt at most a ring's worth beyond our checkpoint;
         # commits advance the checkpoint and repair fetches the rest.
-        self._install_headers(min(target_op, self.op_prepare_max), by_op)
+        self._install_headers(
+            min(target_op, self.op_prepare_max), by_op,
+            suspect_below=log_advanced,
+        )
         # The canonical log just replaced whatever a misdirected write may
         # have clobbered: our log is certified again.
         self._log_suspect = False
 
-        # Ack the uncommitted suffix so the new primary can commit it.
+        # Ack the uncommitted suffix so the new primary can commit it —
+        # but never a SUSPECT header (below the verification floor): it may
+        # be a fork of a discarded view, and an ack would vouch for it.
         for op in range(self.commit_min + 1, self.op + 1):
             hh = self.headers.get(op)
-            if hh is not None and op not in self.missing:
+            if (
+                hh is not None and op not in self.missing
+                and op >= self._verify_floor
+            ):
                 self._append_ok(out, hh)
         out.extend(self._request_missing())
         self._commit_journal(out)
@@ -1419,7 +1575,17 @@ class VsrReplica(Replica):
         if self.missing.get(op) != checksum:
             return []
         self._nacks.setdefault(op, set()).add(int(h["replica"]))
-        if self.status != VIEW_CHANGE or self._new_view_pending is None:
+        if not (
+            (self.status == VIEW_CHANGE and self._new_view_pending is not None)
+            # A recovering-head replica repairing ITSELF may also truncate
+            # at a nack quorum: the proof (no commit quorum was ever
+            # possible for this op) is role-independent, and truncating the
+            # unrepairable suffix is its only path out of suspicion
+            # (_maybe_clear_log_suspect) — without it, a cluster whose
+            # every voter is suspect escalates views forever (VOPR seed
+            # 400396).
+            or (getattr(self, "_log_suspect", False) and op > self.commit_min)
+        ):
             return []
         # Nack threshold: with n - q_replication + 1 provably-never-had
         # replicas (counting ourselves), fewer than q_replication can ever
@@ -1681,15 +1847,21 @@ class VsrReplica(Replica):
         abdication, hostile-manifest restart — so sync-entry invariants
         (abandoning a pending view finish, resetting the fetch buffer) hold
         on every path."""
-        # A half-finished view change must not be resumable after the sync
-        # installs: _finish_view_change(stale view) would regress self.view.
-        self._new_view_pending = None
-        self.status = SYNCING
-        self.sync_target = {"checkpoint_op": 0, "total": None}
-        self.sync_buffer = bytearray()
         self._sync_peer = self._next_peer(
             self._sync_peer if self._sync_peer is not None else self.replica
         )
+        return self._enter_sync(0)
+
+    def _enter_sync(self, checkpoint_op: int) -> List[Msg]:
+        """The ONLY sync-entry point (targeted or latest): sync-entry
+        invariants hold on every path — notably abandoning any pending view
+        finish, or _finish_view_change(stale view) would regress self.view
+        after the sync installs."""
+        self._new_view_pending = None
+        self._pending_finish = None
+        self.status = SYNCING
+        self.sync_target = {"checkpoint_op": checkpoint_op, "total": None}
+        self.sync_buffer = bytearray()
         self._last_sync_req = self._ticks
         return self._request_sync_chunk()
 
@@ -1697,16 +1869,39 @@ class VsrReplica(Replica):
         """If the primary's checkpoint is beyond our journal *head*, our WAL
         no longer overlaps the cluster's and ordinary repair cannot catch us
         up: fetch the checkpoint snapshot.  (A backup merely lagging in
-        commits — head >= the checkpoint — repairs via the WAL instead.)"""
-        if primary_checkpoint_op <= self.op:
-            return []
+        commits — head >= the checkpoint — repairs via the WAL instead.)
+
+        Second trigger, commit-floor starvation: a replica whose NEXT
+        commit (commit_min+1) sits at or below the cluster's checkpoint and
+        is header-gapped, missing, or under the verification floor may be
+        permanently unrepairable — peers prune headers below their
+        checkpoint (_prune_headers) and recycle those WAL slots, so chain
+        repair can have nobody left to answer (VOPR seed 400816: a
+        restarted replica with a damaged WAL prefix wedges at commit 0
+        while the cluster checkpoints past it).  Repair gets a grace of
+        _FLOOR_STALL_SYNC heartbeats; genuine progress resets the
+        counter."""
         if self.sync_target is not None:
             return []
-        self.status = SYNCING
-        self.sync_target = {"checkpoint_op": primary_checkpoint_op, "total": None}
-        self.sync_buffer = bytearray()
-        self._last_sync_req = self._ticks
-        return self._request_sync_chunk()
+        nxt = self.commit_min + 1
+        if primary_checkpoint_op >= nxt and primary_checkpoint_op > 0 and (
+            self.headers.get(nxt) is None
+            or nxt in self.missing
+            or nxt < self._verify_floor
+        ):
+            self._floor_stall += 1
+            if self._floor_stall >= _FLOOR_STALL_SYNC:
+                self._floor_stall = 0
+                self._debug(
+                    "floor_stall_sync", commit_min=self.commit_min,
+                    cluster_checkpoint=primary_checkpoint_op,
+                )
+                return self._enter_sync(primary_checkpoint_op)
+        else:
+            self._floor_stall = 0
+        if primary_checkpoint_op <= self.op:
+            return []
+        return self._enter_sync(primary_checkpoint_op)
 
     def _request_sync_chunk(self) -> List[Msg]:
         req = self._hdr(
@@ -2002,6 +2197,9 @@ class VsrReplica(Replica):
                     "tick_starved", gap_ms=round((now - last) / 1e6, 1)
                 )
 
+        # A repaired recovering-head log may rejoin view changes.
+        self._maybe_clear_log_suspect()
+
         # Deferred view-change completion after repairs.
         if getattr(self, "_pending_finish", None) is not None:
             view = self._pending_finish
@@ -2056,6 +2254,31 @@ class VsrReplica(Replica):
             return out
 
         if self.status == NORMAL and self.is_primary:
+            # Commit-stall abdication: a primary that journals prepares but
+            # cannot EXECUTE them (e.g. restarted with an unrepairable WAL
+            # prefix whose headers the cluster has pruned — VOPR seed
+            # 400816) wedges the whole cluster while looking alive: its
+            # prepares keep resetting every backup's liveness clock.  If
+            # commit_min hasn't advanced for PRIMARY_ABDICATE ticks while
+            # committable work exists, step down — the next view's primary
+            # commits from its intact chain, and this replica's floor-
+            # stall sync (see _maybe_start_sync) heals it as a backup.
+            if self.commit_max > self.commit_min or self.pipeline:
+                if self.commit_min == self._abdicate_commit_mark:
+                    self._abdicate_ticks += 1
+                else:
+                    self._abdicate_commit_mark = self.commit_min
+                    self._abdicate_ticks = 0
+                if self._abdicate_ticks >= PRIMARY_ABDICATE:
+                    self._abdicate_ticks = 0
+                    self._debug(
+                        "primary_abdicate", commit_min=self.commit_min,
+                        commit_max=self.commit_max,
+                    )
+                    out.extend(self._begin_view_change(self.view + 1))
+                    return out
+            else:
+                self._abdicate_ticks = 0
             if self._ticks - self._last_commit_sent >= COMMIT_HEARTBEAT:
                 self._last_commit_sent = self._ticks
                 commit = self._hdr(

@@ -57,6 +57,16 @@ class Recovery:
     # seed 31000: doing so let a VC quorum that excluded the op's other
     # holder truncate committed history).
     foreign_slots: List[int] = dataclasses.field(default_factory=list)
+    # Slots whose content is NONZERO yet undecodable in BOTH rings: a
+    # virgin slot is all-zero, so this is an inhabited slot destroyed by
+    # corruption — the op that lived there may have been ACKED by this
+    # replica, and nothing recoverable says which op it was.  Same
+    # amputation-evidence class as foreign_slots (a replica must not vouch
+    # for its log until repaired): without it, a read-faulted committed
+    # slot recovers as "empty", the replica claims a clean-but-shorter log,
+    # and a view-change quorum of such replicas truncates committed history
+    # (VOPR seed 500285).
+    corrupt_slots: List[int] = dataclasses.field(default_factory=list)
 
 
 class Journal:
@@ -142,6 +152,10 @@ class Journal:
         lay = self.storage.layout
         base = lay.wal_prepares_offset + slot * self.config.message_size_max
         head = self.storage.read(base, self.config.header_size)
+        # Recovery's corrupt-slot evidence needs "were the raw bytes
+        # nonzero" without a second pread per slot (the startup scan is
+        # sized-read-optimized); stash it instead of widening the return.
+        self._last_head_nonzero = any(head)
         try:
             h, command = wire.decode_header(head)
         except ValueError:
@@ -210,6 +224,7 @@ class Journal:
         entries: Dict[int, RecoveredEntry] = {}
         faulty: List[int] = []
         foreign: List[int] = []
+        corrupt: List[int] = []
         repaired = 0
 
         for slot in range(self.slot_count):
@@ -256,11 +271,19 @@ class Journal:
                 if self.slot(op) == slot:
                     entries[op] = RecoveredEntry(op=op, header=ring_hdr, body=None)
                     faulty.append(slot)
-            # else: empty slot.
+            elif slot not in foreign:
+                # Neither ring decodes.  All-zero = virgin; NONZERO bytes
+                # mean an inhabited slot destroyed by corruption — possibly
+                # an op this replica acked (see Recovery.corrupt_slots).
+                # _read_slot(slot) above already read the prepare head;
+                # its nonzero-ness was stashed to avoid a second pread.
+                if any(hbuf) or getattr(self, "_last_head_nonzero", False):
+                    corrupt.append(slot)
 
         if repaired:
             self.storage.sync()
         return Recovery(
             entries=entries, faulty_slots=faulty, repaired_headers=repaired,
             foreign_slots=sorted(set(foreign)),
+            corrupt_slots=corrupt,
         )
