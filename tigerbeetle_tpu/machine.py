@@ -32,6 +32,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -62,6 +63,24 @@ U64_MAX = (1 << 64) - 1
 QUERY_ROWS_MAX = ((1 << 20) - 256) // 128
 
 
+def _group_fast_dispatch_impl(ledger, stacked, counts, timestamps):
+    """Scan the fast commit kernel over GROUP_K stacked batches: one device
+    dispatch, batch order preserved, ledger threaded through the carry
+    (see TpuStateMachine.commit_group_fast)."""
+
+    def step(led, xs):
+        soa, cnt, ts = xs
+        led, codes = sm.create_transfers_impl(led, soa, cnt, ts)
+        return led, codes
+
+    return jax.lax.scan(step, ledger, (stacked, counts, timestamps))
+
+
+_group_fast_dispatch = jax.jit(
+    _group_fast_dispatch_impl, donate_argnames=("ledger",)
+)
+
+
 class TpuStateMachine:
     def __init__(
         self,
@@ -76,6 +95,11 @@ class TpuStateMachine:
         self.config = cfg
         self.batch_lanes = batch_lanes
         self.force_sequential = force_sequential
+        # Grouped device commit (commit_group_fast).  None = auto: enabled
+        # on the TPU backend, where an empty scan step is us-scale; on
+        # XLA-CPU each step pays table-sized temporaries, so per-batch
+        # dispatch is cheaper there.  Tests force True to pin the path.
+        self._group_device_commit: Optional[bool] = None
         # Host data-plane mode (host_engine.py): commits run in the native
         # engine over a numpy mirror; the device ledger is materialized
         # lazily for queries/checkpoints/digests.  The mirror is the
@@ -301,6 +325,18 @@ class TpuStateMachine:
                 self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
             )
             np.asarray(codes_f)
+            if self.group_device_commit:
+                # The grouped dispatch is a distinct program (scan over
+                # GROUP_K); a client must never pay its compile mid-group.
+                stacked = {
+                    key: jnp.stack([v] * self.GROUP_K)
+                    for key, v in soa_t.items()
+                }
+                zeros = jnp.zeros((self.GROUP_K,), jnp.uint64)
+                self.ledger, codes_g = _group_fast_dispatch(
+                    self.ledger, stacked, zeros, zeros + 1
+                )
+                np.asarray(codes_g)
         np.asarray(codes_a), np.asarray(codes_t), int(kflags)
 
     # -- prepare (state_machine.zig:503-512) --------------------------------
@@ -522,6 +558,98 @@ class TpuStateMachine:
         if bool(batch["amount_hi"].any()):
             return False
         return True
+
+    # -- grouped device commit ----------------------------------------------
+
+    @property
+    def group_device_commit(self) -> bool:
+        if self._group_device_commit is None:
+            import os
+
+            env = os.environ.get("TB_GROUP_COMMIT")
+            self._group_device_commit = (
+                env == "1" if env in ("0", "1")
+                else jax.default_backend() == "tpu"
+            )
+        return self._group_device_commit
+
+    @group_device_commit.setter
+    def group_device_commit(self, value: bool) -> None:
+        self._group_device_commit = value
+
+    # Fixed scan length for the grouped dispatch: ONE jit variant (warmed at
+    # startup), groups pad with zero-count batches (the kernel applies
+    # nothing for count=0).  An empty step costs ~the kernel's launch-free
+    # body (us-scale on TPU); per-batch dispatch through a remote-TPU
+    # tunnel costs ~60 ms, so amortizing GROUP_K batches per dispatch is
+    # the difference between the device serving path being RTT-bound and
+    # kernel-bound.
+    GROUP_K = 32
+
+    def commit_group_fast(
+        self, batches: List[np.ndarray], timestamps: List[int]
+    ) -> Optional[List[List[Tuple[int, int]]]]:
+        """Commit a RUN of fast-path-eligible create_transfers batches in
+        ONE device dispatch (lax.scan over the stacked batches) with ONE
+        device->host codes transfer.
+
+        Returns per-batch results index-aligned with ``batches``, or None
+        when the run is not groupable — caller falls back to per-batch
+        commits.  Scan order == batch order, and each batch carries its
+        own already-assigned prepare timestamp, so results are
+        bit-identical to committing the run batch by batch."""
+        if (
+            not self.group_device_commit
+            or self._engine is not None
+            or self.force_sequential
+            or not (2 <= len(batches) <= self.GROUP_K)
+        ):
+            return None
+        counts = [len(b) for b in batches]
+        if any(c == 0 or c > self.batch_lanes for c in counts):
+            return None
+        # Eligibility is ORDER-dependent (the balance bound grows per
+        # batch): note bounds exactly as the per-batch path would.  On a
+        # mid-run refusal the per-batch fallback re-notes the prefix —
+        # harmless, the bound is an over-approximation by contract.
+        for b in batches:
+            self._note_balance_bound(b)
+            if not self._fast_path_ok(b):
+                return None
+        if timestamps[-1] > self.prepare_timestamp:
+            # Replay/backup parity with commit_batch's clock catch-up.
+            self.prepare_timestamp = timestamps[-1]
+        self._grow_if_needed(transfers=sum(counts))
+        k = len(batches)
+        soas = [self._pad_soa(b) for b in batches]
+        pad_soa = self._pad_soa(np.zeros(0, dtype=types.TRANSFER_DTYPE))
+        stacked = {
+            key: jnp.stack(
+                [s[key] for s in soas]
+                + [pad_soa[key]] * (self.GROUP_K - k)
+            )
+            for key in pad_soa
+        }
+        cnt = jnp.asarray(
+            counts + [0] * (self.GROUP_K - k), dtype=jnp.uint64
+        )
+        tss = jnp.asarray(
+            timestamps + [timestamps[-1]] * (self.GROUP_K - k),
+            dtype=jnp.uint64,
+        )
+        self.ledger, codes = _group_fast_dispatch(
+            self.ledger, stacked, cnt, tss
+        )
+        codes = np.asarray(codes)  # ONE D2H for the whole group
+        if bool(np.asarray(self.ledger.transfers.probe_overflow)):
+            raise RuntimeError("transfers probe overflow during fast insert")
+        out = []
+        for j in range(k):
+            self._transfers_bound += counts[j]
+            self._index_append(soas[j], codes[j], counts[j])
+            out.append(self._compress(codes[j], counts[j]))
+            self._update_commit_timestamp(codes[j], counts[j], timestamps[j])
+        return out
 
     def _commit_fast(
         self, batch: np.ndarray, timestamp: int, count: int

@@ -63,7 +63,7 @@ Structure (round-3 refactor for the sharded path, parallel/sharded.py):
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -554,7 +554,7 @@ def _kernel_core(
     count: jax.Array,
     timestamp: jax.Array,
     max_passes: int = _MAX_PASSES,
-    static_trip: bool = None,
+    static_trip: Optional[bool] = None,
 ) -> ApplyPlan:
     """The pure batch semantics: no table access, replicable on a mesh."""
     n = batch["id_lo"].shape[0]
@@ -897,9 +897,11 @@ def _kernel_core(
         k = k + jnp.where(ever_stable, jnp.int32(0), jnp.int32(1))
         return (k, ever_stable | stable, ok_n, code_n, amt_n, aux_n)
 
-    if static_trip if static_trip is not None else (
-        jax.default_backend() == "tpu"
-    ):
+    use_scan = (
+        static_trip if static_trip is not None
+        else jax.default_backend() == "tpu"
+    )
+    if use_scan:
         (k_passes, converged, ok, codes, amount, aux), _ = jax.lax.scan(
             lambda c, _: (step_pass(c), None), carry0, None,
             length=max_passes,
@@ -1017,7 +1019,7 @@ def create_transfers_full_impl(
     max_passes: int = _MAX_PASSES,
     has_postvoid: bool = True,
     has_history: bool = True,
-    static_trip: bool = None,
+    static_trip: Optional[bool] = None,
 ) -> Tuple[Ledger, jax.Array, jax.Array]:
     """Returns (ledger', codes uint32[N], flags uint32 scalar).
 
