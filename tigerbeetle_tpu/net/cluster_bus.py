@@ -165,7 +165,7 @@ class ClusterServer:
         delay_min = self.process.connection_delay_min_ms / 1000.0
         delay_max = self.process.connection_delay_max_ms / 1000.0
         backoff = delay_min
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         while True:
             host, port = self.addresses[j]
             try:
@@ -320,7 +320,7 @@ class ClusterServer:
             # replica memory unboundedly.  The connection stays up.
             if w.transport.get_write_buffer_size() > self.SEND_BUFFER_MAX:
                 self.dropped_sends += 1
-                now = asyncio.get_event_loop().time()
+                now = asyncio.get_running_loop().time()
                 if now - self._last_drop_log > 1.0:  # throttled visibility
                     self._last_drop_log = now
                     log.warning(
@@ -356,7 +356,7 @@ class ClusterServer:
             and self.replica.commit_backlog
         ):
             return
-        if asyncio.get_event_loop().time() < self._pump_backoff_until:
+        if asyncio.get_running_loop().time() < self._pump_backoff_until:
             return  # last pump crashed; don't respawn into a retry storm
         self._pump_task = asyncio.ensure_future(self._commit_pump())
 
@@ -377,7 +377,7 @@ class ClusterServer:
             # traceback-per-tick storm; back off instead — commits stay
             # wedged either way, but the replica remains diagnosable.
             self._pump_backoff_until = (
-                asyncio.get_event_loop().time() + 5.0
+                asyncio.get_running_loop().time() + 5.0
             )
             log.exception("commit pump failure (backing off 5s)")
         finally:

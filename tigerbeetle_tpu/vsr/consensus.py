@@ -141,6 +141,7 @@ class VsrReplica(Replica):
         self.status = RECOVERING
         self.log_view = 0
         self.commit_max = 0
+        self._log_adopted_op = 0
         self.prng = random.Random(seed)
 
         # Journaled prepare headers by op for the live window (chain checks,
@@ -313,6 +314,9 @@ class VsrReplica(Replica):
     def _post_open(self, recovery) -> None:
         self.commit_max = self.commit_min
         self.log_view = getattr(self._sb_state, "log_view", self.view)
+        # Adoption watermark rides through restarts: _persist_view rewrites
+        # it verbatim until the next log_view advance replaces it.
+        self._log_adopted_op = getattr(self._sb_state, "log_adopted_op", 0)
         self._load_chain(recovery)
         self._init_clock()
         if self.replica_count == 1:
@@ -374,15 +378,19 @@ class VsrReplica(Replica):
         persisted_commit = getattr(self._sb_state, "commit_min", 0)
         # The DVC invariant behind (log_view, op) canonical selection: a
         # durable log_view asserts the journal holds that view's canonical
-        # log through self.op.  The durable commit_max (written by
-        # _persist_view during the adoption) records how far that log was
-        # KNOWN to extend — a recovered head below it means the adopted
+        # log through self.op.  The durable log_adopted_op (written only
+        # when log_view advances) records how far that log was KNOWN to
+        # extend at adoption — a recovered head below it means the adopted
         # suffix died with the crash (bodies never journaled), and a DVC
         # claiming (log_view, short-op) would OUT-RANK an intact older-view
         # log and truncate committed history (VOPR seed 500285: a restarted
         # backup's (log_view=2, op=22) beat the intact (log_view=0, op=29)
         # log and ops 24-28, committed, were refilled with new requests).
-        persisted_cm = getattr(self._sb_state, "commit_max", 0)
+        # NOT commit_max: that folds in heartbeat-learned cluster commits a
+        # lagging-but-intact backup's journal never held, and using it here
+        # falsely marked such backups suspect after a crash — wedging view
+        # changes when the primary also died (ADVICE r4 medium).
+        persisted_adopted = getattr(self._sb_state, "log_adopted_op", 0)
         # The slot of op+1 is the ONE slot a write could have been mid-
         # flight to at crash time (prepares journal serially, synced per
         # write): nonzero-undecodable content THERE is an ordinary torn
@@ -397,7 +405,7 @@ class VsrReplica(Replica):
             or bool(corrupt_slots)
             or beyond_head
             or persisted_commit > self.op
-            or persisted_cm > self.op
+            or persisted_adopted > self.op
         )
         self._debug(
             "recovered", op=self.op, commit_min=self.commit_min,
@@ -430,6 +438,7 @@ class VsrReplica(Replica):
             self._sb_state, view=self.view, log_view=self.log_view,
             commit_min=max(self._sb_state.commit_min, self.commit_min),
             commit_max=max(self._sb_state.commit_max, self.commit_max),
+            log_adopted_op=getattr(self, "_log_adopted_op", 0),
         )
         # Through the single merge-point: a concurrent background
         # checkpoint (async_checkpoint) must not be reverted or raced.
@@ -1019,15 +1028,17 @@ class VsrReplica(Replica):
         if not getattr(self, "_log_suspect", False):
             return
         persisted = getattr(self._sb_state, "commit_min", 0)
-        persisted_cm = getattr(self._sb_state, "commit_max", 0)
+        persisted_adopted = getattr(self._sb_state, "log_adopted_op", 0)
         if (
             self.commit_min >= persisted
             # The head must be restored through EVERY durable watermark:
-            # persisted commit_max records how far the log was known to
-            # extend under the durable log_view — clearing with a shorter
-            # head re-arms the seed-500285 truncation (a clean-voting
-            # (log_view, short-op) DVC out-ranking an intact log).
-            and self.op >= max(persisted, persisted_cm)
+            # log_adopted_op records how far the durable log_view's log was
+            # known to extend at adoption — clearing with a shorter head
+            # re-arms the seed-500285 truncation (a clean-voting
+            # (log_view, short-op) DVC out-ranking an intact log).  The
+            # repair machinery CAN drive op there (the headers exist
+            # cluster-wide); heartbeat-learned commit_max it could not.
+            and self.op >= max(persisted, persisted_adopted)
             and self._verify_floor <= self.commit_min + 1
             and not self.missing
             and not self._header_gaps()
@@ -1391,6 +1402,10 @@ class VsrReplica(Replica):
         self._new_view_pending = None
         self._debug("view_normal_primary", new_view=view)
         self._log_suspect = False  # the canonical quorum log is ours now
+        # Adoption watermark: every canonical body IS journaled here (the
+        # gap check above), so the new log_view's log provably extends to
+        # self.op — the one moment this fact is cheap and certain.
+        self._log_adopted_op = self.op
         self._persist_view()
         self.svc_from.pop(view, None)
         self.dvc_from.pop(view, None)
@@ -1451,6 +1466,12 @@ class VsrReplica(Replica):
         self.pipeline.clear()
         self._dvc_sent_for = None
         self.svc_from = {v: s for v, s in self.svc_from.items() if v > view}
+        # Adoption watermark: the SV header certifies the new log_view's
+        # canonical log through target_op.  Persisting it BEFORE our bodies
+        # land is deliberate — a crash mid-install must restart suspect
+        # (presenting (log_view, short-op) would win canonical selection
+        # and truncate committed history: seed 500285).
+        self._log_adopted_op = target_op
         self._persist_view()
 
         # If the cluster's checkpoint is beyond our journal head, peers no
@@ -2090,6 +2111,9 @@ class VsrReplica(Replica):
         self.parent_checksum = 0
         self._verify_floor = op + 1  # nothing above the snapshot known yet
         self._log_suspect = False    # snapshot replaced the clobbered WAL
+        # The snapshot (committed state through op) IS our log now; the
+        # old adoption watermark referred to a WAL the sync replaced.
+        self._log_adopted_op = op
         manifest_checksum = self.forest.adopt_base(
             ledger, meta, op, target["file_checksum"]
         )
@@ -2101,6 +2125,7 @@ class VsrReplica(Replica):
             log_view=self.log_view,
             commit_min=self.commit_min,
             commit_max=self.commit_max,
+            log_adopted_op=self._log_adopted_op,
             op_checkpoint=op,
             checkpoint_file_checksum=target["file_checksum"],
             ledger_digest=self.machine.digest(),

@@ -141,21 +141,25 @@ class Journal:
     # -- reads ---------------------------------------------------------------
 
     def _read_slot(
-        self, slot: int, expect_op: Optional[int] = None
+        self, slot: int, expect_op: Optional[int] = None,
+        head_nonzero_out: Optional[list] = None,
     ) -> Optional[Tuple[np.ndarray, bytes]]:
         """Read+verify whatever prepare the slot holds — embedded header
         first, then exactly the message's ``size`` bytes (a full-slot read
         would drag message_size_max (1 MiB default) through the page cache
         per call; this path runs once per committed op on backups).
         ``expect_op`` bails right after the header decode when the slot
-        holds a different (wrapped) op — no body IO or checksum work."""
+        holds a different (wrapped) op — no body IO or checksum work.
+        ``head_nonzero_out``: recovery's corrupt-slot evidence needs "were
+        the raw head bytes nonzero" without a second pread per slot; when a
+        list is passed, the flag is appended to it (an out-param, NOT
+        instance state — stale stashed state from an interleaved read would
+        misclassify virgin slots as corrupt)."""
         lay = self.storage.layout
         base = lay.wal_prepares_offset + slot * self.config.message_size_max
         head = self.storage.read(base, self.config.header_size)
-        # Recovery's corrupt-slot evidence needs "were the raw bytes
-        # nonzero" without a second pread per slot (the startup scan is
-        # sized-read-optimized); stash it instead of widening the return.
-        self._last_head_nonzero = any(head)
+        if head_nonzero_out is not None:
+            head_nonzero_out.append(any(head))
         try:
             h, command = wire.decode_header(head)
         except ValueError:
@@ -246,7 +250,8 @@ class Journal:
             # its full message_size_max forces the whole prepares ring
             # (1 GiB at production config) through the page cache on every
             # open — ~12 s of replica startup for a mostly-virgin ring.
-            prepare = self._read_slot(slot)
+            head_nonzero: list = []
+            prepare = self._read_slot(slot, head_nonzero_out=head_nonzero)
 
             if prepare is not None and self.slot(int(prepare[0]["op"])) != slot:
                 foreign.append(slot)  # misdirected-write evidence
@@ -276,8 +281,9 @@ class Journal:
                 # mean an inhabited slot destroyed by corruption — possibly
                 # an op this replica acked (see Recovery.corrupt_slots).
                 # _read_slot(slot) above already read the prepare head;
-                # its nonzero-ness was stashed to avoid a second pread.
-                if any(hbuf) or getattr(self, "_last_head_nonzero", False):
+                # its nonzero-ness rode back via the out-param (no second
+                # pread, no hidden instance-state coupling).
+                if any(hbuf) or (head_nonzero and head_nonzero[0]):
                     corrupt.append(slot)
 
         if repaired:

@@ -919,6 +919,7 @@ class Replica:
             log_view=getattr(self, "log_view", self.view),
             commit_min=self.commit_min,
             commit_max=self.op,
+            log_adopted_op=getattr(self, "_log_adopted_op", 0),
             ledger_digest=m.digest(),
             prepare_timestamp=m.prepare_timestamp,
             commit_timestamp=m.commit_timestamp,
@@ -950,6 +951,7 @@ class Replica:
             log_view=fields["log_view"],
             commit_min=op,
             commit_max=fields["commit_max"],
+            log_adopted_op=fields["log_adopted_op"],
             op_checkpoint=op,
             checkpoint_file_checksum=file_checksum,
             ledger_digest=fields["ledger_digest"],
@@ -988,12 +990,29 @@ class Replica:
                     prepare_timestamp=cur.prepare_timestamp,
                     commit_timestamp=cur.commit_timestamp,
                 )
+            # log_adopted_op travels WITH its writer's (log_view,
+            # op_checkpoint): a later adoption may legitimately certify a
+            # SHORTER canonical log (view-change truncation of an
+            # uncommitted suffix), and a state sync legitimately LOWERS the
+            # watermark to the synced checkpoint op at the same log_view —
+            # so the lexicographically newer writer wins; max() would let a
+            # pre-sync SV target_op survive the sync durably and wedge
+            # every post-sync restart log_suspect.
+            skey = (state.log_view, state.op_checkpoint)
+            ckey = (cur.log_view, cur.op_checkpoint)
+            if skey > ckey:
+                adopted = state.log_adopted_op
+            elif skey < ckey:
+                adopted = cur.log_adopted_op
+            else:
+                adopted = max(state.log_adopted_op, cur.log_adopted_op)
             state = dataclasses.replace(
                 state,
                 view=max(state.view, cur.view),
                 log_view=max(state.log_view, cur.log_view),
                 commit_min=max(state.commit_min, cur.commit_min),
                 commit_max=max(state.commit_max, cur.commit_max),
+                log_adopted_op=adopted,
             )
             self.superblock.checkpoint(state)
             return state

@@ -23,7 +23,7 @@ from .checksum import checksum
 from .storage import SUPERBLOCK_COPIES, SUPERBLOCK_COPY_SIZE, Storage
 
 MAGIC = 0x7462_7470_7573_6201  # "tbtpusb\x01"
-VERSION = 1
+VERSION = 2  # v2: +log_adopted_op amputation watermark (round 5)
 
 # Quorum for reading: with 4 copies, require 2 matching (superblock_quorums).
 QUORUM_READ = 2
@@ -47,6 +47,13 @@ SUPERBLOCK_DTYPE = np.dtype(
         ("log_view", "<u4"),
         ("commit_min", "<u8"),           # == checkpoint op
         ("commit_max", "<u8"),
+        # How far the canonical log of the durable log_view was KNOWN to
+        # extend at adoption time (written only when log_view advances) —
+        # the amputation-evidence watermark.  Distinct from commit_max,
+        # which folds in heartbeat-learned cluster knowledge a lagging
+        # backup's journal never held (ADVICE r4: using commit_max there
+        # falsely marked intact lagging backups log_suspect).
+        ("log_adopted_op", "<u8"),
         ("op_checkpoint", "<u8"),
         ("checkpoint_file_checksum_lo", "<u8"),
         ("checkpoint_file_checksum_hi", "<u8"),
@@ -57,7 +64,7 @@ SUPERBLOCK_DTYPE = np.dtype(
         # manifest refs).  Zero => legacy full-snapshot checkpoint.
         ("manifest_checksum_lo", "<u8"),
         ("manifest_checksum_hi", "<u8"),
-        ("reserved", "V3936"),
+        ("reserved", "V3928"),
     ]
 )
 assert SUPERBLOCK_DTYPE.itemsize == SUPERBLOCK_COPY_SIZE, SUPERBLOCK_DTYPE.itemsize
@@ -77,6 +84,7 @@ class SuperBlockState:
     log_view: int = 0
     commit_min: int = 0
     commit_max: int = 0
+    log_adopted_op: int = 0
     op_checkpoint: int = 0
     checkpoint_file_checksum: int = 0
     ledger_digest: int = 0
@@ -100,6 +108,7 @@ def _encode_copy(state: SuperBlockState, copy: int) -> bytes:
     rec["log_view"] = state.log_view
     rec["commit_min"] = state.commit_min
     rec["commit_max"] = state.commit_max
+    rec["log_adopted_op"] = state.log_adopted_op
     rec["op_checkpoint"] = state.op_checkpoint
     rec["checkpoint_file_checksum_lo"] = (
         state.checkpoint_file_checksum & 0xFFFF_FFFF_FFFF_FFFF
@@ -144,6 +153,7 @@ def _decode_copy(buf: bytes) -> Optional[Tuple[SuperBlockState, int]]:
         log_view=int(rec["log_view"]),
         commit_min=int(rec["commit_min"]),
         commit_max=int(rec["commit_max"]),
+        log_adopted_op=int(rec["log_adopted_op"]),
         op_checkpoint=int(rec["op_checkpoint"]),
         checkpoint_file_checksum=(
             (int(rec["checkpoint_file_checksum_hi"]) << 64)
