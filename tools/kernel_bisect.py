@@ -1,16 +1,38 @@
-"""On-device cost bisect for the general transfer kernel.
+"""On-device cost bisect for the general transfer kernel (v2, round 5).
 
-`TPU_EVIDENCE.json` (round 4) showed the fast kernel at ~5.6 us/batch —
-1.4x off the HBM roofline — while the fully-general kernel measured ~131
-ms/batch on the same chip, ~13,000x off ITS roofline, yet only 2.3x the
-fast kernel on XLA-CPU.  Something in the general kernel hits a TPU-specific
-pathological lowering.  This tool times each candidate primitive ON DEVICE
-(fori_loop with a threaded data dependence so XLA cannot hoist the body)
-and the three kernel variants, printing one JSON line for the forensic
-record.  Run it first in a tunnel window: ~1 minute of device time buys
-the bisect that directs the optimization work.
+Round 4's harness left a contradiction standing: the flagship bench measured
+the fast kernel at 5.6-13.7 us/batch while every harness in this tool (and
+bench.py's run_kernel_profile) measured the SAME kernel at ~41 ms/batch on
+the same chip.  Root cause (bench.py:672-676 note + the per-dispatch shape of
+those harnesses): per-batch dispatches through the remote tunnel pay a large
+RTT — and after a single device->host transfer the tunnel degrades to ~60 ms
+per dispatch — so every round-4 whole-kernel and gphase_* number measured the
+tunnel, not the device (VERDICT r4 weak #3).
 
-Usage: python tools/kernel_bisect.py [--reps 32] [--out KERNEL_BISECT.json]
+v2 methodology — every kernel entry uses the flagship bench's EXACT shape:
+
+- the batch is DERIVED INSIDE JIT from the batch index (no captured device
+  constants, no H2D in the timed path);
+- the carry (ledger, fails) is DONATED (in-place table updates);
+- k reps run inside one dispatch via lax.fori_loop;
+- each entry is timed at reps and 2*reps: ``slope`` (us/batch) is the true
+  amortized device cost, ``intercept`` (us/dispatch) is the fixed
+  dispatch/tunnel overhead.  The two are reported separately so a degraded
+  tunnel can never masquerade as kernel cost again.
+
+The forensic ladder:
+  1. primitives (sort/scatter/gather/cumsum + previously-unbenched
+     segment_min, cummax-2d, multi-column table gather);
+  2. fast kernel (control: slope must land ~= the flagship per-batch us);
+  3. general kernel: gated-plain, full two-phase;
+  4. max_passes sweep {1,2,4,8} on the two-phase shape -> per-Jacobi-pass
+     cost by linear fit;
+  5. phase slices (ctx/core/claim/insert/apply), bench-shape harness;
+  6. a deliberate D2H followed by a re-measure of the fast kernel: records
+     the degradation delta that poisoned round-4 numbers (and plausibly the
+     w1-vs-w2 flagship variance).
+
+Usage: python tools/kernel_bisect.py [--reps 24] [--out KERNEL_BISECT.json]
 """
 
 from __future__ import annotations
@@ -27,8 +49,10 @@ sys.path.insert(0, REPO)
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--reps", type=int, default=32)
+    p.add_argument("--reps", type=int, default=24)
     p.add_argument("--force-cpu", action="store_true")
+    p.add_argument("--skip-degrade", action="store_true",
+                   help="skip the deliberate-D2H degradation experiment")
     p.add_argument("--out", default=os.path.join(REPO, "KERNEL_BISECT.json"))
     args = p.parse_args()
 
@@ -46,32 +70,31 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu import types, u128
     from tigerbeetle_tpu.ops import hash_table as ht
     from tigerbeetle_tpu.ops import state_machine as sm
     from tigerbeetle_tpu.ops import transfer_full as tf
 
     N = 8192          # batch lanes
+    COUNT = 8190
     L = 2 * N         # leg domain
     TABLE = 1 << 22   # representative transfers-table capacity
+    N_ACCOUNTS = 1024
 
-    results = {"platform": platform, "reps": args.reps, "lanes": N}
+    results = {"platform": platform, "reps": args.reps, "lanes": N,
+               "methodology": "slope/intercept from reps and 2*reps; "
+                              "batch derived in-jit; donated carry"}
 
-    def timed(name, make_carry, body):
-        """Median-of-3 of (reps inside one jitted fori_loop dispatch).
-
-        body(carry, i) -> carry must THREAD the data (the result feeds the
-        next iteration) or XLA hoists the loop body as invariant and the
-        measurement is fiction."""
+    # ---------------------------------------------------------------------
+    # primitives (cheap controls; fori_loop-amortized, data-threaded)
+    # ---------------------------------------------------------------------
+    def timed_prim(name, make_carry, body):
         @jax.jit
         def run(carry):
-            def f(i, c):
-                return body(c, i)
+            return jax.lax.fori_loop(0, args.reps, lambda i, c: body(c, i),
+                                     carry)
 
-            return jax.lax.fori_loop(0, args.reps, f, carry)
-
-        carry = make_carry()
-        out = run(carry)                      # compile + warm
+        out = run(make_carry())
         jax.block_until_ready(out)
         best = None
         for _ in range(3):
@@ -90,45 +113,20 @@ def main() -> None:
     permL = jnp.asarray(rng.permutation(L).astype(np.int32))
     idxT = jnp.asarray(rng.integers(0, TABLE, size=N, dtype=np.int64))
     big = jnp.zeros((TABLE,), jnp.uint64)
+    segs = jnp.asarray(rng.integers(0, N, size=N, dtype=np.int32))
 
-    # --- primitives --------------------------------------------------------
-    timed("sort_u32_16k", lambda: u32v,
-          lambda c, i: jnp.sort(c ^ i.astype(jnp.uint32)))
-    timed("sort_u64_16k", lambda: u64v,
-          lambda c, i: jnp.sort(c ^ i.astype(jnp.uint64)))
-    timed("argsort_u64_16k", lambda: u64v,
-          lambda c, i: c[jnp.argsort(c ^ i.astype(jnp.uint64))])
-    timed("argsort_u32_16k", lambda: u32v,
-          lambda c, i: c[jnp.argsort(c ^ i.astype(jnp.uint32))])
-    timed(
-        "lexsort_3xu64_8k",
-        lambda: (u64v[:N], u64v[N:]),
-        lambda c, i: (
-            c[0][jnp.lexsort((
-                jnp.arange(N, dtype=jnp.uint64),
-                c[0] ^ i.astype(jnp.uint64), c[1],
-            ))],
-            c[1],
-        ),
-    )
-    timed(
+    timed_prim("sort_u64_16k", lambda: u64v,
+               lambda c, i: jnp.sort(c ^ i.astype(jnp.uint64)))
+    timed_prim("argsort_u64_16k", lambda: u64v,
+               lambda c, i: c[jnp.argsort(c ^ i.astype(jnp.uint64))])
+    timed_prim(
         "scatter_set_perm_16k",
         lambda: (jnp.zeros((L,), jnp.int32), permL),
         lambda c, i: (
             c[0].at[c[1]].set(jnp.arange(L, dtype=jnp.int32) + i), c[1]
         ),
     )
-    timed(
-        "scatter_set_perm_16k_unique",
-        lambda: (jnp.zeros((L,), jnp.int32), permL),
-        lambda c, i: (
-            c[0]
-            .at[c[1]]
-            .set(jnp.arange(L, dtype=jnp.int32) + i, unique_indices=True),
-            c[1],
-        ),
-    )
-    timed(
+    timed_prim(
         "scatter_add_16k",
         lambda: (jnp.zeros((L,), jnp.uint32), permL),
         lambda c, i: (
@@ -136,223 +134,266 @@ def main() -> None:
             c[1],
         ),
     )
-    timed(
+    timed_prim(
         "gather_8k_from_4m",
         lambda: (big, idxT),
         lambda c, i: (c[0], (c[1] + c[0][c[1]].astype(jnp.int64)) % TABLE),
     )
-    timed(
+    timed_prim(
         "cumsum_16kx24_u32",
         lambda: jnp.ones((L, 24), jnp.uint32),
         lambda c, i: jnp.cumsum(c, axis=0) & jnp.uint32(0xFFFF),
     )
-    timed(
-        "while3_trivial",
-        lambda: u64v,
-        lambda c, i: jax.lax.while_loop(
-            lambda s: s[0] < 3,
-            lambda s: (s[0] + 1, s[1] + s[0].astype(jnp.uint64)),
-            (jnp.int32(0), c),
-        )[1],
+    # Previously-unbenched suspects ----------------------------------------
+    timed_prim(
+        "cummax_16kx24_u32",
+        lambda: jnp.ones((L, 24), jnp.uint32),
+        lambda c, i: jax.lax.cummax(c, axis=0) + (c & jnp.uint32(1)),
     )
-
-    # --- hash-table probe --------------------------------------------------
+    timed_prim(
+        "segment_min_8k",
+        lambda: (jnp.arange(N, dtype=jnp.int32), segs),
+        lambda c, i: (
+            jax.ops.segment_min(c[0] ^ i, c[1], num_segments=N), c[1]
+        ),
+    )
+    timed_prim(
+        "scatter_min_8k",
+        lambda: (jnp.full((N,), 1 << 30, jnp.int32), segs),
+        lambda c, i: (
+            c[0].at[c[1]].min(jnp.arange(N, dtype=jnp.int32) + i), c[1]
+        ),
+    )
+    timed_prim(
+        "lexsort_2key_8k",
+        lambda: (u64v[:N], u64v[N:]),
+        lambda c, i: (
+            c[0][jnp.lexsort((jnp.arange(N, dtype=jnp.uint64),
+                              c[0] ^ i.astype(jnp.uint64)))],
+            c[1],
+        ),
+    )
+    # 22-column row gather from a 4M-row table (the GatherCtx shape).
+    tab22 = {f"c{j}": jnp.zeros((TABLE,), jnp.uint64) for j in range(22)}
+    timed_prim(
+        "gather22col_8k_from_4m",
+        lambda: (tab22, idxT),
+        lambda c, i: (
+            c[0],
+            (c[1] + sum(c[0][k][c[1]] for k in c[0]).astype(jnp.int64))
+            % TABLE,
+        ),
+    )
+    # hash-table probe (as shipped)
     table = ht.make_table(TABLE, {"timestamp": jnp.uint64})
-    key = jnp.asarray(
-        rng.integers(1, 1 << 62, size=N, dtype=np.uint64)
-    )
-    timed(
+    key = jnp.asarray(rng.integers(1, 1 << 62, size=N, dtype=np.uint64))
+    timed_prim(
         "ht_lookup_8k_in_4m",
         lambda: (table, key),
         lambda c, i: (
             c[0],
-            c[1] ^ ht.lookup(
-                c[0], c[1], jnp.zeros_like(c[1]), sm.MAX_PROBE
-            ).slot,
+            c[1] ^ ht.lookup(c[0], c[1], jnp.zeros_like(c[1]),
+                             sm.MAX_PROBE).slot,
         ),
     )
 
-    # --- kernel variants (ledger state threads the dependence) -------------
-    n_accounts = 1024
-    led = sm.make_ledger(1 << 12, TABLE, 1 << 20)
-    acc = np.zeros(N, dtype=types.ACCOUNT_DTYPE)
-    acc["id_lo"][:n_accounts] = 1 + np.arange(n_accounts, dtype=np.uint64)
-    acc["ledger"][:n_accounts] = 1
-    acc["code"][:n_accounts] = 10
-    soa_a = {k: jnp.asarray(v) for k, v in types.to_soa(acc).items()}
-    led, codes = sm.create_accounts(
-        led, soa_a, jnp.uint64(n_accounts), jnp.uint64(n_accounts)
-    )
-    assert int(np.asarray(codes)[:n_accounts].sum()) == 0
-
-    count = N - 2
-    lane = np.arange(N, dtype=np.uint64)
-
-    def batch_cols(first_tid, two_phase):
-        b = np.zeros(N, dtype=types.TRANSFER_DTYPE)
-        half = count // 2
-        act = lane < count
-        dr = 1 + (lane * 7) % n_accounts
-        cr = 1 + (dr + 3) % n_accounts
-        b["id_lo"] = np.where(act, first_tid + lane, 0)
-        if two_phase:
-            is_post = (lane >= half) & act
-            b["flags"] = np.where(
-                act,
-                np.where(is_post, np.uint16(types.TransferFlags.POST_PENDING_TRANSFER),
-                         np.uint16(types.TransferFlags.PENDING)),
-                0,
-            ).astype(np.uint16)
-            b["pending_id_lo"] = np.where(is_post, first_tid + lane - half, 0)
-            act = act & ~is_post
-        b["debit_account_id_lo"] = np.where(act, dr, 0)
-        b["credit_account_id_lo"] = np.where(act, cr, 0)
-        b["amount_lo"] = np.where(act, 1 + lane % 100, 0)
-        b["ledger"] = np.where(act, 1, 0).astype(np.uint32)
-        b["code"] = np.where(act, 10, 0).astype(np.uint16)
-        return {k: jnp.asarray(v) for k, v in types.to_soa(b).items()}
-
-    def kernel_timer(name, step):
-        """reps sequential batches inside one dispatch.  The ledger AND a
-        batch-epoch counter thread through warm and timed runs, so every
-        iteration of BOTH dispatches inserts fresh ids at fresh timestamps
-        (a repeat id would take the 'exists' path and skip the apply
-        work)."""
-        @jax.jit
-        def run(carry):
-            def f(i, c):
-                led_, e = c
-                return step(led_, e), e + jnp.uint64(1)
-
-            return jax.lax.fori_loop(0, args.reps, f, carry)
-
-        out = run((led, jnp.uint64(0)))     # compile + warm
-        jax.block_until_ready(out[0].accounts.count)
-        t0 = time.time()
-        out = run(out)
-        jax.block_until_ready(out[0].accounts.count)
-        results[name] = round((time.time() - t0) / args.reps * 1e6, 1)
-        print(f"# {name}: {results[name]} us/batch", file=sys.stderr)
-
-    plain = batch_cols(1 << 33, two_phase=False)
-    twop = batch_cols(1 << 34, two_phase=True)
-    base_ts = jnp.uint64(1 << 20)
-
-    def shift_ids(cols, epoch):
-        # Fresh ids per epoch (N lanes apart; per-kernel bases are 2^33
-        # apart, far beyond reps * N) and strictly-advancing timestamps.
-        off = epoch * jnp.uint64(N)
-        out = dict(cols)
-        out["id_lo"] = jnp.where(cols["id_lo"] != 0, cols["id_lo"] + off, 0)
-        out["pending_id_lo"] = jnp.where(
-            cols["pending_id_lo"] != 0, cols["pending_id_lo"] + off, 0
+    # ---------------------------------------------------------------------
+    # bench-shape kernel harness: slope + intercept
+    # ---------------------------------------------------------------------
+    def make_ledger():
+        led = sm.make_ledger(1 << 12, TABLE, 1 << 20)
+        acc = np.zeros(N, dtype=types.ACCOUNT_DTYPE)
+        acc["id_lo"][:N_ACCOUNTS] = 1 + np.arange(N_ACCOUNTS, dtype=np.uint64)
+        acc["ledger"][:N_ACCOUNTS] = 1
+        acc["code"][:N_ACCOUNTS] = 10
+        soa_a = {k: jnp.asarray(v) for k, v in types.to_soa(acc).items()}
+        led, codes = sm.create_accounts(
+            led, soa_a, jnp.uint64(N_ACCOUNTS), jnp.uint64(N_ACCOUNTS)
         )
-        return out, base_ts + (epoch + jnp.uint64(1)) * jnp.uint64(count)
+        # NO D2H here: asserting codes would permanently degrade the tunnel
+        # (bench.py:672-676); codes fold into the first fails check instead.
+        return led, jnp.sum(codes.astype(jnp.uint64))
 
-    def fast_step(led_, e):
-        cols, ts = shift_ids(plain, e)
-        led_, _ = sm.create_transfers_impl(led_, cols, jnp.uint64(count), ts)
-        return led_
+    def gen_plain(b):
+        lane = jnp.arange(N, dtype=jnp.uint64)
+        gid = b.astype(jnp.uint64) * jnp.uint64(COUNT) + lane
+        h1 = u128.mix64(gid, jnp.uint64(0x1234))
+        h2 = u128.mix64(gid, jnp.uint64(0x9876))
+        dr = h1 % jnp.uint64(N_ACCOUNTS)
+        off = jnp.uint64(1) + h2 % jnp.uint64(N_ACCOUNTS - 1)
+        cr = (dr + off) % jnp.uint64(N_ACCOUNTS)
+        amount = jnp.uint64(1) + ((h1 >> jnp.uint64(32)) & jnp.uint64(0xFFFF))
+        active = lane < jnp.uint64(COUNT)
+        z64 = jnp.zeros((N,), jnp.uint64)
+        z32 = jnp.zeros((N,), jnp.uint32)
+        return {
+            "id_lo": jnp.where(active, jnp.uint64(1 << 35) + gid, 0),
+            "id_hi": z64,
+            "debit_account_id_lo": jnp.where(active, dr + 1, 0),
+            "debit_account_id_hi": z64,
+            "credit_account_id_lo": jnp.where(active, cr + 1, 0),
+            "credit_account_id_hi": z64,
+            "amount_lo": jnp.where(active, amount, 0),
+            "amount_hi": z64,
+            "pending_id_lo": z64, "pending_id_hi": z64,
+            "user_data_128_lo": z64, "user_data_128_hi": z64,
+            "user_data_64": z64, "user_data_32": z32, "timeout": z32,
+            "ledger": jnp.where(active, jnp.uint32(1), z32),
+            "code": jnp.where(active, jnp.uint32(10), z32),
+            "flags": z32, "timestamp": z64,
+        }
 
-    def gated_step(led_, e):
-        cols, ts = shift_ids(plain, e)
-        led_, _, _ = tf.create_transfers_full_impl(
-            led_, cols, jnp.uint64(count), ts,
-            has_postvoid=False, has_history=False,
+    def gen_twop(b):
+        """Half pending creates, half posts of THOSE pendings (the bench's
+        --two-phase shape: in-batch two-phase resolution)."""
+        half = COUNT // 2
+        lane = jnp.arange(N, dtype=jnp.uint64)
+        base = b.astype(jnp.uint64) * jnp.uint64(COUNT)
+        is_post = lane >= jnp.uint64(half)
+        gid = base + jnp.where(is_post, lane - jnp.uint64(half), lane)
+        h1 = u128.mix64(gid, jnp.uint64(0x1234))
+        dr = h1 % jnp.uint64(N_ACCOUNTS)
+        cr = (dr + jnp.uint64(3)) % jnp.uint64(N_ACCOUNTS)
+        amount = jnp.uint64(1) + (h1 & jnp.uint64(0xFF))
+        active = lane < jnp.uint64(2 * half)
+        tid = jnp.uint64(1 << 36) + base + lane
+        ptid = jnp.uint64(1 << 36) + base + (lane - jnp.uint64(half))
+        z64 = jnp.zeros((N,), jnp.uint64)
+        z32 = jnp.zeros((N,), jnp.uint32)
+        return {
+            "id_lo": jnp.where(active, tid, 0), "id_hi": z64,
+            "debit_account_id_lo": jnp.where(active & ~is_post, dr + 1, 0),
+            "debit_account_id_hi": z64,
+            "credit_account_id_lo": jnp.where(active & ~is_post, cr + 1, 0),
+            "credit_account_id_hi": z64,
+            "amount_lo": jnp.where(active & ~is_post, amount, 0),
+            "amount_hi": z64,
+            "pending_id_lo": jnp.where(active & is_post, ptid, 0),
+            "pending_id_hi": z64,
+            "user_data_128_lo": z64, "user_data_128_hi": z64,
+            "user_data_64": z64, "user_data_32": z32, "timeout": z32,
+            "ledger": jnp.where(active & ~is_post, jnp.uint32(1), z32),
+            "code": jnp.where(active & ~is_post, jnp.uint32(10), z32),
+            "flags": jnp.where(
+                active,
+                jnp.where(is_post, jnp.uint32(tf.TF_POST),
+                          jnp.uint32(tf.TF_PENDING)),
+                z32,
+            ),
+            "timestamp": z64,
+        }
+
+    TS0 = jnp.uint64(1 << 20)
+
+    def bench_shape(name, step_fn, *, record=True):
+        """Time step_fn (ledger, fails, b) -> (ledger, fails) at reps and
+        2*reps in the flagship's exact harness; report slope + intercept."""
+        def multi(led_, fails, b0, k):
+            def body(i, c):
+                led2, f = c
+                return step_fn(led2, f, b0 + i.astype(jnp.uint64))
+
+            return jax.lax.fori_loop(0, k, body, (led_, fails))
+
+        run = jax.jit(multi, static_argnames=("k",),
+                      donate_argnames=("led_", "fails"))
+        r1, r2 = args.reps, 2 * args.reps
+
+        led_, fails = make_ledger()
+        # compile + warm both rep counts
+        led_, fails = run(led_, fails, jnp.uint64(0), r1)
+        jax.block_until_ready(fails)
+        led_, fails = run(led_, fails, jnp.uint64(r1), r2)
+        jax.block_until_ready(fails)
+        b0 = r1 + r2
+
+        def once(k, b):
+            nonlocal led_, fails
+            t0 = time.time()
+            led_, fails = run(led_, fails, jnp.uint64(b), k)
+            jax.block_until_ready(fails)
+            return time.time() - t0
+
+        # SYMMETRIC sampling (min-of-2 at BOTH rep counts): a lucky single
+        # r1 sample against jittery tunnel dispatches would bias the slope
+        # low — even negative — and poison the mp-sweep per-pass fit.
+        b = b0
+        t_r1 = min(once(r1, b), once(r1, b + r1))
+        b += 2 * r1
+        t_r2 = min(once(r2, b), once(r2, b + r2))
+        raw_slope = (t_r2 - t_r1) / (r2 - r1) * 1e6
+        slope = max(0.0, raw_slope)
+        intercept = max(0.0, t_r1 - slope * 1e-6 * r1) * 1e6
+        if record:
+            results[name] = {"slope_us": round(slope, 1),
+                             "intercept_us": round(intercept, 1)}
+            if raw_slope < 0:
+                results[name]["noisy_raw_slope_us"] = round(raw_slope, 1)
+            print(f"# {name}: slope {slope:.1f} us/batch, "
+                  f"intercept {intercept:.1f} us/dispatch", file=sys.stderr)
+        del led_
+        return slope, intercept
+
+    def fails_of(codes, kflags=None):
+        f = jnp.sum(codes.astype(jnp.uint64))
+        if kflags is not None:
+            f = f + kflags.astype(jnp.uint64) * jnp.uint64(1 << 32)
+        return f
+
+    # --- control: the fast kernel (flagship shape) ------------------------
+    def fast_step(led_, fails, b):
+        ts = TS0 + (b + jnp.uint64(1)) * jnp.uint64(COUNT)
+        led_, codes = sm.create_transfers_impl(
+            led_, gen_plain(b), jnp.uint64(COUNT), ts
         )
-        return led_
+        return led_, fails + fails_of(codes)
 
-    def full_step(led_, e):
-        cols, ts = shift_ids(twop, e)
-        led_, _, _ = tf.create_transfers_full_impl(
-            led_, cols, jnp.uint64(count), ts,
-            has_postvoid=True, has_history=False,
-        )
-        return led_
+    bench_shape("kernel_fast", fast_step)
 
-    kernel_timer("kernel_fast_us", fast_step)
-    kernel_timer("kernel_general_gated_us", gated_step)
-    kernel_timer("kernel_general_full_us", full_step)
-
-    # --- donated variants: the REAL serving composition ---------------------
-    # bench.py's timed loop donates (ledger, ...): on TPU the in-place table
-    # updates hinge on that donation (window-2 evidence: the donated fast
-    # path runs 5.6-13.7 us/batch while THIS tool's non-donated harness
-    # measured the same kernel at 42.9 ms/batch — whole-table copies).  The
-    # donated general kernel is the open pathology (131 ms/batch in the
-    # donated two-phase bench); the phase slices below bisect WHICH stage of
-    # the composition breaks XLA's in-place aliasing.
-    import functools
-
-    def make_led():
-        led_ = sm.make_ledger(1 << 12, TABLE, 1 << 20)
-        led_, codes_ = sm.create_accounts(
-            led_, soa_a, jnp.uint64(n_accounts), jnp.uint64(n_accounts)
-        )
-        assert int(np.asarray(codes_)[:n_accounts].sum()) == 0
-        return led_
-
-    def kernel_timer_don(name, step):
-        """Same shape as kernel_timer, but the carry is DONATED (the bench's
-        multi_jit shape).  Carry threads (ledger, epoch, acc): read-only
-        phase slices fold their outputs into ``acc`` so XLA cannot DCE the
-        work they are timing."""
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def run(carry):
-            def f(i, c):
-                led_, e, a = c
-                led_, da = step(led_, e)
-                return led_, e + jnp.uint64(1), a + da
-
-            return jax.lax.fori_loop(0, args.reps, f, carry)
-
-        out = run((make_led(), jnp.uint64(0), jnp.uint64(0)))
-        jax.block_until_ready(out[2])
-        t0 = time.time()
-        out = run(out)
-        jax.block_until_ready(out[2])
-        results[name] = round((time.time() - t0) / args.reps * 1e6, 1)
-        print(f"# {name}: {results[name]} us/batch", file=sys.stderr)
-        del out
-
-    def fast_step_d(led_, e):
-        cols, ts = shift_ids(plain, e)
-        led_, codes_ = sm.create_transfers_impl(
-            led_, cols, jnp.uint64(count), ts
-        )
-        return led_, jnp.sum(codes_.astype(jnp.uint64))
-
-    def general_step_d(has_postvoid, has_history):
-        cols0 = twop if has_postvoid else plain
-
-        def step(led_, e):
-            cols, ts = shift_ids(cols0, e)
-            led_, codes_, kflags_ = tf.create_transfers_full_impl(
-                led_, cols, jnp.uint64(count), ts,
-                has_postvoid=has_postvoid, has_history=has_history,
+    # --- general kernel variants ------------------------------------------
+    def general_step(gen, has_postvoid, has_history, max_passes=None):
+        def step(led_, fails, b):
+            ts = TS0 + (b + jnp.uint64(1)) * jnp.uint64(COUNT)
+            kw = {}
+            if max_passes is not None:
+                kw["max_passes"] = max_passes
+            led_, codes, kflags = tf.create_transfers_full_impl(
+                led_, gen(b), jnp.uint64(COUNT), ts,
+                has_postvoid=has_postvoid, has_history=has_history, **kw
             )
-            return led_, jnp.sum(codes_.astype(jnp.uint64)) + kflags_
+            return led_, fails + fails_of(codes, kflags)
+
         return step
 
-    kernel_timer_don("kernel_fast_don_us", fast_step_d)
-    kernel_timer_don("kernel_general_don_us", general_step_d(True, True))
-    kernel_timer_don("kernel_general_nohist_don_us", general_step_d(True, False))
-    kernel_timer_don("kernel_general_plain_don_us", general_step_d(False, False))
+    bench_shape("kernel_general_plain_gated",
+                general_step(gen_plain, False, False))
+    bench_shape("kernel_general_twop_full",
+                general_step(gen_twop, True, True))
 
-    # --- phase-sliced donated bisect of the general kernel ------------------
-    # Mirrors create_transfers_full_impl stage by stage; each slice includes
-    # the previous ones, so consecutive deltas attribute the cost:
-    #   ctx    = build_gather_ctx (all table reads)
-    #   core   = + Jacobi fixpoint (lane-local while_loop)
-    #   claim  = + insert-slot probe loops (transfers + posted reads)
-    #   insert = + transfer/posted row writes (first table scatters)
-    #   apply  = + accounts balance scatter + history append (full kernel)
-    def phase_step(upto, static_trip=None):
-        def step(led_, e):
-            cols, ts = shift_ids(twop, e)
+    # --- max_passes sweep: per-Jacobi-pass cost ---------------------------
+    # NOTE: mp < the batch's cascade depth makes the kernel route FLAG_SEQ
+    # (nothing applied) — fine for timing, the pass loop still runs mp times.
+    mp_slopes = {}
+    for mp in (1, 2, 4, 8):
+        s, _ = bench_shape(f"kernel_general_twop_mp{mp}",
+                           general_step(gen_twop, True, True, mp))
+        mp_slopes[mp] = s
+    if mp_slopes[8] > mp_slopes[1]:
+        per_pass = (mp_slopes[8] - mp_slopes[1]) / 7.0
+        results["jacobi_per_pass_us"] = round(per_pass, 1)
+        results["jacobi_fixed_us"] = round(mp_slopes[1] - per_pass, 1)
+        print(f"# per-Jacobi-pass: {per_pass:.1f} us; "
+              f"outside-loop: {results['jacobi_fixed_us']} us",
+              file=sys.stderr)
+
+    # --- phase slices (ctx/core/claim/insert/apply), bench shape ----------
+    def phase_step(upto):
+        def step(led_, fails, b):
+            cols = gen_twop(b)
+            ts = TS0 + (b + jnp.uint64(1)) * jnp.uint64(COUNT)
             n_ = cols["id_lo"].shape[0]
             lane_i = jnp.arange(n_, dtype=jnp.int32)
-            valid = lane_i < jnp.int32(count)
+            valid = lane_i < jnp.int32(COUNT)
             fl = cols["flags"]
             postvoid = (
                 ((fl & tf.TF_POST) != 0) | ((fl & tf.TF_VOID) != 0)
@@ -362,12 +403,12 @@ def main() -> None:
                 led_, cols, valid, postvoid, None, None, has_postvoid=True
             )
             if upto == "ctx":
-                return led_, jnp.sum(
-                    ctx.probe_grow.astype(jnp.uint64)
-                ) + jnp.sum(ctx.ex_found.astype(jnp.uint64))
-            plan = tf._kernel_core(ctx, cols, jnp.uint64(count), ts,
-                                   tf._MAX_PASSES, static_trip)
-            acc_ = jnp.sum(plan.codes.astype(jnp.uint64))
+                return led_, fails + jnp.sum(
+                    ctx.ex_found.astype(jnp.uint64)
+                ) + jnp.sum(ctx.drT.slot)
+            plan = tf._kernel_core(ctx, cols, jnp.uint64(COUNT), ts,
+                                   tf._MAX_PASSES)
+            acc_ = fails + fails_of(plan.codes)
             if upto == "core":
                 return led_, acc_
             t_claim, t_ovf = ht.claim_slots(
@@ -401,9 +442,7 @@ def main() -> None:
                 )},
             )
             if upto == "insert":
-                return (
-                    led_.replace(transfers=transfers, posted=posted), acc_
-                )
+                return led_.replace(transfers=transfers, posted=posted), acc_
             scat = plan.scat & commit
             cap_sentinel = jnp.uint64(led_.accounts.capacity)
             accounts = ht.scatter_cols(
@@ -411,122 +450,33 @@ def main() -> None:
                 jnp.where(scat, plan.s_slot, cap_sentinel), scat,
                 plan.bal_incl,
             )
-            # History append (mirrors the has_history=True path), so the
-            # ladder's top slice equals the full kernel and the deltas
-            # attribute every stage.
-            do_hist_c = plan.do_hist & commit
-            h = led_.history
-            h_off = (
-                jnp.cumsum(do_hist_c.astype(jnp.uint64))
-                - do_hist_c.astype(jnp.uint64)
-            )
-            h_idx = jnp.where(
-                do_hist_c, h.count + h_off, jnp.uint64(h.capacity)
-            )
-            history = h.replace(
-                cols={
-                    name: h.cols[name].at[h_idx].set(
-                        plan.hist_row[name], mode="drop"
-                    )
-                    for name in h.cols
-                },
-                count=h.count + jnp.sum(do_hist_c.astype(jnp.uint64)),
-            )
             return (
-                led_.replace(
-                    accounts=accounts, transfers=transfers, posted=posted,
-                    history=history,
-                ),
+                led_.replace(accounts=accounts, transfers=transfers,
+                             posted=posted),
                 acc_,
             )
+
         return step
 
     for ph in ("ctx", "core", "claim", "insert", "apply"):
-        kernel_timer_don(f"gphase_{ph}_don_us", phase_step(ph))
-    # Scan-vs-while, directly: the core slice with each loop form forced.
-    # (The entries above use the backend auto-gate: scan on TPU.)
-    kernel_timer_don("gphase_core_while_don_us",
-                     phase_step("core", static_trip=False))
-    kernel_timer_don("gphase_core_scan_don_us",
-                     phase_step("core", static_trip=True))
+        bench_shape(f"gphase_{ph}", phase_step(ph))
 
-    # --- exact bench-shape replicas -----------------------------------------
-    # bench.py's timed loop: batch DERIVED inside jit from the batch index
-    # (b0 dispatch argument + fori induction var), carry (ledger, fails),
-    # k static, donated.  The window-4 numbers left one contradiction
-    # standing: the flagship bench measured the fast kernel at 13.7 us/batch
-    # while every harness here measured ~41 ms/batch doing real inserts.
-    # These entries run the bench's EXACT shape at this tool's table size:
-    # if they hit us-scale, the gap is harness-induced (and the general
-    # kernel's bench-shape number is the one that matters); if they hit
-    # ~40 ms, the bench's own number needs forensics.
-    def bench_shape(step_fn):
-        def multi(led_, fails, b0):
-            def body(i, c):
-                led2, f = c
-                b = b0 + i.astype(jnp.uint64)
-                led2, codes_ = step_fn(led2, b)
-                return led2, f + jnp.sum(codes_.astype(jnp.uint64))
-
-            return jax.lax.fori_loop(0, args.reps, body, (led_, fails))
-
-        run = jax.jit(multi, donate_argnames=("led_", "fails"))
-        led_ = make_led()
-        led_, fails = run(led_, jnp.uint64(0), jnp.uint64(0))
-        jax.block_until_ready(fails)
-        t0 = time.time()
-        led_, fails = run(led_, fails, jnp.uint64(args.reps))
-        jax.block_until_ready(fails)
-        per = round((time.time() - t0) / args.reps * 1e6, 1)
-        del led_
-        return per
-
-    def gen_plain(b):
-        lane_ = jnp.arange(N, dtype=jnp.uint64)
-        gid = b * jnp.uint64(count) + lane_
-        dr_ = jnp.uint64(1) + (gid * jnp.uint64(7)) % jnp.uint64(n_accounts)
-        cr_ = jnp.uint64(1) + (dr_ + jnp.uint64(2)) % jnp.uint64(n_accounts)
-        active = lane_ < jnp.uint64(count)
-        z64 = jnp.zeros((N,), jnp.uint64)
-        z32 = jnp.zeros((N,), jnp.uint32)
-        return {
-            "id_lo": jnp.where(active, jnp.uint64(1 << 35) + gid, 0),
-            "id_hi": z64,
-            "debit_account_id_lo": jnp.where(active, dr_, 0),
-            "debit_account_id_hi": z64,
-            "credit_account_id_lo": jnp.where(active, cr_, 0),
-            "credit_account_id_hi": z64,
-            "amount_lo": jnp.where(active, jnp.uint64(1) + gid % 100, 0),
-            "amount_hi": z64,
-            "pending_id_lo": z64, "pending_id_hi": z64,
-            "user_data_128_lo": z64, "user_data_128_hi": z64,
-            "user_data_64": z64, "user_data_32": z32, "timeout": z32,
-            "ledger": jnp.where(active, jnp.uint32(1), z32),
-            "code": jnp.where(active, jnp.uint32(10), z32),
-            "flags": z32, "timestamp": z64,
+    # --- degradation experiment -------------------------------------------
+    # One deliberate tiny D2H, then re-measure the fast kernel: on a healthy
+    # backend the numbers match; through the degraded tunnel the intercept
+    # jumps by the per-dispatch penalty that poisoned round-4's harnesses.
+    if not args.skip_degrade:
+        _ = int(np.asarray(jnp.uint64(1) + jnp.uint64(1)))  # the D2H
+        s, i = bench_shape("kernel_fast_after_d2h", fast_step)
+        base = results["kernel_fast"]
+        results["d2h_degradation"] = {
+            "slope_delta_us": round(s - base["slope_us"], 1),
+            "intercept_delta_us": round(i - base["intercept_us"], 1),
         }
-
-    def fast_bench(led_, b):
-        ts = jnp.uint64(1 << 20) + (b + jnp.uint64(1)) * jnp.uint64(count)
-        led_, codes_ = sm.create_transfers_impl(
-            led_, gen_plain(b), jnp.uint64(count), ts
-        )
-        return led_, codes_
-
-    def general_bench(led_, b):
-        ts = jnp.uint64(1 << 20) + (b + jnp.uint64(1)) * jnp.uint64(count)
-        led_, codes_, kflags_ = tf.create_transfers_full_impl(
-            led_, gen_plain(b), jnp.uint64(count), ts,
-        )
-        return led_, codes_
-
-    results["kernel_fast_benchshape_us"] = bench_shape(fast_bench)
-    print(f"# kernel_fast_benchshape_us: "
-          f"{results['kernel_fast_benchshape_us']} us/batch", file=sys.stderr)
-    results["kernel_general_benchshape_us"] = bench_shape(general_bench)
-    print(f"# kernel_general_benchshape_us: "
-          f"{results['kernel_general_benchshape_us']} us/batch",
-          file=sys.stderr)
+        print(f"# after-D2H delta: slope {results['d2h_degradation']['slope_delta_us']}"
+              f" us/batch, intercept "
+              f"{results['d2h_degradation']['intercept_delta_us']} us/dispatch",
+              file=sys.stderr)
 
     print(json.dumps(results))
     with open(args.out, "w") as f:
