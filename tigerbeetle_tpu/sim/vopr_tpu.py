@@ -92,6 +92,19 @@ class ClusterState(NamedTuple):
     op: jnp.ndarray          # (R,) i32 journal head (unbounded; slot = op%S)
     commit: jnp.ndarray      # (R,) i32
     checkpoint: jnp.ndarray  # (R,) i32: durable floor (ring may not wrap past)
+    # The adoption watermark (the model twin of consensus.py's
+    # log_adopted_op, round 5): how far the log was KNOWN to extend when
+    # log_view last advanced.  op < adopted_op marks the log suspect —
+    # an amputated suffix must not vouch in canonical selection.
+    adopted_op: jnp.ndarray  # (R,) i32
+    # Journal durability watermark: ops <= durable_op were individually
+    # journaled + fsynced (appends, slot repairs, state sync, election
+    # installs) and SURVIVE crashes — acks and commit execution require
+    # durability, exactly as the real system's acks follow the sync.  A
+    # join install raises op WITHOUT raising durable_op: that gap is the
+    # bodies-not-yet-journaled window crash amputation can erase (the
+    # seed-500285 window; only there, never below an ack).
+    durable_op: jnp.ndarray  # (R,) i32
     log: jnp.ndarray         # (R, S) u32 entry ids (0 empty, CORRUPT damaged)
     log_hdr: jnp.ndarray     # (R, S) u32 redundant headers ring: the entry id
                              # each slot SHOULD hold (journal.zig:17-46 dual
@@ -119,6 +132,8 @@ def make_state(n_replicas: int, slots: int, max_ops: int) -> ClusterState:
         op=jnp.zeros(n_replicas, jnp.int32),
         commit=jnp.zeros(n_replicas, jnp.int32),
         checkpoint=jnp.zeros(n_replicas, jnp.int32),
+        adopted_op=jnp.zeros(n_replicas, jnp.int32),
+        durable_op=jnp.zeros(n_replicas, jnp.int32),
         log=jnp.zeros((n_replicas, slots), jnp.uint32),
         log_hdr=jnp.zeros((n_replicas, slots), jnp.uint32),
         log_op=jnp.zeros((n_replicas, slots), jnp.int32),
@@ -126,6 +141,49 @@ def make_state(n_replicas: int, slots: int, max_ops: int) -> ClusterState:
         side=jnp.zeros(n_replicas, jnp.int32),
         canonical=jnp.zeros(max_ops, jnp.uint32),
         violated=jnp.zeros((), bool),
+    )
+
+
+def draw_faults(
+    key: jax.Array,
+    n_replicas: int,
+    slots: int,
+    *,
+    p_crash: float = 0.01,
+    p_restart: float = 0.2,
+    p_append: float = 0.6,
+    p_link: float = 0.7,
+    p_view_change: float = 0.3,
+    p_corrupt: float = 0.2,
+    p_repartition: float = 0.05,
+    p_amputate: float = 0.15,
+) -> dict:
+    """One step's fault/schedule draws as a plain dict of arrays.
+
+    Split out of step() so a cross-validation harness can extract the
+    EXACT schedule (tools/vopr_crossval.py replays it against the real
+    consensus code in sim/cluster.py) or script its own."""
+    R, S = n_replicas, slots
+    (k_crash, k_restart, k_cgate, k_cslot, k_part, k_append, k_link, k_vc,
+     k_sync, k_amp) = jax.random.split(key, 10)
+    k_pm, k_pg, k_ps, k_pw = jax.random.split(k_part, 4)
+    return dict(
+        crash=jax.random.bernoulli(k_crash, p_crash, (R,)),
+        restart=jax.random.bernoulli(k_restart, p_restart, (R,)),
+        corrupt_gate=jax.random.bernoulli(k_cgate, p_corrupt, (R,)),
+        corrupt_slot=jax.random.randint(k_cslot, (R,), 0, S),
+        # Crash-time suffix amputation (the seed-500285 window: an adopted
+        # suffix's bodies die with the crash while the durable log_view
+        # survives) — round-5 fault, defended by the adopted_op watermark.
+        amputate=jax.random.bernoulli(k_amp, p_amputate, (R,)),
+        repart=jax.random.bernoulli(k_pg, p_repartition),
+        part_mode=jax.random.randint(k_pm, (), 0, 4),
+        part_lone=jax.random.randint(k_pw, (), 0, R),
+        part_side=jax.random.bernoulli(k_ps, 0.5, (R,)).astype(jnp.int32),
+        append=jax.random.bernoulli(k_append, p_append, (R,)),
+        link=jax.random.bernoulli(k_link, p_link, (R,)),
+        vc=jax.random.bernoulli(k_vc, p_view_change, (R,)),
+        sync=jax.random.bernoulli(k_sync, 0.5, (R,)),
     )
 
 
@@ -143,9 +201,15 @@ def step(
     p_view_change: float = 0.3,
     p_corrupt: float = 0.2,
     p_repartition: float = 0.05,
+    p_amputate: float = 0.15,
     bug: Optional[str] = None,
+    faults: Optional[dict] = None,
 ) -> ClusterState:
-    """One simulation step for one cluster (vmapped over clusters)."""
+    """One simulation step for one cluster (vmapped over clusters).
+
+    ``faults``: a pre-drawn schedule dict (draw_faults) overrides the
+    in-step sampling — the cross-validation harness feeds the SAME
+    schedule to this model and to the real consensus code."""
     R, S = n_replicas, slots
     q_repl, q_view = quorums(R)
     if bug == "commit_quorum":
@@ -153,45 +217,68 @@ def step(
     if bug == "split_brain":
         q_view = 1                    # a partition minority may elect
     ckpt_interval = max(1, S // 2)
-    (k_crash, k_restart, k_cgate, k_cslot, k_part, k_append, k_link, k_vc,
-     k_sync) = jax.random.split(key, 9)
+    if faults is None:
+        faults = draw_faults(
+            key, R, S, p_crash=p_crash, p_restart=p_restart,
+            p_append=p_append, p_link=p_link, p_view_change=p_view_change,
+            p_corrupt=p_corrupt, p_repartition=p_repartition,
+            p_amputate=p_amputate,
+        )
     rids = jnp.arange(R)
     sidx = jnp.arange(S)[None, :]
 
-    (status, view, log_view, op, commit, checkpoint, log, log_hdr, log_op,
-     part_active, side, canonical, violated) = state
+    (status, view, log_view, op, commit, checkpoint, adopted_op, durable_op,
+     log, log_hdr, log_op, part_active, side, canonical, violated) = state
     commit0 = commit  # for the oracle: ops committed THIS step
 
     # 1. Crashes and restarts (WAL persists) + crash-time slot corruption
     # (testing/storage.zig: faults injected at crash; detectable via
     # checksums, so the slot is KNOWN damaged — never silently divergent).
-    crash = jax.random.bernoulli(k_crash, p_crash, (R,)) & (status == 0)
-    restart = jax.random.bernoulli(k_restart, p_restart, (R,)) & (status == 1)
+    crash = faults["crash"] & (status == 0)
+    restart = faults["restart"] & (status == 1)
     status = jnp.where(crash, 1, jnp.where(restart, 0, status))
-    corrupt_gate = jax.random.bernoulli(k_cgate, p_corrupt, (R,)) & crash
-    corrupt_slot = jax.random.randint(k_cslot, (R,), 0, S)
+    corrupt_gate = faults["corrupt_gate"] & crash
+    corrupt_slot = faults["corrupt_slot"]
     hit = corrupt_gate[:, None] & (sidx == corrupt_slot[:, None]) & (log_op >= 1)
     # Crash faults damage the PREPARE ring; the redundant headers ring
     # survives, so the replica still knows which checksum the slot needs.
     log = jnp.where(hit, CORRUPT, log)
+    # Crash-time SUFFIX AMPUTATION (round 5; the seed-500285 window): a
+    # join-adopted suffix whose bodies were never individually journaled
+    # dies with the crash — slots in (durable_op, op] zero out and the
+    # head regresses to the durability floor, while the durable log_view
+    # (and adopted_op watermark) survive.  NEVER below durable_op: acks
+    # follow the fsync, so an acked prepare is not losable — erasing one
+    # would (correctly!) fork the cluster, but as a simulator bug, not a
+    # protocol find.  The defense below (suspect = op < adopted_op) keeps
+    # the shortened log from vouching in canonical selection.
+    amputate = faults["amputate"] & crash
+    amp_floor = jnp.maximum(commit, durable_op)
+    amp_hit = (
+        amputate[:, None] & (log_op > amp_floor[:, None])
+        & (log_op <= op[:, None])
+    )
+    log = jnp.where(amp_hit, jnp.uint32(0), log)
+    log_hdr = jnp.where(amp_hit, jnp.uint32(0), log_hdr)
+    log_op = jnp.where(amp_hit, 0, log_op)
+    op = jnp.where(amputate, amp_floor, op)
     alive = status == 0
 
     # 2. Partitions (packet_simulator.zig modes): persistent across steps,
     # re-sampled with p_repartition.  conn[i,j]: i can exchange with j.
-    k_pm, k_pg, k_ps, k_pw = jax.random.split(k_part, 4)
-    repart = jax.random.bernoulli(k_pg, p_repartition)
-    mode = jax.random.randint(k_pm, (), 0, 4)  # 0,1: none; 2: isolate; 3: split
-    lone = jax.random.randint(k_pw, (), 0, R)
+    repart = faults["repart"]
+    mode = faults["part_mode"]  # 0,1: none; 2: isolate; 3: split
+    lone = faults["part_lone"]
     new_side = jnp.where(
         mode == 2,
         (rids == lone).astype(jnp.int32),
-        jax.random.bernoulli(k_ps, 0.5, (R,)).astype(jnp.int32),
+        faults["part_side"],
     )
     side = jnp.where(repart, new_side, side)
     part_active = jnp.where(repart, mode >= 2, part_active)
     conn = (~part_active) | (side[:, None] == side[None, :])
     conn = conn | jnp.eye(R, dtype=bool)
-    link_up = jax.random.bernoulli(k_link, p_link, (R,))
+    link_up = faults["link"]
 
     # 3. Perceived views: gossip is connectivity-bound, so each replica's
     # working view is the max view among the replicas it can reach — two
@@ -215,7 +302,18 @@ def step(
     log_opP = jnp.take(log_op, prim, axis=0)
     opP = op[prim]
     ckptP = checkpoint[prim]
-    if bug != "no_truncate":
+    if bug == "join_keep_stale":
+        # Round-4 real-sweep find, ported: a joiner keeps its own stale
+        # ring content below the SV window (only empty slots install) —
+        # the verification-floor failure that committed a view-0 register
+        # at an op view 1 had refilled.
+        fresh = joiner[:, None] & (log == 0)
+        log = jnp.where(fresh, logP, log)
+        log_hdr = jnp.where(fresh, log_hdrP, log_hdr)
+        log_op = jnp.where(fresh, log_opP, log_op)
+        op = jnp.where(joiner, opP, op)
+        checkpoint = jnp.where(joiner, jnp.maximum(checkpoint, ckptP), checkpoint)
+    elif bug != "no_truncate":
         log = jnp.where(joiner[:, None], logP, log)
         log_hdr = jnp.where(joiner[:, None], log_hdrP, log_hdr)
         log_op = jnp.where(joiner[:, None], log_opP, log_op)
@@ -223,6 +321,13 @@ def step(
         checkpoint = jnp.where(joiner, jnp.maximum(checkpoint, ckptP), checkpoint)
     log_view = jnp.where(joiner, perceived, log_view)
     view = jnp.where(joiner, perceived, view)  # perceived >= view always
+    # The adoption watermark persists with the log_view advance: the SV
+    # certified the canonical log through opP (consensus.py on_start_view).
+    # durable_op does NOT rise (and truncation may lower it): the installed
+    # headers' bodies are fetched+journaled by the repair/fetch paths below
+    # — until then the suffix is crash-losable (the amputation window).
+    adopted_op = jnp.where(joiner, opP, adopted_op)
+    durable_op = jnp.where(joiner, jnp.minimum(durable_op, op), durable_op)
 
     # 5. Acting primaries append (client request -> prepare).  The ring may
     # not wrap past the checkpoint floor (constants.zig checkpoint
@@ -233,7 +338,7 @@ def step(
         floor_ok = jnp.ones_like(floor_ok)
     can_append = (
         acting & floor_ok & (new_op < max_ops - 1)
-        & jax.random.bernoulli(k_append, p_append, (R,))
+        & faults["append"]
     )
     app_entry = _entry(perceived, new_op)
     app_write = can_append[:, None] & (sidx == (new_op % S)[:, None])
@@ -241,6 +346,8 @@ def step(
     log_hdr = jnp.where(app_write, app_entry[:, None], log_hdr)
     log_op = jnp.where(app_write, new_op[:, None], log_op)
     op = jnp.where(can_append, new_op, op)
+    # A primary's own append is journaled+synced before anything acks it.
+    durable_op = jnp.where(can_append, new_op, durable_op)
 
     # 6. Primary self-repair of corrupt slots from reachable peers —
     # request_prepare BY CHECKSUM: the surviving headers ring says exactly
@@ -278,6 +385,12 @@ def step(
             # Op-aware ring: a slot holding a RECYCLED op is a mismatch
             # even when the entry bytes happen to be present.
             mismatch = mismatch | ((log_op != log_opP) & (log_opP >= 1))
+        if bug == "join_keep_stale":
+            # The verification-floor blindness: every slot this replica
+            # populated counts as verified-canonical; only HOLES are seen
+            # as divergence — so stale pre-join content gets acked and
+            # committed as if it chained.
+            mismatch = (log == 0) & (log_opP >= 1)
         first_bad = jnp.min(jnp.where(mismatch, log_opP, INF), axis=1)
         return first_bad, jnp.minimum(first_bad - 1, opP)
 
@@ -310,8 +423,12 @@ def step(
     else:
         log_op = jnp.where(sync_write, log_opP, log_op)
     op = jnp.where(can_sync, jnp.maximum(op, target), op)
+    # Each repaired prepare is journaled + synced individually.
+    durable_op = jnp.where(
+        can_sync & (target == durable_op + 1), target, durable_op
+    )
 
-    state_sync = reachable & ~t_in_ring & jax.random.bernoulli(k_sync, 0.5, (R,))
+    state_sync = reachable & ~t_in_ring & faults["sync"]
     log = jnp.where(state_sync[:, None], logP, log)
     log_hdr = jnp.where(
         state_sync[:, None], jnp.take(log_hdr, prim, axis=0), log_hdr
@@ -320,11 +437,30 @@ def step(
     op = jnp.where(state_sync, opP, op)
     checkpoint = jnp.where(state_sync, jnp.maximum(checkpoint, ckptP), checkpoint)
     commit = jnp.where(state_sync, jnp.maximum(commit, ckptP), commit)
+    # The adopted snapshot+ring IS the log now (written + synced whole);
+    # the old watermark referred to a WAL the sync replaced
+    # (consensus.py sync completion).
+    adopted_op = jnp.where(state_sync, opP, adopted_op)
+    durable_op = jnp.where(state_sync, opP, durable_op)
 
     # Recompute the prefix after repair writes (acks below see fresh state).
     logP = jnp.take(log, prim, axis=0)
     log_opP = jnp.take(log_op, prim, axis=0)
     first_bad, prefix_ok = prefix_vs_primary(log, log_op, logP, log_opP, op[prim])
+
+    # Body fetch: a backup whose ring already matches the primary through
+    # its head (headers installed by a join) pulls outstanding bodies and
+    # journals them — closing the amputation window INCREMENTALLY
+    # (replica.zig repair: request_prepare per missing body, ack follows
+    # each sync; a bulk adoption's bodies take several round trips, which
+    # is exactly the window the amputation fault probes).
+    fetch_chunk = max(1, S // 8)
+    fetched = is_backup & link_up & (first_bad > op) & (durable_op < op)
+    durable_op = jnp.where(
+        fetched,
+        jnp.minimum(op, jnp.maximum(durable_op, commit) + fetch_chunk),
+        durable_op,
+    )
 
     # 9. Commit: each acting primary advances when a replication quorum of
     # in-view, reachable replicas acks op commit+1 — an ack REQUIRES the
@@ -334,7 +470,10 @@ def step(
         alive & (log_view == perceived) & connP & (op >= k_op)
     )
     if bug != "no_truncate":
-        ack = ack & (prefix_ok >= k_op)
+        # An ack asserts BOTH the matching prefix and that the prepare's
+        # body is journaled + synced (acks follow the sync): a join-
+        # installed header alone may never be acked.
+        ack = ack & (prefix_ok >= k_op) & (durable_op >= k_op)
     ack_count = jnp.zeros(R, jnp.int32).at[prim].add(ack.astype(jnp.int32))
     k_self = commit + 1
     k_slot = k_self % S
@@ -351,7 +490,9 @@ def step(
     # 10. Commit heartbeat: backups adopt the primary's commit bounded by
     # their own matching prefix (a backup never commits past what it can
     # prove it holds).
-    hb = jnp.minimum(commit[prim], prefix_ok)
+    # Commit execution needs the BODY (the replica executes from its own
+    # journal), so the heartbeat is durability-bounded too.
+    hb = jnp.minimum(jnp.minimum(commit[prim], prefix_ok), durable_op)
     if bug == "no_truncate":
         hb = commit[prim]
     commit = jnp.where(
@@ -379,24 +520,43 @@ def step(
     # resulting lost-commit fork within 128 schedules.
     dead_prim = alive & (~aliveP | ~connP)
     same_view = perceived[:, None] == perceived[None, :]
-    svc = dead_prim & jax.random.bernoulli(k_vc, p_view_change, (R,))
+    svc = dead_prim & faults["vc"]
     participant = (
         alive[None, :] & conn & same_view & svc[None, :]
     )  # (r, r'): r' is a DVC sender reachable from r in r's view
-    cnt = jnp.sum(participant, axis=1)
+    # Amputation suspicion (the adopted_op watermark): a log whose head
+    # regressed below its adoption certification must not vouch in the
+    # canonical selection — its (log_view, short-op) claim would OUT-RANK
+    # an intact lower-log_view log and truncate committed history (the
+    # seed-500285 class, now a first-class model fault).  The view-change
+    # QUORUM itself counts only clean (non-suspect) senders: then a clean
+    # q_view set intersects every commit quorum (q_repl + q_view > R), so
+    # some acker of each committed op is clean — and a clean winner's op
+    # covers its own adoption certification — so max (log_view, op) over
+    # the clean set holds all committed history.  Counting suspects toward
+    # the quorum while excluding them from selection is UNSOUND: an
+    # election can then fire with one short clean donor while the intact
+    # acker sits outside the partition (found by this oracle at S=8,
+    # seed 7, cluster 73 — committed ops 13-14 truncated).
+    suspect = op < adopted_op
+    if bug != "amputate_vouch":
+        clean_donor_ok = participant & ~suspect[None, :]
+    else:
+        clean_donor_ok = participant
+    cnt = jnp.sum(clean_donor_ok, axis=1)
     fire = svc & (cnt >= q_view)
     new_view = perceived + 1
     new_prim = new_view % R
     inst = fire & (new_prim == rids)
     if bug == "canonical_by_op":
         rank = op[None, :].astype(jnp.int64) - jnp.where(
-            participant, 0, jnp.int64(1) << 60
+            clean_donor_ok, 0, jnp.int64(1) << 60
         )
     else:
         rank = (
             log_view[None, :].astype(jnp.int64) * jnp.int64(max_ops + S)
             + op[None, :]
-            - jnp.where(participant, 0, jnp.int64(1) << 60)
+            - jnp.where(clean_donor_ok, 0, jnp.int64(1) << 60)
         )
     donor = jnp.argmax(rank, axis=1)  # per prospective new primary
     log = jnp.where(inst[:, None], jnp.take(log, donor, axis=0), log)
@@ -408,6 +568,13 @@ def step(
         inst, jnp.maximum(checkpoint, checkpoint[donor]), checkpoint
     )
     log_view = jnp.where(inst, new_view, log_view)
+    # The election certified the donor's log through op[donor] under the
+    # new log_view: that is the new primary's adoption watermark — and the
+    # new primary journals every canonical body before finishing the view
+    # change (consensus._finish_view_change's gap check), so durability
+    # covers the whole adopted log.
+    adopted_op = jnp.where(inst, op[donor], adopted_op)
+    durable_op = jnp.where(inst, op[donor], durable_op)
     # Every DVC sender of a fired election bumps (it is bound to the new
     # view); senders whose election did not fire stay put.
     bumped = jnp.any(inst[:, None] & participant, axis=0)
@@ -448,6 +615,7 @@ def step(
         status.astype(jnp.int32), view.astype(jnp.int32),
         log_view.astype(jnp.int32), op.astype(jnp.int32),
         commit.astype(jnp.int32), checkpoint.astype(jnp.int32),
+        adopted_op.astype(jnp.int32), durable_op.astype(jnp.int32),
         log.astype(jnp.uint32), log_hdr.astype(jnp.uint32),
         log_op.astype(jnp.int32),
         part_active, side.astype(jnp.int32), canonical, violated,
@@ -457,6 +625,15 @@ def step(
 BUGS = (
     "commit_quorum", "canonical_by_op", "no_truncate", "corrupt_serve",
     "wal_wrap", "split_brain",
+    # Round-5 additions, ported from round-4 REAL-code sweep finds
+    # (commit c2b02c2) so the model hunts the bug classes the production
+    # sweep actually caught:
+    # - amputate_vouch: a crash-amputated log ignores its adoption
+    #   watermark and vouches (log_view, short-op) in canonical selection
+    #   (the seed-500285 truncation; consensus.py log_adopted_op defense).
+    # - join_keep_stale: a joiner keeps stale ring content below the SV
+    #   window and trusts it as verified (the verification-floor find).
+    "amputate_vouch", "join_keep_stale",
 )
 
 # The harsh fault schedule certified clean by tests/test_vopr.py and
