@@ -86,12 +86,18 @@ def test_vopr_tpu_log_wrap_is_safe():
 def test_vopr_tpu_catches_injected_bugs(bug):
     # split_brain needs a partition minority that can still reach the
     # (buggy) election size: R=5 split 2/3.  wal_wrap needs frequent ring
-    # wrap: S=8.
+    # wrap: S=8.  amputate_vouch needs the join->crash window held open
+    # (low link-up keeps bodies unfetched) plus aggressive crash/amputate
+    # rates to line up with an election.
     n_replicas = 5 if bug == "split_brain" else 3
     slots = 8 if bug == "wal_wrap" else 32
+    probs = dict(HARSH)
+    if bug == "amputate_vouch":
+        probs.update(p_crash=0.15, p_restart=0.4, p_view_change=0.6,
+                     p_link=0.35, p_repartition=0.2, p_amputate=0.6)
     v = vopr_tpu.run(
         seed=1, n_clusters=256, n_steps=300, bug=bug,
-        n_replicas=n_replicas, slots=slots, **HARSH,
+        n_replicas=n_replicas, slots=slots, **probs,
     )
     assert v.sum() > 0, f"oracle missed injected bug {bug}"
 
@@ -134,3 +140,24 @@ def test_vopr_round4_sweep_regressions(tmp_path, seed, kind):
     exposed them)."""
     result = run_seed(seed, workdir=str(tmp_path))
     assert result.exit_code == EXIT_PASSED, (kind, result)
+
+
+def test_vopr_standby_recovering_view_regression(tmp_path):
+    """Round-5 standby-dimension find (seed 13 @ standbys=2): a standby
+    restarted into a stale view wedged in RECOVERING forever in a
+    quiescent cluster — its request_start_view targeted the OLD view's
+    primary, and the view-change escape valve is voters-only.  Fixed by
+    ping-header view learning while RECOVERING (consensus.on_ping)."""
+    result = run_seed(13, workdir=str(tmp_path), ticks=4_000, standbys=2)
+    assert result.exit_code == EXIT_PASSED, result
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(700_000, 700_012)))
+def test_vopr_standby_sweep(tmp_path, seed):
+    """Standby topologies under the full fault schedule, with mid-schedule
+    promotion (VERDICT r5 ask #10).  Sampled standby counts come from a
+    separate stream, so these schedules are new coverage, not shifted
+    pins."""
+    result = run_seed(seed, workdir=str(tmp_path), ticks=4_000, standbys=None)
+    assert result.exit_code == EXIT_PASSED, result

@@ -201,9 +201,16 @@ class SimCluster:
         hash_log: bool = True,
         audit: bool = True,
         hot_transfers_capacity_max: Optional[int] = None,
+        n_standbys: int = 0,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
+        # Non-voting stream consumers at indexes [n, n + n_standbys)
+        # (constants.zig:31-35); they journal + commit via the prepare
+        # stream but never ack or vote, and may be PROMOTED into a voting
+        # slot mid-schedule (VsrReplica.promote).
+        self.n_standbys = n_standbys
+        self.total = n_replicas + n_standbys
         self.seed = seed
         self.cluster_id = cluster_id
         self.config = config or TEST_MIN
@@ -218,7 +225,7 @@ class SimCluster:
 
         # Per-replica wall-clock offsets (exercise the Marzullo clock).
         self.wall_offsets = [
-            self.rng.randrange(-40, 40) * 1_000_000 for _ in range(self.n)
+            self.rng.randrange(-40, 40) * 1_000_000 for _ in range(self.total)
         ]
         # One fault atlas across the cluster keeps injected storage faults
         # repairable (never a quorum of copies of one object).
@@ -255,28 +262,31 @@ class SimCluster:
                     0.0 if i in self.core else misdirect_probability
                 ),
             )
-            for i in range(self.n)
+            for i in range(self.total)
         ]
         # Divergence oracle: per-replica op->digest logs that SURVIVE
         # restarts (like the disk), so crash-replay digests are checked
         # against the original run (utils/hash_log.OpHashLog).
         from ..utils.hash_log import OpHashLog
 
-        self.hash_logs = [OpHashLog() if hash_log else None for _ in range(self.n)]
+        self.hash_logs = [
+            OpHashLog() if hash_log else None for _ in range(self.total)
+        ]
         # Op-ordered reply auditor (testing/auditor.py, auditor.zig's role):
         # every replica's commits — including crash-replays — are checked
         # bit-for-bit against each other and against the oracle model.
         from ..testing.auditor import Auditor
 
         self.auditor = Auditor() if audit else None
-        self.replicas: List[Optional[VsrReplica]] = [None] * self.n
-        self.alive = [False] * self.n
-        for i in range(self.n):
+        self.replicas: List[Optional[VsrReplica]] = [None] * self.total
+        self.alive = [False] * self.total
+        for i in range(self.total):
             VsrReplica.format(
                 self._data_path(i),
                 cluster=cluster_id,
                 replica=i,
                 replica_count=self.n,
+                standby_count=self.n_standbys,
                 cluster_config=self.config,
                 storage=self.storages[i],
             )
@@ -344,6 +354,29 @@ class SimCluster:
     def restart(self, i: int) -> None:
         self.start(i)
 
+    def promote_standby(self, standby: int, voter_slot: int) -> None:
+        """Promote a (stopped) standby's data file into a (stopped) voting
+        slot — the in-sim twin of VsrReplica.promote + the operator moving
+        the file to the retired voter's address (tests/test_standby.py).
+        The standby index is retired permanently; the promoted node serves
+        from ``voter_slot`` with everything it learned from the stream."""
+        assert standby >= self.n and not self.alive[standby]
+        assert voter_slot < self.n and not self.alive[voter_slot]
+        from ..vsr.superblock import SuperBlock
+
+        sb = SuperBlock(self.storages[standby])
+        state = sb.open()
+        assert state.replica >= state.replica_count, "already a voter"
+        state.replica = voter_slot
+        sb.checkpoint(state)
+        self.storages[standby].sync()
+        # The promoted file now serves from the voter's ADDRESS slot; the
+        # retired voter's old storage is discarded (new machine, same
+        # address) and the standby index never runs again.
+        self.storages[voter_slot] = self.storages[standby]
+        self.hash_logs[voter_slot] = self.hash_logs[standby]
+        self.start(voter_slot)
+
     def partition(self, groups: List[List[int]]) -> None:
         self.net.partition([[("replica", r) for r in g] for g in groups])
 
@@ -381,7 +414,7 @@ class SimCluster:
                 except ValueError:
                     continue
                 client.on_message(h, command, body, self.t)
-        for i in range(self.n):
+        for i in range(self.total):
             if self.alive[i]:
                 try:
                     self._route(("replica", i), self.replicas[i].tick())
@@ -438,28 +471,25 @@ class SimCluster:
 
     def check_conservation(self) -> None:
         """Double-entry invariant: Σ debits_posted == Σ credits_posted and
-        Σ debits_pending == Σ credits_pending over all accounts."""
+        Σ debits_pending == Σ credits_pending over all accounts (shared
+        oracle definition: utils/conservation.py)."""
+        from ..utils.conservation import live_rows, u128_field_total
+
         for i, (r, a) in enumerate(zip(self.replicas, self.alive)):
             if not a:
                 continue
             acc = r.machine.ledger.accounts
-            live = (~np.asarray(acc.tombstone)) & (
-                (np.asarray(acc.key_lo) != 0) | (np.asarray(acc.key_hi) != 0)
+            live = live_rows(acc)
+            assert u128_field_total(
+                acc, "debits_posted", live
+            ) == u128_field_total(acc, "credits_posted", live), (
+                f"replica {i}: posted debits != credits"
             )
-
-            def total(col_lo, col_hi):
-                lo = np.asarray(acc.cols[col_lo], dtype=np.uint64)[live]
-                hi = np.asarray(acc.cols[col_hi], dtype=np.uint64)[live]
-                return int(lo.astype(object).sum()) + (
-                    int(hi.astype(object).sum()) << 64
-                )
-
-            assert total("debits_posted_lo", "debits_posted_hi") == total(
-                "credits_posted_lo", "credits_posted_hi"
-            ), f"replica {i}: posted debits != credits"
-            assert total("debits_pending_lo", "debits_pending_hi") == total(
-                "credits_pending_lo", "credits_pending_hi"
-            ), f"replica {i}: pending debits != credits"
+            assert u128_field_total(
+                acc, "debits_pending", live
+            ) == u128_field_total(acc, "credits_pending", live), (
+                f"replica {i}: pending debits != credits"
+            )
 
     def run_until(self, predicate, max_ticks: int = 20_000, step: int = 50) -> bool:
         for _ in range(0, max_ticks, step):

@@ -42,12 +42,20 @@ def run_seed(
     workdir: Optional[str] = None,
     ticks: int = 6_000,
     settle_ticks: int = 60_000,
+    standbys: Optional[int] = 0,
 ) -> VoprResult:
-    """One VOPR run: random topology + faults from ``seed``."""
+    """One VOPR run: random topology + faults from ``seed``.
+
+    ``standbys``: 0 (default — pinned regression seeds replay their exact
+    round-4 schedules), an explicit count, or None to SAMPLE 0-2 standbys
+    from a separate stream (the sweep runner's mode; a separate stream so
+    enabling the dimension does not shift any pinned seed's schedule)."""
     rng = random.Random(seed)
     n_replicas = rng.choice([2, 3, 3, 3, 5])  # simulator.zig random topology
     n_clients = rng.randint(1, 3)
     requests = rng.randint(8, 20)
+    if standbys is None:
+        standbys = random.Random(seed ^ 0x57B7).choice([0, 0, 0, 1, 2])
     net = PacketSimulator(
         seed=seed + 1,
         delay_mean=rng.randint(2, 5),
@@ -78,9 +86,11 @@ def run_seed(
             read_fault_probability=read_fault_p,
             misdirect_probability=misdirect_p,
             hot_transfers_capacity_max=hot_cap,
+            n_standbys=standbys,
         )
         faults = 0
         down: set = set()
+        retired: set = set()  # promoted-away standbys + retired voters
         partitioned = False
         # With storage faults active, never crash CORE replicas: a faulted
         # copy on a non-core replica plus a crashed core holder of the
@@ -88,21 +98,34 @@ def run_seed(
         # (simulator.zig's liveness core; see SimCluster.core).
         if read_fault_p or misdirect_p:
             crashable = [
-                i for i in range(n_replicas) if i not in cluster.core
+                i for i in range(cluster.total) if i not in cluster.core
             ]
         else:
-            crashable = list(range(n_replicas))
+            crashable = list(range(cluster.total))
         try:
             for t in range(ticks):
                 cluster.step()
                 # Random fault events (simulator.zig crash/partition probs).
                 r = rng.random()
-                if r < 0.002 and len(down) + 1 < n_replicas:
-                    victim = rng.randrange(n_replicas)
-                    # alive check: the sim fail-stops a replica itself on a
-                    # persistent journal write failure.
-                    if victim in crashable and victim not in down and (
-                        cluster.alive[victim]
+                voters_down = sum(1 for d in down if d < n_replicas)
+                # Standby crashes never threaten availability; voter
+                # crashes keep the usual one-short-of-all guard.  For
+                # standbys==0 this `if` condition — INCLUDING its elif
+                # fall-through when the guard fails — and the rng draws
+                # are bit-identical to round 4, so pinned seeds replay
+                # their exact schedules.
+                if r < 0.002 and (standbys or voters_down + 1 < n_replicas):
+                    if standbys:
+                        victim = rng.randrange(cluster.total)
+                        if victim < n_replicas and (
+                            voters_down + 1 >= n_replicas
+                        ):
+                            victim = None  # would break availability
+                    else:
+                        victim = rng.randrange(n_replicas)
+                    if victim is not None and victim in crashable and (
+                        victim not in down and victim not in retired
+                        and cluster.alive[victim]
                     ):
                         cluster.crash(victim)
                         down.add(victim)
@@ -122,6 +145,24 @@ def run_seed(
                 elif r < 0.007 and partitioned:
                     cluster.heal()
                     partitioned = False
+                elif r < 0.008 and standbys:
+                    # PROMOTION mid-schedule: a crashed voter is retired
+                    # and a live standby's file takes over its slot
+                    # (operator reconfiguration under fire).  Guarded on
+                    # standbys>0 so standby-free schedules — including
+                    # every pinned regression seed — are bit-identical.
+                    downs = sorted(d for d in down if d < n_replicas)
+                    live_sb = [
+                        i for i in range(n_replicas, cluster.total)
+                        if cluster.alive[i] and i not in retired
+                    ]
+                    if downs and live_sb:
+                        v, s = downs[0], live_sb[0]
+                        cluster.crash(s)
+                        cluster.promote_standby(s, v)
+                        retired.add(s)
+                        down.discard(v)
+                        faults += 1
                 elif r < 0.009 and n_replicas >= 2:
                     # Clog one replica<->replica path for a while
                     # (packet_simulator.zig clogging).
@@ -131,10 +172,11 @@ def run_seed(
                     )
                     faults += 1
             # Heal everything; the cluster must converge.  Restart every
-            # dead replica — scheduled crashes AND sim fail-stops.
+            # dead node — scheduled crashes AND sim fail-stops — except
+            # promoted-away standby indexes, which never run again.
             cluster.heal()
-            for i in range(n_replicas):
-                if not cluster.alive[i]:
+            for i in range(cluster.total):
+                if i not in retired and not cluster.alive[i]:
                     cluster.restart(i)
             down.clear()
             ok = cluster.run_until(

@@ -2154,7 +2154,19 @@ class VsrReplica(Replica):
             ping_timestamp_monotonic=int(h["ping_timestamp_monotonic"]),
             pong_timestamp_wall=self._realtime(),
         )
-        return [(("replica", int(h["replica"])), wire.encode(pong))]
+        out = [(("replica", int(h["replica"])), wire.encode(pong))]
+        # A RECOVERING replica learns newer views from ping headers: its
+        # request_start_view targets the primary of ITS view, so in a
+        # QUIESCENT cluster (no prepares flowing to bump it) a restart
+        # into a stale view wedged forever — the view-change escape valve
+        # is voters-only, so a restarted STANDBY never recovered (round-5
+        # standby VOPR find, seed 13: standby stuck 'recovering' at view 3
+        # under a view-4 cluster).  Adopt the view and re-aim the RSV.
+        if self.status == RECOVERING and int(h["view"]) > self.view:
+            self.view = int(h["view"])
+            self._persist_view()
+            out.extend(self._request_start_view(self.view))
+        return out
 
     def on_pong(self, h: np.ndarray, body: bytes) -> List[Msg]:
         ping_mono = int(h["ping_timestamp_monotonic"])
