@@ -450,9 +450,30 @@ def main() -> None:
                 jnp.where(scat, plan.s_slot, cap_sentinel), scat,
                 plan.bal_incl,
             )
+            # History append (has_history=True path), so the ladder's top
+            # slice equals kernel_general_twop_full and the stage deltas
+            # attribute EVERY stage — a residual gap would read as noise.
+            do_hist_c = plan.do_hist & commit
+            hst = led_.history
+            h_off = (
+                jnp.cumsum(do_hist_c.astype(jnp.uint64))
+                - do_hist_c.astype(jnp.uint64)
+            )
+            h_idx = jnp.where(
+                do_hist_c, hst.count + h_off, jnp.uint64(hst.capacity)
+            )
+            history = hst.replace(
+                cols={
+                    name: hst.cols[name].at[h_idx].set(
+                        plan.hist_row[name], mode="drop"
+                    )
+                    for name in hst.cols
+                },
+                count=hst.count + jnp.sum(do_hist_c.astype(jnp.uint64)),
+            )
             return (
                 led_.replace(accounts=accounts, transfers=transfers,
-                             posted=posted),
+                             posted=posted, history=history),
                 acc_,
             )
 
