@@ -260,3 +260,47 @@ class TestBusClassificationUpgrade:
                     is_peer = True
                     is_client = False
         assert is_peer and not is_client
+
+
+# -- round-4 advisor medium: log_adopted_op watermark -------------------------
+
+
+def _reopen(tmp_path, i, n=3):
+    r = VsrReplica(
+        str(tmp_path / f"r{i}.data"), cluster_config=CFG,
+        ledger_config=LEDGER, batch_lanes=64, seed=7 + i,
+    )
+    r.open()
+    return r
+
+
+def test_lagging_backup_restart_is_not_suspect(tmp_path):
+    """ADVICE r4 (medium): heartbeat-learned commit_max routinely exceeds
+    an intact lagging backup's journal head — persisting it into the
+    amputation predicate falsely marked such backups log_suspect after a
+    clean crash, wedging view changes when the primary also died.  The
+    suspicion now keys on the log_adopted_op watermark (written only at
+    view adoption), so the common lagging-backup crash restarts clean."""
+    path = str(tmp_path / "r1.data")
+    VsrReplica.format(
+        path, cluster=CLUSTER, replica=1, replica_count=3,
+        cluster_config=CFG,
+    )
+    r = _reopen(tmp_path, 1)
+    r.commit_max = 500          # cluster knowledge, far past the local log
+    r._persist_view()
+    assert r._sb_state.commit_max >= 500
+    assert r._sb_state.log_adopted_op == 0
+
+    r2 = _reopen(tmp_path, 1)
+    assert not getattr(r2, "_log_suspect", False), (
+        "intact lagging backup restarted log_suspect"
+    )
+    # The watermark still arms the seed-500285 guard: a durable adoption
+    # beyond the recovered head marks the log suspect until repaired.
+    r2._log_adopted_op = 40
+    r2._persist_view()
+    r3 = _reopen(tmp_path, 1)
+    assert getattr(r3, "_log_suspect", False), (
+        "short-of-adoption restart must be suspect"
+    )
