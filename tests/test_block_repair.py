@@ -240,3 +240,45 @@ def test_solo_replica_damage_is_fatal(tmp_path):
     _corrupt(base_path)
     with pytest.raises(ForestDamage):
         cluster.restart(0)
+
+
+def test_missing_cold_run_repaired_from_peer(tmp_path):
+    """A missing COLD-TIER run file on a restarting replica routes to peer
+    block repair (kind 'cold', addressed by checksum) instead of crashing
+    the open — round-5 standby-sweep find: cold.load_manifest raised
+    FileNotFoundError straight through replica startup."""
+    net = PacketSimulator(seed=71)
+    cluster = SimCluster(
+        str(tmp_path), n_replicas=3, n_clients=2, seed=70,
+        requests_per_client=220, net=net,
+        hot_transfers_capacity_max=128,  # force evictions -> cold runs
+    )
+    ok = cluster.run_until(
+        lambda: all(
+            a and r.op_checkpoint > 0
+            and r.machine.host_state().get("cold_manifest")
+            for r, a in zip(cluster.replicas, cluster.alive)
+        ),
+        max_ticks=120_000,
+    )
+    assert ok, "cluster never checkpointed with a cold manifest"
+    victim = 0
+    # Restart once cleanly: the reopened replica's cold manifest now
+    # reflects exactly what the DURABLE checkpoint references (the live
+    # pre-crash state may have drifted past the last checkpoint).
+    cluster.crash(victim)
+    cluster.restart(victim)
+    r = cluster.replicas[victim]
+    manifest = r.machine.host_state().get("cold_manifest")
+    assert manifest, "restart lost the cold manifest"
+    rel = manifest[0]["path"]
+    path = os.path.join(r.machine.cold.directory, rel)
+    cluster.crash(victim)
+    assert os.path.exists(path)
+    os.remove(path)
+    cluster.restart(victim)
+    replica = cluster.replicas[victim]
+    assert replica._block_repair is not None, "cold damage not detected"
+    assert any(k == "cold" for k, _, _ in replica._block_repair["queue"])
+    finish(cluster)
+    assert cluster.replicas[victim].blocks_repaired >= 1

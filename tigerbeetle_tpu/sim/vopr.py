@@ -208,6 +208,19 @@ def run_seed(
                 seed, EXIT_CORRECTNESS, f"oracle violation: {err}",
                 cluster.t, 0, faults,
             )
+        except Exception as err:  # noqa: BLE001 — a crash IS a find
+            # An unhandled exception from the production code under fault
+            # schedule is a correctness find, not a sweep-killer: seed
+            # 600434's cold-manifest FileNotFoundError took down a whole
+            # round-5 sweep because only AssertionError was caught.
+            import traceback
+
+            tb = traceback.format_exc().strip().splitlines()
+            return VoprResult(
+                seed, EXIT_CORRECTNESS,
+                f"crash: {type(err).__name__}: {err} @ {tb[-3:]}",
+                cluster.t, 0, faults,
+            )
 
     if workdir is not None:
         return go(workdir)

@@ -307,7 +307,9 @@ class VsrReplica(Replica):
         except ForestDamage as err:
             if self.replica_count == 1:
                 raise  # solo: no peer to repair from
-            self._enter_block_repair(err.damage)
+            self._enter_block_repair(
+                err.damage, getattr(err, "cold_paths", None)
+            )
             return
         self._post_open(recovery)
 
@@ -1722,7 +1724,7 @@ class VsrReplica(Replica):
     # Only if no peer can serve the bytes (peers checkpointed past us and
     # GC'd, or histories diverged) does it fall back to full state sync.
 
-    def _enter_block_repair(self, damage) -> None:
+    def _enter_block_repair(self, damage, cold_paths=None) -> None:
         self._init_clock()
         self.status = RECOVERING
         self._recovering_since = self._ticks
@@ -1732,6 +1734,9 @@ class VsrReplica(Replica):
             "peer": self._next_peer(self.replica),
             "attempts": 0,              # timed-out requests since progress
             "requested": False,
+            # Cold entries are addressed by checksum; this maps each to the
+            # relative file name the fetched bytes install under.
+            "cold_paths": dict(cold_paths or {}),
             # Fire the first request on the very next tick, not after a
             # full resend interval.
             "last_req": self._ticks - BLOCK_REPAIR_RESEND,
@@ -1821,7 +1826,16 @@ class VsrReplica(Replica):
         br["attempts"] = 0
         if len(br["buf"]) < int(h["total"]):
             return self._request_block()
-        if not self.forest.repair_block(kind, ident, expect, bytes(br["buf"])):
+        if kind == "cold":
+            rel = br["cold_paths"].get(expect)
+            installed = rel is not None and self.machine.cold.install_file(
+                rel, expect, bytes(br["buf"])
+            )
+        else:
+            installed = self.forest.repair_block(
+                kind, ident, expect, bytes(br["buf"])
+            )
+        if not installed:
             # Bytes don't hash to the pinned checksum (corrupt/malicious
             # peer): retry the whole file from the next peer.
             br["buf"] = bytearray()
@@ -1843,6 +1857,11 @@ class VsrReplica(Replica):
         except ForestDamage as err:
             br = self._block_repair
             br["queue"] = list(err.damage)
+            # A repaired forest may reveal COLD damage next (or vice
+            # versa): the path map must follow the new queue, or the
+            # receiver can never install the fetched bytes and livelocks
+            # re-requesting the same file.
+            br["cold_paths"] = dict(getattr(err, "cold_paths", None) or {})
             br["buf"] = bytearray()
             br["attempts"] = 0
             return self._request_block()
@@ -2121,6 +2140,7 @@ class VsrReplica(Replica):
             cluster=self.cluster,
             replica=self.replica,
             replica_count=self.replica_count,
+            standby_count=self.standby_count,  # membership rides every write
             view=self.view,
             log_view=self.log_view,
             commit_min=self.commit_min,

@@ -59,16 +59,20 @@ class InvalidRequest(Exception):
 
 
 class ForestDamage(RuntimeError):
-    """Checkpoint files (manifest/base/runs) are corrupt or missing.
+    """Checkpoint files (manifest/base/runs/cold) are corrupt or missing.
 
     ``damage`` lists (kind, ident, expected_checksum) triples.  A solo
     replica treats this as fatal; a consensus replica repairs the files
     from peers via request_blocks/block (the reference's
-    grid_blocks_missing.zig path) before falling back to full state sync."""
+    grid_blocks_missing.zig path) before falling back to full state sync.
+    ``cold_paths`` maps a cold entry's expected checksum to its relative
+    file name so the receiver knows where to install the fetched bytes
+    (cold runs are addressed by checksum on the wire)."""
 
-    def __init__(self, damage):
+    def __init__(self, damage, cold_paths=None):
         super().__init__(f"checkpoint files damaged: {damage}")
         self.damage = damage
+        self.cold_paths = cold_paths or {}
 
 
 class Replica:
@@ -287,7 +291,19 @@ class Replica:
                     ledger, sb.op_checkpoint, sb.checkpoint_file_checksum
                 )
             self.machine.ledger = ledger
-            self.machine.restore_host_state(meta["machine"])
+            try:
+                self.machine.restore_host_state(meta["machine"])
+            except (OSError, RuntimeError, AssertionError) as err:
+                # Cold-tier spill files are checkpoint state too: a restart
+                # whose durable manifest references a missing/corrupt cold
+                # run (crash between a sync install and its cold fetch, or
+                # a damaged disk) must route to peer block repair like any
+                # other checkpoint file — round-5 standby-sweep find: this
+                # crashed the replica (and the whole sweep) instead.
+                damage, cold_paths = self._verify_cold(meta)
+                if damage:
+                    raise ForestDamage(damage, cold_paths=cold_paths) from err
+                raise
             digest = self.machine.digest()
             if digest != sb.ledger_digest:
                 raise RuntimeError(
@@ -306,6 +322,28 @@ class Replica:
             }
 
         return self.journal.recover()
+
+    def _verify_cold(self, meta) -> tuple:
+        """Enumerate damaged cold-tier run files referenced by a
+        checkpoint's machine snapshot: (damage_triples, checksum->relpath).
+        Wraps ColdStore.verify_manifest (one enumeration, incl. unsafe-path
+        rejection); cold runs are requested from peers BY CHECKSUM (block
+        kind 'cold'), so ident rides as 0 and the path map tells the
+        receiver where to install the fetched bytes."""
+        try:
+            damaged = self.machine.cold.verify_manifest(
+                meta.get("machine", {}).get("cold_manifest", [])
+            )
+        except ValueError:
+            return [], {}  # hostile/unsafe manifest: not peer-repairable
+        if any(not expect for _, expect in damaged):
+            # A checksum-less entry cannot be addressed on the wire: no
+            # peer-repair path — the caller re-raises toward state sync.
+            return [], {}
+        return (
+            [("cold", 0, expect) for _, expect in damaged],
+            {expect: name for name, expect in damaged},
+        )
 
     def _restore_root(self):
         """Regenerate + rewrite the deterministic root prepare (op 0 is a
@@ -947,6 +985,11 @@ class Replica:
             cluster=self.cluster,
             replica=self.replica,
             replica_count=self.replica_count,
+            # Membership metadata must ride EVERY superblock write: round-5
+            # standby sweep find — omitting it here let the first
+            # checkpoint erase standby_count, so restarted voters stopped
+            # broadcasting to standbys forever.
+            standby_count=self.standby_count,
             view=fields["view"],
             log_view=fields["log_view"],
             commit_min=op,
