@@ -214,74 +214,13 @@ def main() -> None:
         # (bench.py:672-676); codes fold into the first fails check instead.
         return led, jnp.sum(codes.astype(jnp.uint64))
 
+    from tigerbeetle_tpu.utils.benchgen import gen_plain as _gp, gen_twop as _gt
+
     def gen_plain(b):
-        lane = jnp.arange(N, dtype=jnp.uint64)
-        gid = b.astype(jnp.uint64) * jnp.uint64(COUNT) + lane
-        h1 = u128.mix64(gid, jnp.uint64(0x1234))
-        h2 = u128.mix64(gid, jnp.uint64(0x9876))
-        dr = h1 % jnp.uint64(N_ACCOUNTS)
-        off = jnp.uint64(1) + h2 % jnp.uint64(N_ACCOUNTS - 1)
-        cr = (dr + off) % jnp.uint64(N_ACCOUNTS)
-        amount = jnp.uint64(1) + ((h1 >> jnp.uint64(32)) & jnp.uint64(0xFFFF))
-        active = lane < jnp.uint64(COUNT)
-        z64 = jnp.zeros((N,), jnp.uint64)
-        z32 = jnp.zeros((N,), jnp.uint32)
-        return {
-            "id_lo": jnp.where(active, jnp.uint64(1 << 35) + gid, 0),
-            "id_hi": z64,
-            "debit_account_id_lo": jnp.where(active, dr + 1, 0),
-            "debit_account_id_hi": z64,
-            "credit_account_id_lo": jnp.where(active, cr + 1, 0),
-            "credit_account_id_hi": z64,
-            "amount_lo": jnp.where(active, amount, 0),
-            "amount_hi": z64,
-            "pending_id_lo": z64, "pending_id_hi": z64,
-            "user_data_128_lo": z64, "user_data_128_hi": z64,
-            "user_data_64": z64, "user_data_32": z32, "timeout": z32,
-            "ledger": jnp.where(active, jnp.uint32(1), z32),
-            "code": jnp.where(active, jnp.uint32(10), z32),
-            "flags": z32, "timestamp": z64,
-        }
+        return _gp(b, lanes=N, count=COUNT, n_accounts=N_ACCOUNTS)
 
     def gen_twop(b):
-        """Half pending creates, half posts of THOSE pendings (the bench's
-        --two-phase shape: in-batch two-phase resolution)."""
-        half = COUNT // 2
-        lane = jnp.arange(N, dtype=jnp.uint64)
-        base = b.astype(jnp.uint64) * jnp.uint64(COUNT)
-        is_post = lane >= jnp.uint64(half)
-        gid = base + jnp.where(is_post, lane - jnp.uint64(half), lane)
-        h1 = u128.mix64(gid, jnp.uint64(0x1234))
-        dr = h1 % jnp.uint64(N_ACCOUNTS)
-        cr = (dr + jnp.uint64(3)) % jnp.uint64(N_ACCOUNTS)
-        amount = jnp.uint64(1) + (h1 & jnp.uint64(0xFF))
-        active = lane < jnp.uint64(2 * half)
-        tid = jnp.uint64(1 << 36) + base + lane
-        ptid = jnp.uint64(1 << 36) + base + (lane - jnp.uint64(half))
-        z64 = jnp.zeros((N,), jnp.uint64)
-        z32 = jnp.zeros((N,), jnp.uint32)
-        return {
-            "id_lo": jnp.where(active, tid, 0), "id_hi": z64,
-            "debit_account_id_lo": jnp.where(active & ~is_post, dr + 1, 0),
-            "debit_account_id_hi": z64,
-            "credit_account_id_lo": jnp.where(active & ~is_post, cr + 1, 0),
-            "credit_account_id_hi": z64,
-            "amount_lo": jnp.where(active & ~is_post, amount, 0),
-            "amount_hi": z64,
-            "pending_id_lo": jnp.where(active & is_post, ptid, 0),
-            "pending_id_hi": z64,
-            "user_data_128_lo": z64, "user_data_128_hi": z64,
-            "user_data_64": z64, "user_data_32": z32, "timeout": z32,
-            "ledger": jnp.where(active & ~is_post, jnp.uint32(1), z32),
-            "code": jnp.where(active & ~is_post, jnp.uint32(10), z32),
-            "flags": jnp.where(
-                active,
-                jnp.where(is_post, jnp.uint32(tf.TF_POST),
-                          jnp.uint32(tf.TF_PENDING)),
-                z32,
-            ),
-            "timestamp": z64,
-        }
+        return _gt(b, lanes=N, count=COUNT, n_accounts=N_ACCOUNTS)
 
     TS0 = jnp.uint64(1 << 20)
 
