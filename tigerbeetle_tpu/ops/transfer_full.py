@@ -357,18 +357,23 @@ def _leg_balances(
         col_dp, col_dpo, col_cp, col_cpo = 0, 8, 12, 20
     else:
         col_dp, col_dpo, col_cp, col_cpo = 0, 4, 8, 12
-    v = jnp.stack(sum(streams, []), axis=1)
-    c = jnp.cumsum(v, axis=0)
-    base = jax.lax.cummax(jnp.where(s_head[:, None], c - v, 0), axis=0)
+    # Streams stack on AXIS 0 — (streams, 2N) with the scans along the
+    # MINOR dimension.  The axis-1 layout made XLA flip layouts around
+    # every cumsum/cummax: copyhound counted 52-74 MB-scale copies of
+    # these very temporaries per compiled kernel (one set per Jacobi
+    # pass), all gone in this orientation.
+    v = jnp.stack(sum(streams, []), axis=0)
+    c = jnp.cumsum(v, axis=1)
+    base = jax.lax.cummax(jnp.where(s_head[None, :], c - v, 0), axis=1)
     incl_all = c - base
     excl_all = incl_all - v
 
     zeros2n = jnp.zeros((2 * n,), jnp.uint64)
 
     def recombine(limbs, col):
-        """u64 limb sum from two adjacent 16-bit part-sum columns."""
-        return limbs[:, col].astype(jnp.uint64) + (
-            limbs[:, col + 1].astype(jnp.uint64) << jnp.uint64(16)
+        """u64 limb sum from two adjacent 16-bit part-sum rows."""
+        return limbs[col].astype(jnp.uint64) + (
+            limbs[col + 1].astype(jnp.uint64) << jnp.uint64(16)
         )
 
     def field_vals(field, col, has_sub):
