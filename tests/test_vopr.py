@@ -142,6 +142,23 @@ def test_vopr_round4_sweep_regressions(tmp_path, seed, kind):
     assert result.exit_code == EXIT_PASSED, (kind, result)
 
 
+@pytest.mark.parametrize("seed,kind", [
+    (600919, "safety: promoting a lagging standby into a crashed voter's "
+             "slot discarded the retired voter's journal and its acks; a "
+             "{voter, promoted} view-change quorum then selected a "
+             "canonical log missing a committed op, which was refilled "
+             "and re-committed (promotion now opens log_suspect until a "
+             "canonical start_view certifies the new identity)"),
+    (600484, "liveness: recovering-standby wedge of the same promotion "
+             "class"),
+])
+def test_vopr_round5_standby_sweep_regressions(tmp_path, seed, kind):
+    """Round-5 standby-dimension sweep finds (sampled topologies +
+    mid-schedule promotion), each pinned against the fix in ``kind``."""
+    result = run_seed(seed, workdir=str(tmp_path), standbys=None)
+    assert result.exit_code == EXIT_PASSED, (kind, result)
+
+
 def test_vopr_standby_recovering_view_regression(tmp_path):
     """Round-5 standby-dimension find (seed 13 @ standbys=2): a standby
     restarted into a stale view wedged in RECOVERING forever in a

@@ -362,12 +362,15 @@ class SimCluster:
         from ``voter_slot`` with everything it learned from the stream."""
         assert standby >= self.n and not self.alive[standby]
         assert voter_slot < self.n and not self.alive[voter_slot]
-        from ..vsr.superblock import SuperBlock
+        from ..vsr.superblock import PROMOTION_SUSPECT_OP, SuperBlock
 
         sb = SuperBlock(self.storages[standby])
         state = sb.open()
         assert state.replica >= state.replica_count, "already a voter"
         state.replica = voter_slot
+        # The promoted identity opens log_suspect until a canonical
+        # start_view certifies it (seed 600919; VsrReplica.promote).
+        state.log_adopted_op = PROMOTION_SUSPECT_OP
         sb.checkpoint(state)
         self.storages[standby].sync()
         # The promoted file now serves from the voter's ADDRESS slot; the

@@ -328,9 +328,13 @@ class VsrReplica(Replica):
         elif (
             self.op == 0 and self.commit_min == 0 and self.view == 0
             and self.log_view == 0
+            and not getattr(self, "_log_suspect", False)
         ):
             # Freshly formatted cluster: nothing to recover, start normal
-            # (the reference's format-then-start path).
+            # (the reference's format-then-start path).  A factory-fresh
+            # but SUSPECT file (a promoted never-caught-up standby) must
+            # instead recover via request_start_view so its certification
+            # can actually happen.
             self.status = NORMAL
         else:
             self.status = RECOVERING
@@ -1138,6 +1142,20 @@ class VsrReplica(Replica):
             return []
         return self._send_dvc()
 
+    def _suspect_flag(self) -> int:
+        """0 = clean; 1 = ordinary (amputation-evidence) suspicion;
+        2 = PROMOTION suspicion — the retired voter's journal (and acks)
+        were deliberately destroyed, so this log must not donate even
+        under the all-replicas-present valve (its premise, 'every
+        possible acker is inside the quorum', is false after promotion)."""
+        if not getattr(self, "_log_suspect", False):
+            return 0
+        from .superblock import PROMOTION_SUSPECT_OP
+
+        if getattr(self, "_log_adopted_op", 0) >= PROMOTION_SUSPECT_OP:
+            return 2
+        return 1
+
     def _send_dvc(self) -> List[Msg]:
         self._dvc_sent_for = self.view
         dvc = self._hdr(
@@ -1146,7 +1164,7 @@ class VsrReplica(Replica):
             commit=self.commit_min,
             checkpoint_op=self.op_checkpoint,
             log_view=self.log_view,
-            log_suspect=int(getattr(self, "_log_suspect", False)),
+            log_suspect=self._suspect_flag(),
         )
         body = wire.pack_headers(self._suffix_headers())
         message = wire.encode(dvc, body)
@@ -1202,13 +1220,16 @@ class VsrReplica(Replica):
             "commit": int(h["commit"]),
             "headers": headers,
             "suspect": bool(int(h["log_suspect"])),
+            "promotion": int(h["log_suspect"]) == 2,
         }
+        my_flag = self._suspect_flag()
         self.dvc_from[view][self.replica] = {
             "log_view": self.log_view,
             "op": self.op,
             "commit": self.commit_min,
             "headers": self._suffix_headers(),
-            "suspect": bool(getattr(self, "_log_suspect", False)),
+            "suspect": my_flag != 0,
+            "promotion": my_flag == 2,
         }
         dvcs = self.dvc_from[view]
         clean_n = sum(1 for d in dvcs.values() if not d.get("suspect"))
@@ -1228,9 +1249,21 @@ class VsrReplica(Replica):
             donors = clean
         else:
             # All-replicas-present fallback: every acker is in the quorum,
-            # so the best log over ALL DVCs still holds committed history.
+            # so the best log over ALL DVCs still holds committed history
+            # — EXCEPT promotion-suspects: their retired predecessor's
+            # journal (with the acks it contributed) was destroyed outside
+            # the fault atlas, so the valve's premise does not cover them.
+            # A committed op still lives on its commit quorum of REAL
+            # voter journals, all of which are in dvcs here.
             assert len(dvcs) == self.replica_count
-            donors = dvcs
+            donors = {
+                r: d for r, d in dvcs.items() if not d.get("promotion")
+            }
+            if not donors:
+                # Every log is a promoted identity: the operator destroyed
+                # the entire voting history — refuse to invent a canonical
+                # log (safety over liveness; view-change timeouts retry).
+                return []
         canonical = max(
             donors.values(), key=lambda d: (d["log_view"], d["op"])
         )

@@ -34,7 +34,9 @@ from . import checkpoint as checkpoint_mod
 from . import wire
 from .journal import Journal
 from .storage import Storage
-from .superblock import SuperBlock, SuperBlockState
+from .superblock import (
+    PROMOTION_SUSPECT_OP, SuperBlock, SuperBlockState,
+)
 
 U64_MASK = 0xFFFF_FFFF_FFFF_FFFF
 
@@ -225,7 +227,18 @@ class Replica:
         promoted voter rejoins warm and repairs only the tail (the
         reference reserves standby promotion for operator reconfiguration,
         constants.zig:31-35; the operator must first retire any live
-        replica that holds the target index)."""
+        replica that holds the target index).
+
+        The promoted file opens LOG_SUSPECT (round-5 VOPR find, seed
+        600919): the retired voter's journal — and the prepare_oks it
+        contributed to commit quorums — is gone, so the promoted identity's
+        (log_view, op) claim must not enter canonical selection until a
+        view change carried by the REAL voters (whose quorum provably
+        intersects every commit quorum) certifies its log via start_view.
+        Without this, a view-change quorum of {other voter, promoted}
+        could select a canonical log missing an op the retired voter had
+        committed — the sweep caught exactly that as a double-commit
+        divergence at the refilled op."""
         config = cluster_config or ClusterConfig()
         storage = Storage(data_path, config)
         try:
@@ -241,6 +254,7 @@ class Replica:
                     f"(replica_count={state.replica_count})"
                 )
             state.replica = new_replica
+            state.log_adopted_op = PROMOTION_SUSPECT_OP
             superblock.checkpoint(state)
         finally:
             storage.close()
@@ -1048,7 +1062,17 @@ class Replica:
             elif skey < ckey:
                 adopted = cur.log_adopted_op
             else:
-                adopted = max(state.log_adopted_op, cur.log_adopted_op)
+                a, b = state.log_adopted_op, cur.log_adopted_op
+                if (a >= PROMOTION_SUSPECT_OP) != (b >= PROMOTION_SUSPECT_OP):
+                    # Certification replaces the promotion sentinel at the
+                    # same key: on_start_view's persisted target_op must
+                    # actually land, or every later crash re-opens the
+                    # promoted replica suspect forever.  (A stale
+                    # checkpoint still carrying the sentinel must equally
+                    # not resurrect it over a landed certification.)
+                    adopted = min(a, b)
+                else:
+                    adopted = max(a, b)
             state = dataclasses.replace(
                 state,
                 view=max(state.view, cur.view),

@@ -25,6 +25,12 @@ from .storage import SUPERBLOCK_COPIES, SUPERBLOCK_COPY_SIZE, Storage
 MAGIC = 0x7462_7470_7573_6201  # "tbtpusb\x01"
 VERSION = 2  # v2: +log_adopted_op amputation watermark (round 5)
 
+# log_adopted_op sentinel written by VsrReplica.promote: a promoted data
+# file opens log_suspect and can only be certified by installing a
+# canonical start_view (repair cannot vouch for a REPLACED identity's
+# history — the retired voter's journal, and the acks it held, are gone).
+PROMOTION_SUSPECT_OP = 1 << 62
+
 # Quorum for reading: with 4 copies, require 2 matching (superblock_quorums).
 QUORUM_READ = 2
 
