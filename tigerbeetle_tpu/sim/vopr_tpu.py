@@ -26,6 +26,13 @@ simulator):
   CHECKPOINT FLOOR — the primary may not append past checkpoint + S, and a
   backup that falls behind the primary's ring is repaired by STATE SYNC
   (adopting the checkpoint) instead of slot repair (vsr/sync.zig).
+- SUFFIX AMPUTATION (round 5): a crash erases a join-adopted suffix whose
+  bodies were never individually journaled — never below durable_op (acks
+  follow the fsync), defended by the adopted_op suspicion watermark (the
+  model twin of consensus.py's log_adopted_op; suspects are excluded from
+  the view-change quorum AND selection — counting them toward the quorum
+  while excluding them from selection is unsound, as this oracle proved
+  at S=8).
 
 Protocol model (per cluster, R replicas, S ring slots):
 - Views are per-replica PERCEIVED views: each replica's working view is the
@@ -62,6 +69,12 @@ Injected bug modes (each must be caught; clean model must stay clean):
 - split_brain:     the view-change quorum is ignored, letting a partition
                    minority elect its own primary (R=5 split 2/3: the
                    2-side elects and double-commits).
+- amputate_vouch:  an amputated log ignores its adoption watermark and
+                   vouches (log_view, short-op) in canonical selection
+                   (the seed-500285 truncation class, round-4 real find).
+- join_keep_stale: a joiner keeps stale pre-join ring content below the
+                   SV window and trusts it as verified (the round-4
+                   verification-floor find, ported).
 
 Throughput (recorded for BASELINE config 5): tools/vopr_scale.py runs the
 clean model at >= 100k schedules and writes VOPR_TPU_SCALE.json
