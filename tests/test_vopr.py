@@ -151,6 +151,17 @@ def test_vopr_round4_sweep_regressions(tmp_path, seed, kind):
              "canonical start_view certifies the new identity)"),
     (600484, "liveness: recovering-standby wedge of the same promotion "
              "class"),
+    (601346, "safety: a promoted identity's never_had counted as a NACK "
+             "for the retired voter's journal — one honest nack away from "
+             "'proving' a committed op never committed; truncate-and-"
+             "refill double commit (promotion-suspects no longer nack)"),
+    (602201, "safety: double promotion destroyed BOTH members of an old "
+             "commit quorum — unrecoverable by any protocol; the "
+             "scheduler now enforces the operator rule (a view-change "
+             "quorum of certified voters must remain)"),
+    (601279, "liveness: both voters' identities replaced while "
+             "uncertified; elections correctly refused to invent a "
+             "canonical log forever (same operator-rule fix)"),
 ])
 def test_vopr_round5_standby_sweep_regressions(tmp_path, seed, kind):
     """Round-5 standby-dimension sweep finds (sampled topologies +

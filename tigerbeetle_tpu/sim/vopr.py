@@ -19,6 +19,7 @@ import random
 import tempfile
 from typing import Optional
 
+from ..vsr.consensus import quorums
 from .cluster import SimCluster
 from .network import PacketSimulator
 
@@ -151,6 +152,17 @@ def run_seed(
                     # (operator reconfiguration under fire).  Guarded on
                     # standbys>0 so standby-free schedules — including
                     # every pinned regression seed — are bit-identical.
+                    #
+                    # OPERATOR RULE (seeds 601279/602201): promotion
+                    # requires a view-change quorum of CERTIFIED voters
+                    # (alive, not log_suspect) to remain afterwards.  Each
+                    # certified log covers all committed history up to its
+                    # certification, so committed ops survive the retired
+                    # disk; promoting past this bound destroys an entire
+                    # old commit quorum's journals and NO protocol can
+                    # then distinguish a committed op from an uncommitted
+                    # suffix — the sweep measured exactly that as
+                    # truncate-and-refill double commits.
                     downs = sorted(d for d in down if d < n_replicas)
                     live_sb = [
                         i for i in range(n_replicas, cluster.total)
@@ -158,11 +170,20 @@ def run_seed(
                     ]
                     if downs and live_sb:
                         v, s = downs[0], live_sb[0]
-                        cluster.crash(s)
-                        cluster.promote_standby(s, v)
-                        retired.add(s)
-                        down.discard(v)
-                        faults += 1
+                        certified = [
+                            i for i in range(n_replicas)
+                            if i != v and cluster.alive[i]
+                            and cluster.replicas[i] is not None
+                            and not getattr(
+                                cluster.replicas[i], "_log_suspect", False
+                            )
+                        ]
+                        if len(certified) >= quorums(n_replicas)[1]:
+                            cluster.crash(s)
+                            cluster.promote_standby(s, v)
+                            retired.add(s)
+                            down.discard(v)
+                            faults += 1
                 elif r < 0.009 and n_replicas >= 2:
                     # Clog one replica<->replica path for a while
                     # (packet_simulator.zig clogging).

@@ -1600,6 +1600,14 @@ class VsrReplica(Replica):
         ):
             if checksum and op > self.commit_min and (
                 self.journal.never_had(op, checksum)
+                # A PROMOTED identity's never_had proves nothing about the
+                # RETIRED voter's journal, which may have journaled (and
+                # acked) this very op — a nack under the inherited index
+                # would let a nack quorum "prove" a committed op never
+                # committed (seed 601346: promoted r0's self-nack + one
+                # honest nack truncated committed ops 12-13, which view 4
+                # refilled).  Until certified, stay silent.
+                and self._suspect_flag() != 2
             ):
                 # We provably never journaled it: nack, so a view-change
                 # primary can prove a globally-lost uncommitted body was
@@ -1647,7 +1655,9 @@ class VsrReplica(Replica):
         # replicas (counting ourselves), fewer than q_replication can ever
         # have journaled it — no commit quorum was possible.
         nackers = set(self._nacks.get(op, ()))
-        if self.journal.never_had(op, checksum):
+        if self.journal.never_had(op, checksum) and self._suspect_flag() != 2:
+            # Same promotion guard as the nack response path: the
+            # inherited journal cannot testify for the retired voter's.
             nackers.add(self.replica)
         if len(nackers) < self.replica_count - self.quorum_replication + 1:
             return []
