@@ -162,6 +162,13 @@ def test_vopr_round4_sweep_regressions(tmp_path, seed, kind):
     (601279, "liveness: both voters' identities replaced while "
              "uncertified; elections correctly refused to invent a "
              "canonical log forever (same operator-rule fix)"),
+    (700883, "liveness: promotion under an active storage adversary "
+             "destroyed the retired voter's copy of a latently-corrupted "
+             "op outside the fault atlas's budget — every copy gone, the "
+             "op's fate indeterminate, the protocol correctly wedged "
+             "(schedules now exclude promotions when storage adversaries "
+             "are active, like the never-crash-core rule; plus "
+             "exponential view-change escalation backoff)"),
 ])
 def test_vopr_round5_standby_sweep_regressions(tmp_path, seed, kind):
     """Round-5 standby-dimension sweep finds (sampled topologies +

@@ -146,7 +146,19 @@ def run_seed(
                 elif r < 0.007 and partitioned:
                     cluster.heal()
                     partitioned = False
-                elif r < 0.008 and standbys:
+                elif r < 0.008 and standbys and not (
+                    read_fault_p or misdirect_p
+                ):
+                    # Promotion PERMANENTLY destroys the retired voter's
+                    # journal — a storage fault the atlas cannot account
+                    # for.  Combined with latent read faults on another
+                    # replica's copy of the same op, every copy can vanish
+                    # while the op's fate (committed at the retired
+                    # primary?) stays indeterminate: the protocol then
+                    # correctly wedges rather than truncate (seed 700883).
+                    # Like the never-crash-core rule above, schedules with
+                    # storage adversaries exclude promotions — the
+                    # combination exceeds any f=1 repairability budget.
                     # PROMOTION mid-schedule: a crashed voter is retired
                     # and a live standby's file takes over its slot
                     # (operator reconfiguration under fire).  Guarded on

@@ -218,6 +218,9 @@ class VsrReplica(Replica):
         # every tick for the whole repair window.
         self.commit_budget_stopped = False
         self._vc_started = 0
+        # Consecutive stuck-view-change escalations: doubles the
+        # escalation window (phase-lock breaking); resets on progress.
+        self._vc_escalations = 0
         self._last_sync_req = 0
         self._heartbeat_jitter = 0
         self._recovering_since = 0
@@ -1437,6 +1440,7 @@ class VsrReplica(Replica):
         self._new_view_pending = None
         self._debug("view_normal_primary", new_view=view)
         self._log_suspect = False  # the canonical quorum log is ours now
+        self._vc_escalations = 0   # progress: escalation backoff resets
         # Adoption watermark: every canonical body IS journaled here (the
         # gap check above), so the new log_view's log provably extends to
         # self.op — the one moment this fact is cheap and certain.
@@ -1518,10 +1522,14 @@ class VsrReplica(Replica):
             self.status = NORMAL  # transitional; _maybe_start_sync -> SYNCING
             sync = self._maybe_start_sync(sv_checkpoint)
             if sync:
+                # Escaping the view change via state sync is progress too:
+                # the escalation backoff resets on every NORMAL-entry path.
+                self._vc_escalations = 0
                 return sync
 
         self.status = NORMAL
         self._debug("view_normal_backup", new_view=int(h["view"]))
+        self._vc_escalations = 0   # progress: escalation backoff resets
         # WAL bound: adopt at most a ring's worth beyond our checkpoint;
         # commits advance the checkpoint and repair fetches the rest.
         self._install_headers(
@@ -2513,7 +2521,17 @@ class VsrReplica(Replica):
                     out.append((("replica", primary), wire.encode(req)))
 
         elif self.status == VIEW_CHANGE:
-            if self._ticks - self._vc_started >= VIEW_CHANGE_ESCALATE:
+            # Escalation BACKS OFF exponentially: a fixed window phase-
+            # locks against repair — seed 700883 escalated through 300+
+            # views because the lost-body nack-truncation round trip
+            # (request_prepare -> nack quorum) took longer than one
+            # window, and every escalation reset the repair from scratch.
+            # Doubling the window per consecutive escalation (capped 16x)
+            # guarantees the window eventually exceeds any bounded repair
+            # RTT.  Deterministic (no prng draw: pinned seeds replay).
+            window = VIEW_CHANGE_ESCALATE << min(self._vc_escalations, 4)
+            if self._ticks - self._vc_started >= window:
+                self._vc_escalations += 1
                 out.extend(self._begin_view_change(self.view + 1))
             elif self._vc_timeout.fired(self._ticks):
                 svc = self._hdr(wire.Command.start_view_change)
