@@ -16,4 +16,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tigerbeetle_tpu import jaxenv  # noqa: E402
 
+# Persistent XLA compile cache (repo-local .jax_cache/, gitignored): the
+# kernel suites are compile-dominated on CPU — a warm cache cuts e.g.
+# test_transfer_full from ~81 s to ~26 s, which is what keeps the full
+# 'not slow' sweep inside the driver's 870 s tier-1 budget.  Must be set
+# before the first backend init, like the device-count flag.
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+jaxenv.enable_compile_cache()
+
 jaxenv.force_cpu(8)

@@ -1,0 +1,51 @@
+"""tblint fixture: swallowed-exception violations."""
+
+
+def bad_swallow():
+    try:
+        _risky()
+    except Exception:  # finding: swallow
+        pass
+
+
+def bad_bare():
+    try:
+        _risky()
+    except:  # noqa: E722 — finding: swallow (bare)
+        pass
+
+
+def bad_tuple():
+    try:
+        _risky()
+    except (ValueError, Exception):  # finding: swallow
+        pass
+
+
+def ok_logged():
+    try:
+        _risky()
+    except Exception:
+        _log("boom")
+
+
+def ok_narrow():
+    try:
+        _risky()
+    except ValueError:
+        pass
+
+
+def suppressed():
+    try:
+        _risky()
+    except Exception:  # tblint: ignore[swallow] best-effort probe
+        pass
+
+
+def _risky():
+    raise ValueError("fixture")
+
+
+def _log(msg):
+    return msg

@@ -1,0 +1,24 @@
+/* tblint fixture: header structs for the layout cross-check. */
+#ifndef TBLINT_FIXTURE_TYPES_H
+#define TBLINT_FIXTURE_TYPES_H
+
+#include <stdint.h>
+
+typedef struct { uint64_t lo; uint64_t hi; } tb_uint128_t;
+
+typedef struct {
+    tb_uint128_t id;
+    uint64_t user_data_64;
+    uint32_t user_data_32;
+    uint32_t reserved;
+    uint64_t timestamp;
+} tb_account_t;
+
+typedef struct {
+    tb_uint128_t id;
+    uint16_t code;
+    uint16_t flags;
+    uint32_t ledger;
+} tb_clean_t;
+
+#endif /* TBLINT_FIXTURE_TYPES_H */

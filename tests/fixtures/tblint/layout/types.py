@@ -1,0 +1,32 @@
+"""tblint fixture: dtype layouts drifted from their header structs."""
+
+import numpy as np
+
+# Field-order drift: user_data_64 and user_data_32 are swapped relative to
+# tb_account_t in native/tb_types.h.
+ACCOUNT_DTYPE = np.dtype([
+    ("id_lo", "<u8"), ("id_hi", "<u8"),
+    ("user_data_32", "<u4"),
+    ("user_data_64", "<u8"),
+    ("reserved", "<u4"),
+    ("timestamp", "<u8"),
+])
+
+# Lane-order violation: hi lane precedes lo.
+PAIR_DTYPE = np.dtype([
+    ("amount_hi", "<u8"),
+    ("amount_lo", "<u8"),
+])
+
+# Matches tb_clean_t exactly: no finding.
+CLEAN_DTYPE = np.dtype([
+    ("id_lo", "<u8"), ("id_hi", "<u8"),
+    ("code", "<u2"),
+    ("flags", "<u2"),
+    ("ledger", "<u4"),
+])
+
+SUPPRESSED_DTYPE = np.dtype([  # tblint: ignore[layout-drift]
+    ("x_lo", "<u8"),
+    ("y", "<u4"),
+])

@@ -1,0 +1,17 @@
+"""tblint fixture: host-sync violations in a hot-path (ops/) module."""
+
+import jax
+import jax.numpy as jnp
+
+
+def hot_dispatch(x):
+    y = jnp.sum(x)
+    jax.device_get(y)  # finding: host-sync
+    y.block_until_ready()  # finding: host-sync
+    return y
+
+
+def allowed_sync(x):
+    y = jnp.sum(x)
+    y.block_until_ready()  # tblint: ignore[host-sync] commit barrier
+    return y

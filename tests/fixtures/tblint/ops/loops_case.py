@@ -1,0 +1,44 @@
+"""tblint fixture: batch-proportional trace-time unrolls."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_rowwise(x):
+    acc = jnp.zeros(())
+    for i in range(x.shape[0]):  # finding: unrolled-loop
+        acc = acc + x[i]
+    return acc
+
+
+@jax.jit
+def bad_elementwise(rows: jax.Array):
+    acc = jnp.zeros(())
+    for r in rows:  # finding: unrolled-loop
+        acc = acc + r
+    return acc
+
+
+@jax.jit
+def ok_log_bounded(x):
+    lo = jnp.int64(0)
+    for _ in range(int(x.shape[0]).bit_length()):  # ok: log trip count
+        lo = lo + 1
+    return lo
+
+
+@jax.jit
+def ok_constant_trip(x):
+    acc = jnp.zeros(())
+    for i in range(4):  # ok: constant short unroll (repo idiom)
+        acc = acc + jnp.float64(i)
+    return acc
+
+
+@jax.jit
+def suppressed_loop(x):
+    acc = jnp.zeros(())
+    for i in range(x.shape[0]):  # tblint: ignore[unrolled-loop]
+        acc = acc + x[i]
+    return acc

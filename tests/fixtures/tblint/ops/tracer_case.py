@@ -1,0 +1,60 @@
+"""tblint fixture: traced-branch and concretize violations.
+
+Never imported — pytest reads the expected findings from expected.json and
+runs tblint over this tree.  Line numbers are pinned by the golden file;
+edit with care.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:  # finding: traced-branch
+        return x
+    return -x
+
+
+@jax.jit
+def bad_while_and_assert(x):
+    n = jnp.sum(x)
+    while n > 0:  # finding: traced-branch
+        n = n - 1
+    assert n == 0  # finding: traced-branch
+    return n
+
+
+@jax.jit
+def suppressed_branch(x):
+    if x > 0:  # tblint: ignore[traced-branch]
+        return x
+    return -x
+
+
+@jax.jit
+def ok_static_branch(x):
+    if x.shape[0] > 8:  # ok: shape is static under jit
+        return x
+    if x is not None:  # ok: identity check resolves on the host
+        return x
+    return x
+
+
+@jax.jit
+def bad_concretize(x):
+    a = int(jnp.sum(x))  # finding: concretize
+    b = x.item()  # finding: concretize
+    c = np.asarray(x)  # finding: concretize
+    return a + b + c[0]
+
+
+@jax.jit
+def suppressed_concretize(x):
+    return int(jnp.sum(x))  # tblint: ignore[concretize]
+
+
+def host_helper(rows):
+    # ok: not jit-reachable — host code may concretize freely.
+    return np.asarray(rows)

@@ -1,0 +1,49 @@
+"""tblint fixture: nondeterminism sources under a sim/ path."""
+
+import random
+import time
+
+import numpy as np
+
+
+def bad_wall_clock():
+    t = time.time()  # finding: nondet
+    source = time.time_ns  # finding: nondet (bare reference)
+    return t, source
+
+
+def bad_global_random():
+    x = random.random()  # finding: nondet
+    random.shuffle([1, 2])  # finding: nondet
+    return x
+
+
+def bad_numpy_random():
+    return np.random.randint(0, 4)  # finding: nondet
+
+
+def bad_set_iteration(items):
+    pending = {1, 2, 3}
+    out = []
+    for p in pending:  # finding: nondet (set iteration)
+        out.append(p)
+    victims = set(items)
+    chosen = list(victims)  # finding: nondet (list of set)
+    first = victims.pop()  # finding: nondet (set.pop)
+    return out, chosen, first
+
+
+def ok_patterns(items, seed):
+    rng = random.Random(seed)  # ok: seeded instance
+    s = set(items)
+    total = sum(s)  # ok: order-insensitive reduction
+    ordered = sorted(s)  # ok: sorted normalizes
+    n_small = sum(1 for v in s if v < 4)  # ok: sum of a genexp over a set
+    for v in ordered:  # ok: iterating the sorted list
+        total += v
+    return rng.random(), total, n_small
+
+
+def suppressed(items):
+    s = set(items)
+    return list(s)  # tblint: ignore[nondet]

@@ -1,0 +1,35 @@
+"""tblint fixture: u128 limb arithmetic and wide-literal violations."""
+
+import jax.numpy as jnp
+
+
+def bad_limb_math(a, b):
+    lo = a.lo + b.lo  # finding: u128-limb
+    hi = a.hi - b.hi  # finding: u128-limb
+    return lo, hi
+
+
+def suppressed_limb(a, b):
+    return a.lo + b.lo  # tblint: ignore[u128-limb]
+
+
+def ok_comparison(a, b):
+    return (a.lo == b.lo) & (a.hi == b.hi)  # ok: comparison, not arithmetic
+
+
+def bad_wide_scalar():
+    return jnp.uint64(0x1_0000_0000_0000_0000)  # finding: wide-literal
+
+
+def bad_wide_array():
+    max_u128 = 340282366920938463463374607431768211455
+    return jnp.array([340282366920938463463374607431768211455])  # finding
+    # (the plain assignment above is fine: only jnp call args are checked)
+
+
+def suppressed_wide():
+    return jnp.uint64(0x1_0000_0000_0000_0000)  # tblint: ignore[wide-literal]
+
+
+def ok_u64_max():
+    return jnp.uint64(0xFFFF_FFFF_FFFF_FFFF)  # ok: exactly u64 max

@@ -1,0 +1,33 @@
+"""tblint fixture: header-framing drift in the wire.py idiom."""
+
+import numpy as np
+
+HEADER_SIZE = 256
+
+
+def _dtype(tail):
+    return np.dtype(_FRAME + tail)
+
+
+# Frame sums to 128: ok.
+_FRAME = [
+    ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
+    ("size", "<u4"),
+    ("command", "u1"),
+    ("replica", "u1"),
+    ("reserved_frame", "V106"),
+]
+
+# Tail sums to 120, not 128: finding.
+BAD_TAIL_DTYPE = _dtype([
+    ("op", "<u8"),
+    ("reserved", "V112"),
+])
+
+# Tail sums to 128: ok.
+OK_DTYPE = _dtype([("reserved", "V128")])
+
+SUPPRESSED_DTYPE = _dtype([  # tblint: ignore[layout-drift]
+    ("op", "<u8"),
+    ("reserved", "V100"),
+])

@@ -128,6 +128,7 @@ def _pipeline_lookups(sock, client_id, session, parent, n_requests, ids):
     return sent
 
 
+@pytest.mark.slow  # ~30 s black-box; tools/ci.py integration tier runs it
 def test_slow_consumer_is_evicted_and_others_progress(server):
     _seed_accounts(server, 126)
 

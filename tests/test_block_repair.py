@@ -242,6 +242,7 @@ def test_solo_replica_damage_is_fatal(tmp_path):
         cluster.restart(0)
 
 
+@pytest.mark.slow  # ~60 s sim; tools/ci.py integration tier runs it
 def test_missing_cold_run_repaired_from_peer(tmp_path):
     """A missing COLD-TIER run file on a restarting replica routes to peer
     block repair (kind 'cold', addressed by checksum) instead of crashing

@@ -245,6 +245,7 @@ class TestCompositions:
         check(m, t_rows, expr)
         check(m, t_rows, expr, reversed_=True, limit=7)
 
+    @pytest.mark.slow  # ~35 s sweep; tools/ci.py integration tier runs it
     def test_random_compositions(self, populated):
         m, t_rows, _ = populated
         rng = np.random.default_rng(7)

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
-from tigerbeetle_tpu import types
+from tigerbeetle_tpu import jaxenv, types
 from tigerbeetle_tpu.config import LedgerConfig
 from tigerbeetle_tpu.machine import TpuStateMachine
 from tigerbeetle_tpu.ops import state_machine as sm
@@ -22,6 +22,14 @@ LANES = 256
 
 @pytest.fixture(scope="module")
 def mesh():
+    # conftest asks jaxenv.force_cpu for 8 virtual devices; if the backend
+    # initialized first it degrades instead of raising — one clean skip
+    # here beats a module of confusing mesh-shape failures.
+    if len(jax.devices()) < 8:
+        pytest.skip(
+            f"needs 8 devices, have {len(jax.devices())} "
+            f"(jaxenv degraded: {jaxenv.DEGRADED_DEVICE_COUNT})"
+        )
     devs = np.array(jax.devices()[:8])
     return Mesh(devs, (sharded.AXIS,))
 
@@ -152,10 +160,14 @@ def test_sharded_visible_devices(mesh):
     assert mesh.devices.size == 8
 
 
+@pytest.mark.slow
 def test_sharded_full_kernel_two_phase_parity(mesh):
     """The fully-general kernel over the mesh: pending/post/void + balancing
     + limit accounts produce byte-identical codes and balances to the
-    single-chip machine (VERDICT round-2 #4)."""
+    single-chip machine (VERDICT round-2 #4).
+
+    @slow: ~22 s of 8-device compiles; tools/ci.py's integration tier runs
+    it (the tier-1 'not slow' sweep must fit the driver's budget)."""
     cfg = LedgerConfig(
         accounts_capacity_log2=12, transfers_capacity_log2=13,
         posted_capacity_log2=10,
@@ -271,6 +283,7 @@ def test_sharded_full_kernel_routes_history(mesh):
     assert snapshot_sharded(ledger) == before
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", range(3))
 def test_sharded_full_kernel_random_stream(mesh, seed):
     """Randomized adversarial mix (invalids, dups, pendings, posts/voids,

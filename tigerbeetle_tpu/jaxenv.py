@@ -26,12 +26,31 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import warnings
 from typing import List, Optional
 
 __all__ = [
     "force_cpu", "ensure_backend", "child_env", "current_platform",
     "COMPILE_CACHE_DIR", "enable_compile_cache",
 ]
+
+# Set when force_cpu had to settle for fewer virtual devices than requested
+# (backend initialized before XLA_FLAGS could take effect, or an old jax).
+# Tests and tools can key on this instead of re-deriving it from warnings.
+DEGRADED_DEVICE_COUNT: Optional[int] = None
+
+_HOST_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def _set_host_device_flag(n: int) -> None:
+    """Merge ``--xla_force_host_platform_device_count=n`` into XLA_FLAGS,
+    replacing any previous value.  XLA parses the env var once per process at
+    first backend creation, so this only takes effect if it runs before init —
+    callers still verify the resulting device count."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    parts = [f for f in flags.split() if not f.startswith(_HOST_COUNT_FLAG)]
+    parts.append(f"{_HOST_COUNT_FLAG}={n}")
+    os.environ["XLA_FLAGS"] = " ".join(parts)
 
 # Persistent XLA compilation cache, shared by bench.py and tools/tpu_probe.py
 # so a recovered TPU tunnel never re-pays the 20-40 s first compile.  One
@@ -60,11 +79,11 @@ def _reset_backends() -> None:
     for fn in ("_clear_backends",):
         try:
             getattr(xb, fn)()
-        except Exception:
+        except Exception:  # tblint: ignore[swallow] private-API probe
             pass
     try:
         xb.get_backend.cache_clear()
-    except Exception:
+    except Exception:  # tblint: ignore[swallow] private-API probe
         pass
     # Newer jax caches the device list on jax.devices too; clear defensively.
     import jax
@@ -72,7 +91,7 @@ def _reset_backends() -> None:
     for obj in (jax.devices, jax.local_devices):
         try:
             obj.cache_clear()  # type: ignore[attr-defined]
-        except Exception:
+        except Exception:  # tblint: ignore[swallow] private-API probe
             pass
 
 
@@ -82,7 +101,7 @@ def _pop_non_cpu_factories() -> None:
         for name in list(xb._backend_factories):
             if name != "cpu":
                 xb._backend_factories.pop(name, None)
-    except Exception:
+    except Exception:  # tblint: ignore[swallow] private-API probe
         pass
 
 
@@ -92,7 +111,15 @@ def force_cpu(n_devices: Optional[int] = None) -> List:
     Safe whether or not a backend (even a remote-TPU one) has already
     initialized.  Returns the device list.
     """
+    global DEGRADED_DEVICE_COUNT
+    DEGRADED_DEVICE_COUNT = None  # re-judged below on every call
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        # Must land in the environment BEFORE the first backend creation:
+        # XLA's flag parse is once-per-process, and jax 0.4 has no
+        # jax_num_cpu_devices config option, so the env var is the only
+        # portable way to get >1 virtual CPU device.
+        _set_host_device_flag(n_devices)
     import jax
 
     xb = _bridge()
@@ -100,18 +127,19 @@ def force_cpu(n_devices: Optional[int] = None) -> List:
     def _try_config(n):
         try:
             jax.config.update("jax_platforms", "cpu")
-        except Exception:
+        except Exception:  # tblint: ignore[swallow] verified below
             pass
         if n is not None:
             try:
+                # jax >= 0.5 only; older versions rely on XLA_FLAGS above.
                 jax.config.update("jax_num_cpu_devices", n)
-            except Exception:
-                pass  # backend already initialized; verified below
+            except Exception:  # tblint: ignore[swallow] verified below
+                pass  # unknown option or backend already up
 
     initialized = False
     try:
         initialized = xb.backends_are_initialized()
-    except Exception:
+    except Exception:  # tblint: ignore[swallow] private-API probe
         pass
     if initialized:
         _reset_backends()
@@ -133,9 +161,19 @@ def force_cpu(n_devices: Optional[int] = None) -> List:
             f"force_cpu: CPU backend unavailable, got {devs!r}"
         )
     if n_devices is not None and len(devs) < n_devices:
-        raise RuntimeError(
+        # A backend initialized before our XLA_FLAGS could take effect (the
+        # flag parse is once-per-process).  Raising here used to take down
+        # the whole test collection; degrade to what exists instead —
+        # device-count-sensitive callers (tests/test_sharded.py's mesh
+        # fixture) check DEGRADED_DEVICE_COUNT or len() of the returned
+        # list and skip/shrink accordingly.
+        DEGRADED_DEVICE_COUNT = len(devs)
+        warnings.warn(
             f"force_cpu: wanted {n_devices} CPU devices, got {len(devs)} "
-            "(jax_num_cpu_devices rejected after backend init?)"
+            "(backend initialized before XLA_FLAGS took effect); "
+            "continuing with the available devices",
+            RuntimeWarning,
+            stacklevel=2,
         )
     return devs
 
@@ -281,8 +319,11 @@ def child_env(
     if cpu:
         env["JAX_PLATFORMS"] = "cpu"
     if n_devices is not None:
-        flags = env.get("XLA_FLAGS", "")
-        env["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
-        )
+        # Replace, don't append: force_cpu() may have already written its
+        # own device-count flag into the inherited XLA_FLAGS, and XLA's
+        # handling of duplicate flags is undocumented.
+        parts = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith(_HOST_COUNT_FLAG)]
+        parts.append(f"{_HOST_COUNT_FLAG}={n_devices}")
+        env["XLA_FLAGS"] = " ".join(parts)
     return env

@@ -146,8 +146,12 @@ class ReplicaServer:
             self._processor.cancel()
             try:
                 await self._processor
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                # A processor that died BEFORE the cancel carries the real
+                # failure; losing it here would hide a server-loop crash.
+                log.exception("request processor failed before close")
             self._processor = None
         for task in list(self._flushes):
             task.cancel()
@@ -158,8 +162,8 @@ class ReplicaServer:
         for w in list(self._accepted):
             try:
                 w.close()
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
+            except (OSError, RuntimeError):
+                pass  # already-closed transport / closed event loop
         self._accepted.clear()
 
     async def _process_requests(self) -> None:

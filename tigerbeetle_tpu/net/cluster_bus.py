@@ -138,8 +138,8 @@ class ClusterServer:
         ):
             try:
                 w.close()
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
+            except (OSError, RuntimeError):
+                pass  # already-closed transport / closed event loop
         self._accepted.clear()
 
     def _set_tcp_options(self, writer: asyncio.StreamWriter) -> None:

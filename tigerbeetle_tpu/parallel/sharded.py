@@ -59,9 +59,27 @@ from ..ops.state_machine import (
 )
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map
+    from jax import shard_map as _shard_map_impl
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+# The kwarg disabling the replication/varying-axes check was renamed
+# check_rep -> check_vma across jax versions; detect what this jax takes
+# so the call sites below stay on one spelling.
+import inspect as _inspect
+
+_VARY_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map_impl).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma):
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_VARY_KW: check_vma},
+    )
 
 
 AXIS = "shard"

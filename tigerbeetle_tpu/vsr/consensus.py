@@ -133,8 +133,10 @@ class VsrReplica(Replica):
     ) -> None:
         import time as _time
 
-        realtime = realtime or _time.time_ns
-        monotonic = monotonic or _time.monotonic_ns
+        # Production defaults only: the VOPR cluster injects seeded sim
+        # clocks through these parameters, so replay never sees wall time.
+        realtime = realtime or _time.time_ns  # tblint: ignore[nondet]
+        monotonic = monotonic or _time.monotonic_ns  # tblint: ignore[nondet]
         super().__init__(data_path, time_ns=realtime, **kwargs)
         self._monotonic = monotonic
         self._realtime = realtime
@@ -667,8 +669,12 @@ class VsrReplica(Replica):
         survives restart (mirrors the normal commit path's store)."""
         try:
             self._store_client_reply(client, raw)
-        except Exception:  # noqa: BLE001 — repair is best-effort
-            pass
+        except OSError as err:
+            # Repair is best-effort (the reply still went out over the
+            # wire), but a disk that rejects the write is worth a record —
+            # a silent swallow here hid a full-disk wedge in round 5.
+            self._debug("persist_reply_failed", client=client,
+                        error=f"{type(err).__name__}: {err}")
 
     def on_prepare(self, h: np.ndarray, body: bytes) -> List[Msg]:
         view = int(h["view"])

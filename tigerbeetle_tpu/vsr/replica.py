@@ -84,7 +84,8 @@ class Replica:
         cluster_config: Optional[ClusterConfig] = None,
         ledger_config: Optional[LedgerConfig] = None,
         batch_lanes: int = 8192,
-        time_ns=time.time_ns,
+        # Production default; sim injects a seeded clock for replay.
+        time_ns=time.time_ns,  # tblint: ignore[nondet]
         storage: Optional[Storage] = None,
         aof_path: Optional[str] = None,
         hash_log=None,
@@ -1105,9 +1106,11 @@ class Replica:
     # -- overlapped checkpoint (async_checkpoint; replica.zig:3153-3169) ------
 
     def _checkpoint_async_start(self) -> None:
-        t0 = time.monotonic()
+        # Wall time feeds ONLY the slow-capture diagnostic below, never
+        # replica state — replay stays seed-stable.
+        t0 = time.monotonic()  # tblint: ignore[nondet]
         arrays, meta, fields = self._checkpoint_capture()
-        dt = time.monotonic() - t0
+        dt = time.monotonic() - t0  # tblint: ignore[nondet]
         if dt > 0.05:
             dbg = getattr(self, "_debug", None)
             if dbg is not None:

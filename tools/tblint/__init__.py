@@ -1,0 +1,23 @@
+"""tblint: repo-native static analysis for JAX tracer safety, VOPR
+determinism, and u128/wire invariants.
+
+The bug classes that have actually cost sweep time in this repo — silent
+u128 limb truncation, nondeterministic iteration in the simulator, host
+syncs and concretization inside jitted code — are invisible to generic
+linters but statically detectable with an AST pass tuned to this codebase
+(the tidy.zig discipline, applied to Python).
+
+Usage:
+    python -m tools.tblint tigerbeetle_tpu tools      # human output
+    python -m tools.tblint --json tigerbeetle_tpu     # machine output
+    python -m tools.tblint --list-rules               # rule catalogue
+
+Suppress a finding with a trailing comment on the offending line:
+    x = risky()  # tblint: ignore[RULE-ID]
+    y = risky()  # tblint: ignore          (all rules on this line)
+
+See docs/tblint.md for every rule ID and the production bug class it
+guards against.
+"""
+
+from .core import Finding, Rule, iter_rules, run  # noqa: F401

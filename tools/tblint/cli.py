@@ -1,0 +1,65 @@
+"""tblint command line: human and JSON output, exit code 1 on findings."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .core import iter_files, iter_rules, run
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.tblint",
+        description="Repo-native static analysis: JAX tracer safety, VOPR "
+                    "determinism, u128/wire invariants.",
+    )
+    p.add_argument("paths", nargs="*", default=["tigerbeetle_tpu"],
+                   help="files or directories to lint")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--rule", action="append", dest="only_rules",
+                   metavar="ID", help="run only the named rule(s)")
+    args = p.parse_args(argv)
+
+    rules = iter_rules()
+    if args.list_rules:
+        for rule in sorted(rules, key=lambda r: r.id):
+            print(f"{rule.id:15s} {rule.summary}")
+            print(f"{'':15s}   why: {rule.rationale}")
+        return 0
+
+    if args.only_rules:
+        known = {r.id for r in rules}
+        unknown = set(args.only_rules) - known
+        if unknown:
+            print(f"tblint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in set(args.only_rules)]
+
+    # Expand once; run() treats an explicit file list as-is, so the tree
+    # is walked a single time.
+    files = iter_files(args.paths)
+    findings = run(files, rules=rules)
+    n_files = len(files)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "files_scanned": n_files,
+            "rules": sorted(r.id for r in rules),
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        status = "clean" if not findings else f"{len(findings)} finding(s)"
+        print(f"tblint: {status} across {n_files} file(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

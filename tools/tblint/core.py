@@ -1,0 +1,211 @@
+"""tblint core: finding type, rule registry, suppressions, file walking.
+
+A rule sees one file at a time (``check``) plus an end-of-run hook
+(``finalize``) for cross-file invariants like the wire/types/header layout
+drift check.  Scoping is path-based on *components*, not absolute prefixes,
+so the same rules fire on fixture trees under tests/fixtures/tblint/ that
+mirror the package layout (an ``ops/`` dir, a ``sim/`` dir, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
+
+ALL_RULES: FrozenSet[str] = frozenset({"*"})
+
+_SUPPRESS_RE = re.compile(
+    r"tblint:\s*ignore(?:\[([A-Za-z0-9_\-, ]+)\])?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a source line."""
+
+    rule: str
+    path: str  # display path (relative, forward slashes)
+    line: int
+    col: int
+    message: str
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, FrozenSet[str]]:
+    """Map line number -> set of suppressed rule ids ('*' = all)."""
+    out: Dict[int, FrozenSet[str]] = {}
+    for i, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m is None:
+            continue
+        names = m.group(1)
+        if names is None:
+            out[i] = ALL_RULES
+        else:
+            out[i] = frozenset(n.strip() for n in names.split(",") if n.strip())
+    return out
+
+
+class FileContext:
+    """Parsed view of one scanned file, shared by all rules."""
+
+    def __init__(self, path: str) -> None:
+        self.path = os.path.abspath(path)
+        self.display_path = os.path.relpath(path).replace(os.sep, "/")
+        self.basename = os.path.basename(path)
+        self.parts: Tuple[str, ...] = tuple(
+            self.display_path.split("/")[:-1]
+        )
+        self.is_py = self.basename.endswith(".py")
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.suppressions = _parse_suppressions(self.lines)
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        if self.is_py:
+            try:
+                self.tree = ast.parse(self.source, filename=path)
+            except SyntaxError as err:
+                self.parse_error = err
+        # Per-file scratch space for analyses shared between rules (the
+        # jit-reachability graph is computed once and read by three rules).
+        self.cache: Dict[str, object] = {}
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        names = self.suppressions.get(line)
+        if names is None:
+            return False
+        return names is ALL_RULES or rule in names or "*" in names
+
+    # -- scope helpers shared by the rule modules ---------------------------
+
+    def in_hot_scope(self) -> bool:
+        """ops/ kernels and the machine.py dispatcher: the device hot path."""
+        return "ops" in self.parts or self.basename == "machine.py"
+
+    def in_det_scope(self) -> bool:
+        """sim/ and vsr/: everything VOPR replay depends on being seed-stable."""
+        return "sim" in self.parts or "vsr" in self.parts
+
+
+class ProjectState:
+    """Accumulated per-file contexts, handed to Rule.finalize."""
+
+    def __init__(self) -> None:
+        self.contexts: List[FileContext] = []
+        self.by_path: Dict[str, FileContext] = {}
+
+    def add(self, ctx: FileContext) -> None:
+        self.contexts.append(ctx)
+        self.by_path[ctx.path] = ctx
+
+
+class Rule:
+    """Base class; subclasses register with @register."""
+
+    id: str = ""
+    summary: str = ""
+    #: which production bug class this guards (shown by --list-rules)
+    rationale: str = ""
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, state: ProjectState) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: List[Rule] = []
+
+
+def register(cls):
+    _REGISTRY.append(cls())
+    return cls
+
+
+def iter_rules() -> List[Rule]:
+    _load_rules()
+    return list(_REGISTRY)
+
+
+_loaded = False
+
+
+def _load_rules() -> None:
+    global _loaded
+    if not _loaded:
+        from . import rules  # noqa: F401  (imports register every rule)
+
+        _loaded = True
+
+
+_SKIP_DIRS = {"__pycache__", "node_modules", ".git", ".jax_cache"}
+
+
+def iter_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into the sorted list of lintable files
+    (*.py everywhere, plus *.h for the layout cross-check)."""
+    out = set()
+    for p in paths:
+        if os.path.isfile(p):
+            out.add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for name in sorted(filenames):
+                if name.endswith((".py", ".h")):
+                    out.add(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def run(paths: Sequence[str],
+        rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Lint ``paths``; returns findings sorted by (path, line, col, rule).
+
+    Suppression comments (``# tblint: ignore[RULE]``) are applied here, so
+    rules never need to know about them.
+    """
+    active = list(rules) if rules is not None else iter_rules()
+    state = ProjectState()
+    findings: List[Finding] = []
+    for path in iter_files(paths):
+        ctx = FileContext(path)
+        state.add(ctx)
+        if ctx.parse_error is not None:
+            findings.append(Finding(
+                "parse-error", ctx.display_path,
+                ctx.parse_error.lineno or 1, 0,
+                f"file does not parse: {ctx.parse_error.msg}",
+            ))
+            continue
+        for rule in active:
+            if not rule.applies(ctx):
+                continue
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f.rule, f.line):
+                    findings.append(f)
+    for rule in active:
+        for f in rule.finalize(state):
+            ctx = state.by_path.get(os.path.abspath(f.path)) or next(
+                (c for c in state.contexts if c.display_path == f.path), None
+            )
+            if ctx is not None and ctx.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

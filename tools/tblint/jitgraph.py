@@ -1,0 +1,416 @@
+"""Module-local jit reachability and traced-value analysis.
+
+Three rules (traced-branch, concretize, unrolled-loop) only make sense
+*inside* code that runs under a JAX trace.  This module computes, per file:
+
+- which functions are jit roots (``@jax.jit``, ``name = jax.jit(fn)``,
+  ``@partial(jax.jit, ...)``, ``shard_map``/``pjit`` wrappers, or functions
+  passed to tracing combinators like ``lax.scan``/``vmap``);
+- the transitive closure of module-local calls from those roots
+  ("jit-reachable" functions);
+- per root, the parameter names excluded by ``static_argnames`` /
+  ``static_argnums`` (those are Python values, not tracers).
+
+The traced-value tracker is a deliberate approximation (one forward pass,
+name-level), tuned so that branching on ``x.shape[0]`` — static under jit —
+never fires, while branching on a ``jnp``-derived value always does.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# Names whose call wraps its argument in a trace (the argument function's
+# body runs under tracing even without an enclosing jit).
+TRACE_ENTRY_NAMES = {
+    "jit", "pjit", "scan", "while_loop", "fori_loop", "cond", "switch",
+    "vmap", "pmap", "shard_map", "associative_scan", "checkpoint", "remat",
+    "custom_jvp", "custom_vjp", "grad", "value_and_grad",
+}
+
+# Attributes of traced arrays that are *static* at trace time.  `capacity`
+# is this repo's idiom for the static table size carried on pytree structs
+# (ops/hash_table.Table.capacity is a Python-int property).
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes",
+                "capacity"}
+
+# Module aliases whose call results are traced values.
+TRACED_MODULES = {"jnp", "lax", "u128", "jsp", "jax"}
+
+# jax.* functions that return host (static) values, not tracers.
+_JAX_HOST_FNS = {
+    "default_backend", "devices", "local_devices", "device_count",
+    "local_device_count", "process_index", "process_count", "named_scope",
+}
+
+# Annotation spellings that mark a parameter as definitely-traced /
+# definitely-static for the per-function tracker.
+_ARRAYISH_ANNOTATIONS = {"Array", "ndarray", "U128", "ArrayLike"}
+_STATICISH_ANNOTATIONS = {
+    "int", "bool", "float", "str", "bytes", "Tuple", "tuple", "List",
+    "list", "Dict", "dict", "Sequence", "Optional", "Callable", "Mapping",
+}
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    """'jax.jit' -> 'jit'; 'jit' -> 'jit'; anything else -> None."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    """'jax.numpy.where' -> 'jax'; 'jnp.where' -> 'jnp'."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_trace_entry(func: ast.AST) -> bool:
+    name = _terminal_name(func)
+    return name in TRACE_ENTRY_NAMES
+
+
+def _static_params(call: ast.Call, fn: ast.FunctionDef) -> Set[str]:
+    """Extract static_argnames/static_argnums from a jit(...) call."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    out.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                    if 0 <= node.value < len(params):
+                        out.add(params[node.value])
+    return out
+
+
+class JitInfo:
+    """Result of the per-module analysis."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.roots: Set[str] = set()
+        self.reachable: Set[str] = set()
+        self.static_params: Dict[str, Set[str]] = {}
+
+    def reachable_nodes(self) -> List[ast.FunctionDef]:
+        return [self.functions[n] for n in sorted(self.reachable)
+                if n in self.functions]
+
+
+def analyze_module(tree: ast.AST) -> JitInfo:
+    info = JitInfo()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.functions.setdefault(node.name, node)
+
+    def mark_root(name: str, call: Optional[ast.Call] = None) -> None:
+        fn = info.functions.get(name)
+        if fn is None:
+            return
+        info.roots.add(name)
+        if call is not None:
+            info.static_params.setdefault(name, set()).update(
+                _static_params(call, fn)
+            )
+
+    # Decorated roots: @jax.jit, @jit, @partial(jax.jit, ...), @shard_map...
+    for name, fn in info.functions.items():
+        for dec in fn.decorator_list:
+            if _is_trace_entry(dec):
+                mark_root(name)
+            elif isinstance(dec, ast.Call):
+                if _is_trace_entry(dec.func):
+                    mark_root(name, dec)
+                elif _terminal_name(dec.func) == "partial" and any(
+                    _is_trace_entry(a) for a in dec.args
+                ):
+                    mark_root(name, dec)
+
+    # Call-site roots: jax.jit(fn), lax.scan(body, ...), vmap(fn) — any
+    # known function NAME appearing anywhere inside a trace-entry call's
+    # arguments (covers jax.jit(jax.vmap(fn)) nesting).
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_trace_entry(node.func):
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in info.functions:
+                        mark_root(sub.id, node)
+
+    # Module-local call graph, then closure from the roots.
+    calls: Dict[str, Set[str]] = {}
+    for name, fn in info.functions.items():
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                if node.func.id in info.functions:
+                    callees.add(node.func.id)
+        calls[name] = callees
+    frontier = list(info.roots)
+    info.reachable = set(info.roots)
+    while frontier:
+        cur = frontier.pop()
+        for callee in calls.get(cur, ()):
+            if callee not in info.reachable:
+                info.reachable.add(callee)
+                frontier.append(callee)
+    return info
+
+
+def module_jit_info(ctx) -> JitInfo:
+    """Cached JitInfo for a FileContext."""
+    if "jit_info" not in ctx.cache:
+        ctx.cache["jit_info"] = analyze_module(ctx.tree)
+    return ctx.cache["jit_info"]
+
+
+def _annotation_kind(ann: Optional[ast.AST]) -> Optional[bool]:
+    """True = array-ish, False = static-ish, None = unknown."""
+    if ann is None:
+        return None
+    base = ann.value if isinstance(ann, ast.Subscript) else ann
+    name = _terminal_name(base)
+    if name in _ARRAYISH_ANNOTATIONS:
+        return True
+    if name in _STATICISH_ANNOTATIONS:
+        return False
+    return None
+
+
+def walk_function_shallow(fn: ast.FunctionDef) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested def/class — the
+    nested functions are jit-analyzed on their own if reachable, so rules
+    using this never double-report a site."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                stack.append(child)
+
+
+class TracedTracker:
+    """Forward name-level traced-value propagation through one function.
+
+    ``traced`` holds local names currently bound to (possibly) traced
+    values.  For jit *roots*, parameters start traced (minus
+    static_argnames and static-annotated ones); for transitively-reachable
+    helpers only array-annotated parameters do — helpers routinely take
+    static config flags that were static_argnames two frames up, and
+    flagging branches on those would drown the true positives.  Results of
+    jnp/lax/u128 calls are always traced; ``.shape``/``len()`` and
+    int()/float() conversions produce static values.
+    """
+
+    def __init__(self, fn: ast.FunctionDef, static: Set[str],
+                 known_fns: Set[str], is_root: bool = True) -> None:
+        self.fn = fn
+        self.known_fns = known_fns
+        args = fn.args
+        params = list(args.posonlyargs + args.args + args.kwonlyargs)
+        if args.vararg:
+            params.append(args.vararg)
+        if args.kwarg:
+            params.append(args.kwarg)
+        self.traced: Set[str] = set()
+        #: names definitely bound to arrays (not containers of arrays) —
+        #: the unrolled-loop rule only fires on iteration over these.
+        self.array_names: Set[str] = set()
+        #: names bound to tuple/list containers (possibly OF traced
+        #: values): `not xs` / `len(xs)` on them is static control flow.
+        self.containers: Set[str] = set()
+        _CONTAINER_ANN = {"Tuple", "tuple", "List", "list", "Sequence",
+                          "Dict", "dict", "Mapping"}
+        for p in params:
+            if p.arg in ("self", "cls") or p.arg in static:
+                continue
+            kind = _annotation_kind(p.annotation)
+            if kind is True:
+                self.traced.add(p.arg)
+                self.array_names.add(p.arg)
+            elif kind is None and is_root:
+                self.traced.add(p.arg)
+            elif kind is False:
+                base = p.annotation.value if isinstance(
+                    p.annotation, ast.Subscript) else p.annotation
+                if _terminal_name(base) in _CONTAINER_ANN:
+                    self.containers.add(p.arg)
+        self.branch_sites: List[Tuple[ast.stmt, str]] = []
+        self._walk_body(fn.body)
+
+    # -- expression tracedness ---------------------------------------------
+
+    def is_traced(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.traced
+        if isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, (ast.BinOp,)):
+            return self.is_traced(expr.left) or self.is_traced(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            # `not xs` on a tuple/list container is a static length test.
+            if isinstance(expr.op, ast.Not) and \
+                    isinstance(expr.operand, ast.Name) and \
+                    expr.operand.id in self.containers:
+                return False
+            return self.is_traced(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.is_traced(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            # `x is None` / `x is not None` are identity checks resolved on
+            # the host even when x is a tracer.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+                return False
+            return self.is_traced(expr.left) or any(
+                self.is_traced(c) for c in expr.comparators
+            )
+        if isinstance(expr, ast.IfExp):
+            return (self.is_traced(expr.test) or self.is_traced(expr.body)
+                    or self.is_traced(expr.orelse))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.is_traced(e) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self.is_traced(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.is_traced(expr.value) or self.is_traced(expr.slice)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return False
+            return self.is_traced(expr.value)
+        if isinstance(expr, ast.Call):
+            return self._call_traced(expr)
+        if isinstance(expr, ast.NamedExpr):
+            return self.is_traced(expr.value)
+        return False
+
+    def _call_traced(self, call: ast.Call) -> bool:
+        func = call.func
+        name = _terminal_name(func)
+        root = _root_name(func)
+        if name in {"int", "float", "bool", "len", "isinstance", "range"}:
+            return False  # concrete result (int/float flagged elsewhere)
+        if name == "item":
+            return False  # .item() concretizes; flagged by the rule
+        if root in TRACED_MODULES:
+            if root == "jax" and name in _JAX_HOST_FNS:
+                return False
+            return True
+        if isinstance(func, ast.Name) and func.id in self.known_fns:
+            return True  # module-local helper: assume it returns traced
+        if isinstance(func, ast.Attribute):
+            # method on a traced value (x.astype(...), x.sum(), x.at[i].set())
+            return self.is_traced(func.value)
+        return False
+
+    # -- statement walk -----------------------------------------------------
+
+    def _bind(self, target: ast.AST, traced: bool,
+              array: bool = False, container: bool = False) -> None:
+        if isinstance(target, ast.Name):
+            for flag, group in ((traced, self.traced),
+                                (array, self.array_names),
+                                (container, self.containers)):
+                if flag:
+                    group.add(target.id)
+                else:
+                    group.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, traced, array=array)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, traced, array=array)
+        # Attribute/Subscript targets: no name binding to track.
+
+    def _bind_value(self, target: ast.AST, value: ast.AST) -> None:
+        traced = self.is_traced(value)
+        is_array = isinstance(value, ast.Call) and traced
+        is_container = isinstance(value, (ast.Tuple, ast.List, ast.ListComp))
+        self._bind(target, traced, array=is_array, container=is_container)
+
+    def _bind_for_target(self, target: ast.AST, iter_node: ast.AST) -> None:
+        """Bind a for-loop target; literal-of-literals iterables bind the
+        target tuple elementwise (``for name, mask in ((a, m1), (b, m2))``
+        must not taint ``name`` just because the masks are traced)."""
+        if (isinstance(target, (ast.Tuple, ast.List))
+                and isinstance(iter_node, (ast.Tuple, ast.List))
+                and iter_node.elts
+                and all(isinstance(e, (ast.Tuple, ast.List))
+                        and len(e.elts) == len(target.elts)
+                        for e in iter_node.elts)):
+            for i, t in enumerate(target.elts):
+                col = [e.elts[i] for e in iter_node.elts]
+                container_i = all(
+                    isinstance(c, (ast.Tuple, ast.List)) or (
+                        isinstance(c, ast.Name) and c.id in self.containers
+                    ) for c in col
+                )
+                self._bind(t, any(self.is_traced(c) for c in col),
+                           container=container_i)
+            return
+        self._bind(target, self.is_traced(iter_node))
+
+    def _walk_body(self, body: Iterable[ast.stmt]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt)
+
+    def _walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are analyzed separately if reachable
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._bind_value(t, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind_value(stmt.target, stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            if self.is_traced(stmt.value):
+                self._bind(stmt.target, True)
+        elif isinstance(stmt, ast.If):
+            if self.is_traced(stmt.test):
+                self.branch_sites.append((stmt, "if"))
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            if self.is_traced(stmt.test):
+                self.branch_sites.append((stmt, "while"))
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            if self.is_traced(stmt.test):
+                self.branch_sites.append((stmt, "assert"))
+        elif isinstance(stmt, ast.For):
+            self._bind_for_target(stmt.target, stmt.iter)
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._walk_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body)
+            for h in stmt.handlers:
+                self._walk_body(h.body)
+            self._walk_body(stmt.orelse)
+            self._walk_body(stmt.finalbody)
+
+
+def function_tracker(ctx, fn: ast.FunctionDef) -> TracedTracker:
+    """Cached TracedTracker for one jit-reachable function."""
+    key = ("tracker", id(fn))
+    if key not in ctx.cache:
+        info = module_jit_info(ctx)
+        static = info.static_params.get(fn.name, set())
+        ctx.cache[key] = TracedTracker(
+            fn, static, set(info.functions),
+            is_root=fn.name in info.roots,
+        )
+    return ctx.cache[key]

@@ -1,0 +1,11 @@
+"""Rule modules; importing this package registers every rule."""
+
+from . import (  # noqa: F401
+    determinism,
+    excepts,
+    hostsync,
+    layout,
+    loops,
+    tracer,
+    u128_rules,
+)

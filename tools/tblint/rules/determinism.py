@@ -1,0 +1,259 @@
+"""nondet: nondeterminism sources in sim/ and vsr/ (VOPR replay stability).
+
+A VOPR seed must replay bit-identically — "Index-Based Scheduling for
+Parallel State Machine Replication"-style determinism is the whole premise
+of seed-addressable bug reports.  Three source families break it:
+
+- wall clocks (``time.time``/``time_ns``/``perf_counter``, ``datetime.now``,
+  ``os.urandom``, ``uuid.uuid4``) — anything not derived from the seed;
+- the *global* ``random`` module (unseeded process-wide state; seeded
+  ``random.Random(seed)`` instances are fine) and global ``np.random``;
+- **set iteration feeding control flow**: Python set order depends on
+  PYTHONHASHSEED for str/object elements and on insertion history for
+  ints.  Iterating a set is flagged unless the context is order-insensitive
+  (``sorted``/``sum``/``min``/``max``/``len``/``any``/``all``/set-to-set).
+  Dict iteration is insertion-ordered since 3.7 and is deliberately NOT
+  flagged — determinism there reduces to deterministic insertion, which
+  the other families already police.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import _root_name, _terminal_name
+
+# module name -> attributes that read wall-clock / OS entropy.
+_CLOCK_ATTRS = {
+    "time": {"time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns"},
+    "datetime": {"now", "utcnow", "today"},
+    "os": {"urandom", "getrandom"},
+    "uuid": {"uuid1", "uuid4"},
+    "secrets": {"token_bytes", "token_hex", "randbelow", "choice"},
+}
+# Aliases this repo uses for those modules.
+_MODULE_ALIASES = {"_time": "time", "_datetime": "datetime"}
+
+_GLOBAL_RANDOM_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "getrandbits", "seed", "gauss", "betavariate",
+}
+
+# Callables for which set iteration order cannot matter.
+_ORDER_INSENSITIVE = {
+    "sorted", "sum", "min", "max", "len", "any", "all", "set", "frozenset",
+}
+
+
+def _set_typed_names(fn_body: Iterable[ast.stmt]) -> Set[str]:
+    """Names (including ``self.x`` spelled as 'self.x') assigned set-typed
+    values anywhere in the given statement list."""
+    names: Set[str] = set()
+
+    def target_key(t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Name):
+            return t.id
+        if (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)):
+            return f"{t.value.id}.{t.attr}"
+        return None
+
+    def value_is_set(v: ast.AST) -> bool:
+        if isinstance(v, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(v, ast.Call):
+            name = _terminal_name(v.func)
+            if name in {"set", "frozenset"}:
+                return True
+            if name in {"union", "intersection", "difference",
+                        "symmetric_difference", "copy"}:
+                base = getattr(v.func, "value", None)
+                return base is not None and expr_is_set(base)
+        if isinstance(v, ast.BinOp) and isinstance(
+                v.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            return value_is_set(v.left) or value_is_set(v.right)
+        return False
+
+    def expr_is_set(e: ast.AST) -> bool:
+        key = target_key(e)
+        return (key in names) if key else value_is_set(e)
+
+    for stmt in fn_body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                if value_is_set(node.value):
+                    for t in node.targets:
+                        key = target_key(t)
+                        if key:
+                            names.add(key)
+            elif isinstance(node, ast.AnnAssign):
+                ann = node.annotation
+                ann_name = _terminal_name(ann) or (
+                    _terminal_name(ann.value)
+                    if isinstance(ann, ast.Subscript) else None
+                )
+                if ann_name in {"set", "Set", "frozenset", "FrozenSet"} or (
+                    node.value is not None and value_is_set(node.value)
+                ):
+                    key = target_key(node.target)
+                    if key:
+                        names.add(key)
+    return names
+
+
+class _SetIterVisitor(ast.NodeVisitor):
+    """Find order-sensitive iteration over set-typed expressions."""
+
+    def __init__(self, rule_id: str, ctx: FileContext,
+                 set_names: Set[str]) -> None:
+        self.rule_id = rule_id
+        self.ctx = ctx
+        self.set_names = set_names
+        self.findings: List[Finding] = []
+        # comprehensions appearing directly inside an order-insensitive
+        # call are exempt; collect their ids while visiting Calls.
+        self._exempt: Set[int] = set()
+
+    def _is_set_expr(self, e: ast.AST) -> bool:
+        if isinstance(e, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(e, ast.Name):
+            return e.id in self.set_names
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+            return f"{e.value.id}.{e.attr}" in self.set_names
+        if isinstance(e, ast.Call):
+            name = _terminal_name(e.func)
+            if name in {"set", "frozenset"}:
+                return True
+            if name in {"union", "intersection", "difference",
+                        "symmetric_difference"}:
+                base = getattr(e.func, "value", None)
+                return base is not None and self._is_set_expr(base)
+        return False
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            self.rule_id, self.ctx.display_path,
+            node.lineno, node.col_offset,
+            f"{what} over a set is hash-order dependent; sort first "
+            "(sorted(...)) or use an ordered structure",
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _terminal_name(node.func)
+        if name in _ORDER_INSENSITIVE:
+            for arg in node.args:
+                if isinstance(arg, (ast.GeneratorExp, ast.ListComp)):
+                    self._exempt.add(id(arg))
+                if self._is_set_expr(arg):
+                    # sorted(s) / sum over s / set(s): order-insensitive.
+                    self._exempt.add(id(arg))
+        elif name in {"list", "tuple", "enumerate", "iter"}:
+            for arg in node.args:
+                if self._is_set_expr(arg):
+                    self._flag(node, f"{name}()")
+        elif name == "pop" and isinstance(node.func, ast.Attribute):
+            if self._is_set_expr(node.func.value) and not node.args:
+                self._flag(node, "set.pop()")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._is_set_expr(node.iter) and id(node.iter) not in self._exempt:
+            self._flag(node, "for-loop")
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        if id(node) not in self._exempt:
+            for gen in node.generators:
+                if self._is_set_expr(gen.iter):
+                    self._flag(node, "comprehension")
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    # Set/dict comprehensions over sets rebuild unordered containers: fine.
+
+
+@register
+class NondeterminismRule(Rule):
+    id = "nondet"
+    summary = "nondeterminism source in sim/ or vsr/ (breaks VOPR replay)"
+    rationale = (
+        "A seed must replay bit-identically; wall clocks, global random "
+        "state, and set iteration order all silently break that."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and ctx.in_det_scope()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        aliases = self._import_aliases(ctx.tree)
+        self._check_clock_and_random(ctx, aliases, out)
+        # Set iteration: module level plus each function body, with
+        # set-typed names tracked per scope.
+        module_sets = _set_typed_names(ctx.tree.body)
+        scopes = [(ctx.tree.body, module_sets)]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(
+                    (node.body, module_sets | _set_typed_names(node.body))
+                )
+        seen: Set[int] = set()
+        for body, set_names in scopes:
+            visitor = _SetIterVisitor(self.id, ctx, set_names)
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # inner scopes handled separately
+                visitor.visit(stmt)
+            for f in visitor.findings:
+                key = hash((f.line, f.col, f.message))
+                if key not in seen:
+                    seen.add(key)
+                    out.append(f)
+        return out
+
+    def _import_aliases(self, tree: ast.AST) -> Dict[str, str]:
+        """local alias -> canonical module for the watched modules."""
+        aliases = dict(_MODULE_ALIASES)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in _CLOCK_ATTRS or a.name in ("random", "numpy"):
+                        aliases[a.asname or a.name] = a.name
+        return aliases
+
+    def _check_clock_and_random(self, ctx: FileContext,
+                                aliases: Dict[str, str],
+                                out: List[Finding]) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            root = _root_name(node)
+            module = aliases.get(root or "", root)
+            if module in _CLOCK_ATTRS and node.attr in _CLOCK_ATTRS[module]:
+                out.append(Finding(
+                    self.id, ctx.display_path, node.lineno, node.col_offset,
+                    f"{module}.{node.attr} is a wall-clock/entropy source; "
+                    "derive values from the seed (inject a clock)",
+                ))
+            elif module == "random" and isinstance(node.value, ast.Name) \
+                    and node.attr in _GLOBAL_RANDOM_FNS:
+                out.append(Finding(
+                    self.id, ctx.display_path, node.lineno, node.col_offset,
+                    f"global random.{node.attr} uses unseeded process-wide "
+                    "state; use a seeded random.Random(seed) instance",
+                ))
+            elif (node.attr in _GLOBAL_RANDOM_FNS
+                  and isinstance(node.value, ast.Attribute)
+                  and node.value.attr == "random"
+                  and aliases.get(_root_name(node.value) or "") == "numpy"):
+                out.append(Finding(
+                    self.id, ctx.display_path, node.lineno, node.col_offset,
+                    f"np.random.{node.attr} uses global numpy RNG state; "
+                    "use np.random.default_rng(seed)",
+                ))

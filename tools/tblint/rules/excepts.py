@@ -1,0 +1,76 @@
+"""swallow: ``except Exception: pass`` hiding real failures.
+
+Round-5's sweep killer was exactly this shape: a broad handler swallowed a
+cold-manifest FileNotFoundError and the sweep reported a liveness wedge
+instead of the actual crash.  A handler this broad must either narrow the
+exception type or record the swallow (log/counter) — and if the breadth is
+deliberate (best-effort degradation around private APIs), say so with a
+suppression comment.
+
+Probe/bench utilities (basename contains 'probe' or 'bench') are exempt:
+their job is to survive anything and report a number.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Finding, Rule, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True  # bare except
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _body_swallows(body) -> bool:
+    """True when the handler body does nothing observable."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Continue):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+@register
+class SwallowRule(Rule):
+    id = "swallow"
+    summary = "broad `except Exception: pass` swallows failures silently"
+    rationale = (
+        "A swallowed crash surfaces later as an unrelated liveness wedge "
+        "(round-5 sweep, seed 600434); narrow the type or log the swallow."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        base = ctx.basename
+        return ctx.is_py and "probe" not in base and "bench" not in base
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type) and _body_swallows(node.body):
+                what = "bare except" if node.type is None else \
+                    "except Exception"
+                out.append(Finding(
+                    self.id, ctx.display_path, node.lineno, node.col_offset,
+                    f"{what}: pass swallows failures; narrow the exception "
+                    "type, log the swallow, or suppress with a reason",
+                ))
+        return out

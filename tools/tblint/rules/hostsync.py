@@ -1,0 +1,49 @@
+"""host-sync: explicit device->host synchronization in hot-path modules.
+
+``jax.device_get`` and ``block_until_ready`` in ops/ or machine.py stall
+the dispatch pipeline — the round-1 bench regressions were exactly this
+shape (a stray sync per batch turned async dispatch into lockstep).  Hot
+paths must return device values and let the *caller* decide when to sync;
+deliberate sync points (commit barriers) carry a suppression with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import _root_name, _terminal_name
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    summary = "jax.device_get / block_until_ready in a hot-path module"
+    rationale = (
+        "A sync per batch turns async device dispatch into host lockstep; "
+        "hot paths return device values and sync only at commit barriers."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and ctx.in_hot_scope()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if name == "block_until_ready":
+                out.append(Finding(
+                    self.id, ctx.display_path, node.lineno, node.col_offset,
+                    "block_until_ready() stalls the dispatch pipeline; "
+                    "sync at the commit barrier instead",
+                ))
+            elif name == "device_get" and _root_name(node.func) == "jax":
+                out.append(Finding(
+                    self.id, ctx.display_path, node.lineno, node.col_offset,
+                    "jax.device_get() forces a device->host sync in a hot "
+                    "path; keep the value on device",
+                ))
+        return out

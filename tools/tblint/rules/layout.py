@@ -1,0 +1,302 @@
+"""layout-drift: struct field order/size drift across the three layout
+definitions that must agree byte-for-byte.
+
+The wire/disk layout lives in three places: ``types.py`` (numpy structured
+dtypes), ``vsr/wire.py`` (the 256-byte message header dtypes), and the
+generated ``native/tb_types.h`` (C structs for the native client).  A field
+reordered or resized in one of them ships corrupt frames that still
+checksum correctly — the worst failure class this repo has.  This rule
+statically cross-checks:
+
+- every ``*_DTYPE`` in a types.py against its ``tb_*_t`` struct in the
+  nearest tb_types.h below it (name/size/order, u128 lane pairs merged);
+- wire.py's ``_FRAME`` sums to half of HEADER_SIZE and every ``_dtype``
+  tail fills the other half;
+- u128 lane pairing: every ``*_lo`` u64 field is immediately followed by
+  its ``*_hi`` — a swapped or separated lane pair is byte-order corruption
+  that no runtime assert catches.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import FileContext, Finding, ProjectState, Rule, register
+
+Field = Tuple[str, int]  # (name, byte size)
+
+_FMT_RE = re.compile(r"^[<>=|]?([a-zA-Z])(\d+)$")
+
+_C_SIZES = {
+    "uint64_t": 8, "int64_t": 8, "uint32_t": 4, "int32_t": 4,
+    "uint16_t": 2, "int16_t": 2, "uint8_t": 1, "int8_t": 1,
+    "tb_uint128_t": 16,
+}
+
+_C_STRUCT_RE = re.compile(
+    r"typedef\s+struct\s*\{([^}]*)\}\s*(\w+)\s*;", re.S
+)
+_C_FIELD_RE = re.compile(r"(\w+)\s+(\w+)\s*(?:\[(\d+)\])?\s*;")
+
+
+def _fmt_size(fmt: str) -> Optional[int]:
+    m = _FMT_RE.match(fmt)
+    if m is None:
+        return None
+    return int(m.group(2))
+
+
+def _parse_field_list(node: ast.AST) -> Optional[List[Field]]:
+    """Parse a literal ``[("name", "<u8"), ...]`` list; None if any entry
+    is not a constant 2-tuple we can size."""
+    if not isinstance(node, ast.List):
+        return None
+    fields: List[Field] = []
+    for elt in node.elts:
+        if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2):
+            return None
+        name_n, fmt_n = elt.elts
+        if not (isinstance(name_n, ast.Constant)
+                and isinstance(fmt_n, ast.Constant)
+                and isinstance(name_n.value, str)
+                and isinstance(fmt_n.value, str)):
+            return None
+        size = _fmt_size(fmt_n.value)
+        if size is None:
+            return None
+        fields.append((name_n.value, size))
+    return fields
+
+
+def _merge_lanes(fields: List[Field]) -> List[Field]:
+    """Merge adjacent (x_lo u64, x_hi u64) pairs into one (x, 16) field."""
+    out: List[Field] = []
+    i = 0
+    while i < len(fields):
+        name, size = fields[i]
+        if (name.endswith("_lo") and size == 8 and i + 1 < len(fields)
+                and fields[i + 1][0] == name[:-3] + "_hi"
+                and fields[i + 1][1] == 8):
+            out.append((name[:-3], 16))
+            i += 2
+        else:
+            out.append((name, size))
+            i += 1
+    return out
+
+
+def _lane_pair_findings(rule_id: str, ctx: FileContext, line: int,
+                        label: str, fields: List[Field]) -> List[Finding]:
+    out: List[Finding] = []
+    for i, (name, size) in enumerate(fields):
+        if name.endswith("_lo") and size == 8:
+            follower = fields[i + 1] if i + 1 < len(fields) else None
+            if follower != (name[:-3] + "_hi", 8):
+                out.append(Finding(
+                    rule_id, ctx.display_path, line, 0,
+                    f"{label}: u128 lane `{name}` is not immediately "
+                    f"followed by `{name[:-3]}_hi` — lane order drift",
+                ))
+    return out
+
+
+def _dtype_assigns(tree: ast.AST) -> List[Tuple[str, int, ast.Call]]:
+    """(name, line, call) for every ``X_DTYPE = np.dtype(...)`` or
+    ``X_DTYPE = _dtype(...)`` style assignment."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not (isinstance(target, ast.Name)
+                and target.id.endswith("_DTYPE")):
+            continue
+        if isinstance(node.value, ast.Call):
+            out.append((target.id, node.lineno, node.value))
+    return out
+
+
+def _module_const(tree: ast.AST, name: str, default: int) -> int:
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            return node.value.value
+    return default
+
+
+def _parse_header_structs(source: str) -> Dict[str, List[Field]]:
+    structs: Dict[str, List[Field]] = {}
+    for m in _C_STRUCT_RE.finditer(source):
+        body, name = m.group(1), m.group(2)
+        if name == "tb_uint128_t":
+            continue
+        fields: List[Field] = []
+        ok = True
+        for fm in _C_FIELD_RE.finditer(body):
+            ctype, fname, arr = fm.group(1), fm.group(2), fm.group(3)
+            base = _C_SIZES.get(ctype)
+            if base is None:
+                ok = False
+                break
+            fields.append((fname, base * (int(arr) if arr else 1)))
+        if ok and fields:
+            structs[name] = fields
+    return structs
+
+
+def _header_struct_for(dtype_name: str) -> str:
+    """ACCOUNT_DTYPE -> tb_account_t."""
+    return "tb_" + dtype_name[: -len("_DTYPE")].lower() + "_t"
+
+
+@register
+class LayoutDriftRule(Rule):
+    id = "layout-drift"
+    summary = "field order/size drift across wire.py / types.py / tb_types.h"
+    rationale = (
+        "A reordered or resized field ships frames that parse cleanly on "
+        "one side and scramble on the other; no runtime assert sees it."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return (ctx.is_py and ctx.basename in ("types.py", "wire.py")) \
+            or ctx.basename.endswith(".h")
+
+    # -- per-file structural invariants -------------------------------------
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.is_py:
+            return ()
+        out: List[Finding] = []
+        if ctx.basename == "wire.py":
+            out.extend(self._check_wire(ctx))
+        elif ctx.basename == "types.py":
+            for name, line, call in _dtype_assigns(ctx.tree):
+                fields = self._np_dtype_fields(call)
+                if fields is not None:
+                    out.extend(_lane_pair_findings(
+                        self.id, ctx, line, name, fields))
+        return out
+
+    def _np_dtype_fields(self, call: ast.Call) -> Optional[List[Field]]:
+        if not call.args:
+            return None
+        return _parse_field_list(call.args[0])
+
+    def _check_wire(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        tree = ctx.tree
+        header_size = _module_const(tree, "HEADER_SIZE", 256)
+        frame: Optional[List[Field]] = None
+        frame_line = 0
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "_FRAME"):
+                frame = _parse_field_list(node.value)
+                frame_line = node.lineno
+        if frame is None:
+            return out  # not the header-framing wire.py idiom
+        frame_size = sum(s for _, s in frame)
+        if frame_size != header_size // 2:
+            out.append(Finding(
+                self.id, ctx.display_path, frame_line, 0,
+                f"_FRAME is {frame_size} bytes, expected {header_size // 2} "
+                f"(half of HEADER_SIZE={header_size})",
+            ))
+        out.extend(_lane_pair_findings(
+            self.id, ctx, frame_line, "_FRAME", frame))
+        tail_budget = header_size - frame_size
+        for name, line, call in _dtype_assigns(ctx.tree):
+            if not (isinstance(call.func, ast.Name)
+                    and call.func.id == "_dtype"):
+                continue
+            tail = self._np_dtype_fields(call)
+            if tail is None:
+                continue
+            tail_size = sum(s for _, s in tail)
+            if tail_size != tail_budget:
+                out.append(Finding(
+                    self.id, ctx.display_path, line, 0,
+                    f"{name} tail is {tail_size} bytes, expected "
+                    f"{tail_budget} (HEADER_SIZE - frame)",
+                ))
+            out.extend(_lane_pair_findings(self.id, ctx, line, name, tail))
+        return out
+
+    # -- cross-file types.py <-> tb_types.h comparison ----------------------
+
+    def finalize(self, state: ProjectState) -> Iterable[Finding]:
+        type_files = [c for c in state.contexts
+                      if c.basename == "types.py" and c.tree is not None]
+        headers = [c for c in state.contexts if c.basename.endswith(".h")]
+        out: List[Finding] = []
+        for hdr in headers:
+            structs = _parse_header_structs(hdr.source)
+            if not structs:
+                continue
+            owner = self._owning_types(hdr, type_files)
+            if owner is None:
+                continue
+            dtypes: Dict[str, Tuple[int, List[Field]]] = {}
+            for name, line, call in _dtype_assigns(owner.tree):
+                fields = self._np_dtype_fields(call)
+                if fields is not None:
+                    dtypes[name] = (line, fields)
+            for dtype_name, (line, fields) in sorted(dtypes.items()):
+                struct_name = _header_struct_for(dtype_name)
+                if struct_name not in structs:
+                    continue
+                out.extend(self._compare(
+                    owner, line, dtype_name, _merge_lanes(fields),
+                    hdr, struct_name, structs[struct_name],
+                ))
+        return out
+
+    def _owning_types(self, hdr: FileContext,
+                      type_files: List[FileContext]) -> Optional[FileContext]:
+        """The types.py whose directory is the nearest ancestor of the
+        header's directory (tigerbeetle_tpu/types.py owns native/tb_types.h;
+        a fixture tree pairs with its own local copy)."""
+        hdr_dir = os.path.dirname(hdr.path)
+        best, best_len = None, -1
+        for tf in type_files:
+            tf_dir = os.path.dirname(tf.path)
+            if (hdr_dir + os.sep).startswith(tf_dir + os.sep) \
+                    and len(tf_dir) > best_len:
+                best, best_len = tf, len(tf_dir)
+        return best
+
+    def _compare(self, owner: FileContext, line: int, dtype_name: str,
+                 py: List[Field], hdr: FileContext, struct_name: str,
+                 c_fields: List[Field]) -> List[Finding]:
+        py_total = sum(s for _, s in py)
+        c_total = sum(s for _, s in c_fields)
+        if py_total != c_total:
+            return [Finding(
+                self.id, owner.display_path, line, 0,
+                f"{dtype_name} is {py_total} bytes but {struct_name} in "
+                f"{hdr.display_path} is {c_total} bytes",
+            )]
+        out: List[Finding] = []
+        for i in range(max(len(py), len(c_fields))):
+            pf = py[i] if i < len(py) else None
+            cf = c_fields[i] if i < len(c_fields) else None
+            if pf == cf:
+                continue
+            out.append(Finding(
+                self.id, owner.display_path, line, 0,
+                f"{dtype_name} field #{i} is "
+                f"{pf[0] if pf else '<missing>'}"
+                f"({pf[1] if pf else 0}B) but {struct_name} has "
+                f"{cf[0] if cf else '<missing>'}({cf[1] if cf else 0}B) — "
+                "order/size drift",
+            ))
+            break  # first drift point; the rest cascades
+        return out

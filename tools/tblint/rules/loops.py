@@ -1,0 +1,93 @@
+"""unrolled-loop: batch-proportional Python loops inside jit-reachable code.
+
+A Python ``for`` inside a traced function unrolls at trace time: N loop
+iterations become N copies of the loop body in the XLA graph.  For a
+constant short trip (column lists, BLOOM_HASHES probes, a bit_length
+binary search) that is this repo's deliberate idiom and is fine.  The
+catastrophic case is a trip count proportional to the *data*:
+``range(x.shape[0])`` unrolls 8190 copies of the body per batch and
+re-specializes on every new size — that was the round-3 "40 s first
+compile" shape.  This rule flags exactly that class:
+
+- ``for i in range(...)`` where a ``.shape`` access appears in the range
+  arguments (and is not log-compressed through ``.bit_length()``);
+- ``for x in <array>`` iterating directly over an array-annotated
+  parameter (per-row unrolling).
+
+Use ``lax.scan``/``lax.fori_loop`` for sequential dependencies or
+``vmap`` for independent iterations.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import (
+    _terminal_name,
+    function_tracker,
+    module_jit_info,
+    walk_function_shallow,
+)
+
+
+def _shape_proportional_range(call: ast.Call) -> bool:
+    """range(...) whose trip count is derived from an array shape —
+    unless the derivation goes through bit_length (log trip counts are
+    the deliberate binary-search unroll idiom)."""
+    if _terminal_name(call.func) != "range":
+        return False
+    saw_shape = False
+    for arg in call.args:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Attribute):
+                if sub.attr == "shape":
+                    saw_shape = True
+                elif sub.attr == "bit_length":
+                    return False
+            elif isinstance(sub, ast.Call) and \
+                    _terminal_name(sub.func) == "bit_length":
+                return False
+    return saw_shape
+
+
+@register
+class UnrolledLoopRule(Rule):
+    id = "unrolled-loop"
+    summary = "batch-proportional Python loop inside jit-reachable code"
+    rationale = (
+        "range(x.shape[0]) unrolls one body copy per batch row at trace "
+        "time and recompiles per size; use lax.scan/fori_loop or vmap."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and ctx.in_hot_scope()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        info = module_jit_info(ctx)
+        out: List[Finding] = []
+        for fn in info.reachable_nodes():
+            tracker = function_tracker(ctx, fn)
+            for node in walk_function_shallow(fn):
+                if not isinstance(node, ast.For):
+                    continue
+                if isinstance(node.iter, ast.Call) and \
+                        _shape_proportional_range(node.iter):
+                    out.append(Finding(
+                        self.id, ctx.display_path,
+                        node.lineno, node.col_offset,
+                        "`for` over range(...shape...) unrolls one body "
+                        f"copy per row in jit-reachable `{fn.name}`; use "
+                        "lax.scan/fori_loop or vmap",
+                    ))
+                elif isinstance(node.iter, ast.Name) and \
+                        node.iter.id in tracker.array_names:
+                    out.append(Finding(
+                        self.id, ctx.display_path,
+                        node.lineno, node.col_offset,
+                        f"`for` directly over traced `{node.iter.id}` "
+                        f"unrolls per element in jit-reachable "
+                        f"`{fn.name}`; use lax.scan or vmap",
+                    ))
+        return out

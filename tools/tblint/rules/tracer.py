@@ -1,0 +1,109 @@
+"""Tracer-safety rules for jit-reachable code in ops/ and machine.py.
+
+traced-branch — Python ``if``/``while``/``assert`` on a traced value inside
+jit-reachable code raises ConcretizationTypeError at trace time, or worse,
+silently bakes one branch into the compiled program when the value happens
+to be concrete during tracing.  Use ``jnp.where``/``lax.cond``/``lax.select``.
+
+concretize — ``.item()``, ``int()``, ``float()``, ``np.asarray()`` on traced
+values force a host round trip (or fail under jit); in a hot kernel these
+are the classic "why is my TPU idle" bugs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import (
+    _root_name,
+    _terminal_name,
+    function_tracker,
+    module_jit_info,
+    walk_function_shallow,
+)
+
+
+def _snippet(node: ast.AST, limit: int = 48) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:
+        return "<expr>"
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
+@register
+class TracedBranchRule(Rule):
+    id = "traced-branch"
+    summary = "Python control flow on a traced value inside jitted code"
+    rationale = (
+        "Branching on tracers fails at trace time or silently specializes "
+        "the compiled program to one path; use jnp.where / lax.cond."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and ctx.in_hot_scope()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        info = module_jit_info(ctx)
+        out: List[Finding] = []
+        for fn in info.reachable_nodes():
+            tracker = function_tracker(ctx, fn)
+            for stmt, kind in tracker.branch_sites:
+                test = getattr(stmt, "test", stmt)
+                out.append(Finding(
+                    self.id, ctx.display_path, stmt.lineno, stmt.col_offset,
+                    f"`{kind}` on traced value `{_snippet(test)}` in "
+                    f"jit-reachable `{fn.name}`; use jnp.where/lax.cond",
+                ))
+        return out
+
+
+# Call shapes that force a traced value onto the host.
+_NP_CONCRETIZERS = {"asarray", "array"}
+
+
+@register
+class ConcretizeRule(Rule):
+    id = "concretize"
+    summary = "host concretization (.item()/int()/float()/np.asarray) under jit"
+    rationale = (
+        "Concretizing a tracer fails under jit and, in op-by-op mode, "
+        "serializes the device pipeline with silent host syncs."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and ctx.in_hot_scope()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        info = module_jit_info(ctx)
+        out: List[Finding] = []
+        for fn in info.reachable_nodes():
+            tracker = function_tracker(ctx, fn)
+            for node in walk_function_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                name = _terminal_name(func)
+                if name == "item" and isinstance(func, ast.Attribute):
+                    if tracker.is_traced(func.value):
+                        out.append(self._finding(
+                            ctx, node, fn, ".item()"))
+                elif (isinstance(func, ast.Name)
+                      and func.id in {"int", "float", "bool"}
+                      and node.args and tracker.is_traced(node.args[0])):
+                    out.append(self._finding(ctx, node, fn, f"{func.id}()"))
+                elif (name in _NP_CONCRETIZERS
+                      and _root_name(func) in {"np", "numpy"}):
+                    out.append(self._finding(
+                        ctx, node, fn, f"np.{name}()"))
+        return out
+
+    def _finding(self, ctx: FileContext, node: ast.Call,
+                 fn: ast.FunctionDef, what: str) -> Finding:
+        return Finding(
+            self.id, ctx.display_path, node.lineno, node.col_offset,
+            f"{what} concretizes a traced value in jit-reachable "
+            f"`{fn.name}`; keep the value on device",
+        )

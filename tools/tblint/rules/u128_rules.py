@@ -1,0 +1,94 @@
+"""u128 invariants: limb arithmetic stays in u128.py; wide literals don't
+silently truncate.
+
+u128-limb — raw ``+``/``-``/``*`` on ``.lo``/``.hi`` limb attributes outside
+u128.py drops carries/borrows: ``a.lo + b.lo`` wraps silently at 2**64 and
+the hi lane never hears about it.  Every cross-lane operation must go
+through the u128 helpers (add/sub/sub_saturate/...), whose overflow flags
+mirror the reference's sum_overflows checks.
+
+wide-literal — an int literal above 2**64-1 flowing into a ``jnp`` call
+truncates (or raises, dtype-dependent) because XLA has no 128-bit ints.
+Wide constants must be split into (lo, hi) lanes via ``u128.lit`` /
+``u128_split`` first.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import _root_name
+
+_U64_MAX = 0xFFFF_FFFF_FFFF_FFFF
+_ARITH = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod, ast.Pow)
+_LIMB_ATTRS = {"lo", "hi"}
+
+
+def _is_limb(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Attribute) and expr.attr in _LIMB_ATTRS
+
+
+@register
+class LimbArithmeticRule(Rule):
+    id = "u128-limb"
+    summary = "raw Python arithmetic on u128 .lo/.hi limbs outside u128.py"
+    rationale = (
+        "Lane-wise + / - without carry propagation silently corrupts "
+        "balances at 2**64; use u128.add/sub (they report overflow)."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and ctx.basename != "u128.py"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, _ARITH):
+                if _is_limb(node.left) or _is_limb(node.right):
+                    out.append(self._finding(ctx, node))
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.op, _ARITH):
+                if _is_limb(node.target) or _is_limb(node.value):
+                    out.append(self._finding(ctx, node))
+        return out
+
+    def _finding(self, ctx: FileContext, node: ast.AST) -> Finding:
+        return Finding(
+            self.id, ctx.display_path, node.lineno, node.col_offset,
+            "raw arithmetic on a u128 .lo/.hi limb drops carries; use the "
+            "u128 helpers (add/sub/sub_saturate)",
+        )
+
+
+@register
+class WideLiteralRule(Rule):
+    id = "wide-literal"
+    summary = "int literal > 2**64-1 inside a jnp call (silent truncation)"
+    rationale = (
+        "XLA has no 128-bit integers: a wide literal reaching jnp wraps "
+        "or raises; split it into (lo, hi) lanes with u128.lit first."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _root_name(node.func) not in {"jnp", "lax"}:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, int)
+                            and not isinstance(sub.value, bool)
+                            and sub.value > _U64_MAX):
+                        out.append(Finding(
+                            self.id, ctx.display_path,
+                            sub.lineno, sub.col_offset,
+                            f"literal {hex(sub.value)} exceeds u64 and will "
+                            "truncate in a jnp call; split into (lo, hi) "
+                            "lanes via u128.lit",
+                        ))
+        return out
