@@ -39,8 +39,9 @@ def free_ports(n):
 class TcpCluster:
     """n replicas on localhost TCP with per-replica stop/restart."""
 
-    def __init__(self, tmp_path, n=3):
+    def __init__(self, tmp_path, n=3, statsd=None):
         self.n = n
+        self.statsd = statsd  # shared StatsD sink for every ClusterServer
         self.tmp_path = tmp_path
         self.addresses = [("127.0.0.1", p) for p in free_ports(n)]
         self.replicas = [None] * n
@@ -71,7 +72,8 @@ class TcpCluster:
         self.replicas[i] = r
 
         async def boot():
-            server = ClusterServer(r, self.addresses, tick_interval=0.005)
+            server = ClusterServer(r, self.addresses, tick_interval=0.005,
+                                   statsd=self.statsd)
             await server.start()
             return server
 
@@ -160,6 +162,48 @@ def transfer_batch(first_id, count, amount=1):
             for i in range(count)
         ]
     )
+
+
+def test_cluster_statsd_emission(tmp_path):
+    """The cluster bus's StatsD path (net/cluster_bus._read_loop): every
+    replica that receives a client request emits requests/events samples."""
+    from tigerbeetle_tpu.utils.statsd import StatsD
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(0.5)
+    udp_port = recv.getsockname()[1]
+
+    c = TcpCluster(tmp_path, statsd=StatsD("127.0.0.1", udp_port,
+                                           prefix="tbc"))
+    try:
+        client = Client(c.addresses, cluster=CLUSTER, timeout_s=30.0)
+        try:
+            make_accounts(client)
+            assert client.create_transfers(transfer_batch(500, 8)) == []
+        finally:
+            client.close()
+        samples = []
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            try:
+                samples.append(recv.recv(2048).decode())
+            except TimeoutError:
+                pass
+            if (
+                any(s.startswith("tbc.requests:") for s in samples)
+                and any(s.startswith("tbc.events:") for s in samples)
+            ):
+                break
+        assert any(s.startswith("tbc.requests:1|c") for s in samples), samples
+        event_counts = [
+            int(s.split(":")[1].split("|")[0])
+            for s in samples if s.startswith("tbc.events:")
+        ]
+        assert 8 in event_counts or 16 in event_counts, samples
+    finally:
+        recv.close()
+        c.close()
 
 
 def test_three_replica_tcp_cluster(cluster):

@@ -157,6 +157,88 @@ class TestClientServer:
         client.close()
 
 
+class TestStatsdEmission:
+    def test_server_emits_request_event_latency_samples(self, tmp_path):
+        """The StatsD path stays wired through the group-commit server:
+        requests/events counters and request_ms timings arrive over UDP
+        (net/bus._emit_stats)."""
+        import socket as socket_mod
+
+        from tigerbeetle_tpu.utils.statsd import StatsD
+
+        recv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(0.5)
+        udp_port = recv.getsockname()[1]
+
+        path = str(tmp_path / "statsd.tb")
+        Replica.format(path, cluster=CLUSTER, cluster_config=TEST_CONFIG)
+        replica = Replica(path, cluster_config=TEST_CONFIG,
+                          ledger_config=TEST_LEDGER, batch_lanes=64)
+        replica.open()
+        box = {}
+        ready = threading.Event()
+        thread = threading.Thread(
+            target=run_server, args=(replica, "127.0.0.1", 0),
+            kwargs=dict(
+                ready_callback=lambda p: (box.update(port=p), ready.set()),
+                statsd=StatsD("127.0.0.1", udp_port, prefix="tb"),
+            ),
+            daemon=True,
+        )
+        thread.start()
+        assert ready.wait(30)
+
+        client = Client([("127.0.0.1", box["port"])], cluster=CLUSTER,
+                        config=TEST_CONFIG, timeout_s=10)
+        accounts = np.zeros(3, dtype=types.ACCOUNT_DTYPE)
+        accounts["id_lo"] = [1, 2, 3]
+        accounts["ledger"] = 1
+        accounts["code"] = 10
+        assert client.create_accounts(accounts) == []
+        transfers = np.zeros(2, dtype=types.TRANSFER_DTYPE)
+        transfers["id_lo"] = [100, 101]
+        transfers["debit_account_id_lo"] = [1, 2]
+        transfers["credit_account_id_lo"] = [2, 3]
+        transfers["amount_lo"] = [5, 6]
+        transfers["ledger"] = 1
+        transfers["code"] = 10
+        assert client.create_transfers(transfers) == []
+        client.close()
+
+        samples = []
+        deadline = __import__("time").time() + 5.0
+        while __import__("time").time() < deadline:
+            try:
+                samples.append(recv.recv(2048).decode())
+            except TimeoutError:
+                pass
+            if (
+                sum(
+                    int(s.split(":")[1].split("|")[0])
+                    for s in samples if s.startswith("tb.events:")
+                ) >= 5
+                and any(s.startswith("tb.request_ms:") for s in samples)
+            ):
+                break
+        recv.close()
+        assert any(
+            s.startswith("tb.requests:") and s.endswith("|c")
+            for s in samples
+        ), samples
+        # 3 account + 2 transfer events, possibly split across groups; >=
+        # (not ==) because a client timeout-resend legitimately re-counts.
+        event_counts = [
+            int(s.split(":")[1].split("|")[0])
+            for s in samples if s.startswith("tb.events:")
+        ]
+        assert sum(event_counts) >= 5, samples
+        assert any(
+            s.startswith("tb.request_ms:") and s.endswith("|ms")
+            for s in samples
+        ), samples
+
+
 class TestRepl:
     def test_statements(self, server):
         client = make_client(server)

@@ -29,6 +29,25 @@ def _parse_addresses(value: str) -> List[Tuple[str, int]]:
     return out
 
 
+def _statsd_addr(value: str) -> Tuple[str, int]:
+    """argparse type for --statsd: HOST:PORT with a real port.
+
+    A malformed value used to surface as an unhandled ValueError traceback
+    from deep inside _parse_addresses; argparse.ArgumentTypeError turns it
+    into the standard two-line usage error instead."""
+    host, _, port = value.rpartition(":")
+    if not port or not port.isdigit():
+        raise argparse.ArgumentTypeError(
+            f"expected HOST:PORT with a numeric port, got {value!r}"
+        )
+    port_n = int(port)
+    if not 0 < port_n < 65536:
+        raise argparse.ArgumentTypeError(
+            f"port {port_n} out of range 1-65535"
+        )
+    return (host or "127.0.0.1", port_n)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="tigerbeetle-tpu",
@@ -65,7 +84,12 @@ def main(argv=None) -> int:
     p_start.add_argument("--aof", default=None, metavar="PATH",
                          help="append-only audit log of committed prepares")
     p_start.add_argument("--statsd", default=None, metavar="HOST:PORT",
+                         type=_statsd_addr,
                          help="emit StatsD metrics (UDP, best-effort)")
+    p_start.add_argument("--metrics-json", default=None, metavar="PATH",
+                         help="enable the metrics registry and dump a JSON "
+                              "snapshot to PATH on shutdown (env twin: "
+                              "TB_METRICS_PATH)")
     p_start.add_argument("--direct-io", action="store_true",
                          help="open the data file O_DIRECT (sector-aligned "
                               "IO; bypasses page-cache writeback)")
@@ -117,6 +141,13 @@ def main(argv=None) -> int:
     p_vopr.add_argument("--bug", default=None, choices=vopr_bugs,
                         help="(--tpu) inject a known consensus bug to "
                              "validate the oracle")
+    p_vopr.add_argument("--vopr-viz", action="store_true",
+                        help="record the one-line-per-event cluster status "
+                             "grid; on a failing seed it is written to "
+                             "vopr_viz_<seed>.txt and its tail printed "
+                             "(env twin: TB_VOPR_VIZ)")
+    p_vopr.add_argument("--metrics-json", default=None, metavar="PATH",
+                        help="dump fault/outcome counters to PATH")
 
     p_bench = sub.add_parser("benchmark", help="client-driven load benchmark")
     p_bench.add_argument("--addresses", default=None,
@@ -196,15 +227,33 @@ def _cmd_vopr(args) -> int:
         print("error: --clusters/--steps/--bug apply only with --tpu",
               file=sys.stderr)
         return 2
+    _enable_metrics(args.metrics_json)
     first = args.seed if args.seed is not None else secrets.randbits(31)
     worst = 0
     for seed in range(first, first + args.count):
-        result = run_seed(seed, ticks=args.ticks)
+        result = run_seed(
+            seed, ticks=args.ticks, viz=True if args.vopr_viz else None
+        )
         print(
             f"seed={result.seed} exit={result.exit_code} "
             f"commits={result.commits} faults={result.faults} "
             f"ticks={result.ticks}: {result.reason}"
         )
+        if result.exit_code != 0 and result.viz is not None:
+            # Debuggable finds, not opaque seeds: the full grid lands in a
+            # file, the tail (where the failure is) on stderr.
+            viz_path = f"vopr_viz_{result.seed}.txt"
+            try:
+                with open(viz_path, "w") as f:
+                    f.write(result.viz + "\n")
+                print(f"# cluster visualization: {viz_path}",
+                      file=sys.stderr)
+            except OSError as err:
+                print(f"# could not write {viz_path}: {err}",
+                      file=sys.stderr)
+            tail = result.viz.splitlines()
+            for line in tail[:2] + tail[max(2, len(tail) - 20):]:
+                print(f"# {line}", file=sys.stderr)
         worst = max(worst, result.exit_code)
     return worst
 
@@ -243,18 +292,66 @@ def _cmd_promote(args) -> int:
 
 
 def _make_statsd(value):
+    """Build a StatsD sink from an already-validated (host, port) pair
+    (the --statsd argparse type, _statsd_addr)."""
     if not value:
         return None
     from .utils.statsd import StatsD
 
-    host, port = _parse_addresses(value)[0]
+    host, port = value
     return StatsD(host, port)
+
+
+def _enable_metrics(path):
+    """Opt the process into the metrics registry for a --metrics-json run:
+    series record from here on, jit compiles are accounted, and the caller
+    (or atexit, for the serve-forever paths) dumps the snapshot to
+    ``path``."""
+    if not path:
+        return None
+    from . import jaxenv
+    from .obs.metrics import registry
+
+    registry.enable()
+    jaxenv.instrument_compiles()
+    import atexit
+
+    @atexit.register
+    def _dump() -> None:
+        try:
+            registry.dump(path)
+        except OSError:
+            return
+        print(f"metrics: wrote snapshot to {path}", file=sys.stderr)
+
+    # Servers are stopped with SIGTERM, whose default handler skips atexit —
+    # the flight recorder must still land its snapshot.  Raising SystemExit
+    # unwinds serve_forever and runs the dump; only installed when nothing
+    # else claimed the signal.
+    import signal
+
+    def _on_sigterm(signum, frame):
+        raise SystemExit(143)
+
+    try:
+        if signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread or unsupported platform: atexit still covers
+              # normal exits
+
+    return registry
 
 
 def _cmd_start(args) -> int:
     from .config import LedgerConfig
     from .net.bus import run_server
     from .vsr.replica import Replica
+
+    # Enable BEFORE the replica/machine construct so every series —
+    # including warmup's jit compiles — is captured; the atexit dump covers
+    # both the serve-forever exit and KeyboardInterrupt.
+    _enable_metrics(args.metrics_json)
 
     import dataclasses as _dc
 
@@ -369,15 +466,32 @@ def _cmd_version(args) -> int:
 
     print("tigerbeetle-tpu 0.1.0")
     if args.verbose:
-        # Full two-level preset matrix (main.zig:272-310 version --verbose
-        # dumps every config constant; config.zig:206-303 preset split).
+        # Full resolved runtime config (main.zig:272-310 version --verbose
+        # dumps every config constant; config.zig:206-303 preset split):
+        # the preset matrix, the jax backend actually serving this process,
+        # the compile cache, and the observability env toggles.
         import jax
+
+        from . import jaxenv
 
         for preset in PRESETS.values():
             for level in ("cluster", "process", "ledger"):
                 for key, value in vars(getattr(preset, level)).items():
                     print(f"  {preset.name}.{level}.{key}={value}")
-        print(f"  jax.devices={[str(d) for d in jax.devices()]}")
+        devices = jax.devices()
+        print(f"  jax.version={jax.__version__}")
+        print(f"  jax.backend={devices[0].platform}")
+        print(f"  jax.device_count={len(devices)}")
+        print(f"  jax.devices={[str(d) for d in devices]}")
+        if jaxenv.DEGRADED_DEVICE_COUNT is not None:
+            print(f"  jax.degraded_device_count="
+                  f"{jaxenv.DEGRADED_DEVICE_COUNT}")
+        print(f"  compile_cache.dir={jaxenv.COMPILE_CACHE_DIR}")
+        print(f"  compile_cache.env="
+              f"{os.environ.get('JAX_COMPILATION_CACHE_DIR', '')}")
+        for env in ("TB_TRACE", "TB_TRACE_PATH", "TB_METRICS_PATH",
+                    "TB_VOPR_VIZ", "JAX_PLATFORMS"):
+            print(f"  env.{env}={os.environ.get(env, '')}")
     return 0
 
 

@@ -31,7 +31,7 @@ from typing import List, Optional
 
 __all__ = [
     "force_cpu", "ensure_backend", "child_env", "current_platform",
-    "COMPILE_CACHE_DIR", "enable_compile_cache",
+    "COMPILE_CACHE_DIR", "enable_compile_cache", "instrument_compiles",
 ]
 
 # Set when force_cpu had to settle for fewer virtual devices than requested
@@ -64,6 +64,48 @@ def enable_compile_cache() -> str:
     """Point jax at the persistent cache (must run before jax init)."""
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
     return os.environ["JAX_COMPILATION_CACHE_DIR"]
+
+
+_COMPILE_LISTENER_INSTALLED = False
+
+
+def instrument_compiles() -> bool:
+    """Feed jit compile accounting into the obs metrics registry.
+
+    Registers a ``jax.monitoring`` duration listener: every XLA backend
+    compile increments ``jit.compiles`` and lands its duration in the
+    ``jit.compile_ms`` histogram (re-traces count under ``jit.traces``).
+    This is how a bench or server answers "did that latency spike pay a
+    compile?" without a profiler attached.  Idempotent; returns whether
+    the hook is live.  The listener itself is registered once and gates on
+    ``registry.enabled``, so it costs one branch per compile (compiles are
+    rare by definition) when metrics are off."""
+    global _COMPILE_LISTENER_INSTALLED
+    if _COMPILE_LISTENER_INSTALLED:
+        return True
+    try:
+        from jax._src import monitoring
+    except ImportError:
+        return False
+    from .obs.metrics import registry
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if not registry.enabled:
+            return
+        if event.endswith("backend_compile_duration"):
+            registry.counter("jit.compiles").inc()
+            registry.histogram("jit.compile_ms", "ms").observe(
+                duration * 1e3
+            )
+        elif event.endswith("jaxpr_trace_duration"):
+            registry.counter("jit.traces").inc()
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # tblint: ignore[swallow] private-API probe
+        return False
+    _COMPILE_LISTENER_INSTALLED = True
+    return True
 
 
 def _bridge():

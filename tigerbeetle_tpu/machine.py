@@ -40,6 +40,7 @@ import numpy as np
 
 from . import types
 from .config import LedgerConfig
+from .obs.metrics import registry as _obs
 from .ops import state_machine as sm
 
 _LIMIT_FLAGS = (
@@ -207,8 +208,12 @@ class TpuStateMachine:
         project a zero-tunnel-RTT deployment)."""
         t0 = _time.perf_counter()
         out = np.asarray(codes)
-        self.disp_wait_s += _time.perf_counter() - t0
+        wait = _time.perf_counter() - t0
+        self.disp_wait_s += wait
         self.disp_count += 1
+        if _obs.enabled:
+            _obs.counter("ops.dispatch").inc()
+            _obs.histogram("ops.dispatch_wait_us", "us").observe(wait * 1e6)
         return out
 
     # -- host-engine mode (host_engine.py) -----------------------------------
@@ -418,6 +423,10 @@ class TpuStateMachine:
         count = len(batch)
         if count == 0:
             return []
+        if _obs.enabled:
+            _obs.histogram("ops.batch_fill_pct", "%").observe(
+                100 * count // self.batch_lanes
+            )
         if self._engine is not None:
             return self._engine_commit("create_accounts", batch, timestamp)
 
@@ -461,6 +470,10 @@ class TpuStateMachine:
         count = len(batch)
         if count == 0:
             return []
+        if _obs.enabled:
+            _obs.histogram("ops.batch_fill_pct", "%").observe(
+                100 * count // self.batch_lanes
+            )
         if self._engine is not None:
             return self._engine_commit("create_transfers", batch, timestamp)
 
@@ -498,8 +511,14 @@ class TpuStateMachine:
             # kernel's whole device wait.
             t0 = _time.perf_counter()
             kflags = int(kflags)
-            self.disp_wait_s += _time.perf_counter() - t0
+            wait = _time.perf_counter() - t0
+            self.disp_wait_s += wait
             self.disp_count += 1
+            if _obs.enabled:
+                _obs.counter("ops.dispatch").inc()
+                _obs.histogram("ops.dispatch_wait_us", "us").observe(
+                    wait * 1e6
+                )
             if kflags == 0:
                 codes = np.asarray(codes)
                 self._transfers_bound += count
@@ -795,6 +814,11 @@ class TpuStateMachine:
         self._bloom_dev = jnp.asarray(self._bloom_np)
         self._transfers_bound = max(0, self._transfers_bound - len(rows))
         self._evictions += 1
+        if _obs.enabled:
+            # The tier rebalance is this runtime's compaction stage
+            # (replica pipeline naming: prefetch/commit/compact/checkpoint).
+            _obs.counter("ops.compactions").inc()
+            _obs.counter("ops.rows_evicted").inc(len(rows))
         # The query index stores ids (not slots), so it stays valid; row
         # resolution for cold ids happens in get_account_transfers.
         return len(rows)
@@ -901,6 +925,10 @@ class TpuStateMachine:
         from .ops import scan_path
 
         count = len(batch)
+        if _obs.enabled:
+            # Order-dependent batches are latency-bound (lax.scan): track
+            # how often serving falls off the vectorized kernels.
+            _obs.counter("ops.sequential_batches").inc()
         if operation == "create_accounts":
             self._grow_if_needed(accounts=count)
             if bool((batch["flags"] & types.AccountFlags.HISTORY).any()):

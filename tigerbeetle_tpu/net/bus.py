@@ -22,10 +22,16 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs.metrics import registry as _obs
 from ..vsr import wire
 from ..vsr.replica import Replica
 
 log = logging.getLogger("tigerbeetle_tpu.net")
+
+# Seconds between registry->StatsD bridge flushes when both are active
+# (the registry's replica/ops series ride the same UDP path as the bus's
+# direct counters; see obs/metrics.Registry.flush_statsd).
+STATSD_FLUSH_INTERVAL_S = 1.0
 
 
 class FrameError(Exception):
@@ -102,6 +108,7 @@ class ReplicaServer:
         self.host = host if host is not None else self.process.address
         self.port = port if port is not None else self.process.port
         self.statsd = statsd  # utils.statsd.StatsD; never blocks, optional
+        self._statsd_flushed_at = 0.0  # last registry->statsd bridge flush
         self._server: Optional[asyncio.base_events.Server] = None
         self._accepted: set = set()
         # Pipelined request plane: connection readers enqueue; one processor
@@ -182,7 +189,8 @@ class ReplicaServer:
                     group.append(self._requests.get_nowait())
                 except asyncio.QueueEmpty:
                     break
-            t0 = time.monotonic() if self.statsd is not None else 0.0
+            observing = self.statsd is not None or _obs.enabled
+            t0 = time.monotonic() if observing else 0.0
             try:
                 replies, fsync = self.replica.on_request_group_pipelined(
                     [(h, body) for h, body, _w in group]
@@ -197,7 +205,7 @@ class ReplicaServer:
                 for _h, _b, w in group:
                     w.close()
                 continue
-            if self.statsd is not None:
+            if observing:
                 self._emit_stats(group, time.monotonic() - t0)
             flush = self._flush_group(group, replies, fsync)
             if fsync is None:
@@ -258,16 +266,39 @@ class ReplicaServer:
             pass
 
     def _emit_stats(self, group, elapsed_s: float) -> None:
-        self.statsd.count("requests", len(group))
-        self.statsd.timing("request_ms", elapsed_s * 1000.0 / len(group))
+        """Per-group observability: the direct UDP samples the reference
+        emits (benchmark_load.zig:120-129 spirit) AND the registry series
+        every sink reads (obs/metrics).  Both best-effort, off the commit
+        path's critical section."""
+        events = 0
         for h, body, _w in group:
             try:
                 op = wire.Operation(int(h["operation"]))
                 if op in (wire.Operation.create_accounts,
                           wire.Operation.create_transfers):
-                    self.statsd.count("events", len(body) // 128)
+                    events += len(body) // 128
             except ValueError:
                 pass
+        per_request_ms = elapsed_s * 1000.0 / len(group)
+        if self.statsd is not None:
+            self.statsd.count("requests", len(group))
+            self.statsd.timing("request_ms", per_request_ms)
+            if events:
+                self.statsd.count("events", events)
+        if _obs.enabled:
+            _obs.counter("net.requests").inc(len(group))
+            _obs.counter("net.events").inc(events)
+            _obs.histogram("net.group_size", "requests").observe(len(group))
+            # Microseconds: log2 buckets need sub-ms resolution here (a
+            # loopback group commit is routinely < 1 ms per request).
+            _obs.histogram("net.request_us", "us").observe(
+                per_request_ms * 1000.0
+            )
+            if self.statsd is not None:
+                now = time.monotonic()
+                if now - self._statsd_flushed_at >= STATSD_FLUSH_INTERVAL_S:
+                    self._statsd_flushed_at = now
+                    _obs.flush_statsd(self.statsd)
 
     async def _handle_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

@@ -23,9 +23,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs.metrics import registry as _obs
 from ..vsr import wire
 from ..vsr.consensus import VsrReplica
-from .bus import FrameError, read_message
+from .bus import STATSD_FLUSH_INTERVAL_S, FrameError, read_message
 
 log = logging.getLogger("tigerbeetle_tpu.net.cluster")
 
@@ -76,6 +77,7 @@ class ClusterServer:
         self.port: Optional[int] = None
         self.dropped_sends = 0  # bounded-send-queue drops (backpressure)
         self._last_drop_log = 0.0
+        self._statsd_flushed_at = 0.0  # registry->statsd bridge cadence
         # RTT-adaptive timeouts convert monotonic ns to consensus ticks;
         # keep the conversion in lockstep with the actual tick cadence.
         replica.tick_ns = int(self.tick_interval * 1e9)
@@ -267,18 +269,35 @@ class ClusterServer:
                     writer.write(wire.encode(pong))
                     await writer.drain()
                     continue
-                if self.statsd is not None and command == wire.Command.request:
-                    self.statsd.count("requests")
+                if command == wire.Command.request and (
+                    self.statsd is not None or _obs.enabled
+                ):
+                    events = 0
                     try:
                         op = wire.Operation(int(h["operation"]))
                         if op in (wire.Operation.create_accounts,
                                   wire.Operation.create_transfers):
-                            self.statsd.count("events", len(body) // 128)
+                            events = len(body) // 128
                     except ValueError:
                         pass
+                    if self.statsd is not None:
+                        self.statsd.count("requests")
+                        if events:
+                            self.statsd.count("events", events)
+                    if _obs.enabled:
+                        _obs.counter("net.cluster.requests").inc()
+                        _obs.counter("net.cluster.events").inc(events)
+                        if events:
+                            _obs.histogram(
+                                "net.cluster.batch_events", "events"
+                            ).observe(events)
                 t0 = time.monotonic()
                 out = self.replica.on_message(h, command, body)
                 dt = time.monotonic() - t0
+                if _obs.enabled:
+                    _obs.histogram("net.cluster.dispatch_us", "us").observe(
+                        dt * 1e6
+                    )
                 if dt > 0.05:
                     # Loop-stall forensics: a synchronous dispatch that
                     # blocks the IO loop starves heartbeats AND pongs, and
@@ -343,6 +362,13 @@ class ClusterServer:
                 # advances, and the WAL fills permanently at
                 # op_checkpoint + journal_slot_count.
                 self.replica._checkpoint_poll()
+                if self.statsd is not None and _obs.enabled:
+                    now = time.monotonic()
+                    if now - self._statsd_flushed_at >= (
+                        STATSD_FLUSH_INTERVAL_S
+                    ):
+                        self._statsd_flushed_at = now
+                        _obs.flush_statsd(self.statsd)
             except Exception:
                 log.exception("tick failure")
 

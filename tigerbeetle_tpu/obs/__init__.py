@@ -1,0 +1,15 @@
+"""Observability: unified metrics registry, host+device trace merge, and
+VOPR event visualization.
+
+- ``obs.metrics``   process-global counters/gauges/log2-histograms with a
+                    JSON snapshot and a StatsD flush bridge;
+- ``obs.profile``   ``jax.profiler`` device capture merged with the host
+                    tracer's spans into one Chrome/Perfetto trace;
+- ``obs.vopr_viz``  the reference's one-line-per-event cluster status grid
+                    (docs/internals/testing.md) for simulator finds.
+
+Import ``metrics.registry`` for recording; everything is disabled (and near
+zero-cost) until ``TB_METRICS_PATH`` / ``--metrics-json`` / ``enable()``.
+"""
+
+from .metrics import registry  # noqa: F401 — the canonical entry point

@@ -1,0 +1,173 @@
+"""Host+device trace unification: one Chrome/Perfetto JSON for both worlds.
+
+The host side already records Chrome ``trace_event`` spans
+(utils/tracer.py — commit, journal_write, checkpoint, ...).  The device side
+is captured by ``jax.profiler``, which writes its XLA/TPU timeline as
+gzipped Chrome traces (``plugins/profile/<run>/*.trace.json.gz``).  The two
+use different clocks: the tracer stamps ``perf_counter_ns``-derived
+microseconds, the profiler stamps its own capture-relative epoch.  This
+module captures both over the same wall window and rebases the device
+events onto the host clock, so a ``state_machine_commit`` span lines up with
+the XLA dispatch it triggered — the Tracy-capture experience
+(src/tracer.zig's backend) for the TPU runtime.
+
+Alignment method: ``DeviceCapture`` records the host clock at capture start;
+on merge, device timestamps are shifted so the earliest device event lands
+at that instant.  This is start-anchored (no cross-clock drift correction),
+which over bench-scale windows (seconds) keeps span/dispatch adjacency
+legible; it is a visualization aid, not a measurement.
+
+Degradation: every profiler interaction is best-effort.  If the platform
+has no profiler (or capture fails mid-run), the merge still writes the host
+events — a trace with one world beats no trace.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from typing import List, Optional
+
+# Offset added to device pids in the merged trace so a device track can
+# never collide with (and silently interleave into) the host process row.
+DEVICE_PID_BASE = 1 << 20
+
+# Device event budget for the merged file.  The XLA profiler records EVERY
+# op execution — a seconds-long CPU run yields ~1M events and a >100 MB
+# JSON no tool opens happily.  Over budget, the longest-duration events
+# survive (they are the structure: loops, fusions, dispatches; the dropped
+# tail is micro-ops) — the same bounded-buffer discipline as the tracer's
+# slot cap, and the drop is reported in the merge stats.
+DEVICE_EVENTS_MAX = 200_000
+
+
+class DeviceCapture:
+    """Context manager around ``jax.profiler`` start/stop_trace.
+
+    ``enabled=False`` (or any profiler failure) degrades to a no-op whose
+    ``events()`` is empty.  ``host_t0_us`` is the host-tracer-clock instant
+    of capture start, used by ``merge`` to rebase device timestamps."""
+
+    def __init__(self, log_dir: str, enabled: bool = True) -> None:
+        self.log_dir = log_dir
+        self.enabled = enabled
+        self.active = False
+        self.host_t0_us: Optional[float] = None
+        self.error: Optional[str] = None
+
+    def __enter__(self) -> "DeviceCapture":
+        if not self.enabled:
+            return self
+        try:
+            import jax.profiler
+
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self.active = True
+            self.host_t0_us = time.perf_counter_ns() / 1e3
+        except Exception as err:  # noqa: BLE001 — capture is best-effort
+            # (profiler unavailable on this backend / another trace active);
+            # the merged output then carries host events only.
+            self.error = f"{type(err).__name__}: {err}"
+            self.active = False
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self.active:
+            return
+        self.active = False
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception as err:  # noqa: BLE001 — see __enter__
+            self.error = f"{type(err).__name__}: {err}"
+
+    def events(self) -> List[dict]:
+        return load_device_events(self.log_dir)
+
+
+def load_device_events(log_dir: str) -> List[dict]:
+    """Collect Chrome trace events from every ``*.trace.json.gz`` the
+    profiler wrote under ``log_dir`` (best-effort: unreadable files skip)."""
+    events: List[dict] = []
+    pattern = os.path.join(log_dir, "**", "*.trace.json.gz")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            with gzip.open(path, "rt") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        events.extend(data.get("traceEvents") or [])
+    return events
+
+
+def merge(
+    host_events: List[dict],
+    device_events: List[dict],
+    out_path: str,
+    host_t0_us: Optional[float] = None,
+    device_events_max: int = DEVICE_EVENTS_MAX,
+) -> dict:
+    """Write one Chrome trace combining host spans and device events.
+
+    Device timestamps are rebased so the earliest device event lands at
+    ``host_t0_us`` (capture start on the host tracer clock); metadata
+    events (``ph == "M"``, no ``ts``) pass through unshifted.  Device pids
+    are offset by DEVICE_PID_BASE; device events beyond the budget drop
+    shortest-first (DEVICE_EVENTS_MAX).  Returns ``{"events",
+    "host_events", "device_events", "device_events_dropped"}`` counts."""
+    merged: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": os.getpid(),
+        "args": {"name": "host (tigerbeetle-tpu tracer)"},
+    }]
+    merged.extend(host_events)
+
+    meta = [e for e in device_events if "ts" not in e]
+    timed = [e for e in device_events if "ts" in e]
+    dropped = 0
+    if len(timed) > device_events_max:
+        timed.sort(key=lambda e: e.get("dur", 0.0), reverse=True)
+        dropped = len(timed) - device_events_max
+        timed = timed[:device_events_max]
+        timed.sort(key=lambda e: e["ts"])
+    shift = 0.0
+    if timed and host_t0_us is not None:
+        shift = host_t0_us - min(e["ts"] for e in timed)
+    for e in meta + timed:
+        e = dict(e)
+        if "ts" in e:
+            e["ts"] = e["ts"] + shift
+        if "pid" in e and isinstance(e["pid"], int):
+            e["pid"] = e["pid"] + DEVICE_PID_BASE
+        merged.append(e)
+
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    return {
+        "events": len(merged),
+        "host_events": len(host_events),
+        "device_events": len(meta) + len(timed),
+        "device_events_dropped": dropped,
+    }
+
+
+def merge_with_tracer(capture: DeviceCapture, out_path: str) -> dict:
+    """Drain the process-global host tracer into a merged trace with
+    ``capture``'s device events.  Draining (not copying) hands ownership of
+    the events to the merged file — the tracer's own at-exit dump then sees
+    an empty buffer and skips, so the merged trace is never overwritten by
+    a host-only one."""
+    from ..utils.tracer import tracer
+
+    host_events = tracer.drain()
+    stats = merge(
+        host_events, capture.events(), out_path,
+        host_t0_us=capture.host_t0_us,
+    )
+    if capture.error:
+        stats["device_capture_error"] = capture.error
+    return stats

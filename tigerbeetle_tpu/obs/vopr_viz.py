@@ -1,0 +1,109 @@
+"""VOPR cluster visualization: one line per state-change, a column per node.
+
+The reference simulator prints a per-event cluster grid (one character per
+replica plus the event) so a failing seed reads as a story instead of an
+opaque number (docs/internals/testing.md "cluster visualization").  This is
+that grid for sim/cluster.SimCluster: each sampled tick where anything
+changed emits one line with a fixed-width cell per node —
+
+    status symbol, view : commit_min / op
+
+Symbols:
+    *  primary (status normal)
+    .  backup  (status normal)
+    v  view change
+    r  recovering
+    !  log_suspect (certification pending — promoted standby, state sync)
+    s  standby (non-voting stream consumer)
+    x  crashed / not running
+    -  retired (promoted-away standby index)
+
+The recorder is strictly read-only over the cluster (no rng draws, no state
+mutation), so enabling it cannot shift a seed's fault schedule — the same
+discipline as the hash-log oracle.  The line buffer is bounded; when full,
+the OLDEST lines drop (the tail — where the failure is — is what matters).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+CELL_WIDTH = 14
+
+LEGEND = (
+    "legend: * primary  . backup  v view-change  r recovering  "
+    "! log-suspect  s standby  x down  - retired;  "
+    "cell = symbol view : commit_min / op"
+)
+
+
+def status_symbol(replica, alive: bool, is_standby: bool) -> str:
+    if replica is None or not alive:
+        return "x"
+    if getattr(replica, "_log_suspect", False):
+        return "!"
+    status = getattr(replica, "status", "normal")
+    if status == "view_change":
+        return "v"
+    if status == "recovering":
+        return "r"
+    if is_standby:
+        return "s"
+    if getattr(replica, "is_primary", False):
+        return "*"
+    return "."
+
+
+def node_cell(replica, alive: bool, is_standby: bool) -> str:
+    sym = status_symbol(replica, alive, is_standby)
+    if replica is None or not alive:
+        return sym
+    return (
+        f"{sym}{getattr(replica, 'view', 0)}"
+        f":{getattr(replica, 'commit_min', 0)}"
+        f"/{getattr(replica, 'op', 0)}"
+    )
+
+
+class ClusterViz:
+    """Bounded recorder of cluster state-change lines (module docstring)."""
+
+    def __init__(self, max_lines: int = 4000) -> None:
+        self.lines: collections.deque = collections.deque(maxlen=max_lines)
+        self.dropped = 0
+        self._last_cells: Optional[List[str]] = None
+        self._n_nodes = 0
+        self._n_voters = 0
+
+    def sample(self, cluster) -> None:
+        """Record one line if any node's cell changed since the last sample
+        (one line per cluster-visible event, not per tick)."""
+        self._n_nodes = cluster.total
+        self._n_voters = cluster.n
+        cells = [
+            node_cell(
+                cluster.replicas[i], cluster.alive[i], i >= cluster.n
+            )
+            for i in range(cluster.total)
+        ]
+        if cells == self._last_cells:
+            return
+        self._last_cells = cells
+        if len(self.lines) == self.lines.maxlen:
+            self.dropped += 1
+        self.lines.append(
+            f"{cluster.t:>7}  "
+            + "".join(c.ljust(CELL_WIDTH) for c in cells).rstrip()
+        )
+
+    def render(self) -> str:
+        header = "".join(
+            (f"r{i}" if i < self._n_voters else f"s{i}").ljust(CELL_WIDTH)
+            for i in range(self._n_nodes)
+        ).rstrip()
+        out = [LEGEND, f"{'tick':>7}  {header}"]
+        if self.dropped:
+            out.append(f"  ... {self.dropped} older lines dropped ...")
+        out.extend(self.lines)
+        return "\n".join(out)

@@ -40,10 +40,31 @@ import jax.numpy as jnp
 from flax import struct
 
 from .. import u128
+from ..obs.metrics import registry as _metrics
 from ..u128 import U128
 from . import hash_table as ht
 
 MAX_PROBE = 1 << 12
+
+
+def _obs_jit(impl, name: str, **jit_kwargs):
+    """jit an entry-point kernel with a per-kernel dispatch counter.
+
+    The counter lives OUTSIDE the traced function (incrementing a tracer-
+    side Python int inside jit would either fail or bake in a constant);
+    the wrapper costs one attribute load + branch per dispatch when the
+    registry is disabled.  The raw jitted callable rides along as
+    ``.jitted`` for callers that need jit-object APIs (lower/clear_cache)."""
+    jitted = jax.jit(impl, **jit_kwargs)
+
+    @functools.wraps(impl)
+    def dispatch(*args, **kwargs):
+        if _metrics.enabled:
+            _metrics.counter("ops.kernel." + name).inc()
+        return jitted(*args, **kwargs)
+
+    dispatch.jitted = jitted
+    return dispatch
 
 # Account value columns (table stores everything but the id key; `reserved` is
 # validated to zero and not stored).
@@ -425,7 +446,9 @@ def create_accounts_impl(
     return ledger.replace(accounts=accounts), codes
 
 
-create_accounts = jax.jit(create_accounts_impl, donate_argnames=("ledger",))
+create_accounts = _obs_jit(
+    create_accounts_impl, "create_accounts", donate_argnames=("ledger",)
+)
 
 
 def _exists_ladder_accounts(
@@ -685,7 +708,10 @@ def create_transfers_impl(
     return ledger.replace(accounts=accounts, transfers=transfers), codes
 
 
-create_transfers_fast = jax.jit(create_transfers_impl, donate_argnames=("ledger",))
+create_transfers_fast = _obs_jit(
+    create_transfers_impl, "create_transfers_fast",
+    donate_argnames=("ledger",),
+)
 
 
 def transfer_rows(
@@ -730,8 +756,7 @@ def _exists_ladder_transfers(
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def lookup_accounts(
+def lookup_accounts_impl(
     ledger: Ledger, id_lo: jax.Array, id_hi: jax.Array
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     look = ht.lookup(ledger.accounts, id_lo, id_hi, MAX_PROBE)
@@ -741,8 +766,10 @@ def lookup_accounts(
     return look.found, cols
 
 
-@jax.jit
-def lookup_transfers(
+lookup_accounts = _obs_jit(lookup_accounts_impl, "lookup_accounts")
+
+
+def lookup_transfers_impl(
     ledger: Ledger, id_lo: jax.Array, id_hi: jax.Array
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     look = ht.lookup(ledger.transfers, id_lo, id_hi, MAX_PROBE)
@@ -750,6 +777,9 @@ def lookup_transfers(
     cols["id_lo"] = jnp.where(look.found, id_lo, 0)
     cols["id_hi"] = jnp.where(look.found, id_hi, 0)
     return look.found, cols
+
+
+lookup_transfers = _obs_jit(lookup_transfers_impl, "lookup_transfers")
 
 
 # ---------------------------------------------------------------------------

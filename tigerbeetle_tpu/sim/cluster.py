@@ -202,6 +202,7 @@ class SimCluster:
         audit: bool = True,
         hot_transfers_capacity_max: Optional[int] = None,
         n_standbys: int = 0,
+        viz: bool = False,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
@@ -222,6 +223,13 @@ class SimCluster:
         self.rng = random.Random(seed)
         self.net = net or PacketSimulator(seed=seed + 1)
         self.t = 0
+        # One-line-per-event status grid (obs/vopr_viz): strictly read-only
+        # over the cluster, so enabling it cannot shift a seed's schedule.
+        self.viz = None
+        if viz:
+            from ..obs.vopr_viz import ClusterViz
+
+            self.viz = ClusterViz()
 
         # Per-replica wall-clock offsets (exercise the Marzullo clock).
         self.wall_offsets = [
@@ -425,6 +433,8 @@ class SimCluster:
                     self.crash(i)
         for cid, client in self.clients.items():
             self._route(("client", cid), client.tick(self.t))
+        if self.viz is not None:
+            self.viz.sample(self)
 
     def _route(self, src, envelopes) -> None:
         for dst, message in envelopes:

@@ -15,10 +15,12 @@ fix the bug, re-run the seed.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 import tempfile
 from typing import Optional
 
+from ..obs.metrics import registry as _obs
 from ..vsr.consensus import quorums
 from .cluster import SimCluster
 from .network import PacketSimulator
@@ -36,6 +38,9 @@ class VoprResult:
     ticks: int
     commits: int
     faults: int
+    # Rendered status grid (obs/vopr_viz) when the run recorded one —
+    # requested via run_seed(viz=True) / --vopr-viz / TB_VOPR_VIZ.
+    viz: Optional[str] = None
 
 
 def run_seed(
@@ -44,13 +49,20 @@ def run_seed(
     ticks: int = 6_000,
     settle_ticks: int = 60_000,
     standbys: Optional[int] = 0,
+    viz: Optional[bool] = None,
 ) -> VoprResult:
     """One VOPR run: random topology + faults from ``seed``.
 
     ``standbys``: 0 (default — pinned regression seeds replay their exact
     round-4 schedules), an explicit count, or None to SAMPLE 0-2 standbys
     from a separate stream (the sweep runner's mode; a separate stream so
-    enabling the dimension does not shift any pinned seed's schedule)."""
+    enabling the dimension does not shift any pinned seed's schedule).
+
+    ``viz``: record the one-line-per-event cluster status grid
+    (obs/vopr_viz) into the result — read-only over the cluster, so it
+    never shifts a schedule.  None defers to the TB_VOPR_VIZ env var."""
+    if viz is None:
+        viz = bool(os.environ.get("TB_VOPR_VIZ"))
     rng = random.Random(seed)
     n_replicas = rng.choice([2, 3, 3, 3, 5])  # simulator.zig random topology
     n_clients = rng.randint(1, 3)
@@ -88,7 +100,29 @@ def run_seed(
             misdirect_probability=misdirect_p,
             hot_transfers_capacity_max=hot_cap,
             n_standbys=standbys,
+            viz=viz,
         )
+
+        def done(result: VoprResult) -> VoprResult:
+            """Attach the recorded grid and the registry's outcome/fault
+            accounting (sweep-level convergence counters) to a finished
+            run — shared by every exit path."""
+            if cluster.viz is not None:
+                result.viz = cluster.viz.render()
+            if _obs.enabled:
+                _obs.counter("vopr.seeds").inc()
+                outcome = {
+                    EXIT_PASSED: "passed",
+                    EXIT_LIVENESS: "liveness",
+                    EXIT_CORRECTNESS: "correctness",
+                }[result.exit_code]
+                _obs.counter(f"vopr.{outcome}").inc()
+                _obs.counter("vopr.faults").inc(result.faults)
+                _obs.histogram("vopr.run_ticks", "ticks").observe(
+                    result.ticks
+                )
+            return result
+
         faults = 0
         down: set = set()
         retired: set = set()  # promoted-away standbys + retired voters
@@ -131,6 +165,8 @@ def run_seed(
                         cluster.crash(victim)
                         down.add(victim)
                         faults += 1
+                        if _obs.enabled:
+                            _obs.counter("vopr.faults.crash").inc()
                 elif r < 0.004 and down:
                     back = rng.choice(sorted(down))
                     if not cluster.alive[back]:
@@ -143,6 +179,8 @@ def run_seed(
                     ):
                         partitioned = True
                         faults += 1
+                        if _obs.enabled:
+                            _obs.counter("vopr.faults.partition").inc()
                 elif r < 0.007 and partitioned:
                     cluster.heal()
                     partitioned = False
@@ -196,6 +234,8 @@ def run_seed(
                             retired.add(s)
                             down.discard(v)
                             faults += 1
+                            if _obs.enabled:
+                                _obs.counter("vopr.faults.promotion").inc()
                 elif r < 0.009 and n_replicas >= 2:
                     # Clog one replica<->replica path for a while
                     # (packet_simulator.zig clogging).
@@ -204,6 +244,8 @@ def run_seed(
                         cluster.t, rng.randint(50, 400),
                     )
                     faults += 1
+                    if _obs.enabled:
+                        _obs.counter("vopr.faults.clog").inc()
             # Heal everything; the cluster must converge.  Restart every
             # dead node — scheduled crashes AND sim fail-stops — except
             # promoted-away standby indexes, which never run again.
@@ -225,22 +267,22 @@ def run_seed(
                     (r.status, r.view, r.commit_min, r.op) if r else None
                     for r in cluster.replicas
                 ]
-                return VoprResult(
+                return done(VoprResult(
                     seed, EXIT_LIVENESS,
                     f"no convergence after {settle_ticks} settle ticks: "
                     f"{states}",
                     cluster.t, commits, faults,
-                )
+                ))
             cluster.check_converged()
             cluster.check_conservation()
-            return VoprResult(
+            return done(VoprResult(
                 seed, EXIT_PASSED, "passed", cluster.t, commits, faults
-            )
+            ))
         except AssertionError as err:
-            return VoprResult(
+            return done(VoprResult(
                 seed, EXIT_CORRECTNESS, f"oracle violation: {err}",
                 cluster.t, 0, faults,
-            )
+            ))
         except Exception as err:  # noqa: BLE001 — a crash IS a find
             # An unhandled exception from the production code under fault
             # schedule is a correctness find, not a sweep-killer: seed
@@ -249,11 +291,11 @@ def run_seed(
             import traceback
 
             tb = traceback.format_exc().strip().splitlines()
-            return VoprResult(
+            return done(VoprResult(
                 seed, EXIT_CORRECTNESS,
                 f"crash: {type(err).__name__}: {err} @ {tb[-3:]}",
                 cluster.t, 0, faults,
-            )
+            ))
 
     if workdir is not None:
         return go(workdir)

@@ -26,7 +26,7 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Tuple
 
 # Typed event names mirroring tracer.zig:48-78.
 EVENTS = (
@@ -54,7 +54,8 @@ class Tracer:
     def __init__(self, backend: str = "none") -> None:
         self.backend = backend
         self._events: List[dict] = []
-        self._open: Dict[str, int] = {}
+        # Open start()/stop() spans, keyed (thread id, name) — see start().
+        self._open: Dict[Tuple[int, str], int] = {}
         self._lock = threading.Lock()
         self._pid = os.getpid()
         self.dropped = 0
@@ -79,13 +80,24 @@ class Tracer:
             self._emit(name, start, end, args)
 
     def start(self, name: str) -> None:
+        """Open a span closed by a later stop(name) on the SAME thread.
+
+        Keyed by (thread, name) under the lock: two threads running
+        same-named spans concurrently (e.g. ``checkpoint`` on the serving
+        thread while the background writer runs its own) must not collide —
+        an unkeyed dict let one thread's stop() consume the other's start
+        timestamp, corrupting both durations."""
         if self.enabled:
-            self._open[name] = time.perf_counter_ns()
+            with self._lock:
+                self._open[(threading.get_ident(), name)] = (
+                    time.perf_counter_ns()
+                )
 
     def stop(self, name: str, **args) -> None:
         if not self.enabled:
             return
-        begin = self._open.pop(name, None)
+        with self._lock:
+            begin = self._open.pop((threading.get_ident(), name), None)
         if begin is not None:
             self._emit(name, begin, time.perf_counter_ns(), args)
 
@@ -124,9 +136,14 @@ class Tracer:
         return len(events)
 
     def drain(self) -> List[dict]:
+        """Hand off (and clear) the buffered events.  Also resets the
+        dropped count: it belongs to the drained epoch, and a stale nonzero
+        value would defeat the at-exit empty-buffer skip that protects a
+        merged trace from being overwritten (obs/profile)."""
         with self._lock:
             events = self._events
             self._events = []
+            self.dropped = 0
         return events
 
 
@@ -140,6 +157,12 @@ if tracer.enabled:
 
     @atexit.register
     def _dump_at_exit() -> None:
+        if not tracer._events and not tracer.dropped:
+            # Nothing buffered: the process either traced nothing or a
+            # merged dump (obs/profile.merge_with_tracer) already drained
+            # the events into a host+device trace — overwriting that file
+            # with an empty host-only one would destroy it.
+            return
         path = os.environ.get("TB_TRACE_PATH", "tb_trace.json")
         try:
             n = tracer.dump(path)

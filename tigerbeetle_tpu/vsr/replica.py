@@ -29,6 +29,7 @@ import numpy as np
 from .. import types
 from ..config import ClusterConfig, LedgerConfig
 from ..machine import TpuStateMachine
+from ..obs.metrics import registry as _obs
 from ..utils.tracer import tracer
 from . import checkpoint as checkpoint_mod
 from . import wire
@@ -631,6 +632,11 @@ class Replica:
         sync: bool = True,
     ) -> Tuple[np.ndarray, bytes]:
         """Assign op + timestamp, hash-chain, and journal the prepare."""
+        # The pre-execution stage (the reference pipeline's prefetch slot:
+        # everything between request admission and the state machine —
+        # timestamp assignment, hash chain, WAL write).
+        # Wall time feeds only the metrics registry, never replica state.
+        t0 = time.perf_counter_ns() if _obs.enabled else 0  # tblint: ignore[nondet]
         op = self.op + 1
         count = self._event_count(operation, body)
         timestamp = self.machine.prepare(
@@ -655,6 +661,10 @@ class Replica:
         decoded, _ = wire.decode_header(message)
         self.op = op
         self.parent_checksum = wire.header_checksum(decoded)
+        if _obs.enabled:
+            _obs.histogram("replica.prefetch_us", "us").observe(
+                (time.perf_counter_ns() - t0) / 1e3  # tblint: ignore[nondet] metrics
+            )
         return decoded, body
 
     def _commit_prepare(
@@ -691,10 +701,22 @@ class Replica:
             self._admit_session(session)
         else:
             if result_body is None:
+                t0 = time.perf_counter_ns() if _obs.enabled else 0  # tblint: ignore[nondet] metrics
                 with tracer.span("state_machine_commit", op=op,
                                  operation=operation.name):
                     result_body = self._execute(operation, body, timestamp)
+                if _obs.enabled:
+                    _obs.histogram("replica.commit_us", "us").observe(
+                        (time.perf_counter_ns() - t0) / 1e3  # tblint: ignore[nondet] metrics
+                    )
             self.commit_min = op
+            if _obs.enabled:
+                _obs.counter("replica.commits").inc()
+                count = self._event_count(operation, body)
+                if count:
+                    _obs.histogram(
+                        "replica.batch_events", "events"
+                    ).observe(count)
             if self.hash_log is not None and operation in (
                 wire.Operation.create_accounts,
                 wire.Operation.create_transfers,
@@ -936,8 +958,13 @@ class Replica:
                 return
             self._checkpoint_async_start()
             return
+        t0 = time.perf_counter_ns() if _obs.enabled else 0  # tblint: ignore[nondet] metrics
         with tracer.span("checkpoint", op=self.commit_min):
             self._checkpoint_inner()
+        if _obs.enabled:
+            _obs.histogram("replica.checkpoint_ms", "ms").observe(
+                (time.perf_counter_ns() - t0) / 1e6  # tblint: ignore[nondet] metrics
+            )
 
     def _checkpoint_inner(self) -> None:
         arrays, meta, fields = self._checkpoint_capture()
@@ -1096,6 +1123,9 @@ class Replica:
         )
         self._sb_state = state
         self.op_checkpoint = state.op_checkpoint
+        if _obs.enabled:
+            _obs.counter("replica.checkpoints").inc()
+            _obs.gauge("replica.op_checkpoint").set(self.op_checkpoint)
         # GC only after the superblock referencing the new manifest is
         # durable (crash before this point must find the old files intact).
         self.forest.gc()
@@ -1111,6 +1141,10 @@ class Replica:
         t0 = time.monotonic()  # tblint: ignore[nondet]
         arrays, meta, fields = self._checkpoint_capture()
         dt = time.monotonic() - t0  # tblint: ignore[nondet]
+        if _obs.enabled:
+            _obs.histogram("replica.checkpoint_capture_ms", "ms").observe(
+                dt * 1e3
+            )
         if dt > 0.05:
             dbg = getattr(self, "_debug", None)
             if dbg is not None:

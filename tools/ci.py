@@ -17,6 +17,10 @@ ones green):
                on the 1-core bench host)
   kernel       JAX commit kernels + differential suites + queries + sharding
   consensus    VOPR model + real-code seeds, durability, adversary, fuzz
+  obs          observability smoke (tools/obs_smoke.py): VOPR status grid,
+               traced+metered serving run, mini-bench with TB_TRACE +
+               --metrics-json; asserts the artifacts parse and carry the
+               expected span/series names
   integration  subprocess/black-box: TCP servers, cluster e2e, native
                clients, demos, longhaul (includes @slow)
 
@@ -81,6 +85,14 @@ TIERS = {
         ],
         extra=["-m", "not slow"],
     ),
+    "obs": dict(
+        # Observability smoke, not pytest: tiny VOPR seed with the status
+        # grid, a traced+metered serving run, and a mini-bench with
+        # TB_TRACE + --metrics-json — asserting the trace JSON and metrics
+        # snapshot parse and carry the expected span/series names.
+        # Artifacts: METRICS.json + OBS_SMOKE.json at the repo root.
+        cmd=["tools/obs_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -104,7 +116,7 @@ TIERS = {
         extra=[],
     ),
 }
-ORDER = ["tidy", "lint", "unit", "kernel", "consensus", "integration"]
+ORDER = ["tidy", "lint", "unit", "kernel", "consensus", "obs", "integration"]
 
 
 def run_tier(name: str, timeout_s: float) -> dict:
