@@ -102,6 +102,15 @@ def main(argv=None) -> int:
                          help="cap the device-resident transfers window at "
                               "2^N slots; older transfers spill to a cold "
                               "host store (BASELINE config 4 tiering)")
+    p_start.add_argument("--pipeline-depth", type=int, default=None,
+                         metavar="N",
+                         help="commit-pipeline depth for the serving path: "
+                              "1 = fully blocking (the pre-pipeline "
+                              "engine), >= 2 = deferred device readbacks "
+                              "with one commit group in flight (deeper "
+                              "values reserved, currently equivalent to "
+                              "2; default 2; env twin: TB_PIPELINE, 0 = "
+                              "off)")
     p_start.add_argument("--no-engine", action="store_true",
                          help="force the device-kernel commit path even "
                               "when the native host engine is available")
@@ -399,6 +408,8 @@ def _cmd_start(args) -> int:
             args.path, ledger_config=ledger_config, aof_path=args.aof,
             process_config=process_config, host_engine=bool(args.engine),
         )
+        if args.pipeline_depth is not None:
+            replica.pipeline_depth = args.pipeline_depth
         replica.open()
         replica.machine.warmup()  # compile before announcing readiness
         host = addresses[replica.replica][0]
@@ -434,6 +445,8 @@ def _cmd_start(args) -> int:
     replica = Replica(args.path, ledger_config=ledger_config,
                       aof_path=args.aof, hot_transfers_capacity_max=hot_max,
                       process_config=process_config, host_engine=use_engine)
+    if args.pipeline_depth is not None:
+        replica.pipeline_depth = args.pipeline_depth
     replica.open()
     if replica.replica_count != 1:
         # A multi-replica data file must never be served solo: commits
@@ -490,7 +503,7 @@ def _cmd_version(args) -> int:
         print(f"  compile_cache.env="
               f"{os.environ.get('JAX_COMPILATION_CACHE_DIR', '')}")
         for env in ("TB_TRACE", "TB_TRACE_PATH", "TB_METRICS_PATH",
-                    "TB_VOPR_VIZ", "JAX_PLATFORMS"):
+                    "TB_VOPR_VIZ", "TB_PIPELINE", "JAX_PLATFORMS"):
             print(f"  env.{env}={os.environ.get(env, '')}")
     return 0
 

@@ -69,19 +69,142 @@ QUERY_ROWS_MAX = ((1 << 20) - 256) // 128
 def _group_fast_dispatch_impl(ledger, stacked, counts, timestamps):
     """Scan the fast commit kernel over GROUP_K stacked batches: one device
     dispatch, batch order preserved, ledger threaded through the carry
-    (see TpuStateMachine.commit_group_fast)."""
+    (see TpuStateMachine.commit_group_fast).
+
+    Besides (ledger, codes) it returns the transfers probe_overflow flag
+    widened into a FRESH uint32 buffer: the deferred readback handle must
+    be able to fetch it after a later dispatch donates the ledger, and
+    riding the commit dispatch it costs zero extra syncs."""
 
     def step(led, xs):
         soa, cnt, ts = xs
         led, codes = sm.create_transfers_impl(led, soa, cnt, ts)
         return led, codes
 
-    return jax.lax.scan(step, ledger, (stacked, counts, timestamps))
+    ledger, codes = jax.lax.scan(step, ledger, (stacked, counts, timestamps))
+    return ledger, codes, ledger.transfers.probe_overflow.astype(jnp.uint32)
 
 
 _group_fast_dispatch = jax.jit(
     _group_fast_dispatch_impl, donate_argnames=("ledger",)
 )
+
+
+def pipeline_depth_default() -> int:
+    """Commit-pipeline depth (TB_PIPELINE env; default 2).  Depth 1 (and
+    TB_PIPELINE=0, "off") disables deferral entirely — the serving path is
+    then bit-for-bit the pre-pipeline blocking path.  Depth >= 2 runs the
+    pipelined engine with ONE commit group in flight; deeper values are
+    reserved (currently equivalent to 2)."""
+    import os
+
+    env = os.environ.get("TB_PIPELINE", "")
+    if env.isdigit():
+        return max(1, int(env))  # 0 == off == depth 1
+    return 2
+
+
+class DeviceCommitHandle:
+    """An in-flight fast-path device commit (one batch or a grouped run).
+
+    ``result`` is either the dispatch's (codes, overflow, id_lo, id_hi)
+    device tuple (the dispatch already executed on the calling thread) or
+    a Future of one — deferred dispatches run on the machine's single
+    dispatch-lane thread, which restores the async-dispatch property on
+    backends whose execute blocks the calling thread (XLA-CPU): the
+    serving thread stages uploads, journals, and builds replies while the
+    lane thread sits in the (GIL-free) device execute.
+
+    ``resolve()`` joins the dispatch, performs the ONE deferred
+    device->host readback (result codes + the probe-overflow flag ride
+    together), and runs the host bookkeeping that needs the codes —
+    result compression and commit-timestamp advance — returning per-batch
+    (index, result) lists.  ``join_wait_s`` records how long the join
+    blocked (queue wait, not commit work — callers keep it out of the
+    commit-stage latency series).
+
+    Handles must be resolved in dispatch order (the commit timestamp and
+    index appends are op-ordered); the replica's pipelined commit engine
+    enforces that with a FIFO in-flight queue (at most one commit group's
+    runs deep).
+    """
+
+    __slots__ = ("_machine", "_result", "_stacked", "_counts",
+                 "_timestamps", "_stage", "_resolved", "join_wait_s")
+
+    def __init__(self, machine, result, counts, timestamps,
+                 stacked: bool, stage=None) -> None:
+        self._machine = machine
+        self._result = result        # (codes, overflow) | Future of one
+        self._stacked = stacked      # True: leading GROUP_K dim
+        self._counts = counts
+        self._timestamps = timestamps
+        self._stage = stage          # staging buffer set to release on resolve
+        self._resolved = False
+        self.join_wait_s = 0.0
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def discard(self) -> None:
+        """Abort path: QUIESCE the dispatch (join it, swallow its error)
+        and release the staging set — an orphaned closure left running on
+        the lane would keep mutating machine.ledger concurrently with the
+        serving thread after the caller dropped this handle."""
+        if self._resolved:
+            return
+        self._resolved = True
+        if hasattr(self._result, "result"):
+            try:
+                # The group's failure already propagated via the engine;
+                # this join only quiesces the lane.
+                self._result.result()
+            except BaseException:  # tblint: ignore[swallow] abort quiesce
+                pass
+        if self._stage is not None:
+            self._machine._stage_release(self._stage)
+            self._stage = None
+
+    def resolve(self) -> List[List[Tuple[int, int]]]:
+        assert not self._resolved, "commit handle resolved twice"
+        self._resolved = True
+        m = self._machine
+        try:
+            if hasattr(self._result, "result"):
+                t0 = _time.perf_counter()
+                self._result = self._result.result()
+                self.join_wait_s = _time.perf_counter() - t0
+                if _obs.enabled:
+                    _obs.histogram(
+                        "pipeline.resolve_wait_us", "us"
+                    ).observe(self.join_wait_s * 1e6)
+            codes_dev, overflow_dev = self._result
+            codes, overflow = m._d2h_codes(codes_dev, overflow_dev)
+        finally:
+            if self._stage is not None:
+                # The dispatch completed (or failed terminally): either
+                # way its H2D reads are over — the staging set must go
+                # back on the free-list, not leak with the handle.
+                m._stage_release(self._stage)
+                self._stage = None
+        if int(overflow):
+            # Load-factor management keeps this unreachable; losing inserts
+            # silently is the one unacceptable outcome, so fail loud (the
+            # deferred check fires one resolve later than the blocking
+            # path's, but always before any reply is released).
+            raise RuntimeError("transfers probe overflow during fast insert")
+        if _obs.enabled:
+            _obs.counter("pipeline.resolves").inc()
+        # NOTE: index maintenance already happened inside the dispatch
+        # closure (machine._index_append_device) — it is device work that
+        # must ride the ledger chain; reading self.ledger HERE could see
+        # buffers a later in-flight dispatch already donated.
+        out = []
+        for j, (count, ts) in enumerate(zip(self._counts, self._timestamps)):
+            row = codes[j] if self._stacked else codes
+            out.append(m._compress(row, count))
+            m._update_commit_timestamp(row, count, ts)
+        return out
 
 
 class TpuStateMachine:
@@ -197,24 +320,44 @@ class TpuStateMachine:
         # ask #6): every blocking codes D2H counts one dispatch + its wait.
         self.disp_count = 0
         self.disp_wait_s = 0.0
+        # Commit pipeline (docs/commit_pipeline.md): bounded deferred-
+        # readback depth (TB_PIPELINE; resolved lazily so tests can set the
+        # env per-instance), plus the cached host staging buffers for the
+        # grouped H2D upload and the zero-count pad-SoA template.
+        self._pipeline_depth: Optional[int] = None
+        self._stage_pool: List[tuple] = []  # free staging sets (_stage_acquire)
+        self._pad_soa_zero: dict = {}
+        self._lane = None  # FIFO dispatch-lane executor (see _dispatch_lane)
         if self._tiering:
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
             self._bloom_dev = make_bloom(self._bloom_log2)
 
-    def _d2h_codes(self, codes) -> np.ndarray:
+    def _d2h_codes(self, codes, overflow=None):
         """The blocking device->host read of a commit's result codes: the
         ONE point every device dispatch funnels through.  Timed so the e2e
         bench can decompose wall time into device-wait vs host work (and
-        project a zero-tunnel-RTT deployment)."""
+        project a zero-tunnel-RTT deployment).
+
+        ``overflow`` (the table's probe_overflow flag) rides the SAME
+        device_get, so the per-batch/per-group overflow check costs zero
+        extra syncs; when passed, returns (codes, overflow) instead of
+        codes alone.
+
+        host-sync: commit barrier — this is the deliberate readback point
+        of the deferred commit pipeline (docs/commit_pipeline.md; the
+        bench's RTT-emulation sweep wraps exactly this method)."""
         t0 = _time.perf_counter()
-        out = np.asarray(codes)
+        if overflow is None:
+            out = jax.device_get(codes)
+        else:
+            out, overflow = jax.device_get((codes, overflow))
         wait = _time.perf_counter() - t0
         self.disp_wait_s += wait
         self.disp_count += 1
         if _obs.enabled:
             _obs.counter("ops.dispatch").inc()
             _obs.histogram("ops.dispatch_wait_us", "us").observe(wait * 1e6)
-        return out
+        return out if overflow is None else (out, overflow)
 
     # -- host-engine mode (host_engine.py) -----------------------------------
 
@@ -347,6 +490,14 @@ class TpuStateMachine:
                 self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
             )
             np.asarray(codes_f)
+            if self.pipeline_depth > 1:
+                # The pipelined serving engine dispatches the PROBED
+                # variant (overflow rides the codes readback in a fresh
+                # buffer); a client must never pay its compile mid-request.
+                self.ledger, codes_p, _ovf = sm.create_transfers_fast_probed(
+                    self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
+                )
+                np.asarray(codes_p)
             if self.group_device_commit:
                 # The grouped dispatch is a distinct program (scan over
                 # GROUP_K); a client must never pay its compile mid-group.
@@ -355,7 +506,7 @@ class TpuStateMachine:
                     for key, v in soa_t.items()
                 }
                 zeros = jnp.zeros((self.GROUP_K,), jnp.uint64)
-                self.ledger, codes_g = _group_fast_dispatch(
+                self.ledger, codes_g, _govf = _group_fast_dispatch(
                     self.ledger, stacked, zeros, zeros + 1
                 )
                 np.asarray(codes_g)
@@ -375,6 +526,18 @@ class TpuStateMachine:
     def _pad_soa(self, batch: np.ndarray) -> dict:
         n = len(batch)
         assert n <= self.batch_lanes, "batch exceeds configured lanes"
+        if n == 0:
+            # Zero-count pads recur on every grouped commit (and warmup):
+            # the device columns are immutable, so one cached template per
+            # dtype replaces a fresh alloc + H2D per batch.
+            cached = self._pad_soa_zero.get(batch.dtype)
+            if cached is None:
+                padded = np.zeros(self.batch_lanes, dtype=batch.dtype)
+                cached = {
+                    k: jnp.asarray(v) for k, v in types.to_soa(padded).items()
+                }
+                self._pad_soa_zero[batch.dtype] = cached
+            return cached
         padded = np.zeros(self.batch_lanes, dtype=batch.dtype)
         padded[:n] = batch
         return {k: jnp.asarray(v) for k, v in types.to_soa(padded).items()}
@@ -382,8 +545,9 @@ class TpuStateMachine:
     @staticmethod
     def _compress(codes: np.ndarray, count: int) -> List[Tuple[int, int]]:
         codes = codes[:count]
-        (idx,) = np.nonzero(codes)
-        return [(int(i), int(codes[i])) for i in idx]
+        idx = np.flatnonzero(codes)
+        # tolist() converts both columns to Python ints in one vector pass.
+        return list(zip(idx.tolist(), codes[idx].tolist()))
 
     @staticmethod
     def _has_intra_batch_dup_ids(batch: np.ndarray) -> bool:
@@ -445,9 +609,11 @@ class TpuStateMachine:
         self.ledger, codes = sm.create_accounts(
             self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
         )
-        codes = self._d2h_codes(codes)
+        codes, overflow = self._d2h_codes(
+            codes, self.ledger.accounts.probe_overflow
+        )
         self._accounts_bound += count
-        if bool(np.asarray(self.ledger.accounts.probe_overflow)):
+        if int(overflow):
             # Load-factor management keeps this unreachable; losing inserts
             # silently is the one unacceptable outcome, so fail loud.
             raise RuntimeError("accounts probe overflow during insert")
@@ -620,6 +786,41 @@ class TpuStateMachine:
     def group_device_commit(self, value: bool) -> None:
         self._group_device_commit = value
 
+    @property
+    def pipeline_depth(self) -> int:
+        """Deferred-readback depth (TB_PIPELINE env, default 2; the CLI's
+        --pipeline-depth overrides).  Depth 1 disables deferral — every
+        commit blocks on its own codes readback, exactly the pre-pipeline
+        serving path; depth >= 2 pipelines one commit group (deeper
+        values reserved, currently equivalent to 2)."""
+        if self._pipeline_depth is None:
+            self._pipeline_depth = pipeline_depth_default()
+        return self._pipeline_depth
+
+    @pipeline_depth.setter
+    def pipeline_depth(self, value: int) -> None:
+        self._pipeline_depth = max(1, int(value))
+
+    def _dispatch_lane(self):
+        """The single-thread FIFO executor deferred dispatches run on.
+
+        On backends whose execute BLOCKS the dispatching thread (XLA-CPU:
+        jax runs the computation synchronously inside the call), a deferred
+        handle alone overlaps nothing — the lane restores the async-
+        dispatch property: device execute happens GIL-free on this thread
+        while the serving thread journals, stages the next upload, and
+        builds replies.  On async backends (TPU) the submit returns as
+        soon as the dispatch is enqueued, so the lane adds one cheap hop.
+        ONE worker == dispatch order == op order; growth rides each
+        closure so the ledger chain never interleaves."""
+        if self._lane is None:
+            import concurrent.futures
+
+            self._lane = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tb-dispatch"
+            )
+        return self._lane
+
     # Fixed scan length for the grouped dispatch: ONE jit variant (warmed at
     # startup), groups pad with zero-count batches (the kernel applies
     # nothing for count=0).  An empty step costs ~the kernel's launch-free
@@ -629,9 +830,53 @@ class TpuStateMachine:
     # kernel-bound.
     GROUP_K = 32
 
+    def _stage_acquire(self):
+        """One cached staging buffer set for the grouped H2D upload, from
+        the free-list (or freshly allocated when every cached set is still
+        referenced by an in-flight dispatch): jax may alias a numpy buffer
+        straight into the device transfer (zero-copy on XLA-CPU), so a set
+        must never be refilled while a dispatch that reads it is in flight
+        — DeviceCommitHandle.resolve releases the set back here."""
+        if self._stage_pool:
+            return self._stage_pool.pop()
+        bufs = {}
+        for name in types.TRANSFER_DTYPE.names:
+            dt = types.TRANSFER_DTYPE.fields[name][0]
+            if dt == np.uint16:
+                dt = np.dtype(np.uint32)  # to_soa's widening
+            bufs[name] = np.zeros((self.GROUP_K, self.batch_lanes), dt)
+        return (bufs, [0] * self.GROUP_K)
+
+    def _stage_release(self, stage) -> None:
+        self._stage_pool.append(stage)
+
+    def _stage_group(self, batches: List[np.ndarray]):
+        """Staged H2D upload for the grouped dispatch: host-side stack of
+        the run's batches into a cached staging buffer set, then ONE
+        jax.device_put per field — replacing the previous K x fields
+        separate transfers plus a device-side jnp.stack.  Dirty-row
+        tracking zeroes only the lanes the set's previous occupant
+        touched.  Returns (device columns, staging set) — the caller owns
+        the set until its dispatch resolved."""
+        stage = self._stage_acquire()
+        bufs, dirty = stage
+        for name, buf in bufs.items():
+            for j in range(self.GROUP_K):
+                n = len(batches[j]) if j < len(batches) else 0
+                if dirty[j] > n:
+                    buf[j, n:dirty[j]] = 0
+                if n:
+                    buf[j, :n] = batches[j][name]
+        for j in range(self.GROUP_K):
+            dirty[j] = len(batches[j]) if j < len(batches) else 0
+        return (
+            {name: jax.device_put(buf) for name, buf in bufs.items()}, stage
+        )
+
     def commit_group_fast(
-        self, batches: List[np.ndarray], timestamps: List[int]
-    ) -> Optional[List[List[Tuple[int, int]]]]:
+        self, batches: List[np.ndarray], timestamps: List[int],
+        deferred: bool = False,
+    ):
         """Commit a RUN of fast-path-eligible create_transfers batches in
         ONE device dispatch (lax.scan over the stacked batches) with ONE
         device->host codes transfer.
@@ -640,7 +885,12 @@ class TpuStateMachine:
         when the run is not groupable — caller falls back to per-batch
         commits.  Scan order == batch order, and each batch carries its
         own already-assigned prepare timestamp, so results are
-        bit-identical to committing the run batch by batch."""
+        bit-identical to committing the run batch by batch.
+
+        ``deferred=True`` returns a DeviceCommitHandle instead of blocking
+        on the codes readback: the dispatch is in flight, and the caller
+        resolves the handle (in dispatch order) when it needs the results
+        — dispatch N+1 then overlaps readback N."""
         if (
             not self.group_device_commit
             or self._engine is not None
@@ -666,17 +916,8 @@ class TpuStateMachine:
         if timestamps[-1] > self.prepare_timestamp:
             # Replay/backup parity with commit_batch's clock catch-up.
             self.prepare_timestamp = timestamps[-1]
-        self._grow_if_needed(transfers=sum(counts))
         k = len(batches)
-        soas = [self._pad_soa(b) for b in batches]
-        pad_soa = self._pad_soa(np.zeros(0, dtype=types.TRANSFER_DTYPE))
-        stacked = {
-            key: jnp.stack(
-                [s[key] for s in soas]
-                + [pad_soa[key]] * (self.GROUP_K - k)
-            )
-            for key in pad_soa
-        }
+        stacked, stage = self._stage_group(batches)
         cnt = jnp.asarray(
             counts + [0] * (self.GROUP_K - k), dtype=jnp.uint64
         )
@@ -684,19 +925,38 @@ class TpuStateMachine:
             timestamps + [timestamps[-1]] * (self.GROUP_K - k),
             dtype=jnp.uint64,
         )
-        self.ledger, codes = _group_fast_dispatch(
-            self.ledger, stacked, cnt, tss
+        # Host row bounds advance at SUBMIT (not readback): the next
+        # group's growth decision must see this group's inserts coming,
+        # and the closure's growth target is snapshotted HERE so it never
+        # depends on how far the serving thread raced ahead.
+        need = self._transfers_bound + sum(counts)
+        for c in counts:
+            self._transfers_bound += c
+
+        def dispatch():
+            # Growth + dispatch + index maintenance stay ONE unit so the
+            # FIFO lane preserves the ledger chain (the appends need THIS
+            # ledger live).
+            self._grow_if_needed(transfers_need=need)
+            self.ledger, codes, overflow = _group_fast_dispatch(
+                self.ledger, stacked, cnt, tss
+            )
+            for j in range(k):
+                self._index_append_device(
+                    stacked["id_lo"][j], stacked["id_hi"][j],
+                    codes[j], counts[j],
+                )
+            return codes, overflow
+
+        result = self._dispatch_lane().submit(dispatch) if deferred else (
+            dispatch()
         )
-        codes = self._d2h_codes(codes)  # ONE D2H for the whole group
-        if bool(np.asarray(self.ledger.transfers.probe_overflow)):
-            raise RuntimeError("transfers probe overflow during fast insert")
-        out = []
-        for j in range(k):
-            self._transfers_bound += counts[j]
-            self._index_append(soas[j], codes[j], counts[j])
-            out.append(self._compress(codes[j], counts[j]))
-            self._update_commit_timestamp(codes[j], counts[j], timestamps[j])
-        return out
+        handle = DeviceCommitHandle(
+            self, result, counts, timestamps, stacked=True, stage=stage,
+        )
+        if deferred:
+            return handle
+        return handle.resolve()  # ONE D2H for the whole group
 
     def _commit_fast(
         self, batch: np.ndarray, timestamp: int, count: int
@@ -706,9 +966,12 @@ class TpuStateMachine:
         self.ledger, codes = sm.create_transfers_fast(
             self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
         )
-        codes = self._d2h_codes(codes)
+        # Overflow flag rides the codes readback: one sync, not two.
+        codes, overflow = self._d2h_codes(
+            codes, self.ledger.transfers.probe_overflow
+        )
         self._transfers_bound += count
-        if bool(np.asarray(self.ledger.transfers.probe_overflow)):
+        if int(overflow):
             # Load-factor management keeps this unreachable; losing inserts
             # silently is the one unacceptable outcome, so fail loud.
             raise RuntimeError("transfers probe overflow during fast insert")
@@ -716,6 +979,63 @@ class TpuStateMachine:
         results = self._compress(codes, count)
         self._update_commit_timestamp(codes, count, timestamp)
         return results
+
+    def commit_fast_deferred(
+        self, batch: np.ndarray, timestamp: int
+    ) -> Optional[DeviceCommitHandle]:
+        """Dispatch ONE fast-path create_transfers batch and return a
+        deferred readback handle, or None when the batch is not fast-path
+        eligible (caller falls back to the blocking commit_batch path).
+
+        Semantically identical to the _commit_fast route — same kernel
+        body, same codes, same bookkeeping — only the readback timing
+        moves: the probed kernel variant carries the overflow flag in a
+        fresh output buffer so resolve() works even after a later dispatch
+        donated this ledger (see sm.create_transfers_fast_probed)."""
+        count = len(batch)
+        if (
+            self._engine is not None
+            or self.force_sequential
+            or count == 0
+            or count > self.batch_lanes
+        ):
+            return None
+        bound0 = self._balance_bound
+        self._note_balance_bound(batch)
+        if not self._fast_path_ok(batch):
+            # The blocking fallback re-notes the batch itself; leaving this
+            # note in place would double-count it against the monotonic
+            # bound (same discipline as commit_group_fast's mid-run
+            # refusal).
+            self._balance_bound = bound0
+            return None
+        if timestamp > self.prepare_timestamp:
+            # Replay/backup parity with commit_batch's clock catch-up.
+            self.prepare_timestamp = timestamp
+        if _obs.enabled:
+            _obs.histogram("ops.batch_fill_pct", "%").observe(
+                100 * count // self.batch_lanes
+            )
+        soa = self._pad_soa(batch)  # staged on the serving thread
+        cnt, ts = jnp.uint64(count), jnp.uint64(timestamp)
+        # Snapshot the growth target pre-submit (see _grow_if_needed).
+        need = self._transfers_bound + count
+        self._transfers_bound += count
+
+        def dispatch():
+            self._grow_if_needed(transfers_need=need)
+            self.ledger, codes, overflow = sm.create_transfers_fast_probed(
+                self.ledger, soa, cnt, ts
+            )
+            self._index_append_device(
+                soa["id_lo"], soa["id_hi"], codes, count
+            )
+            return codes, overflow
+
+        fut = self._dispatch_lane().submit(dispatch)
+        return DeviceCommitHandle(
+            self, fut, [count], [timestamp], stacked=False,
+        )
 
     def _maybe_evict_between_batches(self) -> None:
         hot_max = self.hot_transfers_capacity_max
@@ -854,9 +1174,15 @@ class TpuStateMachine:
     def _grow_if_needed(
         self, accounts: int = 0, transfers: int = 0, posted: int = 0,
         history: int = 0, evict_ok: bool = True,
+        transfers_need: Optional[int] = None,
     ) -> None:
         """Keep every table's load factor under 0.5 using host-side row
-        bounds (no device sync; bounds only overestimate)."""
+        bounds (no device sync; bounds only overestimate).
+
+        ``transfers_need``: an explicit row target snapshotted by the
+        caller — the deferred dispatch closures run on the lane thread
+        while the serving thread keeps advancing _transfers_bound, so a
+        live read here would make the growth moment timing-dependent."""
         from .ops import hash_table as ht
 
         led = self.ledger
@@ -866,7 +1192,9 @@ class TpuStateMachine:
         if cap != led.accounts.capacity:
             led = led.replace(accounts=ht.grow(led.accounts, cap))
         cap = self._target_capacity(
-            led.transfers.capacity, self._transfers_bound + transfers
+            led.transfers.capacity,
+            transfers_need if transfers_need is not None
+            else self._transfers_bound + transfers,
         )
         if cap != led.transfers.capacity:
             hot_max = self.hot_transfers_capacity_max
@@ -967,6 +1295,24 @@ class TpuStateMachine:
         results = self._compress(codes, count)
         self._update_commit_timestamp(codes, count, timestamp)
         return results
+
+    def _index_append_device(self, id_lo, id_hi, codes_dev, count) -> None:
+        """_index_append with a device-resident ok mask: runs INSIDE a
+        dispatch-lane closure, right after its kernel, where self.ledger is
+        guaranteed live (a deferred handle's resolve may run while a later
+        dispatch has already donated this ledger's buffers)."""
+        if self.config.lazy_index:
+            if not self.index.stale:
+                self.index.reset()
+            self.scans_transfers.reset()
+            return
+        lane = jnp.arange(self.batch_lanes, dtype=jnp.uint64)
+        ok_dev = (codes_dev == 0) & (lane < jnp.uint64(count))
+        self.index.append_batch(self.ledger, id_lo, id_hi, ok_dev)
+        if self.scans_transfers.indexes:
+            self.scans_transfers.append_batch(
+                self.ledger, id_lo, id_hi, ok_dev
+            )
 
     def _index_append(self, soa: dict, codes: np.ndarray, count: int) -> None:
         if self.config.lazy_index:

@@ -16,6 +16,7 @@ the same framing; see vsr/cluster.py for the multi-replica message flow.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import logging
 import time
 from typing import Optional
@@ -137,8 +138,9 @@ class ReplicaServer:
             limit=self.replica.config.message_size_max + wire.HEADER_SIZE,
         )
         self.port = self._server.sockets[0].getsockname()[1]
-        log.info("replica %d listening on %s:%d",
-                 self.replica.replica, self.host, self.port)
+        log.info("replica %d listening on %s:%d (commit pipeline depth %d)",
+                 self.replica.replica, self.host, self.port,
+                 getattr(self.replica, "pipeline_depth", 1))
         return self.port
 
     async def serve_forever(self) -> None:
@@ -183,6 +185,20 @@ class ReplicaServer:
         bandwidth, not a pipeline stall per group."""
         assert self._requests is not None
         while True:
+            if self._requests.empty() and getattr(
+                self.replica, "pipeline_pending", False
+            ):
+                # Queue idle: no next group will come due to drive the
+                # pending group's readbacks — flush so its replies release
+                # now (latency beats overlap when there is nothing to
+                # overlap with).  Same failure discipline as the group
+                # call below: a flush error fails that group's reply
+                # promise (its flush task drops the connections), and the
+                # processor must keep serving everyone else.
+                try:
+                    self.replica.pipeline_flush()
+                except Exception:
+                    log.exception("pipeline flush failed")
             group = [await self._requests.get()]
             while len(group) < self.GROUP_MAX:
                 try:
@@ -193,7 +209,8 @@ class ReplicaServer:
             t0 = time.monotonic() if observing else 0.0
             try:
                 replies, fsync = self.replica.on_request_group_pipelined(
-                    [(h, body) for h, body, _w in group]
+                    [(h, body) for h, body, _w in group],
+                    deferred_replies=True,
                 )
             except Exception:
                 # A group execution failure is a server-side fault (storage
@@ -207,20 +224,23 @@ class ReplicaServer:
                 continue
             if observing:
                 self._emit_stats(group, time.monotonic() - t0)
-            flush = self._flush_group(group, replies, fsync)
             if fsync is None:
-                await flush
+                await self._flush_group(group, replies, fsync)
             else:
                 # Reply release rides the durability barrier; the processor
                 # moves on.  (Tracked so close() can cancel stragglers.)
                 # FLUSH_MAX caps concurrent in-flight groups (see the
-                # memory-budget invariant above).
+                # memory-budget invariant above).  The coroutine is created
+                # only HERE: a cancellation during the cap wait must not
+                # orphan a never-awaited coroutine.
                 while len(self._flushes) >= self.FLUSH_MAX:
                     await asyncio.wait(
                         list(self._flushes),
                         return_when=asyncio.FIRST_COMPLETED,
                     )
-                task = asyncio.get_running_loop().create_task(flush)
+                task = asyncio.get_running_loop().create_task(
+                    self._flush_group(group, replies, fsync)
+                )
                 self._flushes.add(task)
                 task.add_done_callback(self._flushes.discard)
 
@@ -231,6 +251,20 @@ class ReplicaServer:
             except Exception:
                 log.exception("group fsync failed; dropping %d connections",
                               len(group))
+                for _h, _b, w in group:
+                    w.close()
+                return
+        if isinstance(replies, concurrent.futures.Future):
+            # Pipelined engine: the reply list comes due when the group's
+            # deferred readbacks land (next group / pipeline_flush) — the
+            # reply barrier now awaits BOTH the fsync and the execution.
+            try:
+                replies = await asyncio.wrap_future(replies)
+            except Exception:
+                log.exception(
+                    "pipelined group failed; dropping %d connections",
+                    len(group),
+                )
                 for _h, _b, w in group:
                     w.close()
                 return
@@ -289,6 +323,11 @@ class ReplicaServer:
             _obs.counter("net.requests").inc(len(group))
             _obs.counter("net.events").inc(events)
             _obs.histogram("net.group_size", "requests").observe(len(group))
+            # Reply-release overlap: groups whose fsync barrier is still in
+            # flight while the processor already serves the next group.
+            _obs.histogram("net.flush_inflight", "groups").observe(
+                len(self._flushes)
+            )
             # Microseconds: log2 buckets need sub-ms resolution here (a
             # loopback group commit is routinely < 1 ms per request).
             _obs.histogram("net.request_us", "us").observe(

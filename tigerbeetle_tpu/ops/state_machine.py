@@ -714,6 +714,31 @@ create_transfers_fast = _obs_jit(
 )
 
 
+def create_transfers_fast_probed_impl(
+    ledger: Ledger,
+    batch: Dict[str, jax.Array],
+    count: jax.Array,
+    timestamp: jax.Array,
+) -> Tuple[Ledger, jax.Array, jax.Array]:
+    """Fast kernel + the transfers probe_overflow flag as a third output.
+
+    The overflow flag is widened to a FRESH uint32 buffer (never aliased
+    into the returned ledger's pytree): a deferred readback handle
+    (machine.DeviceCommitHandle) must still be able to fetch it after a
+    LATER dispatch donates the ledger's buffers — reading
+    ``ledger.transfers.probe_overflow`` at resolve time would trip the
+    donation check.  Riding the commit dispatch, it costs zero extra syncs
+    (the codes D2H carries it along)."""
+    ledger, codes = create_transfers_impl(ledger, batch, count, timestamp)
+    return ledger, codes, ledger.transfers.probe_overflow.astype(jnp.uint32)
+
+
+create_transfers_fast_probed = _obs_jit(
+    create_transfers_fast_probed_impl, "create_transfers_fast_probed",
+    donate_argnames=("ledger",),
+)
+
+
 def transfer_rows(
     batch: Dict[str, jax.Array], count: jax.Array, timestamp: jax.Array
 ) -> Dict[str, jax.Array]:

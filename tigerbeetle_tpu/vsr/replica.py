@@ -171,6 +171,13 @@ class Replica:
         self._ckpt_queue: List[tuple] = []
         # commit_min of the newest capture (see _checkpoint_due).
         self._ckpt_captured_op = 0
+        # Cross-group commit pipeline (pipeline_depth >= 2, the TCP serving
+        # engine; docs/commit_pipeline.md): at most ONE group's readbacks +
+        # bookkeeping may be pending while the next group is admitted,
+        # journaled, and dispatched.  Each in-flight entry is
+        # (run, DeviceCommitHandle, its group's result_bodies dict).
+        self._pipeline_inflight: List[tuple] = []
+        self._pipeline_pending: Optional[dict] = None
         self.view = 0
         self.op = 0                 # latest journaled op
         self.commit_min = 0         # latest committed (executed) op
@@ -417,6 +424,7 @@ class Replica:
     def on_request(self, header: np.ndarray, body: bytes) -> List[bytes]:
         """Handle a verified client request; returns wire messages to send
         back (replica.zig on_request :1308-1337 + commit_op :3678-3836)."""
+        self._pipeline_settle()  # strict op order vs any pipelined group
         client = wire.u128(header, "client")
         try:
             operation = wire.Operation(int(header["operation"]))
@@ -482,7 +490,19 @@ class Replica:
             fsync.result()
         return out
 
-    def on_request_group_pipelined(self, requests):
+    @property
+    def pipeline_depth(self) -> int:
+        """Commit-pipeline depth (machine.pipeline_depth: TB_PIPELINE env,
+        default 2, CLI --pipeline-depth).  Depth 1 routes every group
+        through the sequential engine — bit-for-bit the pre-pipeline
+        serving path."""
+        return self.machine.pipeline_depth
+
+    @pipeline_depth.setter
+    def pipeline_depth(self, value: int) -> None:
+        self.machine.pipeline_depth = value
+
+    def on_request_group_pipelined(self, requests, deferred_replies=False):
         """Group commit with the durability barrier EXPOSED: returns
         (replies, fsync_future_or_None).  Replies must not be released to
         clients until the future resolves — but the caller may start the
@@ -494,10 +514,34 @@ class Replica:
         prepares in flight sharing barriers, with replies gated on
         completion (replica.zig commit pipeline).  Reply lists are
         index-aligned with the input (empty list = dropped, client
-        retries)."""
+        retries).
+
+        With pipeline_depth >= 2 the admitted group commits through the
+        pipelined engine (docs/commit_pipeline.md): the leading device run
+        dispatches BEFORE the WAL writes (fsync/compute overlap) and codes
+        readbacks are deferred.  With ``deferred_replies`` additionally
+        True, the returned replies may be a concurrent.futures.Future of
+        the reply list — group N's readbacks + bookkeeping then overlap
+        group N+1's admission/journaling/dispatch, and the caller must
+        await the future exactly like the fsync barrier (and call
+        pipeline_flush() when its queue idles, or the last group's replies
+        never come due).  The reply barrier is unchanged either way: a
+        reply is released only after BOTH the group fsync and the op's
+        execution."""
         out: List[List[bytes]] = [[] for _ in requests]
-        admitted: List[Tuple[int, np.ndarray, bytes]] = []
+        admitted: List[Tuple[int, wire.Operation, np.ndarray, bytes]] = []
         self._checkpoint_poll()
+        # Clients with an op in the still-pending group: their session
+        # state (request number, stored reply) is not yet updated, so a
+        # resend could double-commit — drop, the client retries (the
+        # cross-group twin of the in-group duplicate guard below).
+        busy = (
+            {
+                wire.u128(h, "client")
+                for _i, h, _b in self._pipeline_pending["prepared"]
+            }
+            if self._pipeline_pending is not None else frozenset()
+        )
         for i, (header, body) in enumerate(requests):
             client = wire.u128(header, "client")
             try:
@@ -511,6 +555,8 @@ class Replica:
                 if session is None or int(header["session"]) != session.session:
                     out[i] = [self._eviction(client)]
                     continue
+                if client in busy:
+                    continue
                 if request_n == session.request and session.reply_bytes:
                     out[i] = [session.reply_bytes]
                     continue
@@ -520,19 +566,19 @@ class Replica:
                 # violation: one in-flight request per session) would race
                 # its own session state; only the first is admitted.
                 if any(
-                    wire.u128(h, "client") == client for _, h, _ in admitted
+                    wire.u128(h, "client") == client
+                    for _, _, h, _ in admitted
                 ):
                     continue
             elif session is not None:
                 if session.reply_bytes:
                     out[i] = [session.reply_bytes]
                 continue
-            if self.op + 1 > self.op_prepare_max:
+            # Each admitted request takes exactly one op; preparation is
+            # deferred past admission, so count the queue, not just op+1.
+            if self.op + len(admitted) + 1 > self.op_prepare_max:
                 continue  # WAL full: drop, client retries
-            prepare_h, prepare_body = self._prepare(
-                header, body, operation, sync=False
-            )
-            admitted.append((i, prepare_h, prepare_body))
+            admitted.append((i, operation, header, body))
         if not admitted:
             # No new commits — but duplicate-resend replies above may belong
             # to a group whose fsync is still in flight; gate them on the
@@ -543,11 +589,29 @@ class Replica:
             if last is not None and not last.done():
                 return out, last
             return out, None
+        if self.pipeline_depth > 1 and self.hash_log is None:
+            return self._commit_group_pipelined(admitted, out,
+                                                deferred_replies)
+        return self._commit_group_sequential(admitted, out)
+
+    def _commit_group_sequential(self, admitted, out):
+        """Depth-1 commit engine: journal every admitted request, ONE fsync
+        for the group, then execute + reply strictly per op — the
+        pre-pipeline serving path, preserved bit-for-bit (and the path the
+        determinism oracle requires: per-op digests must capture per-op
+        effects)."""
+        self._pipeline_settle()  # a depth change mid-run must not reorder
+        prepared = []
+        for i, operation, header, body in admitted:
+            prepare_h, prepare_body = self._prepare(
+                header, body, operation, sync=False
+            )
+            prepared.append((i, prepare_h, prepare_body))
         fsync = self._io_pool_submit(self.journal.sync)
         self._last_group_fsync = fsync
-        runs = self._group_device_runs(admitted)
+        runs = self._group_device_runs(prepared)
         precomputed: Dict[int, bytes] = {}
-        for j, (i, prepare_h, prepare_body) in enumerate(admitted):
+        for j, (i, prepare_h, prepare_body) in enumerate(prepared):
             run = runs.get(j)
             if run is not None:
                 # The run's device dispatch executes HERE, at its position
@@ -572,7 +636,297 @@ class Replica:
             self.checkpoint()
         return out, fsync
 
-    def _group_device_runs(self, admitted) -> Dict[int, List[Tuple]]:
+    def _commit_group_pipelined(self, admitted, out, deferred_replies):
+        try:
+            return self._commit_group_pipelined_inner(
+                admitted, out, deferred_replies
+            )
+        except BaseException as err:
+            # A failed group must not strand an earlier group's reply
+            # promise (the bus flush task would await it forever).
+            self._pipeline_abort(err)
+            raise
+
+    def _commit_group_pipelined_inner(self, admitted, out, deferred_replies):
+        """Pipelined commit engine (depth >= 2): three overlaps, one reply
+        barrier.
+
+        1. fsync/compute overlap — ops and prepare headers are assigned
+           first, the LEADING device run dispatches, and only then are the
+           group's WAL writes + fsync issued: the journal IO of group N
+           runs while group N's device dispatch is in flight.  Safe: the
+           device ledger is volatile (durable state only moves at
+           checkpoints, which settle the pipeline first), and no reply is
+           released before both the fsync and the execution — a crash in
+           the window loses ops no client was ever answered for, exactly
+           the pre-pipeline recovery semantics.
+        2. deferred D2H readback — device runs return DeviceCommitHandles
+           executing on the machine's dispatch lane; with
+           ``deferred_replies`` the whole group's readbacks + bookkeeping
+           stay PENDING past return (replies become a Future the caller
+           awaits like the fsync barrier), so group N's readbacks and
+           reply construction overlap group N+1's admission, journaling,
+           and dispatch.  Handles resolve in dispatch order (commit
+           timestamps and index appends are op-ordered).
+        3. every op still EXECUTES at its position in op order: a
+           non-deferrable op (lookup, create_accounts, a refused run)
+           first drains the in-flight handles — its results must observe
+           exactly the ops before it, and a query must see their index
+           appends.
+
+        Bookkeeping + reply construction (phase B) then run per op in
+        order via _commit_prepare with the precomputed result bodies —
+        either before return (blocking callers) or when the pending group
+        comes due (next call / pipeline_flush)."""
+        pending = self._pipeline_pending
+        if pending is not None and (
+            pending["last_op"]
+            - max(self.op_checkpoint, self._ckpt_captured_op)
+            >= self.config.vsr_checkpoint_interval
+        ):
+            # The pending group's bookkeeping crosses a checkpoint
+            # boundary: settle + checkpoint BEFORE dispatching anything
+            # new — the capture must see a ledger exactly at its
+            # commit_min, never one with a newer group's effects applied.
+            if _obs.enabled:
+                _obs.counter("pipeline.stall.checkpoint").inc()
+            self.pipeline_flush()
+        messages: List[bytes] = []
+        prepared = []
+        inflight = self._pipeline_inflight
+        result_bodies: Dict[int, bytes] = {}
+        skip: set = set()
+        runs: Dict[int, List[Tuple]] = {}
+        # Overlap #1: the leading run's dispatch goes to the lane BEFORE
+        # the WAL writes in the finally (and before the previous group's
+        # bookkeeping).  The WHOLE header-assign + lead-dispatch section
+        # rides the try: whatever fails, every op that advanced self.op
+        # has its encoded message journaled — self.op and the WAL must
+        # never disagree, or the next group's hash chain points at ops
+        # recovery cannot find.
+        try:
+            for i, operation, header, body in admitted:
+                prepare_h, prepare_body = self._prepare(
+                    header, body, operation, sync=False,
+                    defer_write=messages
+                )
+                prepared.append((i, prepare_h, prepare_body))
+            runs = self._group_device_runs(prepared, single_ok=True)
+            if _obs.enabled:
+                _obs.gauge("pipeline.depth").set(self.pipeline_depth)
+                _obs.counter("pipeline.groups").inc()
+            lead = runs.get(0)
+            if lead is not None:
+                handle = self._dispatch_run(lead)
+                if handle is not None:
+                    self._pipeline_track(lead, handle, result_bodies, skip)
+        finally:
+            for message in messages:
+                self.journal.write_prepare(message, sync=False)
+        fsync = self._io_pool_submit(self.journal.sync)
+        self._last_group_fsync = fsync
+
+        def drain(reason: str) -> None:
+            if inflight and _obs.enabled:
+                _obs.counter(f"pipeline.stall.{reason}").inc()
+            while inflight:
+                self._pipeline_retire()
+
+        # The previous group comes due: its dispatches ran ahead of ours
+        # on the FIFO lane, so its readbacks + bookkeeping + reply promise
+        # land now — while OUR lead executes.
+        self._pipeline_finish_pending()
+
+        # Phase A: op-order execution; device runs defer their readbacks.
+        for j, (i, prepare_h, prepare_body) in enumerate(prepared):
+            if j in skip:
+                continue
+            run = runs.get(j)
+            if run is not None and j != 0:
+                handle = self._dispatch_run(run)
+                if handle is not None:
+                    self._pipeline_track(run, handle, result_bodies, skip)
+                    continue
+                if _obs.enabled:
+                    _obs.counter("pipeline.stall.refusal").inc()
+                # Refused run (mid-run fast-path refusal, tiering, ...):
+                # its ops fall through to per-op execution at their own
+                # positions below.
+            operation = wire.Operation(int(prepare_h["operation"]))
+            if operation in (wire.Operation.register, wire.Operation.root):
+                continue  # no state-machine execution; bookkeeping-only
+            # Overlap #3 barrier: this op's results must observe every
+            # prior op's effects AND index appends.
+            drain("barrier")
+            t0 = time.perf_counter_ns() if _obs.enabled else 0  # tblint: ignore[nondet] metrics
+            with tracer.span("state_machine_commit",
+                             op=int(prepare_h["op"]),
+                             operation=operation.name):
+                result_bodies[j] = self._execute(
+                    operation, prepare_body, int(prepare_h["timestamp"])
+                )
+            if _obs.enabled:
+                _obs.histogram("replica.commit_us", "us").observe(
+                    (time.perf_counter_ns() - t0) / 1e3  # tblint: ignore[nondet] metrics
+                )
+
+        if deferred_replies and inflight:
+            # Group N stays pending: readbacks + bookkeeping + replies
+            # come due with group N+1 (or pipeline_flush when the queue
+            # idles).  The reply barrier is unchanged — the caller awaits
+            # the promise AND the fsync before releasing anything.
+            import concurrent.futures
+
+            promise: "concurrent.futures.Future" = (
+                concurrent.futures.Future()
+            )
+            self._pipeline_pending = {
+                "prepared": prepared,
+                "out": out,
+                "result_bodies": result_bodies,
+                "promise": promise,
+                "last_op": int(prepared[-1][1]["op"]),
+            }
+            return promise, fsync
+
+        drain("flush")
+        self._pipeline_phase_b(prepared, result_bodies, out)
+        if self._checkpoint_due():
+            self.checkpoint()
+        return out, fsync
+
+    # -- pipelined-engine plumbing (docs/commit_pipeline.md) ------------------
+
+    @property
+    def pipeline_pending(self) -> bool:
+        """True while a commit group's readbacks/bookkeeping are deferred
+        (the bus polls this to flush when its request queue idles)."""
+        return self._pipeline_pending is not None or bool(
+            self._pipeline_inflight
+        )
+
+    def pipeline_flush(self) -> None:
+        """Drain the pipelined commit engine: resolve every in-flight
+        device readback, run the pending group's bookkeeping + replies
+        (fulfilling its reply promise), and take any checkpoint that came
+        due.  No-op when nothing is pending.  Called by the bus when the
+        request queue idles, by every blocking commit entry point, and by
+        close()."""
+        self._pipeline_settle()
+        if self._checkpoint_due():
+            self.checkpoint()
+
+    def _pipeline_settle(self) -> None:
+        """Resolve all in-flight handles + pending bookkeeping WITHOUT the
+        checkpoint-due check (checkpoint() itself calls this; the due
+        check there would recurse)."""
+        try:
+            while self._pipeline_inflight:
+                self._pipeline_retire()
+            self._pipeline_finish_pending()
+        except BaseException as err:
+            self._pipeline_abort(err)
+            raise
+
+    def _pipeline_track(self, run, handle, result_bodies, skip) -> None:
+        if _obs.enabled:
+            _obs.counter("pipeline.dispatches").inc()
+            _obs.histogram("pipeline.inflight", "handles").observe(
+                len(self._pipeline_inflight) + 1
+            )
+        skip.update(jj for jj, _b, _t in run)
+        self._pipeline_inflight.append((run, handle, result_bodies))
+
+    def _pipeline_retire(self) -> None:
+        """Resolve the OLDEST in-flight run (dispatch order == op order)
+        into its group's result bodies.  The resolve IS the deferred ops'
+        commit stage, so it carries the commit-stage series/span the
+        blocking path records per op (one observation per run here)."""
+        run, handle, result_bodies = self._pipeline_inflight.pop(0)
+        t0 = time.perf_counter_ns() if _obs.enabled else 0  # tblint: ignore[nondet] metrics
+        with tracer.span("state_machine_commit", deferred=True,
+                         operation="create_transfers", batches=len(run)):
+            results = handle.resolve()
+        if _obs.enabled:
+            # Queue wait (the join) is pipeline idle time, NOT commit
+            # work: it rides pipeline.resolve_wait_us; commit_us must stay
+            # comparable with the blocking path's execution-only series.
+            _obs.histogram("replica.commit_us", "us").observe(max(
+                (time.perf_counter_ns() - t0) / 1e3  # tblint: ignore[nondet] metrics
+                - handle.join_wait_s * 1e6, 0.0,
+            ))
+        for (jj, _b, _t), res in zip(run, results):
+            result_bodies[jj] = _encode_results(res)
+
+    def _pipeline_finish_pending(self) -> None:
+        """Run the pending group's remaining readbacks + phase B and
+        fulfill its reply promise."""
+        pending = self._pipeline_pending
+        if pending is None:
+            return
+        # Its handles are the oldest in-flight entries (FIFO): resolve
+        # exactly those — a newer group's may already be queued behind.
+        while self._pipeline_inflight and (
+            self._pipeline_inflight[0][2] is pending["result_bodies"]
+        ):
+            self._pipeline_retire()
+        self._pipeline_pending = None
+        try:
+            self._pipeline_phase_b(
+                pending["prepared"], pending["result_bodies"], pending["out"]
+            )
+        except BaseException as err:
+            # The promise must ALWAYS resolve (the bus flush task awaits
+            # it); _pipeline_abort can no longer see this group — pending
+            # was just detached — so fail it here and re-raise.
+            if not pending["promise"].done():
+                pending["promise"].set_exception(
+                    RuntimeError(f"pipelined group commit failed: {err!r}")
+                )
+            raise
+        pending["promise"].set_result(pending["out"])
+
+    def _pipeline_phase_b(self, prepared, result_bodies, out) -> None:
+        """Phase B: bookkeeping + reply construction, strictly in op
+        order.  The reply barrier is unchanged: the caller withholds these
+        until the group fsync resolves."""
+        for j, (i, prepare_h, prepare_body) in enumerate(prepared):
+            reply = self._commit_prepare(
+                prepare_h, prepare_body, replay=False,
+                result_body=result_bodies.get(j),
+            )
+            assert reply is not None
+            out[i] = [reply]
+
+    def _pipeline_abort(self, err) -> None:
+        """Engine failure: QUIESCE in-flight handles (join their lane
+        dispatches — an orphaned closure would keep mutating the machine's
+        ledger concurrently with the serving thread — and release their
+        staging sets) and fail the pending reply promise so its flush task
+        unblocks (the bus then drops those connections — clients retry,
+        exactly the group-failure discipline)."""
+        for _run, handle, _rb in self._pipeline_inflight:
+            handle.discard()
+        self._pipeline_inflight.clear()
+        pending, self._pipeline_pending = self._pipeline_pending, None
+        if pending is not None and not pending["promise"].done():
+            pending["promise"].set_exception(
+                RuntimeError(f"pipelined group commit failed: {err!r}")
+            )
+
+    def _dispatch_run(self, run):
+        """Dispatch one device run deferred; returns a DeviceCommitHandle
+        or None (not eligible — the engine executes the ops inline)."""
+        machine = self.machine
+        batches = [b for _jj, b, _t in run]
+        timestamps = [t for _jj, _b, t in run]
+        if len(run) == 1:
+            return machine.commit_fast_deferred(batches[0], timestamps[0])
+        return machine.commit_group_fast(batches, timestamps, deferred=True)
+
+    def _group_device_runs(
+        self, admitted, single_ok: bool = False
+    ) -> Dict[int, List[Tuple]]:
         """Identify runs of consecutive create_transfers prepares for the
         grouped device dispatch (machine.commit_group_fast): through a
         remote-TPU tunnel a dispatch costs ~60 ms, so per-op dispatch makes
@@ -581,10 +935,18 @@ class Replica:
         run = [(admitted_index, batch, timestamp), ...]; the commit loop
         dispatches each run when it REACHES it, preserving op order.
         Results are bit-identical to per-op commits (scan order == op
-        order, per-op prepare timestamps ride along)."""
+        order, per-op prepare timestamps ride along).
+
+        ``single_ok`` (the pipelined engine): length-1 runs are emitted
+        too — a lone create_transfers op dispatches DEFERRED through the
+        per-batch fast kernel (machine.commit_fast_deferred), so the
+        readback overlap works even where grouping is off (XLA-CPU, where
+        an empty scan step pays table-sized temporaries).  When grouping
+        is off entirely, every create_transfers op becomes its own run."""
         runs: Dict[int, List[Tuple]] = {}
         machine = self.machine
-        if not getattr(machine, "group_device_commit", False):
+        grouping = bool(getattr(machine, "group_device_commit", False))
+        if not grouping and not single_ok:
             return runs
         if self.hash_log is not None:
             # The determinism oracle records a per-op ledger digest at
@@ -594,10 +956,12 @@ class Replica:
             # strict per-op replicas.  The oracle outranks the serving
             # optimization.
             return runs
+        min_len = 1 if single_ok else 2
+        max_len = machine.GROUP_K if grouping else 1
         run: List[Tuple[int, np.ndarray, int]] = []
 
         def flush() -> None:
-            if len(run) >= 2:
+            if len(run) >= min_len:
                 runs[run[0][0]] = list(run)
             run.clear()
 
@@ -606,7 +970,7 @@ class Replica:
                 wire.Operation(int(h["operation"]))
                 == wire.Operation.create_transfers
             ):
-                if len(run) >= machine.GROUP_K:
+                if len(run) >= max_len:
                     flush()
                 run.append((
                     j,
@@ -629,9 +993,14 @@ class Replica:
 
     def _prepare(
         self, request_h: np.ndarray, body: bytes, operation: wire.Operation,
-        sync: bool = True,
+        sync: bool = True, defer_write: Optional[List[bytes]] = None,
     ) -> Tuple[np.ndarray, bytes]:
-        """Assign op + timestamp, hash-chain, and journal the prepare."""
+        """Assign op + timestamp, hash-chain, and journal the prepare.
+
+        ``defer_write``: collect the encoded message instead of writing it
+        — the pipelined engine journals the whole group AFTER dispatching
+        its leading device run, so the WAL IO overlaps device compute (the
+        op/chain assignment here stays strictly ordered either way)."""
         # The pre-execution stage (the reference pipeline's prefetch slot:
         # everything between request admission and the state machine —
         # timestamp assignment, hash chain, WAL write).
@@ -657,7 +1026,10 @@ class Replica:
         )
         h["replica"] = self.replica
         message = wire.encode(h, body)
-        self.journal.write_prepare(message, sync=sync)
+        if defer_write is None:
+            self.journal.write_prepare(message, sync=sync)
+        else:
+            defer_write.append(message)
         decoded, _ = wire.decode_header(message)
         self.op = op
         self.parent_checksum = wire.header_checksum(decoded)
@@ -942,6 +1314,9 @@ class Replica:
         queued and written after it).  Cross-replica forest determinism
         (peer block repair matches files by checksum) depends on every
         replica capturing at identical ops."""
+        # A capture must never see a ledger ahead of commit_min: settle any
+        # pipelined group first (no-op on the paths that already did).
+        self._pipeline_settle()
         if self.async_checkpoint:
             self._checkpoint_poll()
             if self._ckpt_thread is not None:
@@ -1211,10 +1586,15 @@ class Replica:
             self._checkpoint_poll()  # adopts; starts the next queued write
 
     def close(self) -> None:
+        self._pipeline_settle()
         self._checkpoint_drain()
         pool = getattr(self, "_io_pool", None)
         if pool is not None:
             pool.shutdown(wait=True)
+        lane = getattr(self.machine, "_lane", None)
+        if lane is not None:
+            lane.shutdown(wait=True)
+            self.machine._lane = None
         if self.aof is not None:
             self.aof.close()
         dbg = getattr(self, "_debug_file", None)

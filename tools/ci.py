@@ -72,6 +72,7 @@ TIERS = {
             "tests/test_balancing_vector.py", "tests/test_scan_path.py",
             "tests/test_queries.py", "tests/test_scan_builder.py",
             "tests/test_sharded.py", "tests/test_group_commit.py",
+            "tests/test_pipeline.py",
             "tests/test_host_engine.py", "tests/test_cold_tier.py",
         ],
         extra=["-m", "not slow"],
@@ -93,6 +94,14 @@ TIERS = {
         # Artifacts: METRICS.json + OBS_SMOKE.json at the repo root.
         cmd=["tools/obs_smoke.py"],
     ),
+    "pipeline": dict(
+        # Pipelined commit engine smoke (docs/commit_pipeline.md): runs
+        # bench.py --pipeline-depth 1,2 on CPU and asserts depth-1 and
+        # depth-2 report identical reply/ledger digests AND that the
+        # occupancy/stall counters landed in METRICS.json.
+        # Artifact: PIPELINE_SMOKE.json at the repo root.
+        cmd=["tools/pipeline_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -104,6 +113,7 @@ TIERS = {
             "tests/test_demos.py", "tests/test_standby.py",
             "tests/test_longhaul.py",
             "tests/test_vopr.py::test_vopr_standby_sweep",
+            "tests/test_pipeline.py::test_vopr_seed_stable_under_pipeline",
             "tests/test_sharded.py::test_sharded_full_kernel_two_phase_parity",
             "tests/test_sharded.py::test_sharded_full_kernel_random_stream",
             "tests/test_block_repair.py::"
@@ -116,7 +126,10 @@ TIERS = {
         extra=[],
     ),
 }
-ORDER = ["tidy", "lint", "unit", "kernel", "consensus", "obs", "integration"]
+ORDER = [
+    "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
+    "integration",
+]
 
 
 def run_tier(name: str, timeout_s: float) -> dict:
