@@ -334,6 +334,128 @@ class TestReplicaLifecycle:
         r2.close()
 
 
+def test_crash_mid_checkpoint_pipelined_restart_byte_identical(tmp_path):
+    """Crash-during-checkpoint differential under the pipelined commit
+    engine: TB_PIPELINE=2 serving over torn-write sim storage, crash
+    injected MID-checkpoint (forest files written, superblock write never
+    lands) — the restart must recover byte-identical committed state via
+    the OLD checkpoint anchor + WAL replay.  Closes the gap between
+    test_pipeline (no crashes) and the tests above (no pipeline)."""
+    from tigerbeetle_tpu.sim.storage import SimStorage
+    from tigerbeetle_tpu.vsr import wire as w
+
+    storage = SimStorage(TEST_CONFIG, seed=31, replica=0)
+    data_path = str(tmp_path / "mid_ckpt.tb")
+    Replica.format(data_path, cluster=3, cluster_config=TEST_CONFIG,
+                   storage=storage)
+    storage.sync()
+    r = Replica(data_path, cluster_config=TEST_CONFIG,
+                ledger_config=TEST_LEDGER, batch_lanes=64, storage=storage,
+                time_ns=lambda: 0)
+    r.open()
+    r.pipeline_depth = 2
+
+    class Crash(Exception):
+        pass
+
+    crashing = {"armed": False}
+    real_install = r._superblock_install
+
+    def install(state):
+        if crashing["armed"]:
+            # Mid-checkpoint power cut: the WAL/session writes already
+            # issued are synced or torn by SimStorage.crash(); the
+            # superblock referencing the new forest manifest NEVER lands.
+            storage.sync()  # the group fsync worker would have completed
+            storage.crash()
+            raise Crash()
+        return real_install(state)
+
+    r._superblock_install = install
+
+    sessions = {}
+
+    def req(client, n, op, body):
+        h = w.new_header(
+            wire.Command.request, cluster=3, client=client, request=n,
+            session=sessions.get(client, 0), operation=int(op),
+        )
+        h["size"] = w.HEADER_SIZE + len(body)
+        return w.set_checksums(h, body), body
+
+    replies, fs = r.on_request_group_pipelined(
+        [req(0xAB, 0, wire.Operation.register, b"")]
+    )
+    if fs is not None:
+        fs.result()
+    rh, _ = w.decode_header(replies[0][0][:w.HEADER_SIZE])
+    sessions[0xAB] = int(rh["commit"])
+    replies, fs = r.on_request_group_pipelined(
+        [req(0xAB, 1, wire.Operation.create_accounts,
+             accounts_body(range(1, 11)))]
+    )
+    if fs is not None:
+        fs.result()
+    # First checkpoint lands cleanly; the SECOND crashes mid-write.
+    n = 2
+    crashed = False
+    for i in range(3 * TEST_CONFIG.vsr_checkpoint_interval + 6):
+        if r.op_checkpoint > 0 and not crashing["armed"]:
+            crashing["armed"] = True
+        body = transfers_body([(1 + i % 10, 1 + (i + 1) % 10, 5)],
+                              first_id=40_000 + i)
+        try:
+            replies, fs = r.on_request_group_pipelined(
+                [req(0xAB, n, wire.Operation.create_transfers, body)]
+            )
+            if fs is not None:
+                fs.result()
+        except Crash:
+            crashed = True
+            break
+        n += 1
+    assert crashed, "the mid-checkpoint crash never fired"
+    old_checkpoint = r.op_checkpoint  # adopted anchor predates the crash
+    # The machine (host memory) survived the storage crash: its state is
+    # the byte-identity reference for the restart.
+    expected_digest = r.machine.digest()
+    expected_balances = r.machine.balances_snapshot()
+    expected_commit = r.commit_min
+
+    # The DURABLE anchor is still the old checkpoint: the crashed write's
+    # superblock never landed (replay below may legitimately take fresh
+    # checkpoints on the grid as it re-executes).
+    assert SuperBlock(storage).open().op_checkpoint == old_checkpoint, (
+        "a superblock referencing the crashed checkpoint landed"
+    )
+    r2 = Replica(data_path, cluster_config=TEST_CONFIG,
+                 ledger_config=TEST_LEDGER, batch_lanes=64, storage=storage,
+                 time_ns=lambda: 0)
+    r2.open()
+    assert r2.commit_min == expected_commit
+    assert r2.machine.digest() == expected_digest
+    assert r2.machine.balances_snapshot() == expected_balances
+    # And the survivor keeps serving (incl. its next, clean checkpoint).
+    sessions2 = {0xAB: sessions[0xAB]}
+
+    def req2(client, n_, op, body):
+        h = w.new_header(
+            wire.Command.request, cluster=3, client=client, request=n_,
+            session=sessions2.get(client, 0), operation=int(op),
+        )
+        h["size"] = w.HEADER_SIZE + len(body)
+        return w.set_checksums(h, body), body
+
+    replies, fs = r2.on_request_group_pipelined(
+        [req2(0xAB, n, wire.Operation.create_transfers,
+              transfers_body([(1, 2, 9)], first_id=90_000))]
+    )
+    if fs is not None:
+        fs.result()
+    assert replies[0] and replies[0][0][256:] == b""
+    r2.close()
+
+
 def test_checkpoint_is_deterministic_across_replicas(tmp_path):
     """Deterministic-allocation invariant (free_set.zig:27-44's
     reserve->acquire->forfeit discipline, redesigned): two replicas

@@ -64,6 +64,64 @@ class TestTimeout:
             assert a.fired(now) == b.fired(now)
 
 
+class TestClientReconnectBackoff:
+    """client.py's reconnect/failover loop must back off exponentially
+    (vsr/timeout.py) instead of hammering a down cluster at a fixed 20 Hz
+    — attempts counted against a fake clock."""
+
+    def _down_client(self, monkeypatch, timeout_s=30.0):
+        import tigerbeetle_tpu.client as client_mod
+
+        attempts = {"n": 0}
+
+        def refused(addr, timeout=None):
+            attempts["n"] += 1
+            raise OSError("connection refused")
+
+        monkeypatch.setattr(
+            client_mod.socket, "create_connection", refused
+        )
+        c = client_mod.Client(
+            [("127.0.0.1", 1), ("127.0.0.1", 2)], cluster=0,
+            client_id=0xC11E47, timeout_s=timeout_s,
+        )
+        clock = {"t": 0.0}
+        sleeps = []
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            clock["t"] += s
+
+        c._sleep = fake_sleep
+        c._now = lambda: clock["t"]
+        return c, attempts, sleeps
+
+    def test_down_cluster_is_probed_not_hammered(self, monkeypatch):
+        c, attempts, sleeps = self._down_client(monkeypatch, timeout_s=30.0)
+        with pytest.raises(TimeoutError):
+            c.register()
+        # Two addresses per retry cycle; the old fixed 50 ms cadence made
+        # ~600 cycles (1200 attempts) in a 30 s window — backoff must cut
+        # that by an order of magnitude.
+        assert attempts["n"] <= 60, attempts["n"]
+        assert attempts["n"] >= 4  # it did keep probing
+        # Exponential trend: the later half of the waits dominates.
+        assert sum(sleeps[len(sleeps) // 2:]) > sum(
+            sleeps[: len(sleeps) // 2]
+        )
+        # Jittered, capped at max_ticks * RETRY_TICK_S.
+        assert max(sleeps) <= 64 * c.RETRY_TICK_S + 1e-9
+
+    def test_backoff_resets_after_progress(self, monkeypatch):
+        c, attempts, sleeps = self._down_client(monkeypatch, timeout_s=5.0)
+        with pytest.raises(TimeoutError):
+            c.register()
+        assert c._reconnect_backoff.attempts > 1
+        # A successful roundtrip resets the schedule to the base interval.
+        c._reconnect_backoff.reset(0)
+        assert c._reconnect_backoff.attempts == 0
+
+
 class TestConvergenceUnderHeavyLoss:
     def test_view_change_converges_at_30pct_loss(self, tmp_path):
         """The verdict's bar: view-change convergence under 30% loss —

@@ -95,6 +95,9 @@ def test_vopr_tpu_catches_injected_bugs(bug):
     if bug == "amputate_vouch":
         probs.update(p_crash=0.15, p_restart=0.4, p_view_change=0.6,
                      p_link=0.35, p_repartition=0.2, p_amputate=0.6)
+    if bug == "scrub_off":
+        # The scrub-off bug only bites when silent SDC is injected.
+        probs.update(p_sdc=0.3)
     v = vopr_tpu.run(
         seed=1, n_clusters=256, n_steps=300, bug=bug,
         n_replicas=n_replicas, slots=slots, **probs,

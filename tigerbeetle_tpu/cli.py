@@ -111,6 +111,15 @@ def main(argv=None) -> int:
                               "values reserved, currently equivalent to "
                               "2; default 2; env twin: TB_PIPELINE, 0 = "
                               "off)")
+    p_start.add_argument("--scrub-interval", type=int, default=None,
+                         metavar="N",
+                         help="device fault domain (docs/fault_domains.md): "
+                              "scrub the device-resident ledger against the "
+                              "host mirror every N commit batches and at "
+                              "every checkpoint boundary; enables dispatch "
+                              "retry/quarantine and device-state recovery. "
+                              "0 = off (default; env twin: "
+                              "TB_SCRUB_INTERVAL)")
     p_start.add_argument("--no-engine", action="store_true",
                          help="force the device-kernel commit path even "
                               "when the native host engine is available")
@@ -146,7 +155,8 @@ def main(argv=None) -> int:
     # Keep in sync with sim.vopr_tpu.BUGS (asserted in _cmd_vopr; a
     # module import here would pull jax into every CLI invocation).
     vopr_bugs = ["commit_quorum", "canonical_by_op", "no_truncate",
-                 "corrupt_serve", "wal_wrap", "split_brain"]
+                 "corrupt_serve", "wal_wrap", "split_brain",
+                 "amputate_vouch", "join_keep_stale", "scrub_off"]
     p_vopr.add_argument("--bug", default=None, choices=vopr_bugs,
                         help="(--tpu) inject a known consensus bug to "
                              "validate the oracle")
@@ -157,6 +167,14 @@ def main(argv=None) -> int:
                              "(env twin: TB_VOPR_VIZ)")
     p_vopr.add_argument("--metrics-json", default=None, metavar="PATH",
                         help="dump fault/outcome counters to PATH")
+    p_vopr.add_argument("--device-faults", action="store_true",
+                        help="inject the device fault kind (seeded SDC bit "
+                             "flips into ledger columns + forced dispatch "
+                             "exceptions) from a separate stream")
+    p_vopr.add_argument("--scrub-interval", type=int, default=0, metavar="N",
+                        help="arm every replica's scrub mirror at cadence N "
+                             "(0 = off; with --device-faults and N=0 the "
+                             "run demonstrates the undetected-SDC failure)")
 
     p_bench = sub.add_parser("benchmark", help="client-driven load benchmark")
     p_bench.add_argument("--addresses", default=None,
@@ -205,9 +223,13 @@ def _cmd_vopr(args) -> int:
     if args.tpu:
         from .sim import vopr_tpu
 
+        # Round-5 drift fix: the assert (and --bug choices) had fallen
+        # behind BUGS when amputate_vouch/join_keep_stale landed — any
+        # `vopr --tpu` invocation tripped it.
         assert set(vopr_tpu.BUGS) == {
             "commit_quorum", "canonical_by_op", "no_truncate",
             "corrupt_serve", "wal_wrap", "split_brain",
+            "amputate_vouch", "join_keep_stale", "scrub_off",
         }, "cli --bug choices drifted from sim.vopr_tpu.BUGS"
         if args.count != 1 or args.ticks != 6_000:
             print("error: --count/--ticks apply only without --tpu",
@@ -219,6 +241,8 @@ def _cmd_vopr(args) -> int:
             n_clusters=args.clusters,
             n_steps=args.steps,
             bug=args.bug,
+            # scrub_off only bites when silent SDC is actually injected.
+            **({"p_sdc": 0.3} if args.bug == "scrub_off" else {}),
         )
         n = int(violations.sum())
         print(
@@ -241,7 +265,9 @@ def _cmd_vopr(args) -> int:
     worst = 0
     for seed in range(first, first + args.count):
         result = run_seed(
-            seed, ticks=args.ticks, viz=True if args.vopr_viz else None
+            seed, ticks=args.ticks, viz=True if args.vopr_viz else None,
+            scrub_interval=args.scrub_interval,
+            device_faults=args.device_faults,
         )
         print(
             f"seed={result.seed} exit={result.exit_code} "
@@ -407,6 +433,7 @@ def _cmd_start(args) -> int:
         replica = VsrReplica(
             args.path, ledger_config=ledger_config, aof_path=args.aof,
             process_config=process_config, host_engine=bool(args.engine),
+            scrub_interval=args.scrub_interval,
         )
         if args.pipeline_depth is not None:
             replica.pipeline_depth = args.pipeline_depth
@@ -444,7 +471,8 @@ def _cmd_start(args) -> int:
     )
     replica = Replica(args.path, ledger_config=ledger_config,
                       aof_path=args.aof, hot_transfers_capacity_max=hot_max,
-                      process_config=process_config, host_engine=use_engine)
+                      process_config=process_config, host_engine=use_engine,
+                      scrub_interval=args.scrub_interval)
     if args.pipeline_depth is not None:
         replica.pipeline_depth = args.pipeline_depth
     replica.open()
@@ -503,7 +531,8 @@ def _cmd_version(args) -> int:
         print(f"  compile_cache.env="
               f"{os.environ.get('JAX_COMPILATION_CACHE_DIR', '')}")
         for env in ("TB_TRACE", "TB_TRACE_PATH", "TB_METRICS_PATH",
-                    "TB_VOPR_VIZ", "TB_PIPELINE", "JAX_PLATFORMS"):
+                    "TB_VOPR_VIZ", "TB_PIPELINE", "TB_SCRUB_INTERVAL",
+                    "JAX_PLATFORMS"):
             print(f"  env.{env}={os.environ.get(env, '')}")
     return 0
 

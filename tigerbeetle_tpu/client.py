@@ -15,6 +15,7 @@ tb_client API surface (create_accounts/create_transfers/lookup_*).
 
 from __future__ import annotations
 
+import random
 import secrets
 import socket
 import time
@@ -25,6 +26,7 @@ import numpy as np
 from . import types
 from .config import ClusterConfig
 from .vsr import wire
+from .vsr.timeout import Timeout
 
 
 class ClientEvicted(Exception):
@@ -51,6 +53,20 @@ class Client:
         self._sock: Optional[socket.socket] = None
         self._addr_index = 0     # preferred replica (rotates on failure)
         self.failover_count = 0  # lifetime rotations (latency forensics)
+        # Reconnect/failover backoff (vsr/timeout.py): jittered exponential
+        # so a down cluster is probed, not hammered — one tick is
+        # RETRY_TICK_S seconds, base 1 tick, capped at 64 (~3.2 s).  The
+        # jitter prng is seeded from the client id: deterministic per
+        # client, desynchronized across clients.  _sleep/_now are
+        # injectable so tests can count attempts against a fake clock.
+        self._reconnect_backoff = Timeout(
+            random.Random(self.client_id & 0xFFFF_FFFF),
+            base_ticks=1, max_ticks=64,
+        )
+        self._sleep = time.sleep
+        self._now = time.monotonic
+
+    RETRY_TICK_S = 0.05
 
     # -- connection management ----------------------------------------------
 
@@ -139,9 +155,9 @@ class Client:
 
     def _roundtrip(self, message: bytes, request_checksum: int) -> Tuple[np.ndarray, bytes]:
         """Send; wait for the matching reply (retrying on reconnect)."""
-        deadline = time.monotonic() + self.timeout_s
+        deadline = self._now() + self.timeout_s
         while True:
-            if time.monotonic() > deadline:
+            if self._now() > deadline:
                 raise TimeoutError("request timed out")
             try:
                 sock = self._connect()
@@ -162,13 +178,19 @@ class Client:
                         continue  # e.g. pong
                     if wire.u128(h, "request_checksum") != request_checksum:
                         continue  # stale/duplicate reply
+                    # Progress: the next failure backs off from the base.
+                    self._reconnect_backoff.reset(0)
                     return h, body
             except (ConnectionError, OSError, ValueError):
                 self.close()
-                # Rotate the preferred replica before retrying (failover).
+                # Rotate the preferred replica before retrying (failover),
+                # then back off with jittered exponential growth — a down
+                # cluster sees a handful of probes per client, not a
+                # 20 Hz hammer from every waiting caller.
                 self._addr_index = (self._addr_index + 1) % len(self.addresses)
                 self.failover_count += 1
-                time.sleep(0.05)
+                ticks = self._reconnect_backoff.next_backoff()
+                self._sleep(ticks * self.RETRY_TICK_S)
 
     # -- session protocol -----------------------------------------------------
 

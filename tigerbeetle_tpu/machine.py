@@ -30,7 +30,9 @@ lax.scan and is bit-identical but latency-bound.
 
 from __future__ import annotations
 
+import random as _random
 import time as _time
+import warnings
 
 from typing import List, Optional, Tuple
 
@@ -41,7 +43,11 @@ import numpy as np
 from . import types
 from .config import LedgerConfig
 from .obs.metrics import registry as _obs
+from .ops import scrub as scrub_ops
 from .ops import state_machine as sm
+from .ops.scrub import (  # re-exported: the replica's fault-domain surface
+    DEVICE_FAULT_TYPES, DeviceStateUnrecoverable, SimulatedDeviceFault,
+)
 
 _LIMIT_FLAGS = (
     types.AccountFlags.DEBITS_MUST_NOT_EXCEED_CREDITS
@@ -130,10 +136,11 @@ class DeviceCommitHandle:
     """
 
     __slots__ = ("_machine", "_result", "_stacked", "_counts",
-                 "_timestamps", "_stage", "_resolved", "join_wait_s")
+                 "_timestamps", "_stage", "_resolved", "join_wait_s",
+                 "_batches", "_recovered")
 
     def __init__(self, machine, result, counts, timestamps,
-                 stacked: bool, stage=None) -> None:
+                 stacked: bool, stage=None, batches=None) -> None:
         self._machine = machine
         self._result = result        # (codes, overflow) | Future of one
         self._stacked = stacked      # True: leading GROUP_K dim
@@ -142,6 +149,14 @@ class DeviceCommitHandle:
         self._stage = stage          # staging buffer set to release on resolve
         self._resolved = False
         self.join_wait_s = 0.0
+        # Host-side copies of the dispatched batches: the device fault
+        # domain re-dispatches a quarantined run from these after a failed
+        # dispatch (machine._recover_inflight); None when the fault domain
+        # is off (no retention cost).
+        self._batches = batches
+        # Per-batch results computed by a recovery re-dispatch; resolve()
+        # returns them instead of touching the dead device future.
+        self._recovered = None
 
     def __len__(self) -> int:
         return len(self._counts)
@@ -154,6 +169,7 @@ class DeviceCommitHandle:
         if self._resolved:
             return
         self._resolved = True
+        self._machine._inflight_untrack(self)
         if hasattr(self._result, "result"):
             try:
                 # The group's failure already propagated via the engine;
@@ -169,6 +185,11 @@ class DeviceCommitHandle:
         assert not self._resolved, "commit handle resolved twice"
         self._resolved = True
         m = self._machine
+        if self._recovered is not None:
+            # A device-fault recovery already re-committed this run through
+            # the blocking path (machine._recover_inflight): bookkeeping,
+            # index appends and mirror application all happened there.
+            return self._recovered
         try:
             if hasattr(self._result, "result"):
                 t0 = _time.perf_counter()
@@ -180,7 +201,17 @@ class DeviceCommitHandle:
                     ).observe(self.join_wait_s * 1e6)
             codes_dev, overflow_dev = self._result
             codes, overflow = m._d2h_codes(codes_dev, overflow_dev)
+        except DEVICE_FAULT_TYPES as err:
+            # Dispatch-lane funnel: the dispatch (or its readback) failed —
+            # quarantine the in-flight pipeline and re-dispatch every
+            # pending run from the authoritative mirror (docs/
+            # fault_domains.md).  Raises the original error when the fault
+            # domain is disarmed (pre-fault-domain behavior).
+            m._device_fault_at_resolve(err)
+            assert self._recovered is not None
+            return self._recovered
         finally:
+            m._inflight_untrack(self)
             if self._stage is not None:
                 # The dispatch completed (or failed terminally): either
                 # way its H2D reads are over — the staging set must go
@@ -204,6 +235,12 @@ class DeviceCommitHandle:
             row = codes[j] if self._stacked else codes
             out.append(m._compress(row, count))
             m._update_commit_timestamp(row, count, ts)
+        m._device_fault_streak = 0
+        if m._scrub_mirror is not None and self._batches is not None:
+            # Advance the authoritative mirror in resolve (== op) order;
+            # the digest folds at the next scrub point compare against it.
+            for b, ts in zip(self._batches, self._timestamps):
+                m._mirror_apply("create_transfers", b, ts)
         return out
 
 
@@ -328,6 +365,33 @@ class TpuStateMachine:
         self._stage_pool: List[tuple] = []  # free staging sets (_stage_acquire)
         self._pad_soa_zero: dict = {}
         self._lane = None  # FIFO dispatch-lane executor (see _dispatch_lane)
+        # Device fault domain (ops/scrub.py; docs/fault_domains.md).  Armed
+        # by scrub_arm() when scrub_interval > 0: the mirror is the
+        # authoritative host twin (ReferenceStateMachine) every committed
+        # batch also applies to; scrub points compare its expected digests
+        # against the on-device fold, and recovery re-materializes the
+        # device ledger from it.  All None/zero by default: scrub-off runs
+        # take none of these branches.
+        self._scrub_interval: Optional[int] = None  # lazy (TB_SCRUB_INTERVAL)
+        self._scrub_mirror = None
+        self._scrub_suspect = False
+        self._scrub_commits = 0        # create_* commits since the last check
+        self._inflight_handles: List[DeviceCommitHandle] = []
+        self._injected_device_faults = 0
+        self._device_fault_streak = 0  # consecutive failed dispatches
+        self.device_fault_limit = 3    # streak that triggers the degrade
+        # Jittered exponential re-dispatch backoff (vsr/timeout.py): one
+        # tick of backoff sleeps retry_tick_s seconds; the sim pins it to 0
+        # (virtual time).  The prng feeds ONLY sleep jitter, never state.
+        self.retry_tick_s = 0.01
+        self._retry_prng = _random.Random(0x5C12)  # tblint: ignore[nondet] jitter only
+        self._retry_timeout = None
+        # Plain-int event counters (read by obs/vopr_viz and tests without
+        # the global metrics registry).
+        self.scrub_checks = 0
+        self.scrub_mismatches = 0
+        self.device_recoveries = 0
+        self.degraded_to_host_engine = False
         if self._tiering:
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
             self._bloom_dev = make_bloom(self._bloom_log2)
@@ -346,6 +410,7 @@ class TpuStateMachine:
         host-sync: commit barrier — this is the deliberate readback point
         of the deferred commit pipeline (docs/commit_pipeline.md; the
         bench's RTT-emulation sweep wraps exactly this method)."""
+        self._injected_fault_check()
         t0 = _time.perf_counter()
         if overflow is None:
             out = jax.device_get(codes)
@@ -358,6 +423,449 @@ class TpuStateMachine:
             _obs.counter("ops.dispatch").inc()
             _obs.histogram("ops.dispatch_wait_us", "us").observe(wait * 1e6)
         return out if overflow is None else (out, overflow)
+
+    # -- device fault domain (ops/scrub.py, docs/fault_domains.md) -----------
+
+    @property
+    def scrub_interval(self) -> int:
+        """Scrub cadence in commit batches (TB_SCRUB_INTERVAL env; the CLI's
+        --scrub-interval overrides).  0 = the device fault domain is off —
+        no mirror, no checks, no retry: byte-identical to pre-fault-domain
+        behavior."""
+        if self._scrub_interval is None:
+            import os
+
+            env = os.environ.get("TB_SCRUB_INTERVAL", "")
+            self._scrub_interval = int(env) if env.isdigit() else 0
+        return self._scrub_interval
+
+    @scrub_interval.setter
+    def scrub_interval(self, value: int) -> None:
+        self._scrub_interval = max(0, int(value))
+
+    @property
+    def scrub_armed(self) -> bool:
+        return self._scrub_mirror is not None
+
+    @property
+    def scrub_due(self) -> bool:
+        # +1: a check runs BEFORE the commit that would complete the
+        # window, so interval 1 verifies the at-rest state ahead of EVERY
+        # commit (a flip injected between commits is caught before any
+        # commit reads it), interval N ahead of every Nth.
+        return (
+            self._scrub_mirror is not None
+            and not self._scrub_suspect
+            and self._scrub_commits + 1 >= self.scrub_interval
+        )
+
+    def scrub_arm(self) -> bool:
+        """Seed the authoritative host mirror from the CURRENT ledger state
+        and enable the fault domain.  Callers arm only at VERIFIED points:
+        genesis, a digest-checked checkpoint restore + WAL replay, or the
+        end of a recovery.  No-op (returns False) in host-engine mode —
+        there the numpy ledger already IS the authority — or when
+        scrub_interval is 0."""
+        if self._engine is not None or self.scrub_interval <= 0:
+            self._scrub_mirror = None
+            return False
+        self._scrub_mirror = scrub_ops.model_from_ledger(
+            self.ledger,
+            cold_rows=[np.asarray(r) for r in self.cold.runs],
+            prepare_timestamp=self.prepare_timestamp,
+            commit_timestamp=self.commit_timestamp,
+        )
+        self._scrub_suspect = False
+        self._scrub_commits = 0
+        return True
+
+    def scrub_disarm(self) -> None:
+        self._scrub_mirror = None
+        self._scrub_suspect = False
+
+    def inject_device_faults(self, n: int = 1) -> None:
+        """Arm ``n`` simulated dispatch failures (tests / VOPR schedules):
+        the next n device readbacks raise SimulatedDeviceFault through the
+        same funnel a real XlaRuntimeError would."""
+        self._injected_device_faults += int(n)
+
+    def _injected_fault_check(self) -> None:
+        if self._injected_device_faults > 0:
+            self._injected_device_faults -= 1
+            raise SimulatedDeviceFault("injected device dispatch fault")
+
+    _SDC_COLS = (
+        "debits_pending_lo", "debits_posted_lo",
+        "credits_pending_lo", "credits_posted_lo",
+        "debits_pending_hi", "debits_posted_hi",
+        "credits_pending_hi", "credits_posted_hi",
+    )
+
+    def inject_sdc_bitflip(self, rng) -> bool:
+        """Flip one seeded bit in a live account balance column on device —
+        the VOPR's device-SDC fault (tests / sim only).  Returns False when
+        no live account exists yet (nothing to corrupt)."""
+        if self._engine is not None or self._ledger is None:
+            return False
+        a = self._ledger.accounts
+        live = np.flatnonzero(
+            (np.asarray(a.key_lo) != 0) | (np.asarray(a.key_hi) != 0)
+        )
+        if live.size == 0:
+            return False
+        slot = int(live[rng.randrange(live.size)])
+        col = self._SDC_COLS[rng.randrange(len(self._SDC_COLS))]
+        bit = rng.randrange(64)
+        arr = a.cols[col]
+        cols = dict(a.cols)
+        cols[col] = arr.at[slot].set(arr[slot] ^ jnp.uint64(1 << bit))
+        self._ledger = self._ledger.replace(accounts=a.replace(cols=cols))
+        return True
+
+    def _inflight_untrack(self, handle) -> None:
+        try:
+            self._inflight_handles.remove(handle)
+        except ValueError:
+            pass  # never tracked (fault domain off) or already recovered
+
+    def _mirror_apply(self, operation: str, batch: np.ndarray,
+                      timestamp: int) -> None:
+        """Advance the authoritative mirror by one committed batch (strict
+        commit order — callers are the post-success blocking commit paths
+        and FIFO handle resolves).  A mirror application failure marks it
+        SUSPECT: scrub checks stand down and any later recovery escalates
+        to checkpoint + WAL replay (the replica's recover_device_state)."""
+        model = self._scrub_mirror
+        if model is None or self._scrub_suspect:
+            return
+        from .testing import model as M
+
+        self._scrub_commits += 1
+        try:
+            if operation == "create_accounts":
+                events = [M.account_from_row(r) for r in batch]
+            else:
+                events = [M.transfer_from_row(r) for r in batch]
+            model.execute(operation, int(timestamp), events)
+        except Exception:  # noqa: BLE001 — a broken mirror must stand down
+            self._scrub_suspect = True
+            if _obs.enabled:
+                _obs.counter("scrub.mirror_suspect").inc()
+
+    def _guarded_commit(self, operation, batch, timestamp, impl):
+        """The dispatch-lane funnel for blocking commits: scrub cadence
+        check BEFORE the commit reads device state, dispatch retry with
+        jittered exponential backoff on device faults, and the authoritative
+        mirror advanced after success.  Pass-through (zero new branches
+        beyond one None check) when the fault domain is off."""
+        if self._scrub_mirror is None or self._engine is not None or (
+            len(batch) == 0
+        ):
+            return impl(batch, timestamp)
+        while True:
+            try:
+                self._scrub_maybe_check()
+                results = impl(batch, timestamp)
+                self._device_fault_streak = 0
+                break
+            except DEVICE_FAULT_TYPES as err:
+                recovered = self._on_blocking_device_fault(
+                    operation, batch, timestamp, err
+                )
+                if recovered is not None:
+                    return recovered  # degraded: the host engine committed
+        self._mirror_apply(operation, batch, timestamp)
+        return results
+
+    def _on_blocking_device_fault(self, operation, batch, timestamp, err):
+        """One failed blocking dispatch: quarantine + re-materialize + back
+        off (caller retries), or — at device_fault_limit consecutive
+        failures — degrade to the host engine and commit there.  Returns
+        the results when degraded, None when the caller should retry."""
+        if _obs.enabled:
+            _obs.counter("device_recovery.dispatch_faults").inc()
+        self._device_fault_streak += 1
+        if self._device_fault_streak >= self.device_fault_limit:
+            self._degrade_to_host_engine(err)
+            results = self._engine_commit(operation, batch, timestamp)
+            self._device_fault_streak = 0
+            return results
+        self.quarantine()
+        self._rematerialize_from_mirror()
+        self._retry_backoff()
+        self.device_recoveries += 1
+        if _obs.enabled:
+            _obs.counter("device_recovery.recoveries").inc()
+            _obs.counter("device_recovery.redispatches").inc()
+        return None
+
+    def _device_fault_at_resolve(self, err) -> None:
+        """Deferred-path funnel: the oldest in-flight handle's dispatch (or
+        readback) failed.  Quarantine the whole FIFO lane and re-dispatch
+        EVERY pending run from the mirror via the blocking path (which owns
+        retry/backoff/degrade), storing per-handle results for resolve()."""
+        if _obs.enabled:
+            _obs.counter("device_recovery.dispatch_faults").inc()
+        if self._scrub_mirror is None:
+            raise err
+        self._device_fault_streak += 1
+        if self._device_fault_streak >= self.device_fault_limit:
+            # Let the re-dispatch below run on the host engine directly.
+            self._degrade_to_host_engine(err)
+        self._retry_backoff()
+        self._recover_inflight()
+
+    def _recover_inflight(self) -> None:
+        """Quarantine + rebuild from the mirror, then re-commit every
+        pending deferred run's batches in FIFO (== op) order through the
+        guarded blocking path."""
+        pending = list(self._inflight_handles)
+        self._inflight_handles = []
+        self.quarantine()
+        try:
+            if self._engine is None:
+                self._rematerialize_from_mirror()
+            for handle in pending:
+                if hasattr(handle._result, "result"):
+                    try:
+                        handle._result.result()  # quiesce the dead future
+                    except BaseException:  # tblint: ignore[swallow] quiesced fault
+                        pass
+                assert handle._batches is not None, (
+                    "deferred handle tracked without batch retention"
+                )
+                results = [
+                    self._commit_create_transfers(b, ts)
+                    for b, ts in zip(handle._batches, handle._timestamps)
+                ]
+                handle._recovered = results
+                if handle._stage is not None:
+                    self._stage_release(handle._stage)
+                    handle._stage = None
+        except BaseException:
+            # Recovery itself failed (e.g. escalating to the durable-state
+            # rebuild): the not-yet-recovered handles are already
+            # untracked — quiesce them and release their staging sets so
+            # nothing leaks; the caller's pipeline abort (or the direct
+            # caller) sees the escalation, never a dangling handle.
+            for handle in pending:
+                if handle._recovered is not None:
+                    continue
+                if hasattr(handle._result, "result"):
+                    try:
+                        handle._result.result()
+                    except BaseException:  # tblint: ignore[swallow] quiesced fault
+                        pass
+                if handle._stage is not None:
+                    self._stage_release(handle._stage)
+                    handle._stage = None
+            raise
+        self.device_recoveries += 1
+        if _obs.enabled:
+            _obs.counter("device_recovery.recoveries").inc()
+
+    def _scrub_maybe_check(self) -> None:
+        if not self.scrub_due or self._inflight_handles:
+            return
+        self.scrub_check()
+
+    def scrub_check(self, boundary: bool = False) -> bool:
+        """Compare the on-device fold digests (ops/scrub.scrub_digest — ONE
+        readback through the commit-barrier funnel) against the mirror's
+        expectation.  On mismatch: quarantine, re-materialize the device
+        ledger from the mirror, and verify the rebuild took; a rebuild that
+        still diverges marks the state unrecoverable (the replica escalates
+        to checkpoint + WAL replay).  Returns True when the state was
+        already clean.  ``boundary`` marks a checkpoint-boundary check (a
+        divergence there is a hard integrity violation the capture must
+        never bake in — counted separately)."""
+        model = self._scrub_mirror
+        if model is None or self._scrub_suspect:
+            return True
+        assert not self._inflight_handles, (
+            "scrub requires a settled pipeline"
+        )
+        self._scrub_commits = 0
+        self.scrub_checks += 1
+        if _obs.enabled:
+            _obs.counter("scrub.checks").inc()
+        want = scrub_ops.mirror_digests(model)
+        try:
+            got = np.asarray(
+                self._d2h_codes(scrub_ops.scrub_digest(self.ledger))
+            )
+            ok = int(got[0]) == want[0] and int(got[2]) == want[2] and (
+                self.cold.count != 0 or int(got[1]) == want[1]
+            )
+        except DEVICE_FAULT_TYPES:
+            # The scrub dispatch itself failed: same quarantine/rebuild
+            # path as a mismatch (the re-digest below is the retry).
+            if _obs.enabled:
+                _obs.counter("device_recovery.dispatch_faults").inc()
+            ok = False
+        if ok:
+            return True
+        self.scrub_mismatches += 1
+        if _obs.enabled:
+            _obs.counter("scrub.mismatches").inc()
+            if boundary:
+                _obs.counter("scrub.boundary_mismatches").inc()
+        self.quarantine()
+        self._rematerialize_from_mirror()
+        try:
+            got = np.asarray(
+                self._d2h_codes(scrub_ops.scrub_digest(self.ledger))
+            )
+        except DEVICE_FAULT_TYPES as err:
+            # A second fault during the verification re-digest: escalate
+            # to the durable-state rebuild rather than crash the serving
+            # path with a raw device error.
+            self._scrub_suspect = True
+            raise DeviceStateUnrecoverable(
+                "device fault during post-recovery scrub verification"
+            ) from err
+        if int(got[0]) != want[0] or int(got[2]) != want[2] or (
+            self.cold.count == 0 and int(got[1]) != want[1]
+        ):
+            self._scrub_suspect = True
+            raise DeviceStateUnrecoverable(
+                "scrub mismatch survived re-materialization: mirror suspect"
+            )
+        self.device_recoveries += 1
+        if _obs.enabled:
+            _obs.counter("device_recovery.recoveries").inc()
+            _obs.counter("device_recovery.scrub").inc()
+        return False
+
+    def quarantine(self) -> None:
+        """Quarantine the in-flight device pipeline: drain the FIFO dispatch
+        lane (joining any running closure) and invalidate the cached staging
+        buffers and the zero-count pad-SoA template — after a failed or
+        corrupted dispatch chain, every cached device buffer is suspect."""
+        lane, self._lane = self._lane, None
+        if lane is not None:
+            lane.shutdown(wait=True)
+        self._stage_pool.clear()
+        self._pad_soa_zero.clear()
+
+    def _rematerialize_from_mirror(self) -> None:
+        """Rebuild the device ledger (fresh buffers) from the authoritative
+        mirror and resynchronize the host-side derived state.  Content-
+        exact; table layout is rebuilt (invisible to semantics and to the
+        order-independent digests)."""
+        model = self._scrub_mirror
+        if model is None or self._scrub_suspect:
+            raise DeviceStateUnrecoverable("mirror unavailable or suspect")
+        if self._tiering or self.cold.count:
+            # The mirror holds every transfer but cannot reproduce the
+            # hot/cold split the bloom filter and spill manifest encode.
+            raise DeviceStateUnrecoverable(
+                "cold tier active: mirror re-materialization unsupported"
+            )
+        self._ledger = scrub_ops.materialize_ledger(model, self.config)
+        self._resync_host_state_from_mirror(model)
+
+    def _resync_host_state_from_mirror(self, model) -> None:
+        self._accounts_bound = len(model.accounts)
+        self._transfers_bound = len(model.transfers)
+        self._posted_bound = len(model.posted)
+        self._history_bound = len(model.history)
+        self._history_accounts_possible = any(
+            a.flags & types.AccountFlags.HISTORY
+            for a in model.accounts.values()
+        )
+        self._limit_accounts_possible = any(
+            a.flags & _LIMIT_FLAGS for a in model.accounts.values()
+        )
+        bound = 0
+        for a in model.accounts.values():
+            bound = max(a.debits_pending, a.debits_posted,
+                        a.credits_pending, a.credits_posted, bound)
+        self._balance_bound = min(bound, _BOUND_CLAMP)
+        self.commit_timestamp = max(
+            self.commit_timestamp, model.commit_timestamp
+        )
+        self.index.reset()
+        self.scans_transfers.reset()
+        self.scans_accounts.reset()
+
+    def reset_device_state(self) -> None:
+        """Genesis reset (the replica's checkpoint-free recovery path):
+        fresh empty ledger, derived state cleared.  The prepare clock is
+        PRESERVED — already-issued prepare timestamps must stay monotone."""
+        cfg = self.config
+        self._ledger = sm.make_ledger(
+            cfg.accounts_capacity, cfg.transfers_capacity,
+            cfg.posted_capacity, cfg.history_capacity,
+        )
+        self.commit_timestamp = 0
+        self._accounts_bound = self._transfers_bound = 0
+        self._posted_bound = self._history_bound = 0
+        self._history_accounts_possible = False
+        self._limit_accounts_possible = False
+        self._balance_bound = 0
+        self.index.reset()
+        self.scans_transfers.reset()
+        self.scans_accounts.reset()
+
+    def _retry_backoff(self) -> None:
+        """Jittered exponential backoff between re-dispatch attempts
+        (vsr/timeout.py Timeout — the same discipline replica retries use).
+        Sleeps retry_tick_s per tick; 0 (the sim) skips the sleep, keeping
+        virtual-time replay deterministic (the jitter prng feeds only the
+        sleep duration, never state)."""
+        if self._retry_timeout is None:
+            from .vsr.timeout import Timeout
+
+            self._retry_timeout = Timeout(
+                self._retry_prng, base_ticks=1, max_ticks=64
+            )
+        ticks = self._retry_timeout.next_backoff()
+        if _obs.enabled:
+            _obs.counter("device_recovery.retries").inc()
+        if self.retry_tick_s > 0:
+            _time.sleep(ticks * self.retry_tick_s)  # tblint: ignore[nondet] backoff sleep
+
+    def _degrade_to_host_engine(self, err) -> None:
+        """After device_fault_limit consecutive dispatch failures: stop
+        trusting the device entirely and serve from the native host engine
+        over a ledger rebuilt from the mirror — a RuntimeWarning, not a
+        wedge (the DEGRADED_DEVICE_COUNT discipline in jaxenv.py)."""
+        from .host_engine import HostEngine, HostLedger, engine_available
+
+        model = self._scrub_mirror
+        if model is None or self._scrub_suspect:
+            raise DeviceStateUnrecoverable(
+                "device failing and mirror unavailable"
+            ) from err
+        if self._tiering or self.cold.count or (
+            self.hot_transfers_capacity_max is not None
+        ):
+            raise DeviceStateUnrecoverable(
+                "device failing under tiering: host engine cannot take over"
+            ) from err
+        if not engine_available():
+            raise DeviceStateUnrecoverable(
+                "device failing and the native host engine is unavailable"
+            ) from err
+        self.quarantine()
+        self._host_led = scrub_ops.build_host_ledger(model, self.config)
+        self._engine = HostEngine(self._host_led, self.config.max_probe)
+        self._resync_host_state_from_mirror(model)
+        self._index_stale = True
+        self._device_stale = True
+        self._ledger = None  # lazily re-materialized for queries/checkpoints
+        self.scrub_disarm()  # the host ledger IS the authority now
+        self.degraded_to_host_engine = True
+        if _obs.enabled:
+            _obs.counter("device_recovery.degraded").inc()
+        warnings.warn(
+            f"device dispatch failed {self.device_fault_limit} consecutive "
+            f"times ({err!r}); degraded to the native host engine "
+            "(device path disabled for this process)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
 
     # -- host-engine mode (host_engine.py) -----------------------------------
 
@@ -584,6 +1092,14 @@ class TpuStateMachine:
     def _commit_create_accounts(
         self, batch: np.ndarray, timestamp: int
     ) -> List[Tuple[int, int]]:
+        return self._guarded_commit(
+            "create_accounts", batch, timestamp,
+            self._commit_create_accounts_impl,
+        )
+
+    def _commit_create_accounts_impl(
+        self, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
         count = len(batch)
         if count == 0:
             return []
@@ -633,6 +1149,14 @@ class TpuStateMachine:
     def _commit_create_transfers(
         self, batch: np.ndarray, timestamp: int
     ) -> List[Tuple[int, int]]:
+        return self._guarded_commit(
+            "create_transfers", batch, timestamp,
+            self._commit_create_transfers_impl,
+        )
+
+    def _commit_create_transfers_impl(
+        self, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
         count = len(batch)
         if count == 0:
             return []
@@ -675,6 +1199,7 @@ class TpuStateMachine:
             # (the codes transfer below rides an already-complete dispatch)
             # — time it here or the e2e decomposition misses the general
             # kernel's whole device wait.
+            self._injected_fault_check()
             t0 = _time.perf_counter()
             kflags = int(kflags)
             wait = _time.perf_counter() - t0
@@ -916,6 +1441,7 @@ class TpuStateMachine:
         if timestamps[-1] > self.prepare_timestamp:
             # Replay/backup parity with commit_batch's clock catch-up.
             self.prepare_timestamp = timestamps[-1]
+        self._scrub_maybe_check()  # no-op unless armed, due, and lane idle
         k = len(batches)
         stacked, stage = self._stage_group(batches)
         cnt = jnp.asarray(
@@ -948,12 +1474,16 @@ class TpuStateMachine:
                 )
             return codes, overflow
 
+        armed = self._scrub_mirror is not None
         result = self._dispatch_lane().submit(dispatch) if deferred else (
             dispatch()
         )
         handle = DeviceCommitHandle(
             self, result, counts, timestamps, stacked=True, stage=stage,
+            batches=list(batches) if armed else None,
         )
+        if armed:
+            self._inflight_handles.append(handle)
         if deferred:
             return handle
         return handle.resolve()  # ONE D2H for the whole group
@@ -1012,6 +1542,7 @@ class TpuStateMachine:
         if timestamp > self.prepare_timestamp:
             # Replay/backup parity with commit_batch's clock catch-up.
             self.prepare_timestamp = timestamp
+        self._scrub_maybe_check()  # no-op unless armed, due, and lane idle
         if _obs.enabled:
             _obs.histogram("ops.batch_fill_pct", "%").observe(
                 100 * count // self.batch_lanes
@@ -1032,10 +1563,15 @@ class TpuStateMachine:
             )
             return codes, overflow
 
+        armed = self._scrub_mirror is not None
         fut = self._dispatch_lane().submit(dispatch)
-        return DeviceCommitHandle(
+        handle = DeviceCommitHandle(
             self, fut, [count], [timestamp], stacked=False,
+            batches=[batch] if armed else None,
         )
+        if armed:
+            self._inflight_handles.append(handle)
+        return handle
 
     def _maybe_evict_between_batches(self) -> None:
         hot_max = self.hot_transfers_capacity_max
@@ -1697,6 +2233,10 @@ class TpuStateMachine:
         self.scans_transfers.reset()
         self.scans_accounts.reset()
         self._index_stale = False
+        if self._scrub_mirror is not None:
+            # The new ledger is digest-verified by the caller (checkpoint
+            # restore / state-sync install): reseed the mirror from it.
+            self.scrub_arm()
 
     # -- parity surface ------------------------------------------------------
 

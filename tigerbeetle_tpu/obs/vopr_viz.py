@@ -34,7 +34,8 @@ CELL_WIDTH = 14
 LEGEND = (
     "legend: * primary  . backup  v view-change  r recovering  "
     "! log-suspect  s standby  x down  - retired;  "
-    "cell = symbol view : commit_min / op"
+    "cell = symbol view : commit_min / op "
+    "(+Sn = n device scrub/dispatch recoveries)"
 )
 
 
@@ -59,11 +60,19 @@ def node_cell(replica, alive: bool, is_standby: bool) -> str:
     sym = status_symbol(replica, alive, is_standby)
     if replica is None or not alive:
         return sym
-    return (
+    cell = (
         f"{sym}{getattr(replica, 'view', 0)}"
         f":{getattr(replica, 'commit_min', 0)}"
         f"/{getattr(replica, 'op', 0)}"
     )
+    # Device fault domain events (docs/fault_domains.md): a replica that
+    # detected SDC or survived a dispatch failure shows its recovery count
+    # — the grid line where +Sn first appears IS the recovery tick.
+    machine = getattr(replica, "machine", None)
+    recoveries = getattr(machine, "device_recoveries", 0)
+    if recoveries:
+        cell += f"+S{recoveries}"
+    return cell
 
 
 class ClusterViz:

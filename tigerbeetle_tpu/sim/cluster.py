@@ -203,6 +203,7 @@ class SimCluster:
         hot_transfers_capacity_max: Optional[int] = None,
         n_standbys: int = 0,
         viz: bool = False,
+        scrub_interval: int = 0,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
@@ -220,6 +221,11 @@ class SimCluster:
         # Optional cold-tier cap: evictions + rehydration run under
         # consensus and crash/restart (BASELINE config-4 tiering).
         self.hot_transfers_capacity_max = hot_transfers_capacity_max
+        # Device fault domain (docs/fault_domains.md): 0 = off (default —
+        # pinned seeds replay bit-identically); N arms every replica's
+        # scrub mirror at cadence N, enabling SDC detection and dispatch
+        # recovery under the injectors below.
+        self.scrub_interval = scrub_interval
         self.rng = random.Random(seed)
         self.net = net or PacketSimulator(seed=seed + 1)
         self.t = 0
@@ -335,7 +341,10 @@ class SimCluster:
             seed=self.seed * 31 + i,
             hash_log=self.hash_logs[i],
             hot_transfers_capacity_max=self.hot_transfers_capacity_max,
+            scrub_interval=self.scrub_interval,
         )
+        # Virtual time: device-recovery backoff must never wall-sleep.
+        replica.machine.retry_tick_s = 0
         if self.auditor is not None:
             def observe(op, operation, ts, body, results, replay, i=i):
                 self.auditor.observe_commit(
@@ -387,6 +396,22 @@ class SimCluster:
         self.storages[voter_slot] = self.storages[standby]
         self.hash_logs[voter_slot] = self.hash_logs[standby]
         self.start(voter_slot)
+
+    def inject_device_sdc(self, i: int, rng) -> bool:
+        """Flip one seeded bit in replica ``i``'s device-resident ledger
+        (the device-SDC fault kind; sim/vopr.py schedules it).  Returns
+        False when the replica is down or holds no live account yet."""
+        if not self.alive[i] or self.replicas[i] is None:
+            return False
+        return self.replicas[i].machine.inject_sdc_bitflip(rng)
+
+    def inject_dispatch_fault(self, i: int, n: int = 1) -> bool:
+        """Arm ``n`` forced dispatch exceptions on replica ``i``'s machine
+        (the next n device readbacks raise through the dispatch funnel)."""
+        if not self.alive[i] or self.replicas[i] is None:
+            return False
+        self.replicas[i].machine.inject_device_faults(n)
+        return True
 
     def partition(self, groups: List[List[int]]) -> None:
         self.net.partition([[("replica", r) for r in g] for g in groups])

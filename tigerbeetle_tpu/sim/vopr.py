@@ -50,6 +50,8 @@ def run_seed(
     settle_ticks: int = 60_000,
     standbys: Optional[int] = 0,
     viz: Optional[bool] = None,
+    scrub_interval: int = 0,
+    device_faults: bool = False,
 ) -> VoprResult:
     """One VOPR run: random topology + faults from ``seed``.
 
@@ -60,7 +62,16 @@ def run_seed(
 
     ``viz``: record the one-line-per-event cluster status grid
     (obs/vopr_viz) into the result — read-only over the cluster, so it
-    never shifts a schedule.  None defers to the TB_VOPR_VIZ env var."""
+    never shifts a schedule.  None defers to the TB_VOPR_VIZ env var.
+
+    ``device_faults`` (opt-in, default off so every pinned seed replays
+    bit-identically): schedule the DEVICE fault kind — seeded SDC bit
+    flips into live ledger columns plus forced dispatch exceptions — from
+    a SEPARATE rng stream at mid-run ticks.  True injects both families;
+    ``"sdc"`` / ``"dispatch"`` restricts to one (the load-bearing negative
+    control injects SDC alone: with ``scrub_interval`` 0 the flip must
+    demonstrably fail the audit/conservation/convergence oracles, proving
+    the scrub — which makes the same seed pass — is what contains it)."""
     if viz is None:
         viz = bool(os.environ.get("TB_VOPR_VIZ"))
     rng = random.Random(seed)
@@ -87,6 +98,27 @@ def run_seed(
     # seed's fault schedule.
     hot_cap = random.Random(seed ^ 0xC01D).choice([None, None, None, 128])
     partition_modes = ["isolate_single", "uniform_size", "uniform_partition"]
+    # Device fault kind (opt-in; docs/fault_domains.md): schedule drawn
+    # from a SEPARATE stream so arming it cannot shift the base schedule,
+    # and tiering is forced off — mirror re-materialization does not cover
+    # the hot/cold split, so SDC recovery under tiering routes to the
+    # checkpoint+WAL fallback, which these schedules don't exercise.
+    dev_rng = random.Random(seed ^ 0xD5DC) if device_faults else None
+    sdc_ticks: set = set()
+    dispatch_fault_ticks: set = set()
+    if dev_rng is not None:
+        hot_cap = None
+        kinds = (
+            {"sdc", "dispatch"} if device_faults is True
+            else {str(device_faults)}
+        )
+        window = range(max(1, ticks // 4), max(2, (3 * ticks) // 4))
+        # Both schedules ALWAYS draw (stream stability across kinds); only
+        # the selected kinds actuate.
+        sdc_draw = set(dev_rng.sample(window, k=min(2, len(window))))
+        dispatch_draw = set(dev_rng.sample(window, k=min(1, len(window))))
+        sdc_ticks = sdc_draw if "sdc" in kinds else set()
+        dispatch_fault_ticks = dispatch_draw if "dispatch" in kinds else set()
 
     def go(workdir: str) -> VoprResult:
         cluster = SimCluster(
@@ -101,6 +133,7 @@ def run_seed(
             hot_transfers_capacity_max=hot_cap,
             n_standbys=standbys,
             viz=viz,
+            scrub_interval=scrub_interval,
         )
 
         def done(result: VoprResult) -> VoprResult:
@@ -140,6 +173,27 @@ def run_seed(
         try:
             for t in range(ticks):
                 cluster.step()
+                if dev_rng is not None:
+                    # Device fault kind — actuated AFTER the schedule rng
+                    # below never sees it (separate stream, no draws from
+                    # ``rng``), so base schedules stay bit-identical.
+                    live = [
+                        i for i in range(cluster.total) if cluster.alive[i]
+                    ]
+                    if t in sdc_ticks and live:
+                        victim = live[dev_rng.randrange(len(live))]
+                        if cluster.inject_device_sdc(victim, dev_rng):
+                            faults += 1
+                            if _obs.enabled:
+                                _obs.counter("vopr.faults.device_sdc").inc()
+                    if t in dispatch_fault_ticks and live:
+                        victim = live[dev_rng.randrange(len(live))]
+                        if cluster.inject_dispatch_fault(victim):
+                            faults += 1
+                            if _obs.enabled:
+                                _obs.counter(
+                                    "vopr.faults.dispatch_fault"
+                                ).inc()
                 # Random fault events (simulator.zig crash/partition probs).
                 r = rng.random()
                 voters_down = sum(1 for d in down if d < n_replicas)

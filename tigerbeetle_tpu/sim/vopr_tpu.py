@@ -170,17 +170,31 @@ def draw_faults(
     p_corrupt: float = 0.2,
     p_repartition: float = 0.05,
     p_amputate: float = 0.15,
+    p_sdc: float = 0.0,
 ) -> dict:
     """One step's fault/schedule draws as a plain dict of arrays.
 
     Split out of step() so a cross-validation harness can extract the
     EXACT schedule (tools/vopr_crossval.py replays it against the real
-    consensus code in sim/cluster.py) or script its own."""
+    consensus code in sim/cluster.py) or script its own.
+
+    ``p_sdc`` (default 0: existing schedules stay bit-identical): SILENT
+    at-rest bit flips in a RUNNING replica's prepare ring — unlike the
+    crash-time corrupt fault, nothing marks the slot damaged.  The scrub
+    defense (log-vs-headers comparison each step) converts silent damage
+    to detectable CORRUPT; the ``scrub_off`` bug disables it, and the
+    oracle must catch the resulting committed-history corruption.  The
+    draws derive via fold_in, never from the main split, so enabling the
+    dimension cannot shift any existing schedule."""
     R, S = n_replicas, slots
     (k_crash, k_restart, k_cgate, k_cslot, k_part, k_append, k_link, k_vc,
      k_sync, k_amp) = jax.random.split(key, 10)
     k_pm, k_pg, k_ps, k_pw = jax.random.split(k_part, 4)
+    k_sdc = jax.random.fold_in(key, 0x5DC)
+    k_sdc_gate, k_sdc_slot = jax.random.split(k_sdc)
     return dict(
+        sdc=jax.random.bernoulli(k_sdc_gate, p_sdc, (R,)),
+        sdc_slot=jax.random.randint(k_sdc_slot, (R,), 0, S),
         crash=jax.random.bernoulli(k_crash, p_crash, (R,)),
         restart=jax.random.bernoulli(k_restart, p_restart, (R,)),
         corrupt_gate=jax.random.bernoulli(k_cgate, p_corrupt, (R,)),
@@ -215,6 +229,7 @@ def step(
     p_corrupt: float = 0.2,
     p_repartition: float = 0.05,
     p_amputate: float = 0.15,
+    p_sdc: float = 0.0,
     bug: Optional[str] = None,
     faults: Optional[dict] = None,
 ) -> ClusterState:
@@ -235,7 +250,7 @@ def step(
             key, R, S, p_crash=p_crash, p_restart=p_restart,
             p_append=p_append, p_link=p_link, p_view_change=p_view_change,
             p_corrupt=p_corrupt, p_repartition=p_repartition,
-            p_amputate=p_amputate,
+            p_amputate=p_amputate, p_sdc=p_sdc,
         )
     rids = jnp.arange(R)
     sidx = jnp.arange(S)[None, :]
@@ -276,6 +291,28 @@ def step(
     log_op = jnp.where(amp_hit, 0, log_op)
     op = jnp.where(amputate, amp_floor, op)
     alive = status == 0
+
+    # 1b. SILENT at-rest SDC (the device fault domain's model twin): a
+    # running replica's prepare ring flips one bit with NOTHING marking
+    # the slot damaged — the headers ring is the independent truth.  The
+    # SCRUB pass right below compares rings every step and converts silent
+    # damage to detectable CORRUPT (repaired by the existing machinery);
+    # the scrub_off bug disables exactly that pass, and the oracle must
+    # then catch the flipped entry being served/committed as canon — the
+    # load-bearing proof that scrubbing, not luck, is what contains SDC.
+    sdc_hit = (
+        faults["sdc"][:, None] & alive[:, None]
+        & (sidx == faults["sdc_slot"][:, None])
+        & (log_op >= 1) & (log != 0) & (log != CORRUPT)
+    )
+    # Entry ids always carry bit 0 (see _entry): ^2 yields a DIFFERENT
+    # nonzero id with the top bit still clear — never 0, never CORRUPT.
+    log = jnp.where(sdc_hit, log ^ jnp.uint32(2), log)
+    if bug != "scrub_off":
+        silent_damage = (
+            (log != log_hdr) & (log != 0) & (log_hdr != 0) & (log != CORRUPT)
+        )
+        log = jnp.where(silent_damage, CORRUPT, log)
 
     # 2. Partitions (packet_simulator.zig modes): persistent across steps,
     # re-sampled with p_repartition.  conn[i,j]: i can exchange with j.
@@ -647,6 +684,10 @@ BUGS = (
     # - join_keep_stale: a joiner keeps stale ring content below the SV
     #   window and trusts it as verified (the verification-floor find).
     "amputate_vouch", "join_keep_stale",
+    # Round-6: the device-fault-domain twin — scrub_off disables the
+    # per-step ring scrub, so silent at-rest SDC (p_sdc) is served and
+    # committed instead of detected (run with p_sdc > 0 to exercise).
+    "scrub_off",
 )
 
 # The harsh fault schedule certified clean by tests/test_vopr.py and

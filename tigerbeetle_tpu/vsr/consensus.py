@@ -344,6 +344,10 @@ class VsrReplica(Replica):
         else:
             self.status = RECOVERING
             self._recovering_since = self._ticks
+        # Arm the device fault domain from this digest-verified state; the
+        # ops the cluster re-commits from here advance the mirror like any
+        # other commit.  No-op at scrub interval 0.
+        self.machine.scrub_arm()
 
     def _load_chain(self, recovery) -> None:
         """Rebuild the in-memory hash chain from the WAL without executing:

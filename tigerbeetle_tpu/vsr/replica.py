@@ -28,7 +28,7 @@ import numpy as np
 
 from .. import types
 from ..config import ClusterConfig, LedgerConfig
-from ..machine import TpuStateMachine
+from ..machine import DeviceStateUnrecoverable, TpuStateMachine
 from ..obs.metrics import registry as _obs
 from ..utils.tracer import tracer
 from . import checkpoint as checkpoint_mod
@@ -93,6 +93,7 @@ class Replica:
         hot_transfers_capacity_max: Optional[int] = None,
         process_config=None,
         host_engine: bool = False,
+        scrub_interval: Optional[int] = None,
     ) -> None:
         self.data_path = data_path
         # Optional determinism oracle (utils/hash_log.OpHashLog): per-commit
@@ -148,6 +149,11 @@ class Replica:
             # path (per-commit digests + tiering live there).
             host_engine=host_engine,
         )
+        if scrub_interval is not None:
+            # Device fault domain cadence (docs/fault_domains.md); the
+            # mirror arms at the end of open(), once the restored state is
+            # digest-verified and the WAL replayed.
+            self.machine.scrub_interval = scrub_interval
 
         self.cluster = 0
         self.replica = 0
@@ -273,6 +279,9 @@ class Replica:
         recovery = self._open_durable_state()
         # Establish the head: the highest hash-chained op from the checkpoint.
         self._replay(recovery)
+        # Arm the device fault domain from this VERIFIED state (checkpoint
+        # digest checked + checksummed WAL replayed).  No-op at interval 0.
+        self.machine.scrub_arm()
 
     def _open_durable_state(self):
         """Superblock quorum read + checkpoint snapshot load + journal scan
@@ -288,51 +297,10 @@ class Replica:
         self.op_checkpoint = sb.op_checkpoint
         self.commit_min = sb.op_checkpoint
 
-        if sb.op_checkpoint > 0 or sb.checkpoint_file_checksum != 0:
-            if sb.manifest_checksum:
-                try:
-                    ledger, meta = self.forest.open(
-                        sb.op_checkpoint, sb.manifest_checksum
-                    )
-                except (OSError, RuntimeError, ValueError, KeyError) as err:
-                    # Only now pay for a full verify pass (the happy path
-                    # reads each file exactly once): enumerate what is
-                    # damaged so consensus can fetch it from peers.
-                    damage = self.forest.verify(
-                        sb.op_checkpoint, sb.manifest_checksum
-                    )
-                    if damage:
-                        raise ForestDamage(damage) from err
-                    raise
-            else:  # legacy full-snapshot checkpoint (no manifest)
-                ledger, meta = checkpoint_mod.load(
-                    self.data_path, sb.op_checkpoint, sb.checkpoint_file_checksum
-                )
-                # Seed the forest so state-sync can materialize this
-                # checkpoint and the next checkpoint goes delta.
-                self.forest.seed_base(
-                    ledger, sb.op_checkpoint, sb.checkpoint_file_checksum
-                )
-            self.machine.ledger = ledger
-            try:
-                self.machine.restore_host_state(meta["machine"])
-            except (OSError, RuntimeError, AssertionError) as err:
-                # Cold-tier spill files are checkpoint state too: a restart
-                # whose durable manifest references a missing/corrupt cold
-                # run (crash between a sync install and its cold fetch, or
-                # a damaged disk) must route to peer block repair like any
-                # other checkpoint file — round-5 standby-sweep find: this
-                # crashed the replica (and the whole sweep) instead.
-                damage, cold_paths = self._verify_cold(meta)
-                if damage:
-                    raise ForestDamage(damage, cold_paths=cold_paths) from err
-                raise
-            digest = self.machine.digest()
-            if digest != sb.ledger_digest:
-                raise RuntimeError(
-                    f"checkpoint digest mismatch: ledger {digest:#x} != "
-                    f"superblock {sb.ledger_digest:#x}"
-                )
+        loaded = self._load_checkpoint_state(sb)
+        if loaded is not None:
+            ledger, meta = loaded
+            self._install_checkpoint_ledger(ledger, meta, sb)
             self.sessions = {
                 int(client_hex, 16): Session(
                     client=int(client_hex, 16),
@@ -345,6 +313,64 @@ class Replica:
             }
 
         return self.journal.recover()
+
+    def _load_checkpoint_state(self, sb) -> Optional[tuple]:
+        """(ledger, meta) from the durable checkpoint, or None when no
+        checkpoint exists (genesis).  Damage maps to ForestDamage (peer-
+        repairable); shared by open() and recover_device_state()."""
+        if sb is None or not (
+            sb.op_checkpoint > 0 or sb.checkpoint_file_checksum != 0
+        ):
+            return None
+        if sb.manifest_checksum:
+            try:
+                return self.forest.open(
+                    sb.op_checkpoint, sb.manifest_checksum
+                )
+            except (OSError, RuntimeError, ValueError, KeyError) as err:
+                # Only now pay for a full verify pass (the happy path
+                # reads each file exactly once): enumerate what is
+                # damaged so consensus can fetch it from peers.
+                damage = self.forest.verify(
+                    sb.op_checkpoint, sb.manifest_checksum
+                )
+                if damage:
+                    raise ForestDamage(damage) from err
+                raise
+        # Legacy full-snapshot checkpoint (no manifest).
+        ledger, meta = checkpoint_mod.load(
+            self.data_path, sb.op_checkpoint, sb.checkpoint_file_checksum
+        )
+        # Seed the forest so state-sync can materialize this
+        # checkpoint and the next checkpoint goes delta.
+        self.forest.seed_base(
+            ledger, sb.op_checkpoint, sb.checkpoint_file_checksum
+        )
+        return ledger, meta
+
+    def _install_checkpoint_ledger(self, ledger, meta, sb) -> None:
+        """Swap the checkpoint snapshot into the machine and verify its
+        digest against the superblock anchor."""
+        self.machine.ledger = ledger
+        try:
+            self.machine.restore_host_state(meta["machine"])
+        except (OSError, RuntimeError, AssertionError) as err:
+            # Cold-tier spill files are checkpoint state too: a restart
+            # whose durable manifest references a missing/corrupt cold
+            # run (crash between a sync install and its cold fetch, or
+            # a damaged disk) must route to peer block repair like any
+            # other checkpoint file — round-5 standby-sweep find: this
+            # crashed the replica (and the whole sweep) instead.
+            damage, cold_paths = self._verify_cold(meta)
+            if damage:
+                raise ForestDamage(damage, cold_paths=cold_paths) from err
+            raise
+        digest = self.machine.digest()
+        if digest != sb.ledger_digest:
+            raise RuntimeError(
+                f"checkpoint digest mismatch: ledger {digest:#x} != "
+                f"superblock {sb.ledger_digest:#x}"
+            )
 
     def _verify_cold(self, meta) -> tuple:
         """Enumerate damaged cold-tier run files referenced by a
@@ -424,7 +450,8 @@ class Replica:
     def on_request(self, header: np.ndarray, body: bytes) -> List[bytes]:
         """Handle a verified client request; returns wire messages to send
         back (replica.zig on_request :1308-1337 + commit_op :3678-3836)."""
-        self._pipeline_settle()  # strict op order vs any pipelined group
+        self._settle_or_recover()  # strict op order vs any pipelined group
+        self._scrub_poll()
         client = wire.u128(header, "client")
         try:
             operation = wire.Operation(int(header["operation"]))
@@ -531,6 +558,7 @@ class Replica:
         out: List[List[bytes]] = [[] for _ in requests]
         admitted: List[Tuple[int, wire.Operation, np.ndarray, bytes]] = []
         self._checkpoint_poll()
+        self._scrub_poll()  # group boundary: the scrub cadence's home
         # Clients with an op in the still-pending group: their session
         # state (request number, stored reply) is not yet updated, so a
         # resend could double-commit — drop, the client retries (the
@@ -812,9 +840,21 @@ class Replica:
         due.  No-op when nothing is pending.  Called by the bus when the
         request queue idles, by every blocking commit entry point, and by
         close()."""
-        self._pipeline_settle()
+        self._settle_or_recover()
         if self._checkpoint_due():
             self.checkpoint()
+
+    def _settle_or_recover(self) -> None:
+        """_pipeline_settle, routing a device-fault escalation raised while
+        resolving deferred handles (mirror suspect / cold tier active —
+        DeviceStateUnrecoverable) into the durable-state rebuild instead of
+        crashing the serving path.  The failed group was already aborted by
+        the settle (reply promises failed, clients retry); recovery
+        restores the committed prefix and serving continues."""
+        try:
+            self._pipeline_settle()
+        except DeviceStateUnrecoverable:
+            self.recover_device_state()
 
     def _pipeline_settle(self) -> None:
         """Resolve all in-flight handles + pending bookkeeping WITHOUT the
@@ -1133,6 +1173,18 @@ class Replica:
     def _execute(
         self, operation: wire.Operation, body: bytes, timestamp: int
     ) -> bytes:
+        try:
+            return self._execute_inner(operation, body, timestamp)
+        except DeviceStateUnrecoverable:
+            # The machine's in-process mirror recovery could not apply
+            # (mirror suspect / cold tier active): rebuild from durable
+            # state — the fault domain's last resort — and re-execute.
+            self.recover_device_state()
+            return self._execute_inner(operation, body, timestamp)
+
+    def _execute_inner(
+        self, operation: wire.Operation, body: bytes, timestamp: int
+    ) -> bytes:
         if operation == wire.Operation.create_accounts:
             batch = np.frombuffer(body, dtype=types.ACCOUNT_DTYPE)
             results = self.machine.commit_batch("create_accounts", batch, timestamp)
@@ -1316,7 +1368,15 @@ class Replica:
         replica capturing at identical ops."""
         # A capture must never see a ledger ahead of commit_min: settle any
         # pipelined group first (no-op on the paths that already did).
-        self._pipeline_settle()
+        self._settle_or_recover()
+        if self.machine.scrub_armed:
+            # Checkpoint boundary: ALWAYS scrub (docs/fault_domains.md) —
+            # a device-vs-mirror divergence here is a hard integrity
+            # violation the capture must never bake into durable state.
+            try:
+                self.machine.scrub_check(boundary=True)
+            except DeviceStateUnrecoverable:
+                self.recover_device_state()
         if self.async_checkpoint:
             self._checkpoint_poll()
             if self._ckpt_thread is not None:
@@ -1584,6 +1644,73 @@ class Replica:
         while self._ckpt_thread is not None:
             self._ckpt_thread.join()
             self._checkpoint_poll()  # adopts; starts the next queued write
+
+    # -- device fault domain (docs/fault_domains.md) --------------------------
+
+    def _scrub_poll(self) -> None:
+        """Run a due scrub check at a commit-group boundary (the cadence
+        knob: machine.scrub_interval / --scrub-interval).  Settles the
+        pipelined commit engine first — the fold must see a quiesced
+        ledger — and escalates an unrecoverable mismatch to the durable-
+        state rebuild."""
+        m = self.machine
+        if not m.scrub_armed or not m.scrub_due:
+            return
+        self._settle_or_recover()
+        try:
+            m.scrub_check()
+        except DeviceStateUnrecoverable:
+            self.recover_device_state()
+
+    def recover_device_state(self) -> None:
+        """Last-resort device-state recovery: rebuild the machine from the
+        durable checkpoint + WAL replay — the restart recovery path, run
+        in process (the fault domain's fallback when the mirror itself is
+        suspect or cannot re-materialize, e.g. under the cold tier).
+
+        Sessions, the WAL, and all host-side replica state are intact (the
+        fault domain covers only device-resident state); only the machine's
+        ledger and derived state are rebuilt.  The prepare clock is
+        preserved: already-journaled prepares above commit_min keep their
+        timestamps monotone."""
+        m = self.machine
+        if _obs.enabled:
+            _obs.counter("device_recovery.wal_replays").inc()
+        prepare_timestamp = m.prepare_timestamp
+        m.scrub_disarm()
+        m.quarantine()
+        sb = self._sb_state
+        loaded = self._load_checkpoint_state(sb)
+        if loaded is not None:
+            ledger, meta = loaded
+            self._install_checkpoint_ledger(ledger, meta, sb)
+            floor = sb.op_checkpoint
+        else:
+            m.reset_device_state()
+            floor = 0
+        recovery = self.journal.recover()
+        for op in range(floor + 1, self.commit_min + 1):
+            entry = recovery.entries.get(op)
+            if entry is None or entry.body is None:
+                raise RuntimeError(
+                    f"device-state recovery: committed op {op} unreadable "
+                    "from the WAL"
+                )
+            operation = wire.Operation(int(entry.header["operation"]))
+            name = _OP_NAMES.get(operation)
+            if name is None:
+                continue  # register/lookup/query ops: no machine state
+            dtype = (
+                types.ACCOUNT_DTYPE if name == "create_accounts"
+                else types.TRANSFER_DTYPE
+            )
+            m.commit_batch(
+                name, np.frombuffer(entry.body, dtype=dtype),
+                int(entry.header["timestamp"]),
+            )
+        m.prepare_timestamp = max(m.prepare_timestamp, prepare_timestamp)
+        m.device_recoveries += 1
+        m.scrub_arm()  # re-arm from the freshly verified state
 
     def close(self) -> None:
         self._pipeline_settle()

@@ -79,3 +79,13 @@ class Timeout:
         self._last = now
         self._interval = self._compute()
         return True
+
+    def next_backoff(self) -> int:
+        """Clockless retry helper: record one more failed attempt and
+        return the next jittered backed-off interval in ticks.  For retry
+        loops that sleep rather than poll a tick clock (client reconnect,
+        the machine's device re-dispatch); reset() returns to the base
+        after progress, exactly as with fired()."""
+        self.attempts += 1
+        self._interval = self._compute()
+        return self._interval

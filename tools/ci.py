@@ -83,6 +83,7 @@ TIERS = {
             "tests/test_durability.py", "tests/test_adversary.py",
             "tests/test_fuzz.py", "tests/test_block_repair.py",
             "tests/test_cold_consensus.py", "tests/test_storage_direct.py",
+            "tests/test_scrub.py",
         ],
         extra=["-m", "not slow"],
     ),
@@ -102,6 +103,13 @@ TIERS = {
         # Artifact: PIPELINE_SMOKE.json at the repo root.
         cmd=["tools/pipeline_smoke.py"],
     ),
+    "scrub": dict(
+        # Device fault domain smoke (docs/fault_domains.md): one seeded
+        # bitflip -> detection + recovery + final digest identity, the
+        # scrub-off negative control, and a forced-dispatch retry.
+        # Artifact: SCRUB_SMOKE.json at the repo root.
+        cmd=["tools/scrub_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -114,6 +122,10 @@ TIERS = {
             "tests/test_longhaul.py",
             "tests/test_vopr.py::test_vopr_standby_sweep",
             "tests/test_pipeline.py::test_vopr_seed_stable_under_pipeline",
+            "tests/test_scrub.py::TestScrubDigest::"
+            "test_no_false_positives_across_depths_and_grouping",
+            "tests/test_scrub.py::TestVoprTpuScrub::"
+            "test_scrub_off_bug_is_caught",
             "tests/test_sharded.py::test_sharded_full_kernel_two_phase_parity",
             "tests/test_sharded.py::test_sharded_full_kernel_random_stream",
             "tests/test_block_repair.py::"
@@ -128,7 +140,7 @@ TIERS = {
 }
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
-    "integration",
+    "scrub", "integration",
 ]
 
 
