@@ -30,6 +30,14 @@ export class ClientEvictedError extends Error {
   }
 }
 
+/** Internal: capacity eviction (reason != session-mismatch) — retryable;
+ * the request loop re-registers a fresh session within the deadline. */
+class SessionEvictedRetry extends Error {
+  constructor() {
+    super("tigerbeetle: session capacity-evicted (re-registering)");
+  }
+}
+
 /**
  * The request's deadline expired with no matching reply.  The request MAY
  * still commit server-side: the session's request number was not advanced,
@@ -60,7 +68,16 @@ interface Pending {
   resolve: (r: { view: DataView; body: Uint8Array }) => void;
   reject: (err: Error) => void;
   deadline: number;
+  /** Consecutive busy replies for this request (exponential backoff). */
+  busyAttempts: number;
 }
+
+/** One client backoff tick (client.py RETRY_TICK_S). */
+const RETRY_TICK_MS = 50;
+/** One SERVER retry-after hint tick: the consensus cadence (config
+ *  tick_ms = 10; wire BUSY_DTYPE "~10 ms each") — NOT the client's 50 ms
+ *  backoff tick.  Convert each at its own cadence; compare durations. */
+const HINT_TICK_MS = 10;
 
 const BATCH_MAX = Math.floor((wire.MESSAGE_SIZE_MAX - wire.HEADER_SIZE) / 128);
 
@@ -148,7 +165,6 @@ export class Client {
     const run = this.chain.then(async () => {
       if (this.evicted) throw new ClientEvictedError();
       if (this.closed) throw new Error("tigerbeetle: client closed");
-      if (this.session === 0n) await this.register();
       return this.requestLocked(operation, body);
     });
     // Keep the chain alive through failures (next caller still runs).
@@ -156,7 +172,7 @@ export class Client {
     return run;
   }
 
-  private async register(): Promise<void> {
+  private async register(deadline?: number): Promise<void> {
     if (this.registering) return this.registering;
     this.registering = (async () => {
       const message = wire.encodeRequest(
@@ -167,7 +183,7 @@ export class Client {
         new Uint8Array(0),
       );
       const requestChecksum = wire.headerChecksum(message);
-      const { view } = await this.roundtrip(message, requestChecksum);
+      const { view } = await this.roundtrip(message, requestChecksum, deadline);
       // The register reply's op (== commit) is the session number.
       this.session = view.getBigUint64(wire.OFF_REP_OP, true);
       this.parent = requestChecksum;
@@ -183,29 +199,64 @@ export class Client {
   private async requestLocked(
     operation: number, body: Uint8Array,
   ): Promise<Uint8Array> {
-    const message = wire.encodeRequest(
-      {
-        cluster: this.cluster, client: this.clientId, parent: this.parent,
-        session: this.session, request: this.requestNumber, operation,
-      },
-      body,
-    );
-    const requestChecksum = wire.headerChecksum(message);
-    const { body: replyBody } = await this.roundtrip(message, requestChecksum);
-    this.parent = requestChecksum;
-    this.requestNumber += 1;
-    return replyBody;
+    // One deadline for the LOGICAL request: an eviction-triggered
+    // re-register and the retried send share it, so recovery cannot
+    // extend the caller's wait (client.py request()).
+    const deadline = Date.now() + this.timeoutMs;
+    for (let evictions = 0; ; ++evictions) {
+      try {
+        // Register INSIDE the retry scope: an eviction read during the
+        // register roundtrip itself (a late frame for the old session)
+        // must be retryable too, not an internal-error escape.
+        if (this.session === 0n) await this.register(deadline);
+        const message = wire.encodeRequest(
+          {
+            cluster: this.cluster, client: this.clientId,
+            parent: this.parent, session: this.session,
+            request: this.requestNumber, operation,
+          },
+          body,
+        );
+        const requestChecksum = wire.headerChecksum(message);
+        const { body: replyBody } =
+          await this.roundtrip(message, requestChecksum, deadline);
+        this.parent = requestChecksum;
+        this.requestNumber += 1;
+        return replyBody;
+      } catch (err) {
+        if (!(err instanceof SessionEvictedRetry)) throw err;
+        if (Date.now() >= deadline) throw new RequestTimeoutError();
+        // Jittered-exponential backoff before re-registering: register is
+        // itself a committed op that LRU-evicts someone else, so an
+        // oversubscribed session table would otherwise storm (client.py's
+        // _evict_backoff).
+        const cap = Math.min(128, 2 * 2 ** Math.min(evictions, 6));
+        const waitMs = Math.min(
+          (1 + Math.floor(Math.random() * cap)) * RETRY_TICK_MS,
+          Math.max(0, deadline - Date.now()),
+        );
+        await new Promise<void>((r) => {
+          const t = setTimeout(r, waitMs);
+          t.unref?.();
+        });
+        this.session = 0n;
+        this.parent = 0n;
+        this.requestNumber = 0;
+        // Loop top re-registers (session === 0n), inside the try.
+      }
+    }
   }
 
   // -- transport ------------------------------------------------------------
 
   private roundtrip(
-    message: Uint8Array, requestChecksum: bigint,
+    message: Uint8Array, requestChecksum: bigint, deadlineMs?: number,
   ): Promise<{ view: DataView; body: Uint8Array }> {
     return new Promise((resolve, reject) => {
       const pending: Pending = {
         message, requestChecksum, resolve, reject,
-        deadline: Date.now() + this.timeoutMs,
+        deadline: deadlineMs ?? Date.now() + this.timeoutMs,
+        busyAttempts: 0,
       };
       this.pending = pending;
       // Hard deadline even if the socket stays open but silent.  Rotate
@@ -221,7 +272,7 @@ export class Client {
           sock?.destroy();
           reject(new RequestTimeoutError());
         }
-      }, this.timeoutMs);
+      }, Math.max(0, pending.deadline - Date.now()));
       timer.unref?.();
       const done = (fn: typeof resolve | typeof reject) =>
         (arg: never) => {
@@ -297,9 +348,51 @@ export class Client {
     if (h.command === wire.Command.eviction) {
       const who = wire.getU128(h.view, wire.OFF_EVICT_CLIENT);
       if (who === this.clientId) {
-        this.evicted = true;
-        this.dropSocket(new ClientEvictedError());
+        const reason = h.view.getUint8(wire.OFF_EVICT_REASON);
+        if (reason === wire.EVICTION_SESSION_MISMATCH) {
+          const about = h.view.getBigUint64(wire.OFF_EVICT_SESSION, true);
+          if (about !== 0n && about !== this.session) {
+            // A MISMATCH about a session we already replaced (a stale
+            // forward from before our capacity-eviction re-register):
+            // not our live chain — discard (client.py parity).
+            return;
+          }
+          // Our session number is wrong for a session the server still
+          // holds — re-registering could fork the hash chain.  Terminal.
+          this.evicted = true;
+          this.dropSocket(new ClientEvictedError());
+        } else {
+          // Capacity-evicted (or unknown session, including legacy
+          // reason-0 frames): retryable — requestLocked re-registers a
+          // fresh session and retries within the original deadline
+          // (mirrors client.py's eviction branch).
+          this.dropSocket(new SessionEvictedRetry());
+        }
       }
+      return;
+    }
+    if (h.command === wire.Command.busy) {
+      // Overload shed signal: retryable by contract (the request was never
+      // journaled).  Wait max(jittered-exponential backoff, the server's
+      // retry-after hint) and resend on the SAME connection — busy means
+      // the cluster is alive and deliberately shedding, so no failover and
+      // no socket drop (mirrors client.py's busy branch).
+      const p = this.pending;
+      if (!p) return;
+      const who = wire.getU128(h.view, wire.OFF_BUSY_REQUEST_CHECKSUM);
+      if (who !== p.requestChecksum) return; // stale busy for an older request
+      const hint = h.view.getUint32(wire.OFF_BUSY_RETRY_AFTER_TICKS, true);
+      const cap = Math.min(64, 2 ** Math.min(p.busyAttempts, 6));
+      p.busyAttempts += 1;
+      const backoffTicks = 1 + Math.floor(Math.random() * cap);
+      const waitMs = Math.min(
+        Math.max(hint * HINT_TICK_MS, backoffTicks * RETRY_TICK_MS),
+        Math.max(0, p.deadline - Date.now()),
+      );
+      const timer = setTimeout(() => {
+        if (this.pending === p) this.trySend();
+      }, waitMs);
+      timer.unref?.();
       return;
     }
     if (h.command !== wire.Command.reply) return; // e.g. pong

@@ -39,7 +39,22 @@ export const OFF_REP_REQUEST = 232;
 export const OFF_REP_OPERATION = 236;
 
 // Eviction (message_header.zig Eviction: client u128 at the command area).
+// reason: 0 legacy/unknown, 1 no-session (re-register + retry),
+// 2 session-mismatch (protocol violation — surface to the caller).
 export const OFF_EVICT_CLIENT = 128;
+export const OFF_EVICT_REASON = 144;
+// Session the eviction is ABOUT (0 = not session-specific / legacy): lets a
+// re-registered client discard a stale MISMATCH for its replaced session.
+export const OFF_EVICT_SESSION = 145;
+export const EVICTION_NO_SESSION = 1;
+export const EVICTION_SESSION_MISMATCH = 2;
+
+// Busy (overload control): the primary shed this request; retryable.
+export const OFF_BUSY_REQUEST_CHECKSUM = 128;
+export const OFF_BUSY_CLIENT = 160;
+export const OFF_BUSY_REQUEST = 176;
+export const OFF_BUSY_RETRY_AFTER_TICKS = 180;
+export const OFF_BUSY_REASON = 184;
 
 export enum Command {
   reserved = 0,
@@ -53,6 +68,7 @@ export enum Command {
   reply = 8,
   commit = 9,
   eviction = 18,
+  busy = 24,
 }
 
 export const OPERATION_REGISTER = 2;

@@ -32,6 +32,7 @@ def finish(cluster, max_ticks=120_000):
     cluster.check_conservation()
 
 
+@pytest.mark.slow  # ~27 s; tools/ci.py integration tier runs it
 def test_tiered_cluster_converges_with_evictions(tmp_path):
     cluster = make_cluster(tmp_path, seed=81)
     finish(cluster)

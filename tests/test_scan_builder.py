@@ -179,6 +179,7 @@ class TestPrefixScans:
         m, t_rows, _ = populated
         check(m, t_rows, sb.scan_prefix("code", 20), reversed_=True)
 
+    @pytest.mark.slow  # ~26 s; tools/ci.py integration tier runs it
     def test_limit_and_window_growth(self, populated):
         m, t_rows, _ = populated
         # limit far below the match count forces candidate truncation;
@@ -454,6 +455,7 @@ class TestMaintenance:
 
 
 class TestColdTier:
+    @pytest.mark.slow  # ~28 s; tools/ci.py integration tier runs it
     def test_scan_sees_evicted_transfers(self, tmp_path):
         cfg = LedgerConfig(
             accounts_capacity_log2=8, transfers_capacity_log2=8,

@@ -173,11 +173,18 @@ class TestBoundedSendQueue:
             def close(self):
                 self.closed = True
 
+        class FakeReplica:
+            def _debug(self, event, **kw):
+                pass
+
         server = ClusterServer.__new__(ClusterServer)
         server.peer_writers = {1: FakeWriter()}
         server.client_writers = {}
         server.dropped_sends = 0
         server._last_drop_log = 0.0
+        server._drop_logged = set()
+        server.overload_control = False
+        server.replica = FakeReplica()
 
         import asyncio
 

@@ -332,6 +332,7 @@ class TestGrowth:
 
 
 class TestStaticTripParity:
+    @pytest.mark.slow  # ~27 s; tools/ci.py integration tier runs it
     def test_scan_and_while_paths_identical(self):
         """The TPU path runs the Jacobi fixpoint as a STATIC-trip lax.scan
         (data-independent trip count; see _kernel_core), other backends as

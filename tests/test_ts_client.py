@@ -103,6 +103,30 @@ def build_golden() -> dict:
         "results": [[0, 21], [1, 46]],
     }
 
+    # Overload-control frames (the busy/eviction tails client.ts parses):
+    # built by the Python side so the TS offline suite replays the SAME
+    # bytes a real primary would shed with.
+    busy_h = wire.new_header(
+        wire.Command.busy, cluster=0xA1, view=2, replica=0,
+        request_checksum=0xABCDEF, client=0xC11E17, request=1,
+        retry_after_ticks=25, reason=wire.BUSY_PIPELINE,
+    )
+    busy = {
+        "frame_hex": wire.encode(busy_h).hex(),
+        "request_checksum": str(0xABCDEF), "client": str(0xC11E17),
+        "request": 1, "retry_after_ticks": 25,
+        "reason": int(wire.BUSY_PIPELINE),
+    }
+    evict_h = wire.new_header(
+        wire.Command.eviction, cluster=0xA1, view=2, replica=0,
+        client=0xC11E17, reason=wire.EVICTION_NO_SESSION, session=7,
+    )
+    eviction = {
+        "frame_hex": wire.encode(evict_h).hex(),
+        "client": str(0xC11E17),
+        "reason": int(wire.EVICTION_NO_SESSION), "session": 7,
+    }
+
     def field(row, lo, hi=None):
         v = int(row[lo])
         if hi is not None:
@@ -113,6 +137,8 @@ def build_golden() -> dict:
         "aegis": aegis,
         "request_frames": [register, create],
         "reply_frames": [reply],
+        "busy_frames": [busy],
+        "eviction_frames": [eviction],
         "account": {
             "id": field(account_row, "id_lo", "id_hi"),
             "debitsPending": "0", "debitsPosted": "0",

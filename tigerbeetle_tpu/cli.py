@@ -111,6 +111,15 @@ def main(argv=None) -> int:
                               "values reserved, currently equivalent to "
                               "2; default 2; env twin: TB_PIPELINE, 0 = "
                               "off)")
+    p_start.add_argument("--overload-control", action="store_true",
+                         help="explicit overload control (vsr/overload.py): "
+                              "shed new requests with retryable busy "
+                              "replies + retry-after hints instead of "
+                              "silent drops, and shed the bounded send "
+                              "queues by priority class so a client flood "
+                              "never starves repair or an election (env "
+                              "twin: TB_OVERLOAD; default off — the off "
+                              "path is bit-identical)")
     p_start.add_argument("--scrub-interval", type=int, default=None,
                          metavar="N",
                          help="device fault domain (docs/fault_domains.md): "
@@ -171,10 +180,22 @@ def main(argv=None) -> int:
                         help="inject the device fault kind (seeded SDC bit "
                              "flips into ledger columns + forced dispatch "
                              "exceptions) from a separate stream")
-    p_vopr.add_argument("--scrub-interval", type=int, default=0, metavar="N",
+    p_vopr.add_argument("--scrub-interval", type=int, default=None,
+                        metavar="N",
                         help="arm every replica's scrub mirror at cadence N "
                              "(0 = off; with --device-faults and N=0 the "
                              "run demonstrates the undetected-SDC failure)")
+    p_vopr.add_argument("--overload", action="store_true",
+                        help="run the OVERLOAD fault kind instead of the "
+                             "random schedule: seeded client flood at 2-8x "
+                             "pipeline capacity with a mid-flood primary "
+                             "crash; oracles: bounded memory + flood-proof "
+                             "liveness (docs/fault_domains.md)")
+    p_vopr.add_argument("--no-priority", action="store_true",
+                        help="with --overload: force priority scheduling "
+                             "OFF (bounded FIFO tail-drop) — the negative "
+                             "control that demonstrably fails the "
+                             "liveness oracle")
 
     p_bench = sub.add_parser("benchmark", help="client-driven load benchmark")
     p_bench.add_argument("--addresses", default=None,
@@ -220,6 +241,13 @@ def _cmd_vopr(args) -> int:
 
     from .sim.vopr import EXIT_CORRECTNESS
 
+    if args.tpu and (args.overload or args.no_priority):
+        # Same loud-reject discipline as the non-TPU knob checks below:
+        # the TPU vopr runs its own random schedule, so silently dropping
+        # --overload would report a scenario that never ran.
+        print("error: --overload/--no-priority do not apply with --tpu",
+              file=sys.stderr)
+        return 2
     if args.tpu:
         from .sim import vopr_tpu
 
@@ -254,19 +282,47 @@ def _cmd_vopr(args) -> int:
             return 0 if n > 0 else 1  # the oracle must catch a known bug
         return EXIT_CORRECTNESS if n > 0 else 0
 
-    from .sim.vopr import run_seed
+    from .sim.vopr import run_overload_seed, run_seed
 
     if args.bug is not None or args.clusters != 4096 or args.steps != 400:
         print("error: --clusters/--steps/--bug apply only with --tpu",
               file=sys.stderr)
         return 2
+    if args.no_priority and not args.overload:
+        print("error: --no-priority applies only with --overload",
+              file=sys.stderr)
+        return 2
+    if args.overload and (
+        args.ticks != 6_000 or args.scrub_interval is not None
+        or args.vopr_viz
+    ):
+        # Loudly reject knobs the overload kind does not take (its tick
+        # budget and scrub cadence are fixed by the scenario) rather than
+        # silently running with different parameters than the user asked.
+        print("error: --ticks/--scrub-interval/--vopr-viz do not apply "
+              "with --overload", file=sys.stderr)
+        return 2
     _enable_metrics(args.metrics_json)
     first = args.seed if args.seed is not None else secrets.randbits(31)
     worst = 0
     for seed in range(first, first + args.count):
+        if args.overload:
+            result = run_overload_seed(
+                seed,
+                priority=not args.no_priority,
+                device_faults=args.device_faults,
+            )
+            print(
+                f"seed={result.seed} exit={result.exit_code} "
+                f"flood={result.flood_clients} "
+                f"vc_tick={result.view_change_tick} "
+                f"stats={result.stats}: {result.reason}"
+            )
+            worst = max(worst, result.exit_code)
+            continue
         result = run_seed(
             seed, ticks=args.ticks, viz=True if args.vopr_viz else None,
-            scrub_interval=args.scrub_interval,
+            scrub_interval=args.scrub_interval or 0,
             device_faults=args.device_faults,
         )
         print(
@@ -387,6 +443,11 @@ def _cmd_start(args) -> int:
     # including warmup's jit compiles — is captured; the atexit dump covers
     # both the serve-forever exit and KeyboardInterrupt.
     _enable_metrics(args.metrics_json)
+
+    if args.overload_control:
+        # One knob for every layer (consensus shed points, both buses):
+        # the env twin is what VsrReplica/ReplicaServer constructors read.
+        os.environ["TB_OVERLOAD"] = "1"
 
     import dataclasses as _dc
 
@@ -532,7 +593,7 @@ def _cmd_version(args) -> int:
               f"{os.environ.get('JAX_COMPILATION_CACHE_DIR', '')}")
         for env in ("TB_TRACE", "TB_TRACE_PATH", "TB_METRICS_PATH",
                     "TB_VOPR_VIZ", "TB_PIPELINE", "TB_SCRUB_INTERVAL",
-                    "JAX_PLATFORMS"):
+                    "TB_OVERLOAD", "JAX_PLATFORMS"):
             print(f"  env.{env}={os.environ.get(env, '')}")
     return 0
 

@@ -30,7 +30,14 @@ from .vsr.timeout import Timeout
 
 
 class ClientEvicted(Exception):
-    pass
+    """Session lost server-side.  ``reason`` (wire.EVICTION_*) says why:
+    EVICTION_NO_SESSION (capacity-evicted / unknown) is retryable — the
+    client re-registers a fresh session; EVICTION_SESSION_MISMATCH is a
+    protocol violation surfaced to the caller."""
+
+    def __init__(self, message: str, reason: int = 0) -> None:
+        super().__init__(message)
+        self.reason = reason
 
 
 class Client:
@@ -63,10 +70,34 @@ class Client:
             random.Random(self.client_id & 0xFFFF_FFFF),
             base_ticks=1, max_ticks=64,
         )
+        # Busy (overload) backoff — DISTINCT from the reconnect backoff:
+        # a busy reply means the cluster is alive and deliberately
+        # shedding, so the client must not fail over (the next replica
+        # would just forward to the same shedding primary); it waits —
+        # max(jittered-exponential, the server's retry-after hint) — and
+        # resends on the same connection, within its deadline.
+        self._busy_backoff = Timeout(
+            random.Random((self.client_id >> 32) & 0xFFFF_FFFF),
+            base_ticks=2, max_ticks=128,
+        )
+        self.busy_count = 0  # lifetime busy replies (overload forensics)
+        # Capacity-eviction backoff — NOT reset on reply progress (unlike
+        # the two above): in an oversubscribed session table every
+        # re-register succeeds yet evicts someone else, so only a backoff
+        # that keeps growing across those "successes" damps the storm.
+        self._evict_backoff = Timeout(
+            random.Random((self.client_id >> 64) & 0xFFFF_FFFF),
+            base_ticks=2, max_ticks=128,
+        )
         self._sleep = time.sleep
         self._now = time.monotonic
 
     RETRY_TICK_S = 0.05
+    # Server retry-after hints (busy frames) are in CONSENSUS ticks
+    # (config.tick_ms = 10; wire.BUSY_DTYPE: "~10 ms each") — a different
+    # unit from the client's own 50 ms backoff tick.  Convert each at its
+    # own cadence and compare durations, never raw tick counts.
+    HINT_TICK_S = 0.01
 
     # -- connection management ----------------------------------------------
 
@@ -153,16 +184,24 @@ class Client:
             got += len(chunk)
         return b"".join(chunks)
 
-    def _roundtrip(self, message: bytes, request_checksum: int) -> Tuple[np.ndarray, bytes]:
-        """Send; wait for the matching reply (retrying on reconnect)."""
-        deadline = self._now() + self.timeout_s
+    def _roundtrip(
+        self,
+        message: bytes,
+        request_checksum: int,
+        deadline: Optional[float] = None,
+    ) -> Tuple[np.ndarray, bytes]:
+        """Send; wait for the matching reply (retrying on reconnect and
+        backing off on explicit busy signals), honoring ``deadline``."""
+        if deadline is None:
+            deadline = self._now() + self.timeout_s
         while True:
             if self._now() > deadline:
                 raise TimeoutError("request timed out")
             try:
                 sock = self._connect()
                 sock.sendall(message)
-                while True:
+                resend = False
+                while not resend:
                     head = self._recv_exactly(sock, wire.HEADER_SIZE)
                     h, command = wire.decode_header(head)
                     body = b""
@@ -171,15 +210,57 @@ class Client:
                         body = self._recv_exactly(sock, size - wire.HEADER_SIZE)
                         wire.verify_body(h, body)
                     if command == wire.Command.eviction:
+                        if wire.u128(h, "client") != self.client_id:
+                            continue  # someone else's eviction broadcast
+                        if (
+                            int(h["reason"]) == wire.EVICTION_SESSION_MISMATCH
+                            and int(h["session"]) != 0
+                            and int(h["session"]) != self.session
+                        ):
+                            # A MISMATCH about a session we already
+                            # replaced (a stale forward of a request from
+                            # before our capacity-eviction re-register):
+                            # not about our live chain — discard, don't
+                            # die to it.
+                            continue
                         raise ClientEvicted(
-                            f"session evicted for client {self.client_id:#x}"
+                            f"session evicted for client "
+                            f"{self.client_id:#x} "
+                            f"(reason {int(h['reason'])})",
+                            reason=int(h["reason"]),
                         )
+                    if command == wire.Command.busy:
+                        # Explicit overload shed: retryable by contract.
+                        # Wait max(our jittered-exponential schedule, the
+                        # server's retry-after hint) and RESEND on the same
+                        # connection — no failover (every replica forwards
+                        # to the same shedding primary).
+                        if wire.u128(h, "request_checksum") != (
+                            request_checksum
+                        ):
+                            continue  # stale busy for an older request
+                        self.busy_count += 1
+                        wait_s = max(
+                            self._busy_backoff.next_backoff()
+                            * self.RETRY_TICK_S,
+                            int(h["retry_after_ticks"])
+                            * self.HINT_TICK_S,
+                        )
+                        remaining = deadline - self._now()
+                        if remaining <= 0:
+                            raise TimeoutError(
+                                "request timed out (cluster busy)"
+                            )
+                        self._sleep(min(wait_s, remaining))
+                        resend = True
+                        continue
                     if command != wire.Command.reply:
                         continue  # e.g. pong
                     if wire.u128(h, "request_checksum") != request_checksum:
                         continue  # stale/duplicate reply
                     # Progress: the next failure backs off from the base.
                     self._reconnect_backoff.reset(0)
+                    self._busy_backoff.reset(0)
                     return h, body
             except (ConnectionError, OSError, ValueError):
                 self.close()
@@ -194,7 +275,7 @@ class Client:
 
     # -- session protocol -----------------------------------------------------
 
-    def register(self) -> None:
+    def register(self, deadline: Optional[float] = None) -> None:
         h = wire.new_header(
             wire.Command.request,
             cluster=self.cluster,
@@ -206,29 +287,77 @@ class Client:
         )
         message = wire.encode(h, b"")
         request_checksum = wire.header_checksum(wire.decode_header(message)[0])
-        reply_h, _ = self._roundtrip(message, request_checksum)
+        reply_h, _ = self._roundtrip(message, request_checksum, deadline)
         self.session = int(reply_h["op"])
         self.parent = request_checksum
         self.request_number = 1
 
     def request(self, operation: wire.Operation, body: bytes) -> bytes:
-        if self.session == 0:
-            self.register()
-        h = wire.new_header(
-            wire.Command.request,
-            cluster=self.cluster,
-            client=self.client_id,
-            request=self.request_number,
-            parent=self.parent,
-            session=self.session,
-            operation=int(operation),
-        )
-        message = wire.encode(h, body)
-        request_checksum = wire.header_checksum(wire.decode_header(message)[0])
-        _, reply_body = self._roundtrip(message, request_checksum)
-        self.parent = request_checksum
-        self.request_number += 1
-        return reply_body
+        # One deadline for the LOGICAL request: an eviction-triggered
+        # re-register and the retried send share it, so recovery cannot
+        # extend the caller's wait.
+        deadline = self._now() + self.timeout_s
+        while True:
+            try:
+                # Register INSIDE the retry scope: an eviction read during
+                # the register roundtrip itself (a late frame for the old
+                # session) must be retryable too, not a terminal escape.
+                if self.session == 0:
+                    self.register(deadline)
+                h = wire.new_header(
+                    wire.Command.request,
+                    cluster=self.cluster,
+                    client=self.client_id,
+                    request=self.request_number,
+                    parent=self.parent,
+                    session=self.session,
+                    operation=int(operation),
+                )
+                message = wire.encode(h, body)
+                request_checksum = wire.header_checksum(
+                    wire.decode_header(message)[0]
+                )
+                _, reply_body = self._roundtrip(
+                    message, request_checksum, deadline
+                )
+            except ClientEvicted as err:
+                if err.reason == wire.EVICTION_SESSION_MISMATCH:
+                    # Our session number is wrong for a session the server
+                    # still holds: a protocol violation (or a duplicate of
+                    # this client id) — re-registering could fork the hash
+                    # chain.  Terminal.
+                    raise
+                # Capacity-evicted (or unknown session): the reference
+                # client crashes here; this client re-registers a FRESH
+                # session and retries the request within its deadline —
+                # the evicted session's replies are gone either way, and
+                # the new session's chain starts from its register.  If
+                # the in-flight request already COMMITTED under the lost
+                # session, the retry cannot double-apply it: create_* ops
+                # dedup on client-chosen ids (the state machine's `exists`
+                # ladder answers the duplicate), so the divergence is
+                # limited to `exists` result codes, not ledger state.  The
+                # jittered backoff keeps an oversubscribed session table
+                # (more live clients than clients_max) from degenerating
+                # into a mutual evict/register storm: register is itself a
+                # consensus-committed op that LRU-evicts someone else.
+                remaining = deadline - self._now()
+                if remaining <= 0:
+                    raise
+                self._sleep(
+                    min(
+                        self._evict_backoff.next_backoff()
+                        * self.RETRY_TICK_S,
+                        remaining,
+                    )
+                )
+                self.session = 0
+                self.parent = 0
+                self.request_number = 0
+                continue  # loop top re-registers (session == 0)
+            self.parent = request_checksum
+            self.request_number += 1
+            return reply_body
 
     # -- tb_client-style batch API -------------------------------------------
 

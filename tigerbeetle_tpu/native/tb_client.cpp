@@ -36,6 +36,11 @@ constexpr uint32_t kMessageSizeMax = 1u << 20;
 constexpr uint8_t kCommandRequest = 5;
 constexpr uint8_t kCommandReply = 8;
 constexpr uint8_t kCommandEviction = 18;
+// Overload shed signal: retryable by contract — the request was never
+// journaled, so no reply will come.  The roundtrip waits max(server hint,
+// exponential backoff) and resends on the SAME connection: busy means the
+// cluster is alive, so no failover and no socket drop (client.py parity).
+constexpr uint8_t kCommandBusy = 24;
 constexpr uint8_t kOperationRegister = 2;
 
 // Header field offsets (must match vsr/wire.py _FRAME + REQUEST/REPLY tails).
@@ -51,6 +56,29 @@ constexpr size_t kOffReqRequest = 192;
 constexpr size_t kOffReqOperation = 196;
 constexpr size_t kOffRepRequestChecksum = 128;
 constexpr size_t kOffRepOp = 208;
+constexpr size_t kOffBusyRequestChecksum = 128;
+constexpr size_t kOffBusyRetryAfterTicks = 180;
+// Which client the eviction addresses (u128 at 128; frames for OTHER
+// clients are discarded — client.py / client.ts parity).
+constexpr size_t kOffEvictClient = 128;
+constexpr size_t kOffEvictReason = 144;
+// Session the eviction is ABOUT (u64 at 145, unaligned — get_u64 memcpys;
+// 0 = not session-specific / legacy frame).
+constexpr size_t kOffEvictSession = 145;
+// Eviction reasons (vsr/wire.py): capacity eviction (NO_SESSION, or a
+// legacy 0 frame) is retryable — re-register a fresh session; a session
+// MISMATCH is a protocol violation and terminal (client.py parity).
+constexpr uint8_t kEvictionSessionMismatch = 2;
+// One busy retry-after tick (client.py RETRY_TICK_S).  The exponential
+// backoff component caps at 64 ticks (~3.2 s); the server's retry-after
+// hint is honored in full up to a sanity ceiling (600 consensus ticks,
+// ~6 s) against malformed frames.  Hint ticks are the CONSENSUS cadence
+// (config tick_ms = 10; wire BUSY_DTYPE "~10 ms each"), a different unit
+// from the client's 50 ms backoff tick — convert each at its own cadence
+// and compare durations, never raw tick counts.
+constexpr uint32_t kRetryTickUs = 50 * 1000;
+constexpr uint32_t kHintTickUs = 10 * 1000;
+constexpr uint32_t kBusyHintTicksMax = 600;
 
 void put_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
 void put_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
@@ -84,6 +112,7 @@ struct Client {
     std::vector<uint8_t> request_buf;
     std::vector<uint8_t> reply_buf;
     bool evicted = false;
+    uint8_t evict_reason = 0;  // last eviction frame's reason byte
     // Upper bound for MULTIPLEXED request messages: must match the server's
     // message_size_max (grouping two individually-valid packets past the
     // server's limit would make it drop the request and wedge the group).
@@ -185,8 +214,22 @@ void build_request(Client* c, uint8_t operation, const uint8_t* data,
 
 // Send the built request and wait for its reply (retrying on timeout /
 // reconnect, rotating addresses).  The reply body lands in c->reply_buf.
+// Busy waits can reach multiple seconds: sleep in <= 100 ms slices so a
+// racing shutdown is honored promptly and no single usleep() call reaches
+// the 1 s boundary POSIX allows implementations to reject with EINVAL
+// (which would silently turn the backoff into a hot resend loop).
+void backoff_sleep_us(Client* c, uint64_t us) {
+    while (us > 0 && !c->shutdown) {
+        uint32_t slice =
+            us < 100000 ? static_cast<uint32_t>(us) : 100000u;
+        usleep(slice);
+        us -= slice;
+    }
+}
+
 RoundtripResult roundtrip(Client* c, const uint8_t request_checksum[16],
                           int max_tries) {
+    uint32_t busy_attempts = 0;
     for (int tries = 0; max_tries < 0 || tries < max_tries; ++tries) {
         {
             std::unique_lock<std::mutex> lk(c->mu);
@@ -233,8 +276,40 @@ RoundtripResult roundtrip(Client* c, const uint8_t request_checksum[16],
             }
             uint8_t command = header[kOffCommand];
             if (command == kCommandEviction) {
-                c->evicted = true;
+                if (memcmp(header + kOffEvictClient, c->client_id, 16)
+                    != 0) {
+                    // Someone else's eviction (client.py / client.ts
+                    // parity): not about this client's session chain.
+                    continue;
+                }
+                c->evict_reason = header[kOffEvictReason];
+                if (c->evict_reason == kEvictionSessionMismatch) {
+                    uint64_t about = get_u64(header + kOffEvictSession);
+                    if (about != 0 && about != c->session) {
+                        // Stale MISMATCH about a session we already
+                        // replaced — not our live chain (client.py
+                        // parity): discard and keep reading.
+                        continue;
+                    }
+                    c->evicted = true;  // terminal: future calls fail fast
+                }
                 return RoundtripResult::kEvicted;
+            }
+            if (command == kCommandBusy) {
+                if (memcmp(header + kOffBusyRequestChecksum,
+                           request_checksum, 16) != 0) {
+                    continue;  // stale busy for an older request
+                }
+                uint32_t hint = get_u32(header + kOffBusyRetryAfterTicks);
+                if (hint > kBusyHintTicksMax) hint = kBusyHintTicksMax;
+                uint32_t backoff =
+                    1u << (busy_attempts < 6 ? busy_attempts : 6);
+                ++busy_attempts;
+                uint64_t hint_us = uint64_t{hint} * kHintTickUs;
+                uint64_t backoff_us = uint64_t{backoff} * kRetryTickUs;
+                backoff_sleep_us(
+                    c, hint_us > backoff_us ? hint_us : backoff_us);
+                break;  // resend on the SAME connection (fd stays open)
             }
             if (command != kCommandReply) continue;
             if (memcmp(header + kOffRepRequestChecksum, request_checksum,
@@ -254,10 +329,23 @@ RoundtripResult roundtrip(Client* c, const uint8_t request_checksum[16],
     return RoundtripResult::kShutdown;
 }
 
-bool register_session(Client* c) {
+RoundtripResult register_session(Client* c) {
     uint8_t request_checksum[16];
     build_request(c, kOperationRegister, nullptr, 0, request_checksum);
-    return roundtrip(c, request_checksum, 200) == RoundtripResult::kOk;
+    return roundtrip(c, request_checksum, 200);
+}
+
+// One backed-off register-retry round after a capacity eviction: linear
+// backoff (a saturated session table must not degenerate into a mutual
+// evict/register storm), reset the session chain, re-register.  The ONE
+// place the retry discipline lives — the io-thread eviction loop and
+// tb_client_init both use it, so the storm cap stays in one piece.
+RoundtripResult reset_and_register(Client* c, int attempt) {
+    usleep((attempt + 1) * kRetryTickUs);
+    c->session = 0;
+    c->request_number = 0;
+    memset(c->parent, 0, 16);
+    return register_session(c);
 }
 
 // Batch demux (state_machine.zig:114-165, client.zig:45-104): while the IO
@@ -329,9 +417,35 @@ void io_thread_main(Client* c) {
         }
 
         uint8_t request_checksum[16];
-        build_request(c, packet->operation, data, data_size,
-                      request_checksum);
-        switch (roundtrip(c, request_checksum, -1)) {
+        RoundtripResult rr;
+        for (int evictions = 0;; ++evictions) {
+            build_request(c, packet->operation, data, data_size,
+                          request_checksum);
+            rr = roundtrip(c, request_checksum, -1);
+            if (rr != RoundtripResult::kEvicted || c->evicted ||
+                evictions >= 3) {
+                break;  // ok/shutdown, terminal mismatch, or storm cap
+            }
+            // Capacity-evicted: re-register a FRESH session and retry the
+            // request (client.py parity).  An eviction read during the
+            // register roundtrip itself is retryable too (duplicate
+            // eviction frames from a resent request) — each attempt counts
+            // against the same storm cap.  A failed re-register keeps ITS
+            // result: a shutdown racing the retry must complete packets as
+            // CLIENT_SHUTDOWN, not misreport a routine close as a terminal
+            // eviction.
+            RoundtripResult rereg = reset_and_register(c, evictions);
+            while (rereg == RoundtripResult::kEvicted && !c->evicted &&
+                   evictions < 3) {
+                ++evictions;
+                rereg = reset_and_register(c, evictions);
+            }
+            if (rereg != RoundtripResult::kOk) {
+                rr = rereg;
+                break;
+            }
+        }
+        switch (rr) {
             case RoundtripResult::kOk: {
                 if (group.size() == 1) {
                     packet->status = TB_PACKET_OK;
@@ -442,7 +556,17 @@ tb_status_t tb_client_init(void** client_out, const uint8_t cluster_id[16],
         delete c;
         return TB_STATUS_CONNECT_FAILED;
     }
-    if (!register_session(c)) {
+    RoundtripResult rr = register_session(c);
+    for (int attempts = 0;
+         rr == RoundtripResult::kEvicted && !c->evicted && attempts < 3;
+         ++attempts) {
+        // Retryable capacity eviction raced the initial register (another
+        // client's register LRU-evicted our just-committed session): a
+        // transiently saturated session table must not fail client
+        // construction outright.
+        rr = reset_and_register(c, attempts);
+    }
+    if (rr != RoundtripResult::kOk) {
         disconnect(c);
         delete c;
         return TB_STATUS_CONNECT_FAILED;

@@ -24,7 +24,7 @@ from typing import Optional
 import numpy as np
 
 from ..obs.metrics import registry as _obs
-from ..vsr import wire
+from ..vsr import overload, wire
 from ..vsr.replica import Replica
 
 log = logging.getLogger("tigerbeetle_tpu.net")
@@ -120,6 +120,14 @@ class ReplicaServer:
         self._requests: Optional[asyncio.Queue] = None
         self._processor: Optional[asyncio.Task] = None
         self._flushes: set = set()
+        # Overload control (vsr/overload.py): with the knob ON, a full
+        # request queue SIGNALS busy (retryable, with a retry hint) instead
+        # of silently backpressuring the connection reader until the client
+        # times out.  Off (default) the put() backpressure is unchanged.
+        self.overload_control = bool(
+            getattr(replica, "overload_control", None)
+            or overload.enabled()
+        )
 
     async def start(self) -> int:
         # Bounded: put() backpressures connection readers, so a protocol-
@@ -367,6 +375,21 @@ class ReplicaServer:
                     log.warning("wrong cluster %x", wire.u128(h, "cluster"))
                     continue
                 if command == wire.Command.request:
+                    if self.overload_control and self._requests.full():
+                        # Admission shed: the queue drains one group per
+                        # processor wakeup, so a few ticks is an honest
+                        # retry hint.  The request was never journaled —
+                        # resending is not a duplicate.
+                        if _obs.enabled:
+                            _obs.counter("overload.shed.queue").inc()
+                            _obs.counter("overload.busy_sent").inc()
+                        writer.write(overload.busy_message(
+                            self.replica.replica, self.replica.cluster,
+                            self.replica.view, h, wire.BUSY_QUEUE,
+                            retry_after_ticks=5,
+                        ))
+                        await writer.drain()
+                        continue
                     await self._requests.put((h, body, writer))
                     continue
                 for out in self._dispatch(h, command, body):

@@ -19,12 +19,13 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs.metrics import registry as _obs
-from ..vsr import wire
+from ..vsr import overload, wire
 from ..vsr.consensus import VsrReplica
 from .bus import STATSD_FLUSH_INTERVAL_S, FrameError, read_message
 
@@ -77,6 +78,17 @@ class ClusterServer:
         self.port: Optional[int] = None
         self.dropped_sends = 0  # bounded-send-queue drops (backpressure)
         self._last_drop_log = 0.0
+        # Connections whose first send-queue drop was already _debug-logged
+        # (weak refs: entries die with the writer, so the set stays bounded
+        # by LIVE connections and a recycled id can't suppress a fresh
+        # connection's record) — silent backpressure drops must be
+        # observable even with overload off.
+        self._drop_logged: "weakref.WeakSet" = weakref.WeakSet()
+        # Priority-aware shedding (vsr/overload.py): follows the replica's
+        # one knob (TB_OVERLOAD / --overload-control / sim injection).
+        self.overload_control = bool(
+            getattr(replica, "overload_control", False)
+        )
         self._statsd_flushed_at = 0.0  # registry->statsd bridge cadence
         # RTT-adaptive timeouts convert monotonic ns to consensus ticks;
         # keep the conversion in lockstep with the actual tick cadence.
@@ -324,6 +336,58 @@ class ClusterServer:
     # messages to a peer that stops reading are DROPPED (adaptive retry
     # timeouts re-send); the connection itself stays up.
     SEND_BUFFER_MAX = 8 * (1 << 20)
+    # Priority-aware thresholds (overload control ON): the client plane
+    # sheds FIRST (half budget), the replication stream at the base budget,
+    # and view-change/repair traffic — what would actually END an overload
+    # — gets a hard reserve up to 2x.  Memory stays bounded either way.
+    SEND_SHED_AT = {
+        overload.CLASS_VIEW_CHANGE: 2 * SEND_BUFFER_MAX,
+        overload.CLASS_REPAIR: 2 * SEND_BUFFER_MAX,
+        overload.CLASS_PREPARE: SEND_BUFFER_MAX,
+        overload.CLASS_CLIENT: SEND_BUFFER_MAX // 2,
+    }
+
+    def _send_threshold(self, message: bytes) -> Tuple[int, int]:
+        """Per-message (drop threshold, class) for the bounded send queue.
+        The command byte sits at a fixed frame offset
+        (message_header.zig:17); an undecodable command sheds with the
+        client class.  The class rides along so the drop path does not
+        re-classify the same frame."""
+        if not self.overload_control:
+            return self.SEND_BUFFER_MAX, overload.CLASS_CLIENT
+        try:
+            cls = overload.classify(wire.Command(message[110]))
+        except ValueError:
+            cls = overload.CLASS_CLIENT
+        return self.SEND_SHED_AT[cls], cls
+
+    def _count_drop(self, w, cls: int) -> None:
+        """Backpressure-drop accounting (satellite: silent drops must be
+        observable even with overload control off): the bus.dropped_sends
+        series, per-class overload.drop.* when shedding by class, a
+        rate-limited warning, and a one-time _debug record per
+        connection."""
+        self.dropped_sends += 1
+        if _obs.enabled:
+            _obs.counter("bus.dropped_sends").inc()
+            if self.overload_control:
+                _obs.counter(
+                    f"overload.drop.{overload.CLASS_NAMES[cls]}"
+                ).inc()
+        if w not in self._drop_logged:
+            self._drop_logged.add(w)
+            self.replica._debug(
+                "send_queue_drop_first",
+                buffered=w.transport.get_write_buffer_size(),
+                dropped_total=self.dropped_sends,
+            )
+        now = asyncio.get_running_loop().time()
+        if now - self._last_drop_log > 1.0:  # throttled visibility
+            self._last_drop_log = now
+            log.warning(
+                "send queue full: dropped %d messages so far",
+                self.dropped_sends,
+            )
 
     async def _route(self, envelopes) -> None:
         for (kind, ident), message in envelopes:
@@ -336,16 +400,14 @@ class ClusterServer:
             # Bounded send queue (message_bus.zig / message_pool.zig:17-58
             # discipline): a clogged peer's messages DROP — the adaptive
             # retry timeouts re-send — so a slow consumer can never grow
-            # replica memory unboundedly.  The connection stays up.
-            if w.transport.get_write_buffer_size() > self.SEND_BUFFER_MAX:
-                self.dropped_sends += 1
-                now = asyncio.get_running_loop().time()
-                if now - self._last_drop_log > 1.0:  # throttled visibility
-                    self._last_drop_log = now
-                    log.warning(
-                        "send queue full: dropped %d messages so far",
-                        self.dropped_sends,
-                    )
+            # replica memory unboundedly.  The connection stays up.  With
+            # overload control on, the threshold is CLASS-AWARE: a client
+            # flood saturating the buffer sheds its own replies first while
+            # view-change/repair messages still get through (the old single
+            # threshold dropped whatever overflowed, repair included).
+            threshold, cls = self._send_threshold(message)
+            if w.transport.get_write_buffer_size() > threshold:
+                self._count_drop(w, cls)
                 continue
             w.write(message)
 
@@ -362,6 +424,18 @@ class ClusterServer:
                 # advances, and the WAL fills permanently at
                 # op_checkpoint + journal_slot_count.
                 self.replica._checkpoint_poll()
+                if _obs.enabled:
+                    # Queue-depth sampling (overload.* forensics): the
+                    # deepest outbound buffer, once per tick — cheap, and
+                    # enough to see backpressure building before drops.
+                    writers = list(self.peer_writers.values()) + list(
+                        self.client_writers.values()
+                    )
+                    depth = max(
+                        (w.transport.get_write_buffer_size()
+                         for w in writers), default=0,
+                    )
+                    _obs.gauge("bus.send_buffer_max_bytes").set(depth)
                 if self.statsd is not None and _obs.enabled:
                     now = time.monotonic()
                     if now - self._statsd_flushed_at >= (

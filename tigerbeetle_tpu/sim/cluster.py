@@ -55,6 +55,8 @@ class SimClient:
         n_requests: int = 10,
         batch: int = 8,
         retry_ticks: int = 80,
+        start_tick: int = 0,
+        aggressive: bool = False,
     ) -> None:
         self.client_id = client_id
         self.cluster_id = cluster_id
@@ -64,6 +66,12 @@ class SimClient:
         self.n_requests = n_requests
         self.batch = batch
         self.retry_ticks = retry_ticks
+        self.start_tick = start_tick  # flood cohorts activate mid-run
+        # Adversarial cohort: ignores busy retry-after hints and caps its
+        # backoff low — overload control must contain a flood of clients
+        # that do NOT cooperate, or the protection is only as strong as
+        # client politeness.
+        self.aggressive = aggressive
 
         self.session = 0
         self.request_number = 0
@@ -75,6 +83,18 @@ class SimClient:
         # request number -> reply header checksum (coherence oracle).
         self.reply_log: Dict[int, int] = {}
         self.results: List[Tuple[int, bytes]] = []
+        # Overload-control accounting: explicit busy replies back the
+        # client off (jittered exponential + the server hint, mirroring
+        # client.py); latencies record send->reply ticks for every
+        # completed request (the admitted-p99 the bench sweep reports).
+        from ..vsr.timeout import Timeout
+
+        self._busy_backoff = Timeout(
+            random.Random(seed ^ 0xB5), base_ticks=2, max_ticks=64
+        )
+        self.backoff_until = 0
+        self.busy_seen = 0
+        self.latencies: List[int] = []
 
     @property
     def done(self) -> bool:
@@ -113,12 +133,20 @@ class SimClient:
         )
 
     def tick(self, now: int) -> List[Tuple[Tuple[str, int], bytes]]:
-        if self.evicted:
+        if self.evicted or now < self.start_tick:
             return []
+        if now < self.backoff_until:
+            return []  # busy-signaled: deliberately waiting, not retrying
         if self.inflight is not None:
             if now - self.inflight["sent"] >= self.retry_ticks:
-                # Failover: rotate target and resend (client.zig reconnect).
-                self.target = (self.target + 1) % self.n_replicas
+                if not self.inflight.pop("busy_hold", False):
+                    # Failover: rotate target and resend (client.zig
+                    # reconnect).  A busy-scheduled resend must NOT rotate:
+                    # busy means the primary is ALIVE — the real clients
+                    # all resend on the same connection, and rotating here
+                    # would bill the measured sweep an extra forward hop
+                    # plus a second shed opportunity per busy retry.
+                    self.target = (self.target + 1) % self.n_replicas
                 self.inflight["sent"] = now
                 return [(("replica", self.target), self.inflight["message"])]
             return []
@@ -142,6 +170,7 @@ class SimClient:
             "checksum": request_checksum,
             "operation": operation,
             "sent": now,
+            "first_sent": now,
         }
         return [(("replica", self.target), message)]
 
@@ -151,6 +180,29 @@ class SimClient:
         if command == wire.Command.eviction:
             self.evicted = True
             self.inflight = None
+            return
+        if command == wire.Command.busy:
+            # Explicit shed signal: back off (jittered exponential, floored
+            # at the server's retry-after hint) instead of hammering the
+            # retry cadence — mirrors client.py's busy handling.
+            if self.inflight is not None and (
+                wire.u128(h, "request_checksum") == self.inflight["checksum"]
+            ):
+                self.busy_seen += 1
+                if self.aggressive:
+                    ticks = min(self._busy_backoff.next_backoff(), 4)
+                else:
+                    ticks = max(
+                        self._busy_backoff.next_backoff(),
+                        int(h["retry_after_ticks"]),
+                    )
+                self.backoff_until = now + ticks
+                # The backoff IS the retry schedule: rearm the resend clock
+                # so the normal retry doesn't fire the moment it expires,
+                # and pin the resend to the SAME replica (no failover on
+                # busy — the server is alive, just shedding).
+                self.inflight["sent"] = now + ticks - self.retry_ticks
+                self.inflight["busy_hold"] = True
             return
         if command != wire.Command.reply:
             return
@@ -177,6 +229,9 @@ class SimClient:
             self.results.append((request_n, body))
             self.requests_done += 1
             self.request_number += 1
+        self.latencies.append(now - self.inflight["first_sent"])
+        self._busy_backoff.reset(0)
+        self.backoff_until = 0
         self.parent = self.inflight["checksum"]
         self.inflight = None
 
@@ -204,6 +259,7 @@ class SimCluster:
         n_standbys: int = 0,
         viz: bool = False,
         scrub_interval: int = 0,
+        overload: Optional[dict] = None,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
@@ -226,6 +282,46 @@ class SimCluster:
         # scrub mirror at cadence N, enabling SDC detection and dispatch
         # recovery under the injectors below.
         self.scrub_interval = scrub_interval
+        # Overload fault domain (docs/fault_domains.md): when set, every
+        # replica's ingress rides a BOUNDED admission queue drained with a
+        # per-tick dispatch budget — the sim twin of a server whose event
+        # loop admits finitely per scheduling quantum.  Keys:
+        #   queue_cap         declared bound (the bounded-memory oracle
+        #                     checks it every step)
+        #   dispatch_budget   messages dispatched per replica per tick
+        #   priority          class-aware drain/shed (vsr/overload.py) vs
+        #                     plain FIFO tail drop — the negative control
+        #                     the liveness oracle must demonstrably fail
+        #   signal            shed client requests get explicit busy
+        #                     replies; replicas run with overload_control
+        # None (default): direct dispatch, bit-identical to every pinned
+        # seed's schedule.
+        self.overload = None
+        self.admission: List = []
+        self.overload_shed_busy = 0
+        if overload is not None:
+            from ..vsr.overload import AdmissionQueue
+
+            self.overload = {
+                "queue_cap": int(overload.get("queue_cap", 32)),
+                "dispatch_budget": int(overload.get("dispatch_budget", 8)),
+                "priority": bool(overload.get("priority", True)),
+                "signal": bool(overload.get("signal", True)),
+            }
+            self.admission = [
+                AdmissionQueue(
+                    self.overload["queue_cap"], self.overload["priority"]
+                )
+                for _ in range(n_replicas + n_standbys)
+            ]
+            # Counters from queues retired by crash() (the queue's items
+            # die with the replica, but its accounting must survive into
+            # overload_stats() or the flood's heaviest window vanishes
+            # from the oracles and the bench sweep).
+            self._admission_retired = {
+                "admitted": 0, "shed": 0, "depth_peak": 0,
+                "shed_by_class": {},
+            }
         self.rng = random.Random(seed)
         self.net = net or PacketSimulator(seed=seed + 1)
         self.t = 0
@@ -345,6 +441,10 @@ class SimCluster:
         )
         # Virtual time: device-recovery backoff must never wall-sleep.
         replica.machine.retry_tick_s = 0
+        if self.overload is not None:
+            # One knob across the domain: the primary's shed points signal
+            # busy exactly when the governor does.
+            replica.overload_control = self.overload["signal"]
         if self.auditor is not None:
             def observe(op, operation, ts, body, results, replay, i=i):
                 self.auditor.observe_commit(
@@ -367,6 +467,25 @@ class SimCluster:
         self.alive[i] = False
         self.storages[i].crash()
         self.replicas[i] = None
+        if self.overload is not None:
+            # A crashed replica's kernel buffers die with it — but its
+            # shed/admitted accounting must not (overload_stats()).
+            from ..vsr.overload import AdmissionQueue
+
+            old = self.admission[i]
+            retired = self._admission_retired
+            retired["admitted"] += old.admitted
+            retired["shed"] += old.shed
+            retired["depth_peak"] = max(
+                retired["depth_peak"], old.depth_peak
+            )
+            for cls, n in old.shed_by_class.items():
+                retired["shed_by_class"][cls] = (
+                    retired["shed_by_class"].get(cls, 0) + n
+                )
+            self.admission[i] = AdmissionQueue(
+                self.overload["queue_cap"], self.overload["priority"]
+            )
 
     def restart(self, i: int) -> None:
         self.start(i)
@@ -432,6 +551,9 @@ class SimCluster:
                     h, command, body = wire.decode(message)
                 except ValueError:
                     continue  # corrupt frame: dropped like a bad TCP peer
+                if self.overload is not None:
+                    self._admit(ident, h, command, body)
+                    continue
                 try:
                     out = self.replicas[ident].on_message(h, command, body)
                 except JournalWriteFailure:
@@ -450,6 +572,8 @@ class SimCluster:
                 except ValueError:
                     continue
                 client.on_message(h, command, body, self.t)
+        if self.overload is not None:
+            self._drain_admission()
         for i in range(self.total):
             if self.alive[i]:
                 try:
@@ -460,6 +584,123 @@ class SimCluster:
             self._route(("client", cid), client.tick(self.t))
         if self.viz is not None:
             self.viz.sample(self)
+
+    # -- overload governor (the fourth fault domain) ---------------------------
+
+    def _admit(self, ident: int, h, command, body) -> None:
+        """Offer an inbound message to replica ``ident``'s bounded
+        admission queue; shed client requests get an explicit busy reply
+        when signaling is on (everything else relies on sender timeouts)."""
+        from ..vsr import overload as ovl  # deferred: only overload runs
+
+        cls = ovl.classify(command)
+        client = (
+            wire.u128(h, "client") if command == wire.Command.request else 0
+        )
+        shed = self.admission[ident].offer(cls, client, (h, command, body))
+        for scls, _sclient, (sh, scommand, _sbody) in shed:
+            if (
+                scls == ovl.CLASS_CLIENT
+                and scommand == wire.Command.request
+                and self.overload["signal"]
+            ):
+                replica = self.replicas[ident]
+                busy = ovl.busy_message(
+                    ident, self.cluster_id,
+                    replica.view if replica is not None else 0,
+                    sh, wire.BUSY_QUEUE,
+                    retry_after_ticks=4 * self.overload["dispatch_budget"],
+                )
+                self.overload_shed_busy += 1
+                self._route(
+                    ("replica", ident),
+                    [(("client", wire.u128(sh, "client")), busy)],
+                )
+
+    def _drain_admission(self) -> None:
+        for i in range(self.total):
+            q = self.admission[i]
+            # Bounded-memory oracle: the declared cap holds at all times.
+            assert len(q) <= q.cap, (
+                f"replica {i} admission queue {len(q)} > declared cap "
+                f"{q.cap}"
+            )
+            if not self.alive[i]:
+                continue
+            for _ in range(self.overload["dispatch_budget"]):
+                item = q.pop()
+                if item is None:
+                    break
+                _cls, _client, (h, command, body) = item
+                try:
+                    out = self.replicas[i].on_message(h, command, body)
+                except JournalWriteFailure:
+                    self.crash(i)
+                    break
+                self._route(("replica", i), out)
+
+    def add_flood_clients(
+        self,
+        count: int,
+        seed: int,
+        n_requests: int = 4,
+        retry_ticks: int = 4,
+        start_tick: int = 0,
+        batch: int = 8,
+        aggressive: bool = True,
+    ) -> List[int]:
+        """Attach an aggressive client cohort (the overload fault's load):
+        short retry cadence, activation at ``start_tick``.  Ids are derived
+        from a DISTINCT stream (seed ^ 0xF100D) so base-client schedules
+        stay untouched."""
+        ids = []
+        for j in range(count):
+            cid = ((seed ^ 0xF100D) * 1000 + 13 * (j + 1)) | 1
+            self.clients[cid] = SimClient(
+                client_id=cid,
+                cluster_id=self.cluster_id,
+                n_replicas=self.n,
+                seed=(seed ^ 0xF100D) * 77 + j,
+                n_requests=n_requests,
+                batch=batch,
+                retry_ticks=retry_ticks,
+                start_tick=start_tick,
+                aggressive=aggressive,
+            )
+            ids.append(cid)
+        return ids
+
+    def overload_stats(self) -> dict:
+        """Governor accounting for oracles, metrics, and the bench sweep."""
+        if self.overload is None:
+            return {}
+        shed_by_class: Dict[str, int] = {}
+        from ..vsr.overload import CLASS_NAMES
+
+        retired = self._admission_retired
+        for cls, n in retired["shed_by_class"].items():
+            shed_by_class[CLASS_NAMES[cls]] = n
+        for q in self.admission:
+            for cls, n in q.shed_by_class.items():
+                name = CLASS_NAMES[cls]
+                shed_by_class[name] = shed_by_class.get(name, 0) + n
+        return {
+            "admitted": retired["admitted"] + sum(
+                q.admitted for q in self.admission
+            ),
+            "shed": retired["shed"] + sum(
+                q.shed for q in self.admission
+            ),
+            "shed_by_class": shed_by_class,
+            "depth_peak": max(
+                retired["depth_peak"],
+                *(q.depth_peak for q in self.admission),
+            ),
+            "busy_replies": self.overload_shed_busy,
+            "client_busy_seen": sum(
+                c.busy_seen for c in self.clients.values()
+            ),
+        }
 
     def _route(self, src, envelopes) -> None:
         for dst, message in envelopes:

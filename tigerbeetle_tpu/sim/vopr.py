@@ -355,3 +355,245 @@ def run_seed(
         return go(workdir)
     with tempfile.TemporaryDirectory() as d:
         return go(d)
+
+
+@dataclasses.dataclass
+class OverloadResult(VoprResult):
+    """VoprResult + the overload fault kind's accounting."""
+
+    flood_clients: int = 0
+    flood_factor: int = 0
+    view_change_tick: Optional[int] = None
+    stats: Optional[dict] = None
+
+
+def run_overload_seed(
+    seed: int,
+    workdir: Optional[str] = None,
+    priority: bool = True,
+    signal: bool = True,
+    slow_fsync: bool = False,
+    device_faults: bool = False,
+    flood_factor: Optional[int] = None,
+    flood_requests: int = 24,
+    settle_ticks: int = 60_000,
+) -> OverloadResult:
+    """The OVERLOAD fault kind (docs/fault_domains.md): a seeded client
+    flood at 2-8x pipeline capacity against the real consensus code, with
+    the primary crashed mid-flood so an election must complete UNDER the
+    flood.  Every knob draws from a stream separate from run_seed's, so
+    pinned base seeds replay bit-identically.
+
+    Oracles (on top of the standard convergence/conservation/auditor set):
+
+    - bounded-memory: every admission queue stays <= its declared cap for
+      the whole run (asserted every step by the governor);
+    - liveness: a view change completes while the flood is running (within
+      ``VC_WINDOW`` ticks of the crash), and after the flood drains every
+      non-evicted client — flood cohort included — finishes every request
+      (every admitted request is eventually replied to).
+
+    ``priority=False`` is the negative control: plain bounded-FIFO
+    tail-drop queues, under which a pinned seed must demonstrably FAIL the
+    liveness oracle (the flood starves the election traffic) — proving the
+    priority scheduling is what carries liveness, not luck.
+
+    ``slow_fsync`` halves the dispatch budget (a replica wedged behind a
+    slow fsync serves fewer messages per quantum); ``device_faults`` arms
+    two forced dispatch exceptions mid-flood (the device fault kind riding
+    the same schedule).
+    """
+    import random as _random
+
+    from ..config import TEST_MIN
+    from ..vsr.consensus import NORMAL
+
+    rng = _random.Random(seed ^ 0x0F10AD)  # overload's own stream
+    pipeline_cap = TEST_MIN.pipeline_prepare_queue_max
+    factor = flood_factor if flood_factor is not None else rng.randint(2, 8)
+    flood_n = factor * pipeline_cap
+    # The dispatch budget is the scarce resource the flood contends for
+    # (a quarter of the pipeline per tick; a slow-fsync replica serves
+    # half that again) — the flood's sustained inflow EXCEEDS it several
+    # times over, so the bounded queues stay pinned at their cap and drain
+    # ORDER is what carries liveness.
+    budget = max(1, pipeline_cap // (8 if slow_fsync else 4))
+    FLOOD_START = 300
+    CRASH_AT = 600
+    # Wide enough for the worst legitimate path under priority scheduling:
+    # a flood-lagged backup state-syncs (checkpoint fetch, ~chunk count
+    # round trips), rejoins via the recovering escape valve, and THEN the
+    # election completes — all under the live flood.
+    VC_WINDOW = 1000
+    RESTART_AT = CRASH_AT + VC_WINDOW + 200
+    FLOOD_TICKS = RESTART_AT + 400
+    # Deep-but-bounded ingress backlog (the SEND_BUFFER_MAX spirit: ~8 MiB
+    # of 8 KiB messages).  The depth is the point: FIFO head-of-line delay
+    # through a flood-pinned backlog is depth/budget ticks PER HOP — far
+    # beyond the election window — while class-priority drain is immune to
+    # backlog depth.  Tail-drop alone never starves periodic retransmits;
+    # bufferbloat does.
+    queue_cap = 128 * pipeline_cap
+
+    # The flood cohort would thrash the default 32-session table (every
+    # register evicting an LRU session) and measure eviction churn, not
+    # overload: give the run session headroom instead.
+    config = dataclasses.replace(
+        TEST_MIN, clients_max=max(96, flood_n + 16)
+    )
+
+    def go(workdir: str) -> OverloadResult:
+        cluster = SimCluster(
+            workdir,
+            n_replicas=3,
+            n_clients=2,
+            seed=seed,
+            requests_per_client=4,
+            config=config,
+            # Low-latency links: state-sync chunk fetches chain one round
+            # trip per chunk, and the oracle windows assume link RTT is
+            # not what dominates (the governor budget is the bottleneck
+            # under test, not the wire).
+            net=PacketSimulator(
+                seed=(seed ^ 0x0F10AD) + 1, delay_mean=1, delay_max=6,
+            ),
+            overload={
+                "queue_cap": queue_cap,
+                "dispatch_budget": budget,
+                "priority": priority,
+                "signal": signal,
+            },
+            # Device-fault recovery re-materializes from the scrub mirror
+            # (docs/fault_domains.md): combining the kinds arms it, same
+            # contract as run_seed(device_faults=..., scrub_interval=N).
+            scrub_interval=8 if device_faults else 0,
+        )
+        flood_ids = cluster.add_flood_clients(
+            flood_n, seed, n_requests=flood_requests,
+            retry_ticks=1, start_tick=FLOOD_START,
+        )
+        dev_rng = _random.Random(seed ^ 0xD5DC) if device_faults else None
+        faults = 1  # the flood itself
+        view_change_tick: Optional[int] = None
+        flood_active_at_vc = 0
+        primary = 0
+        view_at_crash = 0
+        crashed = False
+        restarted = False
+
+        def stats_result(code: int, reason: str) -> OverloadResult:
+            commits = max(
+                (r.commit_min for r in cluster.replicas if r is not None),
+                default=0,
+            )
+            res = OverloadResult(
+                seed, code, reason, cluster.t, commits, faults,
+            )
+            res.flood_clients = flood_n
+            res.flood_factor = factor
+            res.view_change_tick = view_change_tick
+            res.stats = cluster.overload_stats()
+            res.stats["flood_active_at_vc"] = flood_active_at_vc
+            if _obs.enabled:
+                st = res.stats
+                _obs.counter("overload.vopr.runs").inc()
+                _obs.counter("overload.vopr.shed").inc(st.get("shed", 0))
+                _obs.counter("overload.vopr.busy_replies").inc(
+                    st.get("busy_replies", 0)
+                )
+            return res
+
+        try:
+            for t in range(FLOOD_TICKS):
+                cluster.step()
+                if cluster.t == CRASH_AT:
+                    live = [
+                        r for r, a in zip(cluster.replicas, cluster.alive)
+                        if a
+                    ]
+                    view_at_crash = max(r.view for r in live)
+                    primary = live[0].primary_index(view_at_crash)
+                    if cluster.alive[primary]:
+                        cluster.crash(primary)
+                    crashed = True
+                    faults += 1
+                if dev_rng is not None and cluster.t in (
+                    CRASH_AT + 150, CRASH_AT + 450
+                ):
+                    live = [
+                        i for i in range(cluster.total)
+                        if cluster.alive[i]
+                    ]
+                    if live:
+                        victim = live[dev_rng.randrange(len(live))]
+                        if cluster.inject_dispatch_fault(victim):
+                            faults += 1
+                if (
+                    crashed and view_change_tick is None
+                    and any(
+                        a and r.status == NORMAL
+                        and r.view > view_at_crash
+                        for r, a in zip(cluster.replicas, cluster.alive)
+                    )
+                ):
+                    view_change_tick = cluster.t
+                    flood_active_at_vc = sum(
+                        1 for cid in flood_ids
+                        if not cluster.clients[cid].done
+                    )
+                if (
+                    crashed and not restarted
+                    and cluster.t >= RESTART_AT
+                    and view_change_tick is not None
+                ):
+                    cluster.restart(primary)
+                    restarted = True
+                if (
+                    crashed and view_change_tick is None
+                    and cluster.t > CRASH_AT + VC_WINDOW
+                ):
+                    # LIVENESS ORACLE (mid-flood election): the flood
+                    # starved the view change past its window.
+                    return stats_result(
+                        EXIT_LIVENESS,
+                        f"view change did not complete within {VC_WINDOW} "
+                        f"ticks of the mid-flood primary crash "
+                        f"(flood {flood_n} clients, priority={priority})",
+                    )
+            if not restarted and crashed:
+                cluster.restart(primary)
+            ok = cluster.run_until(
+                lambda: cluster.clients_done() and cluster.converged(),
+                max_ticks=settle_ticks,
+            )
+            if not ok:
+                # LIVENESS ORACLE (admitted requests): some client never
+                # saw its reply even after the flood drained.
+                pending = sum(
+                    1 for c in cluster.clients.values() if not c.done
+                )
+                return stats_result(
+                    EXIT_LIVENESS,
+                    f"{pending} clients unfinished after "
+                    f"{settle_ticks} settle ticks",
+                )
+            cluster.check_converged()
+            cluster.check_conservation()
+            return stats_result(EXIT_PASSED, "passed")
+        except AssertionError as err:
+            return stats_result(
+                EXIT_CORRECTNESS, f"oracle violation: {err}"
+            )
+        except Exception as err:  # noqa: BLE001 — a crash IS a find
+            import traceback
+
+            tb = traceback.format_exc().strip().splitlines()
+            return stats_result(
+                EXIT_CORRECTNESS,
+                f"crash: {type(err).__name__}: {err} @ {tb[-3:]}",
+            )
+
+    if workdir is not None:
+        return go(workdir)
+    with tempfile.TemporaryDirectory() as d:
+        return go(d)

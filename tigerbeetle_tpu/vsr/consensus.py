@@ -52,7 +52,9 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..obs.metrics import registry as _obs
 from . import checkpoint as checkpoint_mod
+from . import overload
 from . import wire
 from .clock import Clock
 from .replica import ForestDamage, InvalidRequest, Replica, Session
@@ -145,6 +147,12 @@ class VsrReplica(Replica):
         self.commit_max = 0
         self._log_adopted_op = 0
         self.prng = random.Random(seed)
+        # Overload control (vsr/overload.py; TB_OVERLOAD / the CLI's
+        # --overload-control, sim injects explicitly).  Off by default:
+        # every shed point below then behaves bit-identically to the
+        # silent-drop behavior pinned seeds and the bench differential
+        # replay against.
+        self.overload_control = overload.enabled()
 
         # Journaled prepare headers by op for the live window (chain checks,
         # repair responses, DVC/SV bodies).  Pruned at checkpoint.
@@ -546,8 +554,23 @@ class VsrReplica(Replica):
 
         session = self.sessions.get(client)
         if operation != wire.Operation.register:
-            if session is None or int(h["session"]) != session.session:
-                return [(("client", client), self._eviction(client))]
+            if session is None:
+                # Unknown session (never registered, or capacity-evicted by
+                # a newer client): the client may re-register and retry.
+                return [(("client", client), self._eviction(
+                    client, wire.EVICTION_NO_SESSION
+                ))]
+            if int(h["session"]) != session.session:
+                # MISMATCH echoes the OFFENDING session: a client that
+                # already re-registered after a capacity eviction discards
+                # a stale MISMATCH about its old session (e.g. a backup's
+                # forwarded copy of the evicted request) instead of dying
+                # to it, while a live duplicate-id client — whose current
+                # session matches the echo — surfaces it terminally.
+                return [(("client", client), self._eviction(
+                    client, wire.EVICTION_SESSION_MISMATCH,
+                    session=int(h["session"]),
+                ))]
             if request_n == session.request:
                 if session.reply_bytes:
                     return [(("client", client), session.reply_bytes)]
@@ -569,13 +592,19 @@ class VsrReplica(Replica):
 
         # NEW requests (everything above serves duplicates without needing a
         # timestamp) require a synchronized clock and pipeline headroom
-        # (replica.zig:1322, :1330).
+        # (replica.zig:1322, :1330).  With overload control on, each shed is
+        # SIGNALED (retryable busy + retry-after hint) instead of silently
+        # dropped; off, these paths are bit-identical to before.
         if self.clock.realtime_synchronized is None:
-            return []  # drop: cannot assign timestamps
+            # Clock syncs via ping/pong rounds: retry after one round.
+            return self._shed_request(h, wire.BUSY_CLOCK, PING_INTERVAL)
         if len(self.pipeline) >= self.config.pipeline_prepare_queue_max:
-            return []  # pipeline full: client will retry
+            # The pipeline drains at commit speed: one heartbeat away.
+            return self._shed_request(h, wire.BUSY_PIPELINE, COMMIT_HEARTBEAT)
         if self.op + 1 > self.op_prepare_max:
-            return []  # WAL full until the next checkpoint: client retries
+            # WAL full until the in-flight checkpoint lands: the longest of
+            # the three conditions — hint half a heartbeat budget.
+            return self._shed_request(h, wire.BUSY_WAL, NORMAL_HEARTBEAT // 2)
         if self.commit_max > self.op:
             # Ops at/below the known commit watermark exist that we don't
             # hold headers for (e.g. a recovering-head DVC's commit claim):
@@ -599,6 +628,38 @@ class VsrReplica(Replica):
             out.append((("replica", successor), message))
         self._maybe_commit_pipeline(out)
         return out
+
+    _BUSY_REASON_NAMES = {
+        wire.BUSY_PIPELINE: "pipeline",
+        wire.BUSY_WAL: "wal",
+        wire.BUSY_CLOCK: "clock",
+        wire.BUSY_QUEUE: "queue",
+    }
+
+    def _shed_request(
+        self, h: np.ndarray, reason: int, retry_after_ticks: int
+    ) -> List[Msg]:
+        """Shed a new client request the primary cannot admit.  Overload
+        control OFF: silent drop, bit-identical to the pre-overload path.
+        ON: signal — a retryable busy with a retry-after hint, plus the
+        overload.* shed accounting."""
+        if not self.overload_control:
+            return []
+        name = self._BUSY_REASON_NAMES.get(reason, "unknown")
+        if _obs.enabled:
+            _obs.counter(f"overload.shed.{name}").inc()
+            _obs.counter("overload.busy_sent").inc()
+        self._debug(
+            "shed_request", reason=name,
+            client=f"{wire.u128(h, 'client'):#x}",
+            request=int(h["request"]),
+        )
+        client = wire.u128(h, "client")
+        message = overload.busy_message(
+            self.replica, self.cluster, self.view, h, reason,
+            retry_after_ticks,
+        )
+        return [(("client", client), message)]
 
     def _primary_now(self) -> int:
         now = self.clock.realtime_synchronized
@@ -2368,6 +2429,24 @@ class VsrReplica(Replica):
                         # Explicit-peer sync (block-repair fallback): a
                         # silent responder means we guessed wrong — rotate.
                         self._sync_peer = self._next_peer(self._sync_peer)
+                    else:
+                        # Targeted sync whose default responder (the
+                        # primary) went silent for a full resend interval:
+                        # rotate through peers from here on.  Every replica
+                        # at the target checkpoint serves sync, and a
+                        # syncing replica abstains from view changes — so a
+                        # DEAD primary would otherwise wedge both this
+                        # replica (polling a corpse forever) and the
+                        # cluster (one abstainer can break the view-change
+                        # quorum).  Found by the overload fault kind: a
+                        # flood-lagged replica synced exactly when the
+                        # primary died.  Seed the rotation PAST the silent
+                        # primary (seeding from self.replica can land right
+                        # back on the corpse and burn another full resend
+                        # interval of the election budget).
+                        self._sync_peer = self._next_peer(
+                            self.primary_index()
+                        )
                     out.extend(self._request_sync_chunk())
             return out
 

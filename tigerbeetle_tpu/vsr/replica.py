@@ -466,9 +466,16 @@ class Replica:
         if operation != wire.Operation.register:
             if session is None:
                 # Unknown session: evict so the client re-registers.
-                return [self._eviction(client)]
+                return [self._eviction(client, wire.EVICTION_NO_SESSION)]
             if int(header["session"]) != session.session:
-                return [self._eviction(client)]
+                # MISMATCH echoes the offending session so a re-registered
+                # client discards stale evictions about its OLD session
+                # while a live duplicate-id client still surfaces the
+                # violation (consensus.py keeps the same rule).
+                return [self._eviction(
+                    client, wire.EVICTION_SESSION_MISMATCH,
+                    session=int(header["session"]),
+                )]
             if request_n == session.request and session.reply_bytes:
                 return [session.reply_bytes]  # duplicate: resend stored reply
             if request_n < session.request:
@@ -580,8 +587,18 @@ class Replica:
             request_n = int(header["request"])
             session = self.sessions.get(client)
             if operation != wire.Operation.register:
-                if session is None or int(header["session"]) != session.session:
-                    out[i] = [self._eviction(client)]
+                if session is None:
+                    out[i] = [self._eviction(
+                        client, wire.EVICTION_NO_SESSION
+                    )]
+                    continue
+                if int(header["session"]) != session.session:
+                    # Session-echoing MISMATCH (same rule as on_request
+                    # and consensus.py).
+                    out[i] = [self._eviction(
+                        client, wire.EVICTION_SESSION_MISMATCH,
+                        session=int(header["session"]),
+                    )]
                     continue
                 if client in busy:
                     continue
@@ -1278,10 +1295,20 @@ class Replica:
             session.slot = min(set(range(self.config.clients_max)) - used)
         self.sessions[session.client] = session
 
-    def _eviction(self, client: int) -> bytes:
+    def _eviction(
+        self, client: int, reason: int = wire.EVICTION_NO_SESSION,
+        session: int = 0,
+    ) -> bytes:
+        """Eviction carries WHY (wire.EVICTION_*): a capacity-evicted or
+        unknown session is retryable (the client re-registers), a session-
+        number mismatch is a protocol violation the client must surface.
+        ``session`` echoes which session the eviction is about (0 = not
+        session-specific) so clients can discard stale MISMATCHes for a
+        session they already replaced."""
         h = wire.new_header(
             wire.Command.eviction,
             cluster=self.cluster, view=self.view, client=client,
+            reason=reason, session=session,
         )
         h["replica"] = self.replica
         return wire.encode(h, b"")

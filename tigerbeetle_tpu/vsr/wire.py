@@ -53,6 +53,13 @@ class Command(enum.IntEnum):
     request_sync_checkpoint = 21
     sync_checkpoint = 22
     nack_prepare = 23
+    # Explicit overload signal (docs/fault_domains.md overload domain): the
+    # primary sheds a NEW request it cannot admit (pipeline full, WAL full
+    # until checkpoint, clock unsynchronized) by REPLYING busy with a
+    # retry-after tick hint, instead of silently dropping and letting the
+    # client burn its whole timeout.  Retryable by contract: the request
+    # was never journaled, so a resend is not a duplicate.
+    busy = 24
 
 
 VSR_OPERATIONS_RESERVED = 128
@@ -187,9 +194,44 @@ PING_CLIENT_DTYPE = _dtype([
 
 PONG_CLIENT_DTYPE = _dtype([("reserved", "V128")])
 
+# Eviction reasons (vsr.zig Header.Eviction.Reason's role): carved out of
+# the previously-reserved (always-zero) tail byte, so legacy frames decode
+# as reason 0 and the byte layout is unchanged.
+EVICTION_NO_SESSION = 1        # capacity-evicted / unknown: re-register
+EVICTION_SESSION_MISMATCH = 2  # stale session number: protocol violation
+
 EVICTION_DTYPE = _dtype([
     ("client_lo", "<u8"), ("client_hi", "<u8"),
-    ("reserved", "V112"),
+    ("reason", "u1"),
+    # Session number the eviction is ABOUT (the offending request's, or the
+    # evicted session for a capacity broadcast) — carved from the reserved
+    # tail like `reason`, so legacy frames decode as 0.  Lets a client that
+    # already re-registered discard a stale MISMATCH for its OLD session
+    # instead of dying to it, while a true duplicate-id client (whose live
+    # session matches) still surfaces the violation terminally.
+    ("session", "<u8"),
+    ("reserved", "V103"),
+])
+
+# Busy reasons (what the primary could not admit).
+BUSY_PIPELINE = 1   # prepare pipeline at pipeline_prepare_queue_max
+BUSY_WAL = 2        # WAL ring full until the next checkpoint lands
+BUSY_CLOCK = 3      # cluster clock unsynchronized: no timestamps yet
+BUSY_QUEUE = 4      # admission queue shed (bus/governor overload)
+
+BUSY_DTYPE = _dtype([
+    # Checksum of the shed request, so the client can match the signal to
+    # its in-flight request exactly like a reply.
+    ("request_checksum_lo", "<u8"), ("request_checksum_hi", "<u8"),
+    ("request_checksum_padding", "V16"),
+    ("client_lo", "<u8"), ("client_hi", "<u8"),
+    ("request", "<u4"),
+    # Hint, not a promise: ticks (~10 ms each) until the primary expects
+    # the shed condition to clear.  Clients combine it with their own
+    # jittered-exponential backoff and their deadline.
+    ("retry_after_ticks", "<u4"),
+    ("reason", "u1"),
+    ("reserved", "V71"),
 ])
 
 # View change messages (message_header.zig StartViewChange/DoViewChange/
@@ -331,6 +373,7 @@ COMMAND_DTYPES = {
     Command.nack_prepare: NACK_PREPARE_DTYPE,
     Command.request_sync_checkpoint: REQUEST_SYNC_CHECKPOINT_DTYPE,
     Command.sync_checkpoint: SYNC_CHECKPOINT_DTYPE,
+    Command.busy: BUSY_DTYPE,
 }
 
 

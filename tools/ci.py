@@ -83,7 +83,7 @@ TIERS = {
             "tests/test_durability.py", "tests/test_adversary.py",
             "tests/test_fuzz.py", "tests/test_block_repair.py",
             "tests/test_cold_consensus.py", "tests/test_storage_direct.py",
-            "tests/test_scrub.py",
+            "tests/test_scrub.py", "tests/test_overload.py",
         ],
         extra=["-m", "not slow"],
     ),
@@ -110,6 +110,14 @@ TIERS = {
         # Artifact: SCRUB_SMOKE.json at the repo root.
         cmd=["tools/scrub_smoke.py"],
     ),
+    "overload": dict(
+        # Overload fault domain smoke (docs/fault_domains.md): busy-reply
+        # round trip against the real consensus cluster at 2x offered
+        # load, priority-preserving shed (client class only), and the
+        # overload.* series in the registry snapshot.
+        # Artifact: OVERLOAD_SMOKE.json at the repo root.
+        cmd=["tools/overload_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -134,13 +142,33 @@ TIERS = {
             "::test_random_compositions",
             "tests/test_backpressure.py::"
             "test_slow_consumer_is_evicted_and_others_progress",
+            # Overload fault kind: the pinned flood seed pair (priority on
+            # passes, FIFO negative control fails liveness) — slow because
+            # the passing run commits a full flood's worth of requests —
+            # plus the governor crash-accounting fold (slow: SimCluster
+            # spin-up), which the consensus tier's "not slow" filter skips.
+            "tests/test_overload.py::TestVoprOverload",
+            "tests/test_overload.py::TestGovernorCrashAccounting",
+            # Tier-1 budget audit (PR 5): the 5 slowest tier-1 tests moved
+            # to @slow; they run whole here so the full matrix still
+            # covers them.
+            "tests/test_queries.py::TestSortedRunsIndex::"
+            "test_incremental_matches_rebuild",
+            "tests/test_scan_builder.py::TestColdTier::"
+            "test_scan_sees_evicted_transfers",
+            "tests/test_transfer_full.py::TestStaticTripParity::"
+            "test_scan_and_while_paths_identical",
+            "tests/test_cold_consensus.py::"
+            "test_tiered_cluster_converges_with_evictions",
+            "tests/test_scan_builder.py::TestPrefixScans::"
+            "test_limit_and_window_growth",
         ],
         extra=[],
     ),
 }
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
-    "scrub", "integration",
+    "scrub", "overload", "integration",
 ]
 
 

@@ -1,0 +1,124 @@
+"""CI overload smoke: prove the overload-control surface end to end,
+cheaply (docs/fault_domains.md, overload domain).
+
+In-process (CPU-pinned, deterministic sim time), three proofs with
+asserted artifacts:
+
+1. Busy-reply round trip — a polite client cohort offered 2x pipeline
+   capacity against the REAL consensus cluster receives explicit busy
+   replies (not silence), backs off, and still completes EVERY request:
+   signal-don't-drop, measured.
+2. Priority-preserving shed — under the synthetic flood the bounded
+   admission queues shed ONLY client-class traffic; view-change and
+   repair classes ride through untouched, and the AdmissionQueue's
+   drain/shed contract holds at the unit level too.
+3. ``overload.*`` metrics — the registry snapshot carries the shed/busy
+   series every sink reads (busy_sent + shed reasons from the consensus
+   shed points, bench counters from the sweep).
+
+Artifact: OVERLOAD_SMOKE.json at the repo root; the ``overload`` tier in
+tools/ci.py records pass/fail in CI_LAST.json.
+
+Usage: python tools/overload_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tigerbeetle_tpu.obs.metrics import registry
+    from tigerbeetle_tpu.vsr import overload, wire
+
+    registry.enable()
+    summary = {}
+
+    # -- 2a. AdmissionQueue unit contract -----------------------------------
+    q = overload.AdmissionQueue(4)
+    for i in range(4):
+        q.offer(overload.CLASS_CLIENT, 0xA, i)
+    shed = q.offer(overload.CLASS_VIEW_CHANGE, 0, "svc")
+    assert shed and shed[0][0] == overload.CLASS_CLIENT, (
+        "a view-change arrival must displace queued client traffic"
+    )
+    assert q.pop()[2] == "svc", "view-change class must drain first"
+    fifo = overload.AdmissionQueue(2, priority=False)
+    fifo.offer(overload.CLASS_CLIENT, 1, "a")
+    fifo.offer(overload.CLASS_CLIENT, 1, "b")
+    assert fifo.offer(overload.CLASS_VIEW_CHANGE, 0, "svc"), (
+        "FIFO mode must tail-drop regardless of class (negative control)"
+    )
+
+    # -- 1 + 2b. flood against the real cluster -----------------------------
+    import bench
+
+    point = bench.run_offered_load(2, seed=11, requests=6)
+    assert point["busy_replies"] > 0, (
+        "a 2x flood produced no busy replies — signal-don't-drop is dead"
+    )
+    assert point["drained"], "flood clients never drained"
+    expected = point["clients"] * 6
+    assert point["completed"] == expected, (
+        f"admitted-request liveness: {point['completed']} of {expected} "
+        "requests replied"
+    )
+    summary["flood_2x"] = {
+        "busy_replies": point["busy_replies"],
+        "shed_rate": point["shed_rate"],
+        "completed": point["completed"],
+        "admitted_p99_ms": point["admitted_p99_ms"],
+        "shed_by_class": point["shed_by_class"],
+    }
+
+    # At 2x the admission queues absorb the flood without class-level
+    # sheds, so the protected-class assertion would be vacuous there; 4x
+    # actually forces queue-cap evictions — the check only means something
+    # when client-class sheds demonstrably happened.
+    heavy = bench.run_offered_load(4, seed=11, requests=6)
+    by = heavy["shed_by_class"]
+    assert by["client"] > 0, (
+        f"4x flood forced no client-class sheds — the priority-shed proof "
+        f"is vacuous: {by}"
+    )
+    assert by["view_change"] == 0 and by["repair"] == 0, (
+        f"priority shed leaked into protected classes: {by}"
+    )
+    assert heavy["drained"], "4x flood clients never drained"
+    summary["flood_4x"] = {
+        "busy_replies": heavy["busy_replies"],
+        "shed_rate": heavy["shed_rate"],
+        "completed": heavy["completed"],
+        "admitted_p99_ms": heavy["admitted_p99_ms"],
+        "shed_by_class": by,
+    }
+
+    # -- 3. overload.* series in the registry -------------------------------
+    snap = registry.snapshot()
+    counters = snap["counters"]
+    series = sorted(
+        k for k in counters if k.startswith("overload.")
+    )
+    assert any(k.startswith("overload.shed.") for k in series), (
+        f"no overload.shed.* series recorded: {series}"
+    )
+    assert counters.get("overload.busy_sent", 0) > 0, (
+        "overload.busy_sent never incremented"
+    )
+    summary["series"] = series
+
+    out_path = os.path.join(REPO, "OVERLOAD_SMOKE.json")
+    with open(out_path, "w") as f:
+        json.dump({"green": True, **summary}, f, indent=1)
+    print(json.dumps({"green": True, **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
