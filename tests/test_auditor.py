@@ -12,6 +12,7 @@ import pytest
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.sim import PacketSimulator, SimCluster
 from tigerbeetle_tpu.testing.auditor import AuditError
+from tigerbeetle_tpu.vsr import wire
 
 
 def make_cluster(tmp_path, seed=1, n=3, clients=2, requests=8, **kw):
@@ -207,6 +208,79 @@ def test_audit_lookup_transfers_unit():
             3, "lookup_transfers", ts_transfers, body.tobytes(),
             bytes(bad), replica=0, replay=False,
         )
+
+
+class TestLyingReply:
+    """The byzantine fault domain's reply oracle (Auditor.observe_reply):
+    a reply contradicting committed state — or claiming an op no replica
+    ever committed — must be flagged.  Before this, the auditor only ever
+    saw honest histories."""
+
+    def _seeded_auditor(self):
+        from tigerbeetle_tpu.testing.auditor import Auditor, _encode_results
+
+        auditor = Auditor()
+        accounts = types.accounts_array(
+            [types.account(id=i, ledger=1, code=10) for i in (1, 2)]
+        )
+        results = _encode_results([])
+        auditor.observe_commit(
+            1, "create_accounts", 100, accounts.tobytes(), results,
+            replica=0, replay=False,
+        )
+        return auditor, results
+
+    def test_truthful_reply_passes(self):
+        auditor, results = self._seeded_auditor()
+        auditor.observe_reply(
+            1, "create_accounts", results, client=0xC, request=1
+        )
+
+    def test_reply_contradicting_committed_state_flagged(self):
+        auditor, _ = self._seeded_auditor()
+        lie = np.zeros(1, dtype=types.EVENT_RESULT_DTYPE)
+        lie[0]["index"] = 0
+        lie[0]["result"] = 77  # a failure the committed op never produced
+        with pytest.raises(AuditError, match="lying reply"):
+            auditor.observe_reply(
+                1, "create_accounts", lie.tobytes(), client=0xC, request=1
+            )
+
+    def test_reply_for_uncommitted_op_flagged(self):
+        auditor, results = self._seeded_auditor()
+        with pytest.raises(AuditError, match="fabricated"):
+            auditor.observe_reply(
+                99, "create_transfers", results, client=0xC, request=2
+            )
+
+    def test_reply_claiming_wrong_operation_flagged(self):
+        auditor, results = self._seeded_auditor()
+        with pytest.raises(AuditError, match="committed op is"):
+            auditor.observe_reply(
+                1, "create_transfers", results, client=0xC, request=1
+            )
+
+    def test_cluster_wiring_end_to_end(self, tmp_path):
+        """The sim wires every accepted client reply through the oracle: a
+        lying body injected at the cluster hook trips it."""
+        cluster = make_cluster(tmp_path, seed=78, requests=4)
+        finish(cluster)
+        some_op = max(cluster.auditor.records)
+        rec = cluster.auditor.records[some_op]
+        h = np.zeros((), dtype=wire.REPLY_DTYPE)
+        h["op"] = some_op
+        h["request"] = 1
+        operation = wire.Operation.create_transfers
+        # Find a committed create_transfers op so operation names line up.
+        for op, r in cluster.auditor.records.items():
+            if r[0] == "create_transfers":
+                some_op, rec = op, r
+                break
+        h["op"] = some_op
+        with pytest.raises(AuditError):
+            cluster._observe_client_reply(
+                0xAB, h, operation, rec[3][:-1] + b"\x01"
+            )
 
 
 def test_pending_expiry_mirrored(tmp_path):

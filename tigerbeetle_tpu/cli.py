@@ -154,7 +154,9 @@ def main(argv=None) -> int:
                         help="single seed; omit for a random one")
     p_vopr.add_argument("--count", type=int, default=1,
                         help="number of consecutive seeds to run")
-    p_vopr.add_argument("--ticks", type=int, default=6_000)
+    p_vopr.add_argument("--ticks", type=int, default=None,
+                        help="schedule ticks (default: 6000; the byzantine "
+                             "kind defaults to 2600)")
     p_vopr.add_argument("--tpu", action="store_true",
                         help="run the vectorized protocol-model VOPR on "
                              "the available accelerator mesh instead")
@@ -196,6 +198,18 @@ def main(argv=None) -> int:
                              "OFF (bounded FIFO tail-drop) — the negative "
                              "control that demonstrably fails the "
                              "liveness oracle")
+    p_vopr.add_argument("--byzantine", action="store_true",
+                        help="run the BYZANTINE fault kind: one replica of "
+                             "six equivocates prepares, corrupts bodies "
+                             "under stale checksums, replays captured "
+                             "frames, and forges lying client replies, "
+                             "under the deterministic open-loop workload; "
+                             "oracle: the auditor (docs/fault_domains.md)")
+    p_vopr.add_argument("--no-verify", action="store_true",
+                        help="with --byzantine: force checksum/source/"
+                             "consensus ingress verification OFF — the "
+                             "negative control that demonstrably fails "
+                             "the safety oracle")
 
     p_bench = sub.add_parser("benchmark", help="client-driven load benchmark")
     p_bench.add_argument("--addresses", default=None,
@@ -241,12 +255,15 @@ def _cmd_vopr(args) -> int:
 
     from .sim.vopr import EXIT_CORRECTNESS
 
-    if args.tpu and (args.overload or args.no_priority):
+    if args.tpu and (
+        args.overload or args.no_priority
+        or args.byzantine or args.no_verify
+    ):
         # Same loud-reject discipline as the non-TPU knob checks below:
         # the TPU vopr runs its own random schedule, so silently dropping
         # --overload would report a scenario that never ran.
-        print("error: --overload/--no-priority do not apply with --tpu",
-              file=sys.stderr)
+        print("error: --overload/--no-priority/--byzantine/--no-verify "
+              "do not apply with --tpu", file=sys.stderr)
         return 2
     if args.tpu:
         from .sim import vopr_tpu
@@ -259,7 +276,7 @@ def _cmd_vopr(args) -> int:
             "corrupt_serve", "wal_wrap", "split_brain",
             "amputate_vouch", "join_keep_stale", "scrub_off",
         }, "cli --bug choices drifted from sim.vopr_tpu.BUGS"
-        if args.count != 1 or args.ticks != 6_000:
+        if args.count != 1 or args.ticks is not None:
             print("error: --count/--ticks apply only without --tpu",
                   file=sys.stderr)
             return 2
@@ -282,7 +299,7 @@ def _cmd_vopr(args) -> int:
             return 0 if n > 0 else 1  # the oracle must catch a known bug
         return EXIT_CORRECTNESS if n > 0 else 0
 
-    from .sim.vopr import run_overload_seed, run_seed
+    from .sim.vopr import run_byzantine_seed, run_overload_seed, run_seed
 
     if args.bug is not None or args.clusters != 4096 or args.steps != 400:
         print("error: --clusters/--steps/--bug apply only with --tpu",
@@ -292,8 +309,22 @@ def _cmd_vopr(args) -> int:
         print("error: --no-priority applies only with --overload",
               file=sys.stderr)
         return 2
+    if args.no_verify and not args.byzantine:
+        print("error: --no-verify applies only with --byzantine",
+              file=sys.stderr)
+        return 2
+    if args.byzantine and (
+        args.overload or args.device_faults
+        or args.scrub_interval is not None or args.vopr_viz
+    ):
+        # Same loud-rejection discipline as --overload: the byzantine
+        # scenario owns its schedule; silently dropping a knob would
+        # report a run that never happened.
+        print("error: --overload/--device-faults/--scrub-interval/"
+              "--vopr-viz do not apply with --byzantine", file=sys.stderr)
+        return 2
     if args.overload and (
-        args.ticks != 6_000 or args.scrub_interval is not None
+        args.ticks is not None or args.scrub_interval is not None
         or args.vopr_viz
     ):
         # Loudly reject knobs the overload kind does not take (its tick
@@ -306,6 +337,21 @@ def _cmd_vopr(args) -> int:
     first = args.seed if args.seed is not None else secrets.randbits(31)
     worst = 0
     for seed in range(first, first + args.count):
+        if args.byzantine:
+            result = run_byzantine_seed(
+                seed,
+                verify=not args.no_verify,
+                ticks=args.ticks if args.ticks is not None else 2_600,
+            )
+            print(
+                f"seed={result.seed} exit={result.exit_code} "
+                f"byz_replica={result.byz_replica} "
+                f"verify={result.verify} attacks={result.attacks} "
+                f"rejected={result.rejected} "
+                f"detected={result.equivocations_detected}: {result.reason}"
+            )
+            worst = max(worst, result.exit_code)
+            continue
         if args.overload:
             result = run_overload_seed(
                 seed,
@@ -321,7 +367,9 @@ def _cmd_vopr(args) -> int:
             worst = max(worst, result.exit_code)
             continue
         result = run_seed(
-            seed, ticks=args.ticks, viz=True if args.vopr_viz else None,
+            seed,
+            ticks=args.ticks if args.ticks is not None else 6_000,
+            viz=True if args.vopr_viz else None,
             scrub_interval=args.scrub_interval or 0,
             device_faults=args.device_faults,
         )

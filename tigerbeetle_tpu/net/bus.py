@@ -39,31 +39,61 @@ class FrameError(Exception):
     pass
 
 
-async def read_message(reader: asyncio.StreamReader, message_size_max: int):
+def _count_reject(reason: str, on_reject=None) -> None:
+    """Shared rejected-frame accounting (the byzantine fault domain's
+    drop-and-count discipline, docs/fault_domains.md): the always-on
+    ``bus.rejected_frames`` series plus the per-reason byzantine.* family,
+    and the caller's per-connection hook (first-reject `_debug` record)."""
+    if _obs.enabled:
+        _obs.counter("bus.rejected_frames").inc()
+        _obs.counter(f"byzantine.rejected.{reason}").inc()
+    if on_reject is not None:
+        on_reject(reason)
+
+
+async def read_message(
+    reader: asyncio.StreamReader, message_size_max: int, on_reject=None
+):
     """Read one framed message; returns (header, command, body) or None on
-    clean EOF. Raises FrameError on corruption (caller drops the connection)."""
-    try:
-        head = await reader.readexactly(wire.HEADER_SIZE)
-    except (asyncio.IncompleteReadError, ConnectionResetError):
-        return None
-    try:
-        h, command = wire.decode_header(head)
-    except ValueError as err:
-        raise FrameError(f"bad header: {err}") from err
-    size = int(h["size"])
-    if size > message_size_max:
-        raise FrameError(f"size {size} exceeds message_size_max")
-    body = b""
-    if size > wire.HEADER_SIZE:
+    clean EOF.
+
+    Corruption discipline (message_bus.zig terminate-on-invalid, refined
+    for the byzantine fault domain): a bad HEADER means the length prefix
+    cannot be trusted, so framing is lost — FrameError, the caller drops
+    the connection.  A bad BODY under a valid header leaves framing intact
+    — the frame is skipped, counted (``bus.rejected_frames`` /
+    ``byzantine.rejected.*``, plus the caller's ``on_reject`` hook), and
+    the connection keeps serving: one malformed frame must not let a
+    malicious peer sever an honest link."""
+    while True:
         try:
-            body = await reader.readexactly(size - wire.HEADER_SIZE)
+            head = await reader.readexactly(wire.HEADER_SIZE)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             return None
         try:
+            h, command = wire.decode_header(head)
+        except ValueError as err:
+            _count_reject(getattr(err, "reason", "header"), on_reject)
+            raise FrameError(f"bad header: {err}") from err
+        size = int(h["size"])
+        if size > message_size_max:
+            _count_reject("oversize", on_reject)
+            raise FrameError(f"size {size} exceeds message_size_max")
+        body = b""
+        if size > wire.HEADER_SIZE:
+            try:
+                body = await reader.readexactly(size - wire.HEADER_SIZE)
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                return None
+        try:
+            # Empty bodies verify too: a header-only frame with a stale
+            # checksum_body is forged/corrupt even though its header
+            # checksum (which covers the stale field) passes.
             wire.verify_body(h, body)
         except ValueError as err:
-            raise FrameError(f"bad body: {err}") from err
-    return h, command, body
+            _count_reject(getattr(err, "reason", "body"), on_reject)
+            continue  # framing intact: skip the frame, keep the connection
+        return h, command, body
 
 
 class ReplicaServer:
@@ -363,10 +393,27 @@ class ReplicaServer:
                 except OSError:
                     pass
         self._accepted.add(writer)
+        # First-reject-per-connection record (mirrors cluster_bus's
+        # first-drop discipline): one _debug line + warning per connection,
+        # however many malformed frames follow.
+        rejected = {"n": 0}
+
+        def on_reject(reason: str) -> None:
+            rejected["n"] += 1
+            if rejected["n"] == 1:
+                dbg = getattr(self.replica, "_debug", None)
+                if dbg is not None:
+                    dbg("frame_reject_first", reason=reason, peer=str(peer))
+                log.warning(
+                    "rejected malformed frame from %s: %s (connection kept)",
+                    peer, reason,
+                )
+
         try:
             while True:
                 msg = await read_message(
-                    reader, self.replica.config.message_size_max
+                    reader, self.replica.config.message_size_max,
+                    on_reject=on_reject,
                 )
                 if msg is None:
                     break

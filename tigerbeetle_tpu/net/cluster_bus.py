@@ -27,7 +27,9 @@ import numpy as np
 from ..obs.metrics import registry as _obs
 from ..vsr import overload, wire
 from ..vsr.consensus import VsrReplica
-from .bus import STATSD_FLUSH_INTERVAL_S, FrameError, read_message
+from .bus import (
+    STATSD_FLUSH_INTERVAL_S, FrameError, _count_reject, read_message,
+)
 
 log = logging.getLogger("tigerbeetle_tpu.net.cluster")
 
@@ -77,6 +79,7 @@ class ClusterServer:
         self._accepted: set = set()  # live inbound transports (see close())
         self.port: Optional[int] = None
         self.dropped_sends = 0  # bounded-send-queue drops (backpressure)
+        self.rejected_frames = 0  # malformed/impersonated ingress frames
         self._last_drop_log = 0.0
         # Connections whose first send-queue drop was already _debug-logged
         # (weak refs: entries die with the writer, so the set stays bounded
@@ -234,10 +237,35 @@ class ClusterServer:
         # writer as that client — the reply would be misrouted).
         is_peer = peer is not None
         is_client = False
+        # Pinned peer identity (the byzantine fault domain's source
+        # authentication, docs/fault_domains.md): a dialed connection's
+        # identity is its address index; an accepted one pins to the first
+        # replica-classifying message's sender.  Frames whose header
+        # asserts a DIFFERENT voter identity for a source-authenticated
+        # command are forged votes/heartbeats: drop-and-count, keep the
+        # connection (one bad frame must not sever an honest link).
+        pinned = peer
+        rejected = {"n": 0}
+
+        def on_reject(reason: str) -> None:
+            self.rejected_frames += 1
+            rejected["n"] += 1
+            if rejected["n"] == 1:
+                self.replica._debug(
+                    "frame_reject_first", reason=reason,
+                    peer=-1 if pinned is None else pinned,
+                    rejected_total=self.rejected_frames,
+                )
+                log.warning(
+                    "rejected malformed frame (peer %s): %s "
+                    "(connection kept)", pinned, reason,
+                )
+
         try:
             while True:
                 msg = await read_message(
-                    reader, self.replica.config.message_size_max
+                    reader, self.replica.config.message_size_max,
+                    on_reject=on_reject,
                 )
                 if msg is None:
                     return
@@ -253,6 +281,15 @@ class ClusterServer:
                         # upgrades it (ADVICE round-1).
                         is_client = True
                     else:
+                        sender = int(h["replica"])
+                        if not (0 <= sender < self.replica.node_count):
+                            # A replica-classifying frame with an
+                            # out-of-range identity must not classify the
+                            # connection UNPINNED — that would disable the
+                            # impersonation guard for its whole lifetime.
+                            # Drop-and-count; the next frame re-attempts.
+                            _count_reject("impersonation", on_reject)
+                            continue
                         is_peer = True
                         if is_client:
                             # Upgrade: purge client registrations made during
@@ -264,9 +301,18 @@ class ClusterServer:
                             ]:
                                 del self.client_writers[key]
                         is_client = False
-                        sender = int(h["replica"])
-                        if 0 <= sender < self.replica.node_count:
-                            self.peer_writers.setdefault(sender, writer)
+                        self.peer_writers.setdefault(sender, writer)
+                        if pinned is None:
+                            pinned = sender  # accepted link: pin now
+                if (
+                    is_peer and pinned is not None
+                    and command in wire.SOURCE_AUTHENTICATED_COMMANDS
+                    and int(h["replica"]) != pinned
+                ):
+                    # A vote/heartbeat/repair frame asserting a different
+                    # voter identity than this connection's: forged.
+                    _count_reject("impersonation", on_reject)
+                    continue
                 if is_client and command in CLIENT_COMMANDS:
                     client = wire.u128(h, "client")
                     if client:

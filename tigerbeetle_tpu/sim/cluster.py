@@ -42,6 +42,213 @@ TICK_NS = 10_000_000  # one simulated tick = 10 ms
 WALL_EPOCH_NS = 1_700_000_000 * 1_000_000_000  # virtual wall clock base
 
 
+class ByzantineActor:
+    """Seeded Byzantine wrapper around ONE replica (the fifth fault domain,
+    docs/fault_domains.md): a man-in-the-middle on the replica's egress
+    plus an injector of forged frames.  The wrapped replica's INTERNAL
+    state stays honest (it journals and commits like everyone else, so the
+    cluster oracles still cover it); only what it SENDS lies.
+
+    Attack repertoire, each drawn from the actor's dedicated rng stream so
+    pinned seeds replay bit-identically:
+
+    - ``equivocate``: a forwarded prepare is replaced by two CONFLICTING
+      fully-valid variants (mutated body, checksums recomputed, the
+      primary's origin header kept) sent to different peers — the classic
+      conflicting-prepares-for-one-op-number attack.
+    - ``corrupt``: a forwarded frame's body is bit-flipped with the STALE
+      ``checksum_body`` kept and only the header checksum recomputed — the
+      satellite-audit class that slips past header-only verification.
+    - ``replay``: captured ingress frames (peers' heartbeats, votes, old
+      prepares) are re-sent later under the actor's own connection —
+      stale-view replays and impersonation in one.
+    - ``lie_reply``: a forged client reply for a request learned from the
+      prepare stream, claiming fabricated results (stale body checksum —
+      see the threat model in docs/fault_domains.md for what a fully-valid
+      forged reply would additionally require).
+    """
+
+    KINDS = ("equivocate", "corrupt", "replay", "lie_reply")
+
+    def __init__(
+        self,
+        replica: int,
+        n_replicas: int,
+        cluster_id: int,
+        seed: int,
+        kinds=None,
+        rate: float = 0.2,
+        window: Tuple[int, int] = (0, 1 << 60),
+    ) -> None:
+        self.replica = replica
+        self.n = n_replicas
+        self.cluster_id = cluster_id
+        self.rng = random.Random(seed)
+        self.kinds = set(kinds) if kinds else set(self.KINDS)
+        unknown = self.kinds - set(self.KINDS)
+        assert not unknown, f"unknown byzantine kinds: {sorted(unknown)}"
+        self.rate = rate
+        self.window = window
+        # verify=False is the run-level negative control (the cluster also
+        # strips ingress verification everywhere); the actor itself attacks
+        # identically either way — same seed, same draws, same frames.
+        self.verify = True
+        self.active = True
+        self.attacks: Dict[str, int] = {k: 0 for k in self.KINDS}
+        # Bounded observation state (learned from the wrapped replica's own
+        # ingress): client-request facts for forging replies, captured raw
+        # frames for replays.
+        self._requests: List[dict] = []
+        self._replay_pool: List[Tuple[Tuple[str, int], bytes]] = []
+
+    def _on(self, now: int) -> bool:
+        return self.active and self.window[0] <= now < self.window[1]
+
+    # -- observation ---------------------------------------------------------
+
+    def observe_ingress(
+        self, h, command: wire.Command, body: bytes, message: bytes, now: int
+    ) -> None:
+        """Record attack material from frames delivered TO the wrapped
+        replica (it legitimately sees the prepare stream and peer votes)."""
+        if not self._on(now):
+            return
+        if command == wire.Command.prepare and wire.u128(h, "client"):
+            self._requests.append({
+                "client": wire.u128(h, "client"),
+                "request": int(h["request"]),
+                "op": int(h["op"]),
+                "commit": int(h["commit"]),
+                "view": int(h["view"]),
+                "timestamp": int(h["timestamp"]),
+                "operation": int(h["operation"]),
+                "request_checksum": wire.u128(h, "request_checksum"),
+            })
+            del self._requests[:-32]
+        if command in (wire.Command.commit, wire.Command.prepare_ok,
+                       wire.Command.ping, wire.Command.pong):
+            if self.rng.random() < 0.25:
+                self._replay_pool.append(message)
+                del self._replay_pool[:-16]
+
+    # -- frame forgery --------------------------------------------------------
+
+    def _flip(self, body: bytes, salt: int = 0) -> bytes:
+        out = bytearray(body)
+        i = (self.rng.randrange(len(out)) + salt) % len(out)
+        out[i] ^= 1 << self.rng.randrange(8)
+        return bytes(out)
+
+    def _stale_body_frame(self, h, body: bytes) -> bytes:
+        """A frame whose header checksum VERIFIES but whose checksum_body
+        does not match the body it carries — the corruption class that a
+        header-only ingress check silently accepts."""
+        from ..vsr.checksum import checksum as _checksum
+
+        h = h.copy()
+        h["size"] = wire.HEADER_SIZE + len(body)
+        # checksum_body left as-is (stale for the flipped body) — or, for a
+        # header-only frame, deliberately poisoned.
+        if not wire.u128(h, "checksum_body") or not body:
+            stale = _checksum(body + b"\x00")
+            h["checksum_body_lo"] = stale & 0xFFFF_FFFF_FFFF_FFFF
+            h["checksum_body_hi"] = stale >> 64
+        c = _checksum(h.tobytes()[16:])
+        h["checksum_lo"] = c & 0xFFFF_FFFF_FFFF_FFFF
+        h["checksum_hi"] = c >> 64
+        return h.tobytes() + body
+
+    def _forge_reply(self, req: dict) -> bytes:
+        """A lying client reply: fabricated result bytes for a real request
+        (facts lifted from the observed prepare)."""
+        lie = np.zeros(1, dtype=types.EVENT_RESULT_DTYPE)
+        lie[0]["index"] = 0
+        lie[0]["result"] = 0xBAD
+        h = wire.new_header(
+            wire.Command.reply,
+            cluster=self.cluster_id,
+            view=req["view"],
+            request_checksum=req["request_checksum"],
+            client=req["client"],
+            op=req["op"],
+            commit=req["commit"],
+            timestamp=req["timestamp"],
+            request=req["request"],
+            operation=req["operation"],
+        )
+        h["replica"] = self.replica
+        return self._stale_body_frame(h, lie.tobytes())
+
+    # -- the attack surface ---------------------------------------------------
+
+    def transform(self, envelopes, now: int):
+        """Filter the wrapped replica's egress: pass, corrupt, or replace
+        with conflicting forgeries."""
+        if not self._on(now):
+            return envelopes
+        out = []
+        for dst, message in envelopes:
+            command = message[110] if len(message) > 110 else 0
+            is_prepare = command == int(wire.Command.prepare)
+            draw = self.rng.random()
+            if (
+                is_prepare and "equivocate" in self.kinds
+                and draw < self.rate
+                and len(message) > wire.HEADER_SIZE
+            ):
+                h, _, body = wire.decode(message)
+                evil_a = wire.encode(h.copy(), self._flip(body))
+                evil_b = wire.encode(h.copy(), self._flip(body, salt=7))
+                self.attacks["equivocate"] += 1
+                out.append((dst, evil_a))
+                others = [
+                    ("replica", r) for r in range(self.n)
+                    if r != self.replica and ("replica", r) != dst
+                ]
+                if others:
+                    out.append((self.rng.choice(others), evil_b))
+                continue  # the honest frame is suppressed: equivocation
+            if (
+                is_prepare and "corrupt" in self.kinds
+                and draw < 2 * self.rate
+                and len(message) > wire.HEADER_SIZE
+            ):
+                h, _, body = wire.decode(message)
+                self.attacks["corrupt"] += 1
+                out.append((dst, self._stale_body_frame(h, self._flip(body))))
+                continue
+            out.append((dst, message))
+        return out
+
+    def inject(self, now: int):
+        """Frames the actor originates on its own: stale replays and lying
+        client replies."""
+        if not self._on(now):
+            return []
+        out = []
+        if (
+            "replay" in self.kinds and self._replay_pool
+            and self.rng.random() < self.rate / 2
+        ):
+            victim = self.rng.randrange(self.n)
+            if victim != self.replica:
+                self.attacks["replay"] += 1
+                out.append((
+                    ("replica", victim),
+                    self._replay_pool[
+                        self.rng.randrange(len(self._replay_pool))
+                    ],
+                ))
+        if (
+            "lie_reply" in self.kinds and self._requests
+            and self.rng.random() < self.rate / 2
+        ):
+            req = self._requests[self.rng.randrange(len(self._requests))]
+            self.attacks["lie_reply"] += 1
+            out.append((("client", req["client"]), self._forge_reply(req)))
+        return out
+
+
 class SimClient:
     """A simulated client: register, then a finite stream of workload
     requests with retry/failover (vsr/client.zig semantics on virtual time)."""
@@ -95,6 +302,10 @@ class SimClient:
         self.backoff_until = 0
         self.busy_seen = 0
         self.latencies: List[int] = []
+        # Optional hook (client_id, reply_header, operation, body) fired on
+        # every ACCEPTED reply — the cluster wires it to the auditor's
+        # lying-reply oracle (Auditor.observe_reply).
+        self.reply_observer = None
 
     @property
     def done(self) -> bool:
@@ -222,6 +433,13 @@ class SimClient:
             return
         if wire.u128(h, "request_checksum") != self.inflight["checksum"]:
             return  # stale reply
+        if self.reply_observer is not None:
+            # Safety oracle: the accepted reply must agree with committed
+            # state (testing/auditor.observe_reply — the byzantine fault
+            # domain's lying-reply check).
+            self.reply_observer(
+                self.client_id, h, self.inflight["operation"], body
+            )
         if self.inflight["operation"] == wire.Operation.register:
             self.session = int(h["op"])
             self.request_number = 1
@@ -234,6 +452,64 @@ class SimClient:
         self.backoff_until = 0
         self.parent = self.inflight["checksum"]
         self.inflight = None
+
+
+class OpenLoopClient(SimClient):
+    """Open-loop session: requests come from a PRE-GENERATED script of
+    (arrival_tick, operation, body) entries (sim/openloop.OpenLoopGen) —
+    arrivals land on the schedule whether or not earlier requests
+    completed.  The session protocol still serializes one request at a
+    time per client id, so when the cluster lags a BACKLOG forms and the
+    arrival→reply latency (``queue_latencies``) grows — the open-loop
+    queueing signal a closed loop can never produce."""
+
+    def __init__(
+        self,
+        client_id: int,
+        cluster_id: int,
+        n_replicas: int,
+        seed: int,
+        script: List[Tuple[int, wire.Operation, bytes]],
+        retry_ticks: int = 80,
+    ) -> None:
+        super().__init__(
+            client_id, cluster_id, n_replicas, seed,
+            n_requests=len(script), retry_ticks=retry_ticks,
+        )
+        self.script = list(script)
+        self.queue_latencies: List[int] = []  # arrival -> reply, in ticks
+        self._now = 0
+        self._last_arrival: Optional[int] = None
+
+    def tick(self, now: int) -> List[Tuple[Tuple[str, int], bytes]]:
+        self._now = now
+        out = super().tick(now)
+        if (
+            self.inflight is not None
+            and self._last_arrival is not None
+            and "arrival" not in self.inflight
+        ):
+            self.inflight["arrival"] = self._last_arrival
+            self._last_arrival = None
+        return out
+
+    def _next_request(self):
+        if not self.script or self._now < self.script[0][0]:
+            return None  # nothing due yet (register rides the first due op)
+        if self.session == 0:
+            return wire.Operation.register, b""
+        arrival, operation, body = self.script.pop(0)
+        self._last_arrival = arrival
+        return operation, body
+
+    def on_message(self, h, command, body, now: int) -> None:
+        inflight = self.inflight
+        super().on_message(h, command, body, now)
+        if (
+            inflight is not None and self.inflight is None
+            and "arrival" in inflight
+        ):
+            self.queue_latencies.append(now - inflight["arrival"])
 
 
 class SimCluster:
@@ -260,6 +536,7 @@ class SimCluster:
         viz: bool = False,
         scrub_interval: int = 0,
         overload: Optional[dict] = None,
+        byzantine: Optional[dict] = None,
     ) -> None:
         self.workdir = workdir
         self.n = n_replicas
@@ -322,6 +599,34 @@ class SimCluster:
                 "admitted": 0, "shed": 0, "depth_peak": 0,
                 "shed_by_class": {},
             }
+        # Byzantine fault domain (docs/fault_domains.md): one replica's
+        # egress is wrapped by a seeded ByzantineActor (its own rng stream:
+        # seed ^ 0xB12A — arming it never shifts a base schedule's draws).
+        # Keys: replica (index, default n-1), kinds, rate, window
+        # ((start, end) ticks), verify — False is the NEGATIVE CONTROL: the
+        # cluster delivers frames without checksum/source verification and
+        # replicas skip their ingress checks, modeling a build whose
+        # verification is broken so the same pinned attack schedule must
+        # demonstrably fail the safety oracles.
+        self.byzantine = None
+        self._byz: Optional[ByzantineActor] = None
+        # Ingress drop-and-count accounting (reason -> frames), always-on
+        # for the sim's source-auth and decode rejections so oracles can
+        # assert on it without the metrics registry.
+        self.rejected_frames: Dict[str, int] = {}
+        if byzantine is not None:
+            b = dict(byzantine)
+            self._byz = ByzantineActor(
+                replica=int(b.get("replica", n_replicas - 1)),
+                n_replicas=n_replicas,
+                cluster_id=cluster_id,
+                seed=seed ^ 0xB12A,
+                kinds=b.get("kinds"),
+                rate=float(b.get("rate", 0.2)),
+                window=tuple(b.get("window", (0, 1 << 60))),
+            )
+            self._byz.verify = bool(b.get("verify", True))
+            self.byzantine = b
         self.rng = random.Random(seed)
         self.net = net or PacketSimulator(seed=seed + 1)
         self.t = 0
@@ -413,6 +718,20 @@ class SimCluster:
             )
             for j in range(n_clients)
         }
+        for c in self.clients.values():
+            self._wire_client(c)
+
+    def _wire_client(self, client: SimClient) -> None:
+        """Attach the lying-reply oracle: every reply a client ACCEPTS is
+        cross-checked against the auditor's committed records."""
+        if self.auditor is not None:
+            client.reply_observer = self._observe_client_reply
+
+    def _observe_client_reply(self, client_id, h, operation, body) -> None:
+        self.auditor.observe_reply(
+            int(h["op"]), operation.name, body,
+            client=client_id, request=int(h["request"]),
+        )
 
     def _data_path(self, i: int) -> str:
         return os.path.join(self.workdir, f"replica_{i}.data")
@@ -441,6 +760,10 @@ class SimCluster:
         )
         # Virtual time: device-recovery backoff must never wall-sleep.
         replica.machine.retry_tick_s = 0
+        if self._byz is not None and not self._byz.verify:
+            # Negative control: the consensus-level byzantine checks are
+            # forced off along with the transport's (see step()).
+            replica.ingress_verify = False
         if self.overload is not None:
             # One knob across the domain: the primary's shed points signal
             # busy exactly when the governor does.
@@ -540,17 +863,59 @@ class SimCluster:
 
     # -- the tick loop (simulator.zig main loop) ------------------------------
 
+    def _ingress_reject(self, reason: str) -> None:
+        """Drop-and-count (never crash, never apply): the byzantine.*
+        rejection family, mirrored in a plain dict so oracles can assert
+        on it with the registry disabled."""
+        self.rejected_frames[reason] = self.rejected_frames.get(reason, 0) + 1
+        from ..obs.metrics import registry as _obs
+
+        if _obs.enabled:
+            _obs.counter(f"byzantine.rejected.{reason}").inc()
+
+    def _source_ok(self, src, h, command: wire.Command) -> bool:
+        """Transport-level source authentication (the sim twin of the
+        cluster bus's pinned peer identity): a frame whose header asserts a
+        voter identity must have arrived FROM that voter; client frames
+        must carry their own sender's client id.  Relayed commands
+        (prepare, forwarded requests, re-served replies) are exempt — their
+        header origin is legitimately not the transport source."""
+        skind, sid = src
+        if skind == "replica":
+            if command in wire.SOURCE_AUTHENTICATED_COMMANDS:
+                return int(h["replica"]) == sid
+            return True
+        if command in (wire.Command.request, wire.Command.ping_client):
+            return wire.u128(h, "client") == sid
+        return False
+
     def step(self) -> None:
         self.t += 1
+        unverified = self._byz is not None and not self._byz.verify
         for src, dst, message in self.net.deliver(self.t):
             kind, ident = dst
             if kind == "replica":
                 if not self.alive[ident]:
                     continue
                 try:
-                    h, command, body = wire.decode(message)
-                except ValueError:
-                    continue  # corrupt frame: dropped like a bad TCP peer
+                    if unverified:
+                        # NEGATIVE CONTROL ONLY: parse without checksum or
+                        # source verification (wire.decode_unverified).
+                        h, command, body = wire.decode_unverified(message)
+                    else:
+                        h, command, body = wire.decode(message)
+                except ValueError as err:
+                    # Corrupt frame: dropped like a bad TCP peer — and
+                    # counted by reason (drop-and-count discipline).
+                    self._ingress_reject(getattr(err, "reason", "decode"))
+                    continue
+                if not unverified and not self._source_ok(src, h, command):
+                    self._ingress_reject("impersonation")
+                    continue
+                if self._byz is not None and ident == self._byz.replica:
+                    self._byz.observe_ingress(
+                        h, command, body, message, self.t
+                    )
                 if self.overload is not None:
                     self._admit(ident, h, command, body)
                     continue
@@ -568,10 +933,19 @@ class SimCluster:
                 if client is None:
                     continue
                 try:
-                    h, command, body = wire.decode(message)
-                except ValueError:
+                    if unverified:
+                        h, command, body = wire.decode_unverified(message)
+                    else:
+                        h, command, body = wire.decode(message)
+                except ValueError as err:
+                    self._ingress_reject(getattr(err, "reason", "decode"))
                     continue
                 client.on_message(h, command, body, self.t)
+        if self._byz is not None and self.alive[self._byz.replica]:
+            for dst, message in self._byz.inject(self.t):
+                self.net.send(
+                    ("replica", self._byz.replica), dst, message, self.t
+                )
         if self.overload is not None:
             self._drain_admission()
         for i in range(self.total):
@@ -667,6 +1041,7 @@ class SimCluster:
                 start_tick=start_tick,
                 aggressive=aggressive,
             )
+            self._wire_client(self.clients[cid])
             ids.append(cid)
         return ids
 
@@ -703,6 +1078,10 @@ class SimCluster:
         }
 
     def _route(self, src, envelopes) -> None:
+        if self._byz is not None and src == ("replica", self._byz.replica):
+            # The Byzantine wrapper owns this replica's egress: frames may
+            # pass, corrupt, or fan out as conflicting forgeries.
+            envelopes = self._byz.transform(envelopes, self.t)
         for dst, message in envelopes:
             self.net.send(src, dst, message, self.t)
 

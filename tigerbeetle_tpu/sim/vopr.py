@@ -358,6 +358,161 @@ def run_seed(
 
 
 @dataclasses.dataclass
+class ByzantineResult(VoprResult):
+    """VoprResult + the byzantine fault kind's accounting."""
+
+    byz_replica: int = -1
+    verify: bool = True
+    attacks: Optional[dict] = None       # kind -> frames forged/suppressed
+    rejected: Optional[dict] = None      # reason -> ingress frames dropped
+    equivocations_detected: int = 0
+    openloop_requests: int = 0
+
+
+def run_byzantine_seed(
+    seed: int,
+    workdir: Optional[str] = None,
+    verify: bool = True,
+    ticks: int = 2_600,
+    settle_ticks: int = 60_000,
+    rate: float = 0.2,
+    kinds=None,
+) -> ByzantineResult:
+    """The BYZANTINE fault kind (docs/fault_domains.md, fifth domain): one
+    replica of SIX lies — it equivocates conflicting prepares, corrupts
+    bodies under stale checksums, replays captured frames as its own, and
+    forges lying client replies (sim/cluster.ByzantineActor) — while a
+    deterministic open-loop workload (sim/openloop.py: Zipfian hot
+    accounts, seeded Poisson arrivals, two-phase + query mix) drives the
+    cluster.  Every byzantine draw comes from its own stream
+    (seed ^ 0xB12A), every open-loop draw from its own (seed ^ 0x09E7), so
+    pinned seeds replay bit-identically.
+
+    Oracle: the testing/auditor.py Auditor, on top of the standard set —
+    the honest quorum's committed state stays byte-identical across
+    replicas and model-exact, and every reply a client ACCEPTS matches the
+    committed record (Auditor.observe_reply).  Liveness (convergence after
+    the attack window) is asserted only because exactly 1 of 6 replicas is
+    Byzantine — a minority a view-change quorum of 4 never needs.
+
+    ``verify=False`` is the NEGATIVE CONTROL (the scrub-off discipline):
+    the same attack schedule is delivered with checksum/source/consensus
+    ingress verification forced off, and the run must demonstrably fail
+    the safety oracle — proving the verification layer is what contains
+    the Byzantine replica, not luck."""
+    import random as _random
+
+    from ..testing.auditor import AuditError
+    from .openloop import OpenLoopGen
+
+    byz_rng = _random.Random(seed ^ 0xB12A5)
+    n_replicas = 6
+    # Never the initial primary: with no crash schedule the run stays in
+    # view 0, so the Byzantine replica is a backup inside the replication
+    # ring for the whole attack window (a Byzantine PRIMARY's full forgery
+    # power is documented as undefended — docs/fault_domains.md).
+    byz_replica = byz_rng.randrange(1, n_replicas)
+    attack_window = (200, max(400, ticks - 600))
+    gen = OpenLoopGen(
+        seed ^ 0x09E7,
+        n_clients=12,
+        hot_accounts=48,
+        arrival="poisson",
+        rate=0.5,
+        start_tick=40,
+        horizon=max(500, ticks - 800),
+        batch=4,
+    )
+
+    def go(workdir: str) -> ByzantineResult:
+        cluster = SimCluster(
+            workdir,
+            n_replicas=n_replicas,
+            n_clients=1,
+            seed=seed,
+            requests_per_client=4,
+            net=PacketSimulator(seed=seed + 1, delay_mean=2, delay_max=10),
+            byzantine={
+                "replica": byz_replica,
+                "verify": verify,
+                "rate": rate,
+                "kinds": kinds,
+                "window": attack_window,
+            },
+        )
+        gen.attach(cluster)
+
+        def result(code: int, reason: str) -> ByzantineResult:
+            commits = max(
+                (r.commit_min for r in cluster.replicas if r is not None),
+                default=0,
+            )
+            actor = cluster._byz
+            res = ByzantineResult(
+                seed, code, reason, cluster.t, commits,
+                sum(actor.attacks.values()),
+            )
+            res.byz_replica = byz_replica
+            res.verify = verify
+            res.attacks = dict(actor.attacks)
+            res.rejected = dict(cluster.rejected_frames)
+            res.equivocations_detected = sum(
+                r.byzantine_detections
+                for r in cluster.replicas if r is not None
+            )
+            res.openloop_requests = gen.total_requests
+            if _obs.enabled:
+                _obs.counter("byzantine.vopr.runs").inc()
+                _obs.counter("byzantine.vopr.attacks").inc(res.faults)
+                for reason_, n in res.rejected.items():
+                    _obs.counter(
+                        f"byzantine.vopr.rejected.{reason_}"
+                    ).inc(n)
+            return res
+
+        try:
+            for _ in range(ticks):
+                cluster.step()
+            # Attack window over: the actor stands down (pass-through) and
+            # the cluster must converge and audit green.
+            cluster._byz.active = False
+            ok = cluster.run_until(
+                lambda: cluster.clients_done() and cluster.converged(),
+                max_ticks=settle_ticks,
+            )
+            if not ok:
+                states = [
+                    (r.status, r.view, r.commit_min, r.op) if r else None
+                    for r in cluster.replicas
+                ]
+                return result(
+                    EXIT_LIVENESS,
+                    f"no convergence after {settle_ticks} settle ticks "
+                    f"with 1 byzantine of {n_replicas}: {states}",
+                )
+            cluster.check_converged()
+            cluster.check_conservation()
+            return result(EXIT_PASSED, "passed")
+        except (AssertionError, AuditError) as err:
+            return result(
+                EXIT_CORRECTNESS, f"oracle violation: {err}"
+            )
+        except Exception as err:  # noqa: BLE001 — a crash IS a find
+            import traceback
+
+            tb = traceback.format_exc().strip().splitlines()
+            return result(
+                EXIT_CORRECTNESS,
+                f"crash: {type(err).__name__}: {err} @ {tb[-3:]}",
+            )
+
+    if workdir is not None:
+        return go(workdir)
+    with tempfile.TemporaryDirectory() as d:
+        return go(d)
+
+
+@dataclasses.dataclass
 class OverloadResult(VoprResult):
     """VoprResult + the overload fault kind's accounting."""
 
@@ -377,6 +532,7 @@ def run_overload_seed(
     flood_factor: Optional[int] = None,
     flood_requests: int = 24,
     settle_ticks: int = 60_000,
+    workload: str = "openloop",
 ) -> OverloadResult:
     """The OVERLOAD fault kind (docs/fault_domains.md): a seeded client
     flood at 2-8x pipeline capacity against the real consensus code, with
@@ -402,6 +558,15 @@ def run_overload_seed(
     slow fsync serves fewer messages per quantum); ``device_faults`` arms
     two forced dispatch exceptions mid-flood (the device fault kind riding
     the same schedule).
+
+    ``workload="openloop"`` (the default): the base traffic under the
+    flood is the deterministic open-loop generator (sim/openloop.py —
+    Zipfian hot accounts, seeded arrivals, two-phase + query mix over many
+    client ids), so the admission queues meet realistic production-shaped
+    traffic rather than only the synthetic flood; drawn from its own
+    stream (seed ^ 0x09E7), and the liveness oracle covers the cohort
+    (every open-loop request must eventually be replied to).
+    ``workload="uniform"`` restores the pre-openloop closed-loop-only run.
     """
     import random as _random
 
@@ -472,6 +637,21 @@ def run_overload_seed(
             flood_n, seed, n_requests=flood_requests,
             retry_ticks=1, start_tick=FLOOD_START,
         )
+        openloop_n = 0
+        if workload == "openloop":
+            from .openloop import OpenLoopGen
+
+            gen = OpenLoopGen(
+                seed ^ 0x09E7,
+                n_clients=8,
+                hot_accounts=32,
+                arrival="poisson",
+                rate=0.25,
+                start_tick=60,
+                horizon=FLOOD_TICKS - 200,
+                batch=4,
+            )
+            openloop_n = len(gen.attach(cluster))
         dev_rng = _random.Random(seed ^ 0xD5DC) if device_faults else None
         faults = 1  # the flood itself
         view_change_tick: Optional[int] = None
@@ -494,6 +674,7 @@ def run_overload_seed(
             res.view_change_tick = view_change_tick
             res.stats = cluster.overload_stats()
             res.stats["flood_active_at_vc"] = flood_active_at_vc
+            res.stats["openloop_clients"] = openloop_n
             if _obs.enabled:
                 st = res.stats
                 _obs.counter("overload.vopr.runs").inc()

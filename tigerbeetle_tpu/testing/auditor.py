@@ -97,6 +97,47 @@ class Auditor:
         self.records[op] = rec
         self._drain()
 
+    def observe_reply(
+        self,
+        op: int,
+        operation: str,
+        result_body: bytes,
+        client: int = 0,
+        request: int = 0,
+    ) -> None:
+        """Cross-check a client-ACCEPTED reply against committed state — the
+        byzantine fault domain's lying-reply oracle (docs/fault_domains.md).
+
+        A reply exists only because some replica committed the op and
+        answered, and every replica's commit of that op was already staged
+        through ``observe_commit`` (the primary commits before it replies,
+        and network delivery happens strictly later on the sim's virtual
+        time).  So a reply naming an op with NO record is fabricated, and a
+        reply whose result bytes differ from the committed record is a lie
+        about state the honest quorum agreed on — both are safety
+        violations regardless of which replica sent the frame."""
+        rec = self.records.get(op)
+        if rec is None:
+            raise AuditError(
+                f"client {client:#x} accepted a reply claiming op {op} "
+                f"({operation}, request {request}) but no replica ever "
+                f"committed that op — fabricated reply"
+            )
+        rec_operation, _ts, _body, rec_results = rec
+        if rec_operation != operation:
+            raise AuditError(
+                f"client {client:#x} accepted a reply for op {op} claiming "
+                f"{operation}, but the committed op is {rec_operation}"
+            )
+        if bytes(result_body) != rec_results:
+            raise AuditError(
+                f"client {client:#x} accepted a lying reply for op {op} "
+                f"({operation}, request {request}): result bytes diverge "
+                f"from the committed record "
+                f"(got {bytes(result_body)[:48]!r} "
+                f"want {rec_results[:48]!r})"
+            )
+
     def _drain(self) -> None:
         while self.next_op in self.records:
             operation, timestamp, body, result_body = self.records[self.next_op]

@@ -153,6 +153,25 @@ class VsrReplica(Replica):
         # silent-drop behavior pinned seeds and the bench differential
         # replay against.
         self.overload_control = overload.enabled()
+        # Byzantine ingress discipline (docs/fault_domains.md byzantine
+        # domain).  ON by default — the checks only reject frames an honest
+        # cluster never produces (forged origin fields, commit-checksum
+        # conflicts), so every pinned seed replays bit-identically.  The
+        # VOPR byzantine kind's negative control forces it off
+        # (run_byzantine_seed(verify=False)) to prove the verification is
+        # what carries safety, the scrub-off discipline.
+        self.ingress_verify = True
+        # Plain equivocation-detection count (registry-independent): the
+        # VOPR byzantine kind reads it for its proof artifacts.
+        self.byzantine_detections = 0
+        # Content anchors (op -> canonical header checksum) learned from
+        # SOURCE-AUTHENTICATED origins only: commit heartbeats
+        # (commit_checksum) and installed view-change windows.  Backups
+        # execute an op only when its journaled content parent-chains up to
+        # an anchor (_content_certified) — the defense that makes a relayed
+        # forged prepare inert: it can enter the journal, but it can never
+        # EXECUTE, because no honest primary will ever anchor its checksum.
+        self._anchors: Dict[int, int] = {}
 
         # Journaled prepare headers by op for the live window (chain checks,
         # repair responses, DVC/SV bodies).  Pruned at checkpoint.
@@ -470,11 +489,38 @@ class VsrReplica(Replica):
 
     # -- message dispatch ----------------------------------------------------
 
+    def _reject_frame(self, reason: str, **kw) -> List[Msg]:
+        """Drop-and-count a provably ill-formed ingress frame (never crash,
+        never apply): the byzantine.* rejection family every sink reads."""
+        if _obs.enabled:
+            _obs.counter(f"byzantine.rejected.{reason}").inc()
+        if self._debug_file is not None:
+            self._debug("ingress_reject", reason=reason, **kw)
+        return []
+
+    # Commands that only the primary of their stamped view ever originates.
+    # Prepares keep the preparing primary's header through ring forwarding
+    # and repair fills, so the invariant holds for EVERY honest frame of
+    # these commands, current-view or archival — a frame violating it is
+    # forged regardless of transport-level source authentication.
+    _PRIMARY_ORIGIN_COMMANDS = (
+        wire.Command.prepare, wire.Command.commit, wire.Command.start_view,
+    )
+
     def on_message(
         self, h: np.ndarray, command: wire.Command, body: bytes
     ) -> List[Msg]:
         if wire.u128(h, "cluster") != self.cluster:
             return []
+        if (
+            self.ingress_verify
+            and command in self._PRIMARY_ORIGIN_COMMANDS
+            and int(h["replica"]) != self.primary_index(int(h["view"]))
+        ):
+            return self._reject_frame(
+                "not_primary", cmd=command.name,
+                claimed=int(h["replica"]), view=int(h["view"]),
+            )
         if self._block_repair is not None and command not in (
             wire.Command.block, wire.Command.ping, wire.Command.pong
         ):
@@ -810,6 +856,13 @@ class VsrReplica(Replica):
                 # parent link of the next header before adopting.
                 self.stash[op] = (h, body)
                 self._fill_gaps(out)
+            elif existing is not None and _obs.enabled:
+                # Two different prepares for the same op in the SAME view:
+                # an honest primary assigns each op once, so this is
+                # equivocation evidence (the conflicting frame is dropped
+                # either way; the commit-checksum anchor adjudicates which
+                # copy is canonical).
+                _obs.counter("byzantine.prepare_conflicts").inc()
             return out
 
         if op == self.op + 1 and wire.u128(h, "parent") == self.parent_checksum:
@@ -821,6 +874,22 @@ class VsrReplica(Replica):
             self._drain_stash(out)
             self._commit_journal(out)
         else:
+            if (
+                self.ingress_verify
+                and op == self.op + 1
+                and self.op > self.commit_min
+                and _obs.enabled
+            ):
+                # A same-view prepare extending the chain names a different
+                # checksum for our uncommitted head: equivocation evidence.
+                # Observability only — a single unauthenticated frame must
+                # NOT evict the head (a forged parent claim would discard a
+                # journaled, possibly-acked op and poison the repair target
+                # with an unfulfillable checksum); adjudication belongs to
+                # the source-authenticated anchors (on_commit,
+                # _content_certified) and the anchor-certified headers
+                # path (on_headers).
+                _obs.counter("byzantine.prepare_conflicts").inc()
             # Gap (lost prepare) or fork: stash and repair.
             self.stash[op] = (h, body)
             out.extend(self._repair_gaps())
@@ -960,11 +1029,101 @@ class VsrReplica(Replica):
         if self.status != NORMAL or self.is_primary:
             return []
         self._primary_spoke()
-        self.commit_max = max(self.commit_max, int(h["commit"]))
         out: List[Msg] = []
+        # Commit-content anchoring (byzantine domain): the heartbeat names
+        # the checksum of the op it commits.  If OUR header for that op
+        # differs, a forged prepare equivocated its content into our chain
+        # — evict the fork and repair the canonical body (by checksum, so
+        # repair responses are unforgeable) BEFORE the commit path can
+        # execute it.  checksum 0 = unanchored (legacy/pruned): skip.
+        want = wire.u128(h, "commit_checksum")
+        commit_op = int(h["commit"])
+        if want:
+            self._note_anchor(commit_op, want)
+        if self.ingress_verify and want and commit_op > self.commit_min:
+            mine = self.headers.get(commit_op)
+            if mine is not None and wire.header_checksum(mine) != want:
+                self.byzantine_detections += 1
+                if _obs.enabled:
+                    _obs.counter("byzantine.equivocation_detected").inc()
+                self._debug(
+                    "commit_checksum_conflict", op=commit_op,
+                    mine=f"{wire.header_checksum(mine):#x}"[:18],
+                )
+                self._evict_fork(commit_op, want)
+                self.commit_max = max(self.commit_max, commit_op)
+                out.extend(self._request_missing())
+                return out
+            if mine is None and self.missing.get(commit_op, want) != want:
+                # A forged frame polluted the repair target for this op;
+                # the source-authenticated anchor corrects it (honest runs
+                # already record the canonical checksum — this is a no-op
+                # there).
+                self.missing[commit_op] = want
+        self.commit_max = max(self.commit_max, commit_op)
         self._commit_journal(out)
         out.extend(self._maybe_start_sync(int(h["checkpoint_op"])))
         return out
+
+    def _note_anchor(self, op: int, checksum: int) -> None:
+        """Record a source-authenticated content anchor; bounded by the
+        live journal window (pruned below commit_min)."""
+        if op <= self.commit_min and op in self._anchors:
+            return
+        self._anchors[op] = checksum
+        if len(self._anchors) > 64:
+            for o in [o for o in self._anchors if o < self.commit_min]:
+                del self._anchors[o]
+
+    def _content_certified(self, op: int) -> bool:
+        """True iff the journaled content at ``op`` parent-chains up to a
+        source-authenticated anchor (see _anchors).  Walking DOWN from the
+        anchor, any non-linking header is a detected fork: evicted, with
+        the canonical checksum recorded for repair-by-checksum."""
+        for a in sorted(o for o in self._anchors if o >= op):
+            if a > self.op:
+                break  # no headers past our head to walk from
+            h = self.headers.get(a)
+            if h is None:
+                continue
+            if wire.header_checksum(h) != self._anchors[a]:
+                self.byzantine_detections += 1
+                if _obs.enabled:
+                    _obs.counter("byzantine.equivocation_detected").inc()
+                self._debug("anchor_fork_evicted", op=a)
+                self._evict_fork(a, self._anchors[a])
+                return False
+            k = a
+            while k > op:
+                hk = self.headers.get(k)
+                below = self.headers.get(k - 1)
+                if hk is None or below is None:
+                    return False  # header gap: repair must fill first
+                parent = wire.u128(hk, "parent")
+                if wire.header_checksum(below) != parent:
+                    self.byzantine_detections += 1
+                    if _obs.enabled:
+                        _obs.counter(
+                            "byzantine.equivocation_detected"
+                        ).inc()
+                    self._debug("anchor_chain_fork_evicted", op=k - 1)
+                    self._evict_fork(k - 1, parent)
+                    return False
+                k -= 1
+            return True
+        return False
+
+    def _evict_fork(self, op: int, canonical_checksum: int) -> None:
+        """An uncommitted header at ``op`` is provably not the canonical
+        ``canonical_checksum``: evict it and schedule a repair fetch by the
+        canonical checksum.  The chain walk and the repair fill's downward
+        cascade (_fill_missing) evict any forged ancestors the same way."""
+        assert op > self.commit_min
+        self.headers.pop(op, None)
+        self.stash.pop(op, None)
+        self.pipeline.pop(op, None)
+        self._nacks.pop(op, None)
+        self.missing[op] = canonical_checksum
 
     def _extend_verification(self) -> None:
         """Walk the parent chain DOWN from the verification floor, marking
@@ -1043,6 +1202,16 @@ class VsrReplica(Replica):
                 break
             h = self.headers.get(op)
             if h is None:
+                break
+            if (
+                self.ingress_verify and self.replica_count > 1
+                and not self.is_primary and not self._content_certified(op)
+            ):
+                # CERTIFIED COMMITS (byzantine domain): a backup executes
+                # only content that chains to a source-authenticated
+                # anchor.  Waiting costs at most one commit-heartbeat
+                # interval in honest runs; executing early is how a forged
+                # relayed prepare becomes committed state.
                 break
             read = self.journal.read_prepare(op)
             if read is None or wire.header_checksum(read[0]) != (
@@ -1446,6 +1615,16 @@ class VsrReplica(Replica):
         head = self.headers.get(self.op)
         if head is not None:
             self.parent_checksum = wire.header_checksum(head)
+        # The installed window is quorum-selected canonical content arriving
+        # over a source-authenticated SV/DVC: anchor it for certified
+        # commits (sparsely + the top, to keep certification walks short).
+        for op_a in by_op:
+            if self.commit_min < op_a <= target_op and (
+                op_a == target_op or op_a % 16 == 0
+            ):
+                self._note_anchor(
+                    op_a, wire.header_checksum(by_op[op_a])
+                )
         # The installed window is canonical by construction: lower the
         # verification floor to its CONTIGUOUS-from-head start (never raise
         # it — a narrow SV on an already-verified log must not re-suspect
@@ -1807,10 +1986,60 @@ class VsrReplica(Replica):
                         self.missing[op] = checksum
                     else:
                         self._repipeline(op, ch)
+        # Anchor-certified cover of the response (byzantine domain): ops
+        # whose header matches a SOURCE-AUTHENTICATED anchor, extended
+        # downward through the response's own parent links.  Only this
+        # certified set may testify against our journaled head — a forged
+        # headers response cannot reproduce an anchored checksum, so it
+        # can never evict an honest head (checksums are not MACs; a single
+        # unauthenticated frame must not pick repair targets).
+        certified: set = set()
+        if self.ingress_verify:
+            by_op = {int(ch["op"]): ch for ch in headers}
+            for a in sorted(by_op, reverse=True):
+                if a in certified:
+                    continue
+                if self._anchors.get(a) != wire.header_checksum(by_op[a]):
+                    continue
+                k = a
+                while k in by_op:
+                    certified.add(k)
+                    below = by_op.get(k - 1)
+                    if below is None or wire.header_checksum(below) != (
+                        wire.u128(by_op[k], "parent")
+                    ):
+                        break
+                    k -= 1
         for ch in sorted(headers, key=lambda x: int(x["op"])):
             op = int(ch["op"])
             if op > self.op_prepare_max:
                 break  # WAL bound: cannot take bodies this far ahead yet
+            if (
+                self.ingress_verify
+                and op == self.op + 1
+                and op in certified
+                and wire.u128(ch, "parent") != self.parent_checksum
+                and self.op > self.commit_min
+                and not self.is_primary
+                and self.op not in self.pipeline
+            ):
+                # The ANCHORED canonical suffix chains from a different
+                # checksum for our uncommitted head than we journaled: our
+                # head is a fork (a forged variant slipped into the ring),
+                # and without eviction suffix adoption would wedge forever
+                # — the byzantine ring tail's repair responses never link
+                # onto a forged head.  The parent named by a certified
+                # header IS canonical, so the checksum-matched refetch is
+                # satisfiable by any honest peer.
+                self.byzantine_detections += 1
+                if _obs.enabled:
+                    _obs.counter("byzantine.equivocation_detected").inc()
+                self._debug(
+                    "headers_head_fork_evicted", op=self.op,
+                )
+                self._evict_fork(self.op, wire.u128(ch, "parent"))
+                out.extend(self._request_missing())
+                break  # re-adopt on the next repair round, head-first
             if op == self.op + 1 and wire.u128(ch, "parent") == (
                 self.parent_checksum
             ):
@@ -1824,8 +2053,32 @@ class VsrReplica(Replica):
     def _fill_missing(self, h: np.ndarray, body: bytes) -> None:
         op = int(h["op"])
         self.journal.write_prepare(wire.encode(h, body))
+        # Install the header too: a fork evicted by the commit-checksum
+        # anchor (_evict_fork) left only the `missing` entry — the fill is
+        # what restores the canonical header.  (For the ordinary
+        # missing-body case the header is already this one: checksum
+        # identity covers every header byte.)
+        self.headers[op] = h
+        if op == self.op:
+            # Refilled the HEAD: re-anchor the chain tip or the next fresh
+            # prepare would be checked against the evicted fork's checksum.
+            self.parent_checksum = wire.header_checksum(h)
         del self.missing[op]
         self._nacks.pop(op, None)
+        # Downward cascade: the canonical fill names its parent's checksum.
+        # A predecessor that does not match is a forged ancestor
+        # (equivocated into our chain before the anchor caught it): evict
+        # it and repair by the now-known canonical checksum, all the way
+        # down until the chain meets honest history.
+        if self.ingress_verify and op - 1 > self.commit_min:
+            below = self.headers.get(op - 1)
+            parent = wire.u128(h, "parent")
+            if below is not None and wire.header_checksum(below) != parent:
+                self.byzantine_detections += 1
+                if _obs.enabled:
+                    _obs.counter("byzantine.equivocation_detected").inc()
+                self._debug("chain_fork_evicted", op=op - 1)
+                self._evict_fork(op - 1, parent)
         self._repipeline(op, h)
         self._repair_timeout.reset(self._ticks)  # repair progressing
         if getattr(self, "_new_view_pending", None) is not None and (
@@ -2478,9 +2731,20 @@ class VsrReplica(Replica):
                 self._abdicate_ticks = 0
             if self._ticks - self._last_commit_sent >= COMMIT_HEARTBEAT:
                 self._last_commit_sent = self._ticks
+                # commit_checksum anchors the heartbeat to the CONTENT of
+                # the committed head, not just its number: backups verify
+                # it against their own header for that op, so a Byzantine
+                # peer equivocating prepare bodies is detected before the
+                # forged op ever executes (see on_commit).  0 when the
+                # header is gone (pruned below a checkpoint) — legacy
+                # frames decode the same way, so the field is skippable.
+                head = self.headers.get(self.commit_min)
                 commit = self._hdr(
                     wire.Command.commit,
                     commit=self.commit_min,
+                    commit_checksum=(
+                        wire.header_checksum(head) if head is not None else 0
+                    ),
                     checkpoint_op=self.op_checkpoint,
                     timestamp_monotonic=self.clock.ping_timestamp(),
                 )

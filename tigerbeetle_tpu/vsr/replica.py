@@ -1342,8 +1342,13 @@ class Replica:
         )
         buf = self.storage.read(off, size)
         try:
-            h, _, body = wire.decode(buf)
-            return buf[: int(h["size"])]
+            # Slice to the header's own size before verifying: the stored
+            # slot may legitimately hold more bytes than this reply
+            # (decode() itself rejects trailing bytes on ingress frames).
+            h, _ = wire.decode_header(buf)
+            raw = buf[: int(h["size"])]
+            wire.verify_body(h, raw[wire.HEADER_SIZE:])
+            return raw
         except ValueError:
             return b""  # corrupt stored reply: client will retry
 

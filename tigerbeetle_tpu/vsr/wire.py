@@ -26,6 +26,16 @@ HEADER_SIZE = 256
 VERSION = 0
 
 
+class WireError(ValueError):
+    """A frame failed verification.  ``reason`` is a stable slug (the
+    byzantine.* rejection taxonomy — docs/fault_domains.md): ingress paths
+    drop-and-count by it instead of parsing message text."""
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
 class Command(enum.IntEnum):
     """VSR protocol commands (vsr.zig:168-206)."""
 
@@ -446,35 +456,99 @@ def encode(h: np.ndarray, body: bytes = b"") -> bytes:
 
 
 def decode_header(buf: bytes) -> Tuple[np.ndarray, Command]:
-    """Parse+verify the 256-byte header prefix. Raises ValueError on a bad
-    checksum/command — callers treat that as a corrupt/malicious frame."""
+    """Parse+verify the 256-byte header prefix. Raises WireError (a
+    ValueError) on a bad checksum/command — callers treat that as a
+    corrupt/malicious frame."""
     if len(buf) < HEADER_SIZE:
-        raise ValueError("short header")
+        raise WireError("short_header", f"short header: {len(buf)} bytes")
     prefix = np.frombuffer(buf[:HEADER_SIZE], dtype=PREFIX_DTYPE)[0]
     expected = checksum(buf[16:HEADER_SIZE])
     if u128(prefix, "checksum") != expected:
-        raise ValueError("header checksum mismatch")
+        raise WireError("header_checksum", "header checksum mismatch")
     try:
         command = Command(int(prefix["command"]))
     except ValueError as err:
-        raise ValueError(f"unknown command {int(prefix['command'])}") from err
+        raise WireError(
+            "unknown_command",
+            f"unknown command {int(prefix['command'])}",
+        ) from err
     dt = COMMAND_DTYPES.get(command, PREFIX_DTYPE)
     h = np.frombuffer(buf[:HEADER_SIZE], dtype=dt)[0]
     if int(h["size"]) < HEADER_SIZE:
-        raise ValueError("size < header size")
+        raise WireError("bad_size", "size < header size")
     return h, command
 
 
 def verify_body(h: np.ndarray, body: bytes) -> None:
+    """Verify the body against the header's checksum_body — including the
+    EMPTY body: a header-only frame whose checksum_body is not checksum(b"")
+    is corrupt/forged too (its header checksum covers the stale field, so
+    the header check alone cannot see it)."""
     if len(body) != int(h["size"]) - HEADER_SIZE:
-        raise ValueError("body length != size")
+        raise WireError("body_length", "body length != size")
     if checksum(body) != u128(h, "checksum_body"):
-        raise ValueError("body checksum mismatch")
+        raise WireError("body_checksum", "body checksum mismatch")
 
 
 def decode(buf: bytes) -> Tuple[np.ndarray, Command, bytes]:
-    """Parse+verify a full message (header + body)."""
+    """Parse+verify a full message (header + body).  The buffer must hold
+    EXACTLY one frame: trailing bytes beyond ``size`` are rejected — a
+    forged short ``size`` must not silently discard (and thereby smuggle
+    past the checksums) part of what the peer actually sent."""
     h, command = decode_header(buf)
+    if len(buf) != int(h["size"]):
+        raise WireError(
+            "trailing_bytes", f"{len(buf)} bytes, size {int(h['size'])}"
+        )
     body = buf[HEADER_SIZE : int(h["size"])]
     verify_body(h, body)
     return h, command, body
+
+
+def decode_unverified(buf: bytes) -> Tuple[np.ndarray, Command, bytes]:
+    """Parse a frame WITHOUT any checksum/size verification.
+
+    This exists ONLY as the VOPR byzantine negative control
+    (sim/vopr.run_byzantine_seed(verify=False) — the scrub-off analogue):
+    it models a build whose ingress verification is broken, so the pinned
+    attack schedule can demonstrably fail the safety oracles.  Never call
+    it from production paths; tblint's ingress discipline assumes decode().
+    """
+    if len(buf) < HEADER_SIZE:
+        raise WireError("short_header", f"short header: {len(buf)} bytes")
+    prefix = np.frombuffer(buf[:HEADER_SIZE], dtype=PREFIX_DTYPE)[0]
+    try:
+        command = Command(int(prefix["command"]))
+    except ValueError as err:
+        raise WireError(
+            "unknown_command",
+            f"unknown command {int(prefix['command'])}",
+        ) from err
+    dt = COMMAND_DTYPES.get(command, PREFIX_DTYPE)
+    h = np.frombuffer(buf[:HEADER_SIZE], dtype=dt)[0]
+    size = int(h["size"])
+    if size < HEADER_SIZE:
+        raise WireError("bad_size", "size < header size")
+    return h, command, buf[HEADER_SIZE:size]
+
+
+# Commands whose header ``replica`` field asserts the SENDER's own identity
+# (votes, acks, heartbeats, repair requests/responses built fresh by the
+# sender).  Transports that know the authenticated source — the sim's packet
+# addresses, the cluster bus's dialed peer connections — require
+# header.replica == source for these and drop-and-count the rest
+# (byzantine.rejected.impersonation): without it one Byzantine replica can
+# forge any peer's vote or heartbeat.  Deliberately EXCLUDED (legitimately
+# relayed, so the header's origin is not the socket peer): ``prepare``
+# (ring replication + repair fills keep the original primary's header),
+# ``request`` (backups forward client requests), ``reply``/``eviction``/
+# ``busy`` (stored replies are re-served verbatim by peers).
+SOURCE_AUTHENTICATED_COMMANDS = frozenset({
+    Command.ping, Command.pong,
+    Command.prepare_ok, Command.commit,
+    Command.start_view_change, Command.do_view_change, Command.start_view,
+    Command.request_start_view, Command.request_headers,
+    Command.request_prepare, Command.nack_prepare, Command.headers,
+    Command.request_reply, Command.request_blocks, Command.block,
+    Command.request_sync_checkpoint, Command.sync_checkpoint,
+})

@@ -1,0 +1,119 @@
+"""CI byzantine smoke: prove the fifth fault domain end to end, cheaply
+(docs/fault_domains.md, byzantine domain).
+
+In-process (deterministic sim time), four proofs with asserted artifacts:
+
+1. Pinned seed, defenses ON — one replica of six equivocates, corrupts,
+   replays, and lies to clients under the open-loop Zipfian workload, and
+   the run passes every safety oracle (auditor, convergence,
+   conservation, client-reply coherence) with rejections and equivocation
+   detections demonstrably firing.
+2. Bit-identical replay — the same seed reproduces the exact attack and
+   rejection counts (VOPR reproducibility discipline).
+3. Negative control — the SAME schedule with checksum/source/consensus
+   ingress verification forced off (``verify=False``) must fail the
+   safety oracle (exit 129): the verification layer is what contains the
+   Byzantine replica, not luck.
+4. ``byzantine.*`` metrics — the registry snapshot (dumped to
+   METRICS.json like the other smoke tiers) carries the rejected-frame
+   counters every sink reads.
+
+Artifact: BYZANTINE_SMOKE.json at the repo root; the ``byzantine`` tier
+in tools/ci.py records pass/fail in CI_LAST.json.
+
+Usage: python tools/byzantine_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 42
+TICKS = 2_600
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tigerbeetle_tpu.obs.metrics import registry
+    from tigerbeetle_tpu.sim.vopr import (
+        EXIT_CORRECTNESS, EXIT_PASSED, run_byzantine_seed,
+    )
+
+    registry.enable()
+    summary = {"seed": SEED, "ticks": TICKS}
+
+    # -- 1. pinned seed, defenses on ----------------------------------------
+    on = run_byzantine_seed(SEED, ticks=TICKS)
+    assert on.exit_code == EXIT_PASSED, (
+        f"defended byzantine seed failed: exit={on.exit_code} {on.reason}"
+    )
+    assert sum(on.attacks.values()) > 0, "the actor never attacked"
+    assert on.rejected.get("body_checksum", 0) > 0, (
+        f"no corrupt frames rejected by body checksum: {on.rejected}"
+    )
+    assert on.rejected.get("impersonation", 0) > 0, (
+        f"no forged-origin frames rejected by source auth: {on.rejected}"
+    )
+    assert on.equivocations_detected > 0, (
+        "no equivocation was ever detected by the anchor machinery"
+    )
+    summary["defended"] = {
+        "exit": on.exit_code,
+        "byz_replica": on.byz_replica,
+        "attacks": on.attacks,
+        "rejected": on.rejected,
+        "equivocations_detected": on.equivocations_detected,
+        "commits": on.commits,
+        "openloop_requests": on.openloop_requests,
+    }
+
+    # -- 2. bit-identical replay --------------------------------------------
+    replay = run_byzantine_seed(SEED, ticks=TICKS)
+    for field in (
+        "exit_code", "reason", "ticks", "commits", "attacks", "rejected",
+        "equivocations_detected", "byz_replica",
+    ):
+        a, b = getattr(on, field), getattr(replay, field)
+        assert a == b, f"replay diverged on {field}: {a} vs {b}"
+    summary["replay_identical"] = True
+
+    # -- 3. negative control: verification forced off -----------------------
+    off = run_byzantine_seed(SEED, ticks=TICKS, verify=False)
+    assert off.exit_code == EXIT_CORRECTNESS, (
+        f"verification off must fail the safety oracle, got "
+        f"exit={off.exit_code}: {off.reason}"
+    )
+    summary["negative_control"] = {
+        "exit": off.exit_code, "reason": off.reason[:160],
+    }
+
+    # -- 4. byzantine.* series in METRICS.json ------------------------------
+    metrics_path = os.path.join(REPO, "METRICS.json")
+    snap = registry.dump(metrics_path)
+    counters = snap["counters"]
+    rejected_series = sorted(
+        k for k in counters if k.startswith("byzantine.rejected.")
+    )
+    assert rejected_series, (
+        f"no byzantine.rejected.* counters in METRICS.json: "
+        f"{sorted(counters)[:20]}"
+    )
+    assert counters.get("byzantine.equivocation_detected", 0) > 0, (
+        "byzantine.equivocation_detected never incremented"
+    )
+    summary["series"] = rejected_series + ["byzantine.equivocation_detected"]
+
+    out_path = os.path.join(REPO, "BYZANTINE_SMOKE.json")
+    with open(out_path, "w") as f:
+        json.dump({"green": True, **summary}, f, indent=1)
+    print(json.dumps({"green": True, **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,7 @@ TIERS = {
             "tests/test_fuzz.py", "tests/test_block_repair.py",
             "tests/test_cold_consensus.py", "tests/test_storage_direct.py",
             "tests/test_scrub.py", "tests/test_overload.py",
+            "tests/test_byzantine.py",
         ],
         extra=["-m", "not slow"],
     ),
@@ -118,6 +119,15 @@ TIERS = {
         # Artifact: OVERLOAD_SMOKE.json at the repo root.
         cmd=["tools/overload_smoke.py"],
     ),
+    "byzantine": dict(
+        # Byzantine fault domain smoke (docs/fault_domains.md): pinned
+        # seed with one equivocating/corrupting/lying replica of six
+        # passes all safety oracles with defenses on, replays
+        # bit-identically, and demonstrably fails the auditor with
+        # verification forced off; byzantine.* counters asserted in
+        # METRICS.json.  Artifact: BYZANTINE_SMOKE.json at the repo root.
+        cmd=["tools/byzantine_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -149,6 +159,9 @@ TIERS = {
             # spin-up), which the consensus tier's "not slow" filter skips.
             "tests/test_overload.py::TestVoprOverload",
             "tests/test_overload.py::TestGovernorCrashAccounting",
+            # Byzantine fault kind: the pinned on/off proof pair (slow:
+            # two full 6-replica runs under the open-loop workload).
+            "tests/test_byzantine.py::TestVoprByzantine",
             # Tier-1 budget audit (PR 5): the 5 slowest tier-1 tests moved
             # to @slow; they run whole here so the full matrix still
             # covers them.
@@ -168,7 +181,7 @@ TIERS = {
 }
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
-    "scrub", "overload", "integration",
+    "scrub", "overload", "byzantine", "integration",
 ]
 
 
