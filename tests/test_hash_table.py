@@ -144,3 +144,90 @@ def test_insert_under_jit():
     t = step(t, lo, hi)
     t = step(t, lo, hi)  # idempotent: already present
     assert int(t.count) == 3
+
+
+def _claim_slots_sorted_reference(table, key_lo, key_hi, insert_mask,
+                                  max_probe):
+    """The pre-PR7 sort-based claim protocol, kept as the parity oracle:
+    per iteration every unplaced lane probes home+i, and among unplaced
+    lanes sharing a slot the lowest batch index wins (argsort + first-of-
+    run).  claim_slots' group-rank rewrite must pick IDENTICAL slots."""
+    from tigerbeetle_tpu.u128 import mix64
+
+    capacity = table.capacity
+    n = key_lo.shape[0]
+    mask = jnp.uint64(capacity - 1)
+    home = mix64(key_lo, key_hi) & mask
+    sentinel = jnp.uint64(capacity)
+    occ = np.asarray(
+        (table.key_lo != 0) | (table.key_hi != 0) | table.tombstone
+    ).copy()
+    home_np = np.asarray(home)
+    unplaced = np.asarray(insert_mask).copy()
+    claimed = np.full(n, capacity, np.uint64)
+    offset = np.zeros(n, np.uint64)
+    while unplaced.any():
+        cur = (home_np + offset) & np.uint64(capacity - 1)
+        cand = np.where(unplaced, cur, np.uint64(capacity))
+        order = np.argsort(cand, kind="stable")
+        first = np.ones(n, bool)
+        first[1:] = cand[order][1:] != cand[order][:-1]
+        winner = np.zeros(n, bool)
+        winner[order] = first
+        win = unplaced & ~occ[cur] & winner
+        claimed[win] = cur[win]
+        occ[cur[win]] = True
+        unplaced = unplaced & ~win
+        offset[unplaced] += 1
+        if (offset >= max_probe).any():
+            break
+    return claimed
+
+
+def test_claim_parity_with_sorted_protocol():
+    """The group-rank claim rewrite is bit-identical to the documented
+    sort-based protocol, including intra-batch home collisions, masked
+    lanes interleaved with live ones, and a well-filled table."""
+    rng = np.random.default_rng(0xC1A1)
+    t = ht.make_table(1 << 12, {"val": jnp.uint64})
+    # Pre-fill to ~45% so probe chains are realistic.
+    pre = rng.choice(np.arange(1, 1 << 20), size=1800, replace=False)
+    lo, hi = keys_of([int(v) for v in pre])
+    t, _ = ht.insert(t, lo, hi, jnp.ones(len(pre), jnp.bool_),
+                     {"val": lo}, MAX_PROBE)
+    for trial in range(3):
+        n = 512
+        ids = rng.choice(np.arange(1 << 20, 1 << 21), size=n, replace=False)
+        mask_np = rng.random(n) < 0.8  # interleaved masked-out lanes
+        lo, hi = keys_of([int(v) for v in ids])
+        mask = jnp.asarray(mask_np)
+        got, ovf = ht.claim_slots(t, lo, hi, mask, MAX_PROBE)
+        want = _claim_slots_sorted_reference(t, lo, hi, mask, MAX_PROBE)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert not bool(ovf)
+        # Commit this trial's claims so the next trial sees a fuller table.
+        t = ht.write_rows(t, lo, hi, got, mask, {"val": lo})
+
+
+def test_claim_parity_forced_home_collisions():
+    """Many lanes sharing one home slot place in strict batch-lane order
+    past the cluster (the lowest-lane-wins rule)."""
+    t = ht.make_table(1 << 8, {"val": jnp.uint64})
+    # Find 6 keys with the SAME home slot by brute force.
+    from tigerbeetle_tpu.u128 import mix64
+
+    cands = np.arange(1, 4000, dtype=np.uint64)
+    homes = np.asarray(
+        mix64(jnp.asarray(cands), jnp.zeros(len(cands), jnp.uint64))
+    ) & np.uint64((1 << 8) - 1)
+    target = np.bincount(homes.astype(np.int64)).argmax()
+    same = cands[homes == target][:6]
+    assert len(same) >= 4
+    lo, hi = keys_of([int(v) for v in same])
+    mask = jnp.ones(len(same), jnp.bool_)
+    got, ovf = ht.claim_slots(t, lo, hi, mask, MAX_PROBE)
+    want = _claim_slots_sorted_reference(t, lo, hi, mask, MAX_PROBE)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # Lane order == placement order within the shared cluster.
+    slots = np.asarray(got)
+    assert (np.diff(slots.astype(np.int64)) > 0).all()

@@ -78,9 +78,16 @@ def _group_fast_dispatch_impl(ledger, stacked, counts, timestamps):
     (see TpuStateMachine.commit_group_fast).
 
     Besides (ledger, codes) it returns the transfers probe_overflow flag
-    widened into a FRESH uint32 buffer: the deferred readback handle must
-    be able to fetch it after a later dispatch donates the ledger, and
-    riding the commit dispatch it costs zero extra syncs."""
+    widened into a FRESH uint32 buffer (the deferred readback handle must
+    be able to fetch it after a later dispatch donates the ledger; riding
+    the commit dispatch it costs zero extra syncs) and the stacked id
+    columns, so the dispatch closure's index maintenance never holds the
+    whole 17-column stacked SoA alive past the kernel call.  ``stacked``
+    itself is deliberately NOT donated: on XLA-CPU jax.device_put may
+    alias the numpy staging buffers straight into these device arrays
+    (the _stage_group zero-copy note), and a donated alias would let XLA
+    scribble scratch into the pooled staging set behind the dirty-row
+    tracking's back."""
 
     def step(led, xs):
         soa, cnt, ts = xs
@@ -88,7 +95,10 @@ def _group_fast_dispatch_impl(ledger, stacked, counts, timestamps):
         return led, codes
 
     ledger, codes = jax.lax.scan(step, ledger, (stacked, counts, timestamps))
-    return ledger, codes, ledger.transfers.probe_overflow.astype(jnp.uint32)
+    return (
+        ledger, codes, ledger.transfers.probe_overflow.astype(jnp.uint32),
+        stacked["id_lo"], stacked["id_hi"],
+    )
 
 
 _group_fast_dispatch = jax.jit(
@@ -362,6 +372,8 @@ class TpuStateMachine:
         # env per-instance), plus the cached host staging buffers for the
         # grouped H2D upload and the zero-count pad-SoA template.
         self._pipeline_depth: Optional[int] = None
+        # Wave scheduler (TB_WAVES; docs/waves.md), lazy like the depth.
+        self._waves_enabled: Optional[bool] = None
         self._stage_pool: List[tuple] = []  # free staging sets (_stage_acquire)
         self._pad_soa_zero: dict = {}
         self._lane = None  # FIFO dispatch-lane executor (see _dispatch_lane)
@@ -542,10 +554,14 @@ class TpuStateMachine:
 
         self._scrub_commits += 1
         try:
+            # Batched column-wise conversion (testing/model.py): one C pass
+            # per column instead of ~17 numpy scalar reads per event — the
+            # dominant term of the scrub mirror tax (BENCH_r05 ~1.6x
+            # overhead_vs_off; re-measured in BENCH_r08).
             if operation == "create_accounts":
-                events = [M.account_from_row(r) for r in batch]
+                events = M.accounts_from_batch(batch)
             else:
-                events = [M.transfer_from_row(r) for r in batch]
+                events = M.transfers_from_batch(batch)
             model.execute(operation, int(timestamp), events)
         except Exception:  # noqa: BLE001 — a broken mirror must stand down
             self._scrub_suspect = True
@@ -983,13 +999,15 @@ class TpuStateMachine:
         # True-history variants compile on first use; warming them here
         # would charge every history-free server two extra compiles.)
         for has_postvoid in (False, True):
-            self.ledger, codes_t, kflags = tf.create_transfers_full(
+            r = tf.create_transfers_full(
                 self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1),
                 self._bloom_dev, cold_checked,
                 max_passes=self.config.jacobi_max_passes,
                 has_postvoid=has_postvoid,
                 has_history=self._history_accounts_possible,
+                use_waves=self.waves_enabled,
             )
+            self.ledger, codes_t, kflags = r[0], r[1], r[2]
         if self._fast_path_ok(np.zeros(0, dtype=types.TRANSFER_DTYPE)):
             # Only pay the extra compile when the fast path is reachable
             # (tiering / restored limit flags / blown balance bound disable
@@ -1002,8 +1020,13 @@ class TpuStateMachine:
                 # The pipelined serving engine dispatches the PROBED
                 # variant (overflow rides the codes readback in a fresh
                 # buffer); a client must never pay its compile mid-request.
-                self.ledger, codes_p, _ovf = sm.create_transfers_fast_probed(
-                    self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
+                # It donates its batch, so the cached zero-count template
+                # gets a throwaway copy here.
+                soa_probe = {k: v.copy() for k, v in soa_t.items()}
+                self.ledger, codes_p, _ovf, _il, _ih = (
+                    sm.create_transfers_fast_probed(
+                        self.ledger, soa_probe, jnp.uint64(0), jnp.uint64(1)
+                    )
                 )
                 np.asarray(codes_p)
             if self.group_device_commit:
@@ -1014,8 +1037,9 @@ class TpuStateMachine:
                     for key, v in soa_t.items()
                 }
                 zeros = jnp.zeros((self.GROUP_K,), jnp.uint64)
-                self.ledger, codes_g, _govf = _group_fast_dispatch(
-                    self.ledger, stacked, zeros, zeros + 1
+                self.ledger, codes_g, _govf, _gil, _gih = (
+                    _group_fast_dispatch(self.ledger, stacked, zeros,
+                                         zeros + 1)
                 )
                 np.asarray(codes_g)
         np.asarray(codes_a), np.asarray(codes_t), int(kflags)
@@ -1036,15 +1060,22 @@ class TpuStateMachine:
         assert n <= self.batch_lanes, "batch exceeds configured lanes"
         if n == 0:
             # Zero-count pads recur on every grouped commit (and warmup):
-            # the device columns are immutable, so one cached template per
-            # dtype replaces a fresh alloc + H2D per batch.
-            cached = self._pad_soa_zero.get(batch.dtype)
+            # the device columns are immutable, so one cached template
+            # replaces a fresh alloc + H2D per batch.  Keyed by
+            # (dtype, pipeline depth): each depth's warmup/serving variant
+            # set owns its template, so flipping the depth (tests, the CLI
+            # --pipeline-depth, a re-warm) never evicts or re-materializes
+            # another depth's — and a template handed to a BATCH-DONATING
+            # kernel variant must always be copied first
+            # (create_transfers_fast_probed's contract).
+            key = (batch.dtype, self.pipeline_depth)
+            cached = self._pad_soa_zero.get(key)
             if cached is None:
                 padded = np.zeros(self.batch_lanes, dtype=batch.dtype)
                 cached = {
                     k: jnp.asarray(v) for k, v in types.to_soa(padded).items()
                 }
-                self._pad_soa_zero[batch.dtype] = cached
+                self._pad_soa_zero[key] = cached
             return cached
         padded = np.zeros(self.batch_lanes, dtype=batch.dtype)
         padded[:n] = batch
@@ -1188,20 +1219,35 @@ class TpuStateMachine:
         # history append.  Each (hint, hint) pair is its own jit variant.
         has_postvoid = pv_count > 0
         has_history = self._history_accounts_possible
+        use_waves = self.waves_enabled
         for _attempt in range(8):
-            self.ledger, codes, kflags = tf.create_transfers_full(
+            r = tf.create_transfers_full(
                 self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp),
                 self._bloom_dev, cold_checked,
                 max_passes=self.config.jacobi_max_passes,
                 has_postvoid=has_postvoid, has_history=has_history,
+                use_waves=use_waves,
             )
+            self.ledger, codes, kflags = r[0], r[1], r[2]
+            wave_vec = r[3] if use_waves else None
             # The kflags scalar read IS this path's blocking device sync
             # (the codes transfer below rides an already-complete dispatch)
             # — time it here or the e2e decomposition misses the general
             # kernel's whole device wait.
             self._injected_fault_check()
             t0 = _time.perf_counter()
-            kflags = int(kflags)
+            if wave_vec is not None and _obs.enabled:
+                # The wave occupancy series rides the SAME sync (11 extra
+                # scalars on an already-blocking fetch — the int(kflags)
+                # below IS this path's commit barrier).
+                got = jax.device_get(  # tblint: ignore[host-sync] commit barrier
+                    (kflags, wave_vec)
+                )
+                kflags, wave_host = got
+                kflags = int(kflags)
+            else:
+                kflags = int(kflags)
+                wave_host = None
             wait = _time.perf_counter() - t0
             self.disp_wait_s += wait
             self.disp_count += 1
@@ -1211,6 +1257,12 @@ class TpuStateMachine:
                     wait * 1e6
                 )
             if kflags == 0:
+                if wave_host is not None:
+                    # Only COMMITTED batches feed the wave occupancy
+                    # series: a routed (FLAG_SEQ/FLAG_COLD/grow) or
+                    # retried attempt applied nothing and would overstate
+                    # waves.batches_scheduled / wave0_pct.
+                    self._record_wave_metrics(wave_host)
                 codes = np.asarray(codes)
                 self._transfers_bound += count
                 self._posted_bound += pv_count
@@ -1247,6 +1299,23 @@ class TpuStateMachine:
             if self._tiering and self._evictions != ev0 and cold_checked is not None:
                 cold_checked = jnp.zeros((self.batch_lanes,), jnp.bool_)
         raise RuntimeError("transfer kernel could not place batch after growth")
+
+    def _record_wave_metrics(self, wave_host) -> None:
+        """Wave occupancy series (docs/observability.md): wave_host is the
+        kernel's int32[11] = (passes, bound, hist[9]) profile vector."""
+        passes, bound = int(wave_host[0]), int(wave_host[1])
+        hist = [int(v) for v in wave_host[2:]]
+        if bound > 0:
+            _obs.counter("waves.batches_scheduled").inc()
+            _obs.histogram("waves.bound_passes", "passes").observe(bound)
+        else:
+            _obs.counter("waves.batches_unscheduled").inc()
+        _obs.histogram("waves.jacobi_passes", "passes").observe(passes)
+        total = sum(hist)
+        if total:
+            _obs.histogram("waves.wave0_pct", "%").observe(
+                100 * hist[0] // total
+            )
 
     def _note_balance_bound(self, batch: np.ndarray) -> None:
         """Over-approximate the largest possible single balance field after
@@ -1310,6 +1379,25 @@ class TpuStateMachine:
     @group_device_commit.setter
     def group_device_commit(self, value: bool) -> None:
         self._group_device_commit = value
+
+    @property
+    def waves_enabled(self) -> bool:
+        """Conflict-index wave scheduler for the general commit kernel
+        (TB_WAVES env; default off).  Off is bit-for-bit today's path —
+        the kernel compiles the exact pre-waves program.  On, the general
+        kernel computes a per-batch conflict index over the touched
+        (debit, credit) account slots and commits certified batches after
+        a PROVED number of Jacobi passes instead of waiting for the
+        stability pass — same codes, same balances (docs/waves.md)."""
+        if self._waves_enabled is None:
+            import os
+
+            self._waves_enabled = os.environ.get("TB_WAVES", "") == "1"
+        return self._waves_enabled
+
+    @waves_enabled.setter
+    def waves_enabled(self, value: bool) -> None:
+        self._waves_enabled = bool(value)
 
     @property
     def pipeline_depth(self) -> int:
@@ -1464,13 +1552,12 @@ class TpuStateMachine:
             # FIFO lane preserves the ledger chain (the appends need THIS
             # ledger live).
             self._grow_if_needed(transfers_need=need)
-            self.ledger, codes, overflow = _group_fast_dispatch(
+            self.ledger, codes, overflow, id_lo, id_hi = _group_fast_dispatch(
                 self.ledger, stacked, cnt, tss
             )
             for j in range(k):
                 self._index_append_device(
-                    stacked["id_lo"][j], stacked["id_hi"][j],
-                    codes[j], counts[j],
+                    id_lo[j], id_hi[j], codes[j], counts[j],
                 )
             return codes, overflow
 
@@ -1555,12 +1642,14 @@ class TpuStateMachine:
 
         def dispatch():
             self._grow_if_needed(transfers_need=need)
-            self.ledger, codes, overflow = sm.create_transfers_fast_probed(
-                self.ledger, soa, cnt, ts
+            # The probed kernel donates BOTH the ledger and the staged SoA
+            # (the pad columns become scratch instead of pinned inputs);
+            # index maintenance uses the passed-through id columns — the
+            # donated ``soa`` dict must not be touched after this call.
+            self.ledger, codes, overflow, id_lo, id_hi = (
+                sm.create_transfers_fast_probed(self.ledger, soa, cnt, ts)
             )
-            self._index_append_device(
-                soa["id_lo"], soa["id_hi"], codes, count
-            )
+            self._index_append_device(id_lo, id_hi, codes, count)
             return codes, overflow
 
         armed = self._scrub_mirror is not None

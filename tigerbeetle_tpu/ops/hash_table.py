@@ -125,52 +125,98 @@ def claim_slots(
     BEFORE committing any state (the transfer kernel folds it into its
     routing flags so 'flags != 0 => nothing applied' holds exactly), then
     apply via write_rows.
+
+    Placement protocol (unchanged since v1; this is a cost rewrite):
+    every still-unplaced lane probes home+i at iteration i, and among
+    unplaced lanes sharing a slot the lowest batch index wins.  Because ALL
+    unplaced lanes advance together, two lanes can only collide when they
+    share the same HOME slot — group membership is static.  So the winner
+    of any iteration is simply the group's next lane in batch order: ONE
+    upfront sort assigns each lane its rank within its home group, and the
+    loop body just compares rank against a per-group placed counter.  The
+    previous per-iteration argsort (an XLA comparator sort of all N lanes,
+    the dominant term of the commit hot path at realistic table fills —
+    BENCH_r08 vs_baseline) is gone; occupancy rides a 1-bit-per-slot packed
+    bitmap so the loop carry is capacity/32 words, not a capacity-wide
+    bool column.  Claimed slots are bit-identical to the sort-based
+    protocol — tests/test_hash_table.py keeps that protocol as an inline
+    numpy oracle and pins claim parity against it (random fills, masked
+    lanes, forced same-home collisions).
     """
     capacity = table.capacity
     n = key_lo.shape[0]
     mask = jnp.uint64(capacity - 1)
     home = (mix64(key_lo, key_hi) >> jnp.uint64(hash_shift)) & mask
     sentinel = jnp.uint64(capacity)  # out-of-range: dropped by scatters
+    lane = jnp.arange(n, dtype=jnp.uint32)
+
+    # Home-group ranks (one sort per call, outside the probe loop): masked
+    # lanes key to a shared tail group and never win, so their ranks are
+    # inert.  rank = position within the group in batch-lane order.
+    gkey = jnp.where(insert_mask, home, sentinel)
+    order = jnp.lexsort((lane, gkey))
+    s_home = gkey[order]
+    s_head = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), s_home[1:] != s_home[:-1]]
+    )
+    gid_sorted = (jnp.cumsum(s_head.astype(jnp.int32)) - 1).astype(jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    gstart = jax.lax.cummax(jnp.where(s_head, pos, 0))
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(pos - gstart)
+    gid = jnp.zeros((n,), jnp.int32).at[order].set(gid_sorted)
+
+    # Packed occupancy bitmap (1 bit/slot).  Tiny test tables may be
+    # narrower than one word; pad with zero bits the probe mask never
+    # addresses.
+    occ_bool = (table.key_lo != 0) | (table.key_hi != 0) | table.tombstone
+    pad = (-capacity) % 32
+    if pad:
+        occ_bool = jnp.concatenate(
+            [occ_bool, jnp.zeros((pad,), jnp.bool_)]
+        )
+    occ0 = jnp.sum(
+        occ_bool.reshape(-1, 32).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, :],
+        axis=1, dtype=jnp.uint32,
+    )
+    nwords = jnp.uint64(occ0.shape[0])
 
     def cond(state):
-        _, _, unplaced, _, overflow = state
+        _, _, unplaced, _, overflow, _ = state
         return jnp.any(unplaced) & ~overflow
 
     def body(state):
-        occ, offset, unplaced, claimed, _ = state
+        occ, offset, unplaced, claimed, _, next_rank = state
         cur = (home + offset) & mask
-        cand = jnp.where(unplaced, cur, sentinel)
+        word = cur >> jnp.uint64(5)
+        bit = (cur & jnp.uint64(31)).astype(jnp.uint32)
+        occupied = ((occ[word] >> bit) & jnp.uint32(1)).astype(jnp.bool_)
 
-        occupied = occ[cur]
-
-        # Intra-batch collision resolution: sort candidate slots; within a run
-        # of equal slots the first (stable sort keeps lane order) wins.
-        order = jnp.argsort(cand, stable=True)
-        sorted_cand = cand[order]
-        first_of_run = jnp.concatenate(
-            [jnp.ones((1,), jnp.bool_), sorted_cand[1:] != sorted_cand[:-1]]
-        )
-        is_winner = jnp.zeros((n,), jnp.bool_).at[order].set(first_of_run)
-
+        # The group's next unclaimed lane in batch order is THE winner
+        # (lanes sharing a slot always share a home — see docstring).
+        is_winner = rank == next_rank[gid]
         win = unplaced & ~occupied & is_winner
         claimed = jnp.where(win, cur, claimed)
-        # Mark claimed slots occupied so later iterations (and later lanes)
-        # probe past them. Only winners scatter; their slots are unique.
-        occ = occ.at[jnp.where(win, cur, sentinel)].set(True, mode="drop")
+        # Winners' slots are unique, but two winners may share a WORD:
+        # distinct bits make the add an OR with no carries.
+        occ = occ.at[jnp.where(win, word, nwords)].add(
+            jnp.uint32(1) << bit, mode="drop"
+        )
+        next_rank = next_rank.at[jnp.where(win, gid, n)].add(1, mode="drop")
 
         unplaced = unplaced & ~win
         offset = jnp.where(unplaced, offset + jnp.uint64(1), offset)
         overflow = jnp.any(offset >= jnp.uint64(max_probe))
-        return occ, offset, unplaced, claimed, overflow
+        return occ, offset, unplaced, claimed, overflow, next_rank
 
-    occ0 = (table.key_lo != 0) | (table.key_hi != 0) | table.tombstone
     offset0 = jnp.zeros((n,), jnp.uint64)
     unplaced0 = insert_mask
     claimed0 = jnp.full((n,), sentinel, jnp.uint64)
     overflow0 = jnp.bool_(False)
+    next_rank0 = jnp.zeros((n,), jnp.int32)
 
-    _, _, _, claimed, overflow = jax.lax.while_loop(
-        cond, body, (occ0, offset0, unplaced0, claimed0, overflow0)
+    _, _, _, claimed, overflow, _ = jax.lax.while_loop(
+        cond, body, (occ0, offset0, unplaced0, claimed0, overflow0, next_rank0)
     )
     return claimed, overflow
 

@@ -719,7 +719,7 @@ def create_transfers_fast_probed_impl(
     batch: Dict[str, jax.Array],
     count: jax.Array,
     timestamp: jax.Array,
-) -> Tuple[Ledger, jax.Array, jax.Array]:
+) -> Tuple[Ledger, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fast kernel + the transfers probe_overflow flag as a third output.
 
     The overflow flag is widened to a FRESH uint32 buffer (never aliased
@@ -728,14 +728,26 @@ def create_transfers_fast_probed_impl(
     LATER dispatch donates the ledger's buffers — reading
     ``ledger.transfers.probe_overflow`` at resolve time would trip the
     donation check.  Riding the commit dispatch, it costs zero extra syncs
-    (the codes D2H carries it along)."""
+    (the codes D2H carries it along).
+
+    The BATCH is donated along with the ledger (its ~1 MB of pad-SoA
+    columns become scratch/output space instead of live inputs pinned for
+    the whole dispatch); the id columns the caller's index maintenance
+    needs are passed through as outputs, which may alias the donated
+    buffers.  Callers must hand this kernel a per-dispatch staged SoA
+    (machine._pad_soa with count > 0, or an explicit copy) — never the
+    cached zero-count template."""
+    id_lo, id_hi = batch["id_lo"], batch["id_hi"]
     ledger, codes = create_transfers_impl(ledger, batch, count, timestamp)
-    return ledger, codes, ledger.transfers.probe_overflow.astype(jnp.uint32)
+    return (
+        ledger, codes, ledger.transfers.probe_overflow.astype(jnp.uint32),
+        id_lo, id_hi,
+    )
 
 
 create_transfers_fast_probed = _obs_jit(
     create_transfers_fast_probed_impl, "create_transfers_fast_probed",
-    donate_argnames=("ledger",),
+    donate_argnames=("ledger", "batch"),
 )
 
 

@@ -167,6 +167,12 @@ class ApplyPlan(NamedTuple):
     hist_row: Dict[str, jax.Array]
     # Jacobi iterations the fixpoint actually took (instrumentation).
     passes: jax.Array  # int32 scalar
+    # Wave scheduler instrumentation (use_waves; zeros when off):
+    # wave_bound: proved pass bound (depth_max + 1) when the conflict index
+    # certified the batch, else 0.  wave_hist: per-lane wave-depth histogram
+    # (buckets 0..7, 8 = deeper), valid lanes only.
+    wave_bound: jax.Array  # int32 scalar
+    wave_hist: jax.Array  # int32[9]
 
 
 def _first_code(checks) -> jnp.ndarray:
@@ -413,6 +419,103 @@ def _leg_balances(
     )
 
 
+def _wave_schedule(
+    hazard: jax.Array,
+    unschedulable: jax.Array,
+    wdr_slot: jax.Array,
+    wdr_live: jax.Array,
+    wcr_slot: jax.Array,
+    wcr_live: jax.Array,
+    valid: jax.Array,
+    cap_sentinel: jax.Array,
+    max_rounds: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Vectorized conflict-index wave scheduler (docs/waves.md).
+
+    Assigns every lane a WAVE DEPTH: 0 for lanes whose outcome is provably
+    independent of every other lane's outcome (non-hazard: fixed amount, no
+    clamp/limit/overflow/fulfillment/dup/chain sensitivity), and for hazard
+    lanes 1 + the maximum depth of any EARLIER hazard lane sharing one of
+    its accounts — the index-based schedule of 1911.11329, restricted to
+    the lanes whose outcomes can actually change across Jacobi iterates.
+    Outcome changes propagate only through shared account balances, and
+    only hazard lanes ever change outcome, so pass d+1 of the Jacobi
+    fixpoint is exact for every lane of depth <= d (induction over depth;
+    non-hazard lanes are exact at pass 1).  max depth + 1 is therefore a
+    PROVED pass bound: the loop may commit after that many passes without
+    observing stability, skipping the verification pass entirely — wave-0
+    batches (no conflicts) commit in one evaluation pass plus the single
+    balance-update (aux) pass.
+
+    Depth is the longest chain in a DAG, computed by at most ``max_rounds``
+    cheap relaxation rounds over ONE (slot, leg-position) sort — each round
+    is a segmented exclusive running-max, ~20x cheaper than a semantic
+    Jacobi pass.  A batch whose depth has not stabilized within
+    ``max_rounds`` rounds would need more passes than the Jacobi budget
+    anyway, so it simply falls back to the stability exit (today's path).
+
+    Returns (proved bool scalar, passes_needed int32 scalar, depth int32[N],
+    hist int32[9]).
+    """
+    n = hazard.shape[0]
+    leg_slot = jnp.stack([wdr_slot, wcr_slot], axis=1).reshape(-1)
+    leg_live = jnp.stack([wdr_live, wcr_live], axis=1).reshape(-1)
+    leg_slot = jnp.where(leg_live, leg_slot, cap_sentinel)
+    # (slot, legpos) sort: leg position order IS event order within a slot
+    # run (the _leg_balances invariant), so "earlier leg in my run" is
+    # exactly "earlier conflicting lane".
+    leg_pos_id = jnp.arange(2 * n, dtype=jnp.uint64)
+    leg_order = jnp.argsort((leg_slot << jnp.uint64(15)) | leg_pos_id)
+    s_slot = leg_slot[leg_order]
+    s_head = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), s_slot[1:] != s_slot[:-1]]
+    )
+    s_lane = (leg_order >> 1).astype(jnp.int32)
+    s_live = s_slot < cap_sentinel
+    run_id = jnp.cumsum(s_head.astype(jnp.uint64)) - 1
+
+    def relax_round(carry):
+        depth, _, rounds = carry
+        # Segmented EXCLUSIVE running max of hazard depths within slot
+        # runs, via (run_id << 32 | depth) key packing: run_id is
+        # nondecreasing down the sorted array, so a plain cummax never
+        # leaks a value across runs (an earlier run's key always packs
+        # smaller than the current run's zero).  Dead legs (sentinel
+        # slot) share one tail run and are masked out of both sides.
+        leg_depth = jnp.where(
+            s_live, depth[s_lane], jnp.int32(0)
+        ).astype(jnp.uint64)
+        packed = (run_id << jnp.uint64(32)) | leg_depth
+        incl = jax.lax.cummax(packed)
+        excl = jnp.concatenate([jnp.zeros((1,), jnp.uint64), incl[:-1]])
+        excl_val = jnp.where(
+            s_live & ((excl >> jnp.uint64(32)) == run_id),
+            (excl & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32),
+            jnp.int32(0),
+        )
+        prior = jnp.zeros((n,), jnp.int32).at[s_lane].max(excl_val)
+        new_depth = jnp.where(
+            hazard, jnp.maximum(depth, jnp.int32(1) + prior), jnp.int32(0)
+        )
+        return new_depth, jnp.any(new_depth != depth), rounds + 1
+
+    depth, changed, _ = jax.lax.while_loop(
+        lambda c: c[1] & (c[2] < max_rounds),
+        relax_round,
+        (
+            jnp.where(hazard, jnp.int32(1), jnp.int32(0)),
+            jnp.bool_(True),
+            jnp.int32(0),
+        ),
+    )
+    proved = ~unschedulable & ~changed
+    passes_needed = jnp.max(jnp.where(valid, depth, 0)) + jnp.int32(1)
+    hist = jnp.zeros((9,), jnp.int32).at[
+        jnp.where(valid, jnp.clip(depth, 0, 8), 9)
+    ].add(1, mode="drop")
+    return proved, passes_needed, depth, hist
+
+
 def _at(val: U128, pos: jax.Array) -> U128:
     return U128(val.lo[pos], val.hi[pos])
 
@@ -573,6 +676,7 @@ def _kernel_core(
     max_passes: int = _MAX_PASSES,
     static_trip: Optional[bool] = None,
     has_postvoid: bool = True,
+    use_waves: bool = False,
 ) -> ApplyPlan:
     """The pure batch semantics: no table access, replicable on a mesh.
 
@@ -630,6 +734,70 @@ def _kernel_core(
     ov_timeout = (ts + timeout_ns) < ts
     dr_limf = ((drT.flags & AF_DEBITS_MUST_NOT_EXCEED_CREDITS) != 0) & drT.found
     cr_limf = ((crT.flags & AF_CREDITS_MUST_NOT_EXCEED_DEBITS) != 0) & crT.found
+
+    if use_waves:
+        # --- conflict-index wave schedule (TB_WAVES; docs/waves.md) -------
+        # HAZARD lanes are the only ones whose (code, amount) can change
+        # across Jacobi iterates: balancing clamps, balance-limit
+        # accounts, and start balances within one batch's delta margin of
+        # u128 overflow (the near_ov threshold the failed-chain hazard
+        # route already uses).  Everything else has a fixed outcome from
+        # pass 1, whatever its account conflicts — including a post/void
+        # of a TABLE pending: its whole ladder compares fixed table/batch
+        # values (the reference's post_or_void path has no balance
+        # checks), so even the fulfillment winner race resolves from codes
+        # that never change across iterates.  A post/void whose pending
+        # may resolve IN BATCH is the exception (it reads another lane's
+        # composed row) and is excluded batch-wide below.
+        #
+        # The margin is stricter than near_ov's: any start field >=
+        # 2^127 - 2^80 is hazard, so for non-hazard lanes every overflow
+        # operand (single fields AND the dp+dpo / cp+cpo pair sums, whose
+        # u128 wrap boundary the ladder is sensitive to) sits further from
+        # 2^128 than one batch's total delta (< n * 2^64 <= 2^77) can
+        # move it — no overflow code can change across iterates.
+        near_w = jnp.uint64(0x7FFF_FFFF_FFFF_0000)
+
+        def _near_start(v: AccountView):
+            return v.found & (
+                (v.bal["debits_pending_hi"] >= near_w)
+                | (v.bal["debits_posted_hi"] >= near_w)
+                | (v.bal["credits_pending_hi"] >= near_w)
+                | (v.bal["credits_posted_hi"] >= near_w)
+            )
+
+        hazard = valid & (
+            balancing | dr_limf | cr_limf
+            | _near_start(drT) | _near_start(crT)
+        )
+        # Unschedulable couplings fall back to the stability exit (today's
+        # behavior, bit-for-bit): linked chains propagate failure BACKWARD
+        # (a cycle in the dependency DAG), duplicate ids couple through
+        # winner selection rather than accounts, and the in-batch pending
+        # reference above.
+        unschedulable = jnp.any(linked) | idx.any_dup
+        if has_postvoid:
+            hazard = hazard | (
+                postvoid & (_near_start(pdr) | _near_start(pcr))
+            )
+            unschedulable = unschedulable | jnp.any(postvoid & pj_hit)
+            wdr_slot = jnp.where(postvoid, pdr.slot, drT.slot)
+            wdr_live = jnp.where(postvoid, pdr.found, drT.found & valid)
+            wcr_slot = jnp.where(postvoid, pcr.slot, crT.slot)
+            wcr_live = jnp.where(postvoid, pcr.found, crT.found & valid)
+        else:
+            wdr_slot, wdr_live = drT.slot, drT.found & valid
+            wcr_slot, wcr_live = crT.slot, crT.found & valid
+        sched_proved, passes_needed, _wave_depth, wave_hist = _wave_schedule(
+            hazard, unschedulable, wdr_slot, wdr_live, wcr_slot, wcr_live,
+            valid, cap_sentinel, max_passes,
+        )
+        wave_bound = jnp.where(sched_proved, passes_needed, jnp.int32(0))
+    else:
+        sched_proved = jnp.bool_(False)
+        passes_needed = jnp.int32(_MAX_PASSES + 1)
+        wave_bound = jnp.int32(0)
+        wave_hist = jnp.zeros((9,), jnp.int32)
 
     # ------------------------------------------------------------------
     # One Jacobi pass of the sequential semantics.
@@ -1014,16 +1182,29 @@ def _kernel_core(
         head = min(max_passes, 4)
         c = chunk(carry0, head)
         if max_passes > head:
+            # The wave bound joins the stability flag in the chunk gate: a
+            # certified batch whose proved pass count fits in the head
+            # chunk skips the tail even when stability was never observed.
             c = jax.lax.cond(
-                c[1], lambda c_: c_,
+                c[1] | (sched_proved & (c[0] >= passes_needed)),
+                lambda c_: c_,
                 lambda c_: chunk(c_, max_passes - head), c,
             )
         k_passes, converged, ok_f, code_f, amt_f = c
     else:
+        # Wave-bound early exit: once the certified pass count has run,
+        # the iterate IS the fixpoint (docs/waves.md) — stop without the
+        # verification pass.  With use_waves off, sched_proved is a False
+        # constant and this folds to the pre-waves condition.
         k_passes, converged, ok_f, code_f, amt_f = jax.lax.while_loop(
-            lambda c: ~c[1] & (c[0] < max_passes), step_pass, carry0
+            lambda c: (
+                ~c[1] & (c[0] < max_passes)
+                & ~(sched_proved & (c[0] >= passes_needed))
+            ),
+            step_pass, carry0,
         )
-    unconverged = ~converged
+    proved_done = sched_proved & (k_passes >= passes_needed)
+    unconverged = ~converged & ~proved_done
 
     # The single aux-bearing pass from the fixpoint (see the carry note).
     ok, codes, amount, aux = one_pass(ok_f, amt_f)
@@ -1122,6 +1303,7 @@ def _kernel_core(
         s_slot=legs.s_slot, scat=legs.is_last & legs.s_live,
         bal_incl=bal_incl, do_hist=do_hist, hist_row=hist_row,
         passes=k_passes,
+        wave_bound=wave_bound, wave_hist=wave_hist,
     )
 
 
@@ -1136,14 +1318,23 @@ def create_transfers_full_impl(
     has_postvoid: bool = True,
     has_history: bool = True,
     static_trip: Optional[bool] = None,
-) -> Tuple[Ledger, jax.Array, jax.Array]:
-    """Returns (ledger', codes uint32[N], flags uint32 scalar).
+    use_waves: bool = False,
+) -> Tuple[jax.Array, ...]:
+    """Returns (ledger', codes uint32[N], flags uint32 scalar), plus a
+    fourth wave-profile vector when ``use_waves`` (see below).
 
     flags == 0: the batch was applied and ``codes`` are the final results.
     flags != 0: NOTHING was applied (ledger' == ledger value-wise); the host
     must grow the flagged tables, resolve cold ids (FLAG_COLD: ``bloom`` is
     the cold-id filter, ``cold_checked`` marks lanes the host already
     certified), and/or re-route to the sequential path.
+
+    ``use_waves`` (STATIC; TB_WAVES at the machine level) arms the
+    conflict-index wave scheduler: bit-identical codes/ledger, fewer
+    Jacobi passes on batches the conflict index certifies, and a FOURTH
+    return — int32[11] = (passes, wave_bound, hist[9 wave-depth buckets])
+    — for the bench/metrics surface.  Off compiles exactly the pre-waves
+    program with the three-tuple return.
     """
     n = batch["id_lo"].shape[0]
     lane = jnp.arange(n, dtype=jnp.int32)
@@ -1157,7 +1348,7 @@ def create_transfers_full_impl(
         has_postvoid=has_postvoid,
     )
     plan = _kernel_core(ctx, batch, count, timestamp, max_passes, static_trip,
-                        has_postvoid=has_postvoid)
+                        has_postvoid=has_postvoid, use_waves=use_waves)
 
     # Insert slots are claimed (no writes) BEFORE the flags are finalized so
     # an insert-probe overflow also routes the batch with nothing applied.
@@ -1239,6 +1430,12 @@ def create_transfers_full_impl(
     out = Ledger(
         accounts=accounts, transfers=transfers, posted=posted, history=history
     )
+    if use_waves:
+        wave_vec = jnp.concatenate([
+            plan.passes.reshape(1), plan.wave_bound.reshape(1),
+            plan.wave_hist,
+        ])
+        return out, plan.codes, kflags, wave_vec
     return out, plan.codes, kflags
 
 
@@ -1304,6 +1501,7 @@ def _exists_postvoid(t, e, p, n) -> jax.Array:
 create_transfers_full = jax.jit(
     create_transfers_full_impl, donate_argnames=("ledger",),
     static_argnames=(
-        "max_passes", "has_postvoid", "has_history", "static_trip"
+        "max_passes", "has_postvoid", "has_history", "static_trip",
+        "use_waves",
     ),
 )

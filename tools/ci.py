@@ -72,7 +72,7 @@ TIERS = {
             "tests/test_balancing_vector.py", "tests/test_scan_path.py",
             "tests/test_queries.py", "tests/test_scan_builder.py",
             "tests/test_sharded.py", "tests/test_group_commit.py",
-            "tests/test_pipeline.py",
+            "tests/test_pipeline.py", "tests/test_waves.py",
             "tests/test_host_engine.py", "tests/test_cold_tier.py",
         ],
         extra=["-m", "not slow"],
@@ -119,6 +119,13 @@ TIERS = {
         # Artifact: OVERLOAD_SMOKE.json at the repo root.
         cmd=["tools/overload_smoke.py"],
     ),
+    "waves": dict(
+        # Wave-scheduler smoke (docs/waves.md): waves on/off identity on a
+        # Zipfian two-phase mix, the kernel-level pass-bound certification
+        # (2 -> 1 passes on a conflict-free batch), and the waves.* series
+        # asserted in METRICS.json.  Artifact: WAVES_SMOKE.json.
+        cmd=["tools/waves_smoke.py"],
+    ),
     "byzantine": dict(
         # Byzantine fault domain smoke (docs/fault_domains.md): pinned
         # seed with one equivocating/corrupting/lying replica of six
@@ -162,6 +169,13 @@ TIERS = {
             # Byzantine fault kind: the pinned on/off proof pair (slow:
             # two full 6-replica runs under the open-loop workload).
             "tests/test_byzantine.py::TestVoprByzantine",
+            # Wave scheduler: the pinned VOPR seed re-validated under
+            # TB_WAVES=1 (slow: a full sim run), plus the depth-swept
+            # limit-account differentials (tier-1 budget audit: the
+            # heaviest parametrized class rides here instead).
+            "tests/test_waves.py::TestVoprWaves",
+            "tests/test_waves.py::TestWavesDifferential::"
+            "test_zipf_mix_with_limits_vs_model",
             # Tier-1 budget audit (PR 5): the 5 slowest tier-1 tests moved
             # to @slow; they run whole here so the full matrix still
             # covers them.
@@ -181,7 +195,7 @@ TIERS = {
 }
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
-    "scrub", "overload", "byzantine", "integration",
+    "scrub", "overload", "waves", "byzantine", "integration",
 ]
 
 
