@@ -111,6 +111,16 @@ def main(argv=None) -> int:
                               "values reserved, currently equivalent to "
                               "2; default 2; env twin: TB_PIPELINE, 0 = "
                               "off)")
+    p_start.add_argument("--shards", type=int, default=None, metavar="N",
+                         help="sharded execution mode (docs/sharding.md): "
+                              "partition the device ledger over N devices "
+                              "(power of two) and commit through shard_map "
+                              "— account capacity scales with device count "
+                              "and each shard is a commit lane.  0/absent "
+                              "= single-device (bit-identical to pre-"
+                              "sharding; env twin: TB_SHARDS).  Exclusive "
+                              "with --hot-transfers-log2-max (cold tiering "
+                              "is single-device)")
     p_start.add_argument("--overload-control", action="store_true",
                          help="explicit overload control (vsr/overload.py): "
                               "shed new requests with retryable busy "
@@ -497,6 +507,29 @@ def _cmd_start(args) -> int:
         # the env twin is what VsrReplica/ReplicaServer constructors read.
         os.environ["TB_OVERLOAD"] = "1"
 
+    if args.shards is not None:
+        if args.shards < 0 or (
+            args.shards >= 2 and args.shards & (args.shards - 1) != 0
+        ):
+            # Validate at the CLI boundary: the machine's internal check is
+            # an assert, which must never be an operator's first error.
+            print(f"error: --shards must be 0 or a power of two, got "
+                  f"{args.shards}", file=sys.stderr)
+            return 1
+        if args.shards >= 2 and args.hot_transfers_log2_max is not None:
+            print("error: --shards and --hot-transfers-log2-max are "
+                  "exclusive (cold tiering is a single-device concern; "
+                  "docs/sharding.md)", file=sys.stderr)
+            return 1
+        if args.shards >= 2 and args.engine:
+            print("error: --shards runs on the device path; --engine "
+                  "commits through the native host engine — pick one",
+                  file=sys.stderr)
+            return 1
+        # The env twin is what the TpuStateMachine constructor reads (the
+        # machine is built inside Replica/VsrReplica).
+        os.environ["TB_SHARDS"] = str(max(0, args.shards))
+
     import dataclasses as _dc
 
     from .config import PROCESS_DEFAULT
@@ -577,6 +610,9 @@ def _cmd_start(args) -> int:
         return 1
     use_engine = (
         engine_available() and hot_max is None and not args.no_engine
+        # Sharding runs on the device path only: the mesh ledger IS the
+        # serving authority, never the numpy engine mirror.
+        and not (args.shards or 0) >= 2
     )
     replica = Replica(args.path, ledger_config=ledger_config,
                       aof_path=args.aof, hot_transfers_capacity_max=hot_max,

@@ -32,6 +32,7 @@ from typing import List, Optional
 __all__ = [
     "force_cpu", "ensure_backend", "child_env", "current_platform",
     "COMPILE_CACHE_DIR", "enable_compile_cache", "instrument_compiles",
+    "shard_map",
 ]
 
 # Set when force_cpu had to settle for fewer virtual devices than requested
@@ -64,6 +65,45 @@ def enable_compile_cache() -> str:
     """Point jax at the persistent cache (must run before jax init)."""
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
     return os.environ["JAX_COMPILATION_CACHE_DIR"]
+
+
+# Resolved lazily by shard_map(): (impl, vary-check kwarg name).  jax must
+# not be imported at module import time (this module's whole point is to
+# configure the environment BEFORE the first backend init).
+_SHARD_MAP_IMPL = None
+
+
+def _resolve_shard_map():
+    global _SHARD_MAP_IMPL
+    if _SHARD_MAP_IMPL is None:
+        try:  # jax >= 0.4.35 exposes shard_map at top level
+            from jax import shard_map as impl
+        except ImportError:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map as impl
+        # The kwarg disabling the replication/varying-axes check was renamed
+        # check_rep -> check_vma across jax versions; detect what this jax
+        # takes so every call site stays on one spelling.
+        import inspect
+
+        kw = (
+            "check_vma"
+            if "check_vma" in inspect.signature(impl).parameters
+            else "check_rep"
+        )
+        _SHARD_MAP_IMPL = (impl, kw)
+    return _SHARD_MAP_IMPL
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma):
+    """Version-portable jax.shard_map (the check_rep -> check_vma rename
+    shim, jax 0.4.37 vs newer).  ONE shared wrapper for machine.py,
+    parallel/sharded.py, and future mesh callers — and one place to drop
+    the shim when jax is pinned past the rename."""
+    impl, kw = _resolve_shard_map()
+    return impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{kw: check_vma},
+    )
 
 
 _COMPILE_LISTENER_INSTALLED = False

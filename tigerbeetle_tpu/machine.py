@@ -263,11 +263,83 @@ class TpuStateMachine:
         spill_dir: Optional[str] = None,
         hot_transfers_capacity_max: Optional[int] = None,
         host_engine: bool = False,
+        shards: Optional[int] = None,
     ) -> None:
         cfg = ledger_config or LedgerConfig()
         self.config = cfg
         self.batch_lanes = batch_lanes
         self.force_sequential = force_sequential
+        # Sharded execution mode (docs/sharding.md): the pad SoA lives
+        # under a Mesh + NamedSharding(PartitionSpec('shard')) over the
+        # account axis and commits dispatch through shard_map
+        # (parallel/sharded.py).  ``shards`` None defers to TB_SHARDS; 0 is
+        # today's single-device path, bit-identical by construction (not
+        # one sharded branch is taken).
+        if shards is None:
+            import os
+
+            env = os.environ.get("TB_SHARDS", "")
+            shards = int(env) if env.isdigit() else 0
+        self.shards = 0
+        self._shard_mesh = None
+        self._shard_steps = None
+        self._canon = None            # cached canonical (single-layout) view
+        self._ledger_is_sharded = False
+        self.shard_lanes_total = 0    # plain-int counters (tests/bench)
+        self.shard_lanes_cross = 0
+        self.shard_seq_fallbacks = 0
+        # Per-shard attempted-insert bounds (accounts/transfers): the
+        # global load<=0.5 policy no longer bounds a SHARD's load — hash
+        # skew can overfill one cap/n local region while the global count
+        # sits under cap/2, and a fast-path probe overflow there is fatal
+        # (rows already dropped).  Owners are host-computable (one mix64
+        # pass per batch), so growth sizes off the peak shard too.
+        self._shard_insert_bounds: dict = {}
+        if shards >= 2 and (host_engine or hot_transfers_capacity_max is not None):
+            # Sharding runs on the device path and excludes cold tiering
+            # (no bloom on the mesh path).  A process-wide TB_SHARDS env
+            # must not take down a host-engine solo server or a tiered
+            # replica: degrade to the proven single-device path loudly
+            # (the DEGRADED_DEVICE_COUNT discipline).
+            warnings.warn(
+                f"TB_SHARDS={shards} ignored: "
+                + ("the host engine is the commit authority here"
+                   if host_engine else
+                   "cold tiering is a single-device concern"),
+                RuntimeWarning, stacklevel=2,
+            )
+            shards = 0
+        if shards >= 2:
+            assert shards & (shards - 1) == 0, "TB_SHARDS must be a power of 2"
+            devs = jax.devices()
+            if len(devs) < shards:
+                # The DEGRADED_DEVICE_COUNT discipline (jaxenv.py): degrade
+                # to the proven single-device path rather than wedge.
+                warnings.warn(
+                    f"TB_SHARDS={shards} but only {len(devs)} device(s) "
+                    "visible; running single-device",
+                    RuntimeWarning, stacklevel=2,
+                )
+            else:
+                from .parallel import sharded as shard_mod
+                from jax.sharding import Mesh
+
+                for cap in (cfg.accounts_capacity, cfg.transfers_capacity,
+                            cfg.posted_capacity):
+                    assert cap % shards == 0, "capacity not shard-divisible"
+                self.shards = shards
+                self._shard_mesh = Mesh(
+                    np.array(devs[:shards]), (shard_mod.AXIS,)
+                )
+                self._shard_steps = shard_mod.machine_steps(
+                    self._shard_mesh, cfg.jacobi_max_passes
+                )
+                self._shard_insert_bounds = {
+                    "accounts": np.zeros(shards, np.int64),
+                    "transfers": np.zeros(shards, np.int64),
+                }
+                if _obs.enabled:
+                    _obs.gauge("sharding.shards").set(shards)
         # Grouped device commit (commit_group_fast).  None = auto: enabled
         # on the TPU backend, where an empty scan step is us-scale; on
         # XLA-CPU each step pays table-sized temporaries, so per-batch
@@ -297,6 +369,17 @@ class TpuStateMachine:
             self._engine = HostEngine(self._host_led, cfg.max_probe)
             self._device_stale = True
             self._ledger = None
+        elif self._shard_mesh is not None:
+            from .parallel import sharded as shard_mod
+
+            self._ledger = shard_mod.make_sharded_ledger(
+                self._shard_mesh,
+                cfg.accounts_capacity,
+                cfg.transfers_capacity,
+                cfg.posted_capacity,
+                history_capacity=cfg.history_capacity,
+            )
+            self._ledger_is_sharded = True
         else:
             self._ledger = sm.make_ledger(
                 cfg.accounts_capacity,
@@ -532,6 +615,7 @@ class TpuStateMachine:
         cols = dict(a.cols)
         cols[col] = arr.at[slot].set(arr[slot] ^ jnp.uint64(1 << bit))
         self._ledger = self._ledger.replace(accounts=a.replace(cols=cols))
+        self._canon = None  # the corruption must be visible to queries too
         return True
 
     def _inflight_untrack(self, handle) -> None:
@@ -707,9 +791,7 @@ class TpuStateMachine:
             _obs.counter("scrub.checks").inc()
         want = scrub_ops.mirror_digests(model)
         try:
-            got = np.asarray(
-                self._d2h_codes(scrub_ops.scrub_digest(self.ledger))
-            )
+            got = self._scrub_fold_digests()
             ok = int(got[0]) == want[0] and int(got[2]) == want[2] and (
                 self.cold.count != 0 or int(got[1]) == want[1]
             )
@@ -729,9 +811,7 @@ class TpuStateMachine:
         self.quarantine()
         self._rematerialize_from_mirror()
         try:
-            got = np.asarray(
-                self._d2h_codes(scrub_ops.scrub_digest(self.ledger))
-            )
+            got = self._scrub_fold_digests()
         except DEVICE_FAULT_TYPES as err:
             # A second fault during the verification re-digest: escalate
             # to the durable-state rebuild rather than crash the serving
@@ -752,6 +832,26 @@ class TpuStateMachine:
             _obs.counter("device_recovery.recoveries").inc()
             _obs.counter("device_recovery.scrub").inc()
         return False
+
+    def _scrub_fold_digests(self) -> np.ndarray:
+        """The on-device (accounts, transfers, posted) fold triple through
+        the commit-barrier funnel (ONE readback).  Under TB_SHARDS the
+        readback is the per-shard uint64 lane matrix (n_shards, 3) from
+        parallel/sharded.sharded_scrub_digest, summed mod 2^64 into the
+        global digests — the folds are wrap-adds over disjoint owner
+        partitions, so the sum equals the single-device fold bit for bit
+        (and the lanes localize a mismatch to one shard)."""
+        if self._ledger_is_sharded:
+            lanes = np.asarray(
+                self._d2h_codes(self._shard_steps["scrub"](self.ledger))
+            )
+            if _obs.enabled:
+                _obs.counter("sharding.scrub_lane_checks").inc()
+            with np.errstate(over="ignore"):
+                return lanes.sum(axis=0, dtype=np.uint64)
+        return np.asarray(
+            self._d2h_codes(scrub_ops.scrub_digest(self.ledger))
+        )
 
     def quarantine(self) -> None:
         """Quarantine the in-flight device pipeline: drain the FIFO dispatch
@@ -778,7 +878,9 @@ class TpuStateMachine:
             raise DeviceStateUnrecoverable(
                 "cold tier active: mirror re-materialization unsupported"
             )
-        self._ledger = scrub_ops.materialize_ledger(model, self.config)
+        # Property assignment: under TB_SHARDS the setter re-places the
+        # single-layout materialization onto the mesh.
+        self.ledger = scrub_ops.materialize_ledger(model, self.config)
         self._resync_host_state_from_mirror(model)
 
     def _resync_host_state_from_mirror(self, model) -> None:
@@ -810,10 +912,25 @@ class TpuStateMachine:
         fresh empty ledger, derived state cleared.  The prepare clock is
         PRESERVED — already-issued prepare timestamps must stay monotone."""
         cfg = self.config
-        self._ledger = sm.make_ledger(
-            cfg.accounts_capacity, cfg.transfers_capacity,
-            cfg.posted_capacity, cfg.history_capacity,
-        )
+        if self._shard_mesh is not None:
+            from .parallel import sharded as shard_mod
+
+            self._ledger = shard_mod.make_sharded_ledger(
+                self._shard_mesh, cfg.accounts_capacity,
+                cfg.transfers_capacity, cfg.posted_capacity,
+                history_capacity=cfg.history_capacity,
+            )
+            self._ledger_is_sharded = True
+            self._shard_insert_bounds = {
+                "accounts": np.zeros(self.shards, np.int64),
+                "transfers": np.zeros(self.shards, np.int64),
+            }
+        else:
+            self._ledger = sm.make_ledger(
+                cfg.accounts_capacity, cfg.transfers_capacity,
+                cfg.posted_capacity, cfg.history_capacity,
+            )
+        self._canon = None
         self.commit_timestamp = 0
         self._accounts_bound = self._transfers_bound = 0
         self._posted_bound = self._history_bound = 0
@@ -897,7 +1014,24 @@ class TpuStateMachine:
 
     @ledger.setter
     def ledger(self, value) -> None:
+        if (
+            getattr(self, "_shard_mesh", None) is not None
+            and getattr(self, "_ledger_is_sharded", False)
+            and value is not None
+            and np.ndim(value.accounts.count) == 0
+        ):
+            # External install of a single-layout ledger (checkpoint
+            # restore, state sync) while sharded mode is live: re-place it
+            # into the owner-partitioned layout.  Internal sharded commits
+            # assign sharded values (vector counts) and pass through; the
+            # sequential-fallback window flips _ledger_is_sharded off so
+            # its single-layout intermediate states also pass through.
+            from .parallel import sharded as shard_mod
+
+            value = shard_mod.shard_ledger(value, self._shard_mesh)
+            self._refresh_shard_bounds(value)
         self._ledger = value
+        self._canon = None
         if getattr(self, "_engine", None) is not None:
             # External ledger swap (checkpoint restore, state sync): refresh
             # the host mirror — it must mirror the new authority exactly.
@@ -906,6 +1040,32 @@ class TpuStateMachine:
             self._host_led = HostLedger.from_device(value)
             self._engine.ledger = self._host_led
             self._device_stale = False
+
+    def _query_ledger(self):
+        """The single-layout ledger view queries/lookups/checkpoints probe:
+        identity when sharding is off; under TB_SHARDS a cached canonical
+        un-sharding of the live ledger (content-exact, single-device probe
+        layout), rebuilt lazily after a commit invalidates it.  Every query
+        kernel (index, scans, history, point lookups) and the checkpoint
+        serializer thus keep their existing single-device programs."""
+        if self._shard_mesh is None or not self._ledger_is_sharded:
+            return self.ledger
+        if self._canon is None:
+            from .parallel import sharded as shard_mod
+
+            self._canon = shard_mod.unshard_ledger(
+                self._ledger, self._shard_mesh
+            )
+            if _obs.enabled:
+                _obs.counter("sharding.unshards").inc()
+        return self._canon
+
+    def checkpoint_ledger(self):
+        """The ledger snapshot checkpoints serialize: canonical single-
+        device layout, so a checkpoint restores into ANY shard config (and
+        every replica of a homogeneous cluster writes byte-identical
+        arrays — the converters are deterministic)."""
+        return self._query_ledger()
 
     def _engine_grow(
         self, accounts: int = 0, transfers: int = 0, posted: int = 0,
@@ -977,6 +1137,25 @@ class TpuStateMachine:
         the serving hot loop)."""
         if self._engine is not None:
             self._host_led.prefault()
+            return
+        if self._ledger_is_sharded:
+            # Warm the sharded commit kernels (accounts, fast, the full
+            # variant for the current waves setting): one zero-count
+            # dispatch each, state value-identical.
+            soa_a = self._pad_soa(np.zeros(0, dtype=types.ACCOUNT_DTYPE))
+            self.ledger, codes_a = self._shard_steps["accounts"](
+                self.ledger, soa_a, jnp.uint64(0), jnp.uint64(1)
+            )
+            soa_t = self._pad_soa(np.zeros(0, dtype=types.TRANSFER_DTYPE))
+            self.ledger, codes_f = self._shard_steps["fast"](
+                self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
+            )
+            step = self._shard_steps[
+                "full_waves" if self.waves_enabled else "full"
+            ]
+            r = step(self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1))
+            self.ledger = r[0]
+            np.asarray(codes_a), np.asarray(codes_f), np.asarray(r[1])
             return
         from .ops import transfer_full as tf
 
@@ -1147,20 +1326,28 @@ class TpuStateMachine:
         ):
             return self._sequential("create_accounts", batch, timestamp)
 
+        self._note_shard_inserts("accounts", batch, count)
         self._grow_if_needed(accounts=count)
         if bool((batch["flags"] & types.AccountFlags.HISTORY).any()):
             self._history_accounts_possible = True
         if bool((batch["flags"] & _LIMIT_FLAGS).any()):
             self._limit_accounts_possible = True
         soa = self._pad_soa(batch)
-        self.ledger, codes = sm.create_accounts(
-            self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
-        )
+        if self._ledger_is_sharded:
+            # Same codes, owner-local inserts (parallel/sharded.py); the
+            # probe_overflow check below reads the per-shard lane vector.
+            self.ledger, codes = self._shard_steps["accounts"](
+                self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
+            )
+        else:
+            self.ledger, codes = sm.create_accounts(
+                self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
+            )
         codes, overflow = self._d2h_codes(
             codes, self.ledger.accounts.probe_overflow
         )
         self._accounts_bound += count
-        if int(overflow):
+        if bool(np.any(overflow)):
             # Load-factor management keeps this unreachable; losing inserts
             # silently is the one unacceptable outcome, so fail loud.
             raise RuntimeError("accounts probe overflow during insert")
@@ -1202,6 +1389,9 @@ class TpuStateMachine:
         if self.force_sequential:
             return self._sequential("create_transfers", batch, timestamp)
 
+        if self._ledger_is_sharded:
+            return self._sharded_commit_transfers(batch, timestamp, count)
+
         if self._fast_path_ok(batch):
             return self._commit_fast(batch, timestamp, count)
 
@@ -1231,45 +1421,13 @@ class TpuStateMachine:
             self.ledger, codes, kflags = r[0], r[1], r[2]
             wave_vec = r[3] if use_waves else None
             # The kflags scalar read IS this path's blocking device sync
-            # (the codes transfer below rides an already-complete dispatch)
-            # — time it here or the e2e decomposition misses the general
-            # kernel's whole device wait.
-            self._injected_fault_check()
-            t0 = _time.perf_counter()
-            if wave_vec is not None and _obs.enabled:
-                # The wave occupancy series rides the SAME sync (11 extra
-                # scalars on an already-blocking fetch — the int(kflags)
-                # below IS this path's commit barrier).
-                got = jax.device_get(  # tblint: ignore[host-sync] commit barrier
-                    (kflags, wave_vec)
-                )
-                kflags, wave_host = got
-                kflags = int(kflags)
-            else:
-                kflags = int(kflags)
-                wave_host = None
-            wait = _time.perf_counter() - t0
-            self.disp_wait_s += wait
-            self.disp_count += 1
-            if _obs.enabled:
-                _obs.counter("ops.dispatch").inc()
-                _obs.histogram("ops.dispatch_wait_us", "us").observe(
-                    wait * 1e6
-                )
+            # (the codes transfer below rides an already-complete dispatch).
+            kflags, wave_host = self._full_kflags_sync(kflags, wave_vec)
             if kflags == 0:
-                if wave_host is not None:
-                    # Only COMMITTED batches feed the wave occupancy
-                    # series: a routed (FLAG_SEQ/FLAG_COLD/grow) or
-                    # retried attempt applied nothing and would overstate
-                    # waves.batches_scheduled / wave0_pct.
-                    self._record_wave_metrics(wave_host)
-                codes = np.asarray(codes)
-                self._transfers_bound += count
-                self._posted_bound += pv_count
-                self._history_bound += hist_count
-                self._index_append(soa, codes, count)
-                results = self._compress(codes, count)
-                self._update_commit_timestamp(codes, count, timestamp)
+                results = self._full_commit_success(
+                    soa, codes, count, pv_count, hist_count, timestamp,
+                    wave_host,
+                )
                 # Deferred tier rebalance: eviction is only safe BETWEEN
                 # batches (mid-loop it would invalidate the certification
                 # and the batch's hot gathers).
@@ -1300,6 +1458,46 @@ class TpuStateMachine:
                 cold_checked = jnp.zeros((self.batch_lanes,), jnp.bool_)
         raise RuntimeError("transfer kernel could not place batch after growth")
 
+    def _full_kflags_sync(self, kflags, wave_vec):
+        """The general kernel's blocking commit barrier, shared by the
+        single-device and sharded dispatch loops: the kflags scalar read
+        (plus the 11-scalar wave profile riding the SAME sync when armed),
+        timed so the e2e decomposition sees the device wait."""
+        self._injected_fault_check()
+        t0 = _time.perf_counter()
+        if wave_vec is not None and _obs.enabled:
+            got = jax.device_get(  # tblint: ignore[host-sync] commit barrier
+                (kflags, wave_vec)
+            )
+            kflags, wave_host = int(got[0]), got[1]
+        else:
+            kflags = int(kflags)
+            wave_host = None
+        wait = _time.perf_counter() - t0
+        self.disp_wait_s += wait
+        self.disp_count += 1
+        if _obs.enabled:
+            _obs.counter("ops.dispatch").inc()
+            _obs.histogram("ops.dispatch_wait_us", "us").observe(wait * 1e6)
+        return kflags, wave_host
+
+    def _full_commit_success(self, soa, codes, count, pv_count, hist_count,
+                             timestamp, wave_host):
+        """Post-commit bookkeeping of a COMMITTED general-kernel batch
+        (kflags == 0), shared by both dispatch loops.  Only committed
+        batches feed the wave occupancy series — a routed or retried
+        attempt applied nothing and would overstate them."""
+        if wave_host is not None:
+            self._record_wave_metrics(wave_host)
+        codes = np.asarray(codes)
+        self._transfers_bound += count
+        self._posted_bound += pv_count
+        self._history_bound += hist_count
+        self._index_append(soa, codes, count)
+        results = self._compress(codes, count)
+        self._update_commit_timestamp(codes, count, timestamp)
+        return results
+
     def _record_wave_metrics(self, wave_host) -> None:
         """Wave occupancy series (docs/observability.md): wave_host is the
         kernel's int32[11] = (passes, bound, hist[9]) profile vector."""
@@ -1315,6 +1513,136 @@ class TpuStateMachine:
         if total:
             _obs.histogram("waves.wave0_pct", "%").observe(
                 100 * hist[0] // total
+            )
+
+    def _sharded_commit_transfers(
+        self, batch: np.ndarray, timestamp: int, count: int
+    ) -> List[Tuple[int, int]]:
+        """The sharded live commit path (docs/sharding.md): cross-shard
+        transfers settle through a two-phase split inside the jitted step —
+        each shard probes/validates its local partition (the debit and
+        credit legs of a cross-shard lane resolve on different shards), ONE
+        psum-combined context exchange carries every leg's outcome to every
+        shard, the pure validation core runs replicated, and balances/
+        inserts apply owner-locally.  Result codes and balances are
+        byte-identical to the single-device kernels; linked chains, in-batch
+        pending refs, and history accounts fall back to the sequential path
+        exactly like the wave scheduler's unschedulable exit."""
+        from .ops import transfer_full as tf
+
+        self._note_cross_shard(batch, count)
+        self._note_shard_inserts("transfers", batch, count)
+        cnt, ts = jnp.uint64(count), jnp.uint64(timestamp)
+        if self._fast_path_ok(batch):
+            self._grow_if_needed(transfers=count)
+            soa = self._pad_soa(batch)
+            self.ledger, codes = self._shard_steps["fast"](
+                self.ledger, soa, cnt, ts
+            )
+            codes, overflow = self._d2h_codes(
+                codes, self.ledger.transfers.probe_overflow
+            )
+            self._transfers_bound += count
+            if bool(np.any(overflow)):
+                raise RuntimeError(
+                    "transfers probe overflow during fast insert"
+                )
+            if _obs.enabled:
+                _obs.counter("sharding.batches").inc()
+            self._index_append(soa, codes, count)
+            results = self._compress(codes, count)
+            self._update_commit_timestamp(codes, count, timestamp)
+            return results
+
+        pv_count, hist_count = self._transfer_growth_counts(batch)
+        self._grow_if_needed(
+            transfers=count, posted=pv_count, history=hist_count
+        )
+        soa = self._pad_soa(batch)
+        use_waves = self.waves_enabled
+        step = self._shard_steps["full_waves" if use_waves else "full"]
+        for _attempt in range(8):
+            r = step(self.ledger, soa, cnt, ts)
+            self.ledger, codes, kflags = r[0], r[1], r[2]
+            wave_vec = r[3] if use_waves else None
+            kflags, wave_host = self._full_kflags_sync(kflags, wave_vec)
+            if kflags == 0:
+                if _obs.enabled:
+                    _obs.counter("sharding.batches").inc()
+                return self._full_commit_success(
+                    soa, codes, count, pv_count, hist_count, timestamp,
+                    wave_host,
+                )
+            if kflags & tf.FLAG_SEQ:
+                # Order-dependent (linked / balancing-chain / limit
+                # cascade), in-batch pending refs, or history accounts:
+                # the unschedulable exit.
+                return self._sequential("create_transfers", batch, timestamp)
+            # No FLAG_COLD on the mesh path (tiering is single-device);
+            # remaining bits are probe-overflow growth requests.
+            self._grow_flagged(kflags)
+        raise RuntimeError(
+            "sharded transfer kernel could not place batch after growth"
+        )
+
+    def _note_shard_inserts(self, which: str, batch: np.ndarray,
+                            count: int) -> None:
+        """Advance the per-shard attempted-insert bound for ``which`` by
+        this batch's id owners (over-approximation, like the global
+        bounds: rejected lanes still count).  Called BEFORE the growth
+        decision, mirroring the global bound+count discipline."""
+        if self._shard_mesh is None or count == 0:
+            return
+        from .ops.scrub import mix64_np
+
+        owners = (
+            mix64_np(
+                batch["id_lo"][:count].astype(np.uint64),
+                batch["id_hi"][:count].astype(np.uint64),
+            ) & np.uint64(self.shards - 1)
+        ).astype(np.int64)
+        self._shard_insert_bounds[which] += np.bincount(
+            owners, minlength=self.shards
+        )
+
+    def _refresh_shard_bounds(self, ledger) -> None:
+        """Re-floor the per-shard bounds at the actual live per-shard
+        counts (external install, sequential-fallback reshard, recovery)
+        — the same floor discipline restore_host_state applies to the
+        global bounds."""
+        if self._shard_mesh is None:
+            return
+        self._shard_insert_bounds = {
+            "accounts": np.asarray(ledger.accounts.count).astype(np.int64),
+            "transfers": np.asarray(ledger.transfers.count).astype(np.int64),
+        }
+
+    def _note_cross_shard(self, batch: np.ndarray, count: int) -> None:
+        """Cross-shard accounting, host-side (one mix64 pass per side): a
+        lane whose debit and credit accounts hash to different owners
+        settles through the psum leg exchange (docs/sharding.md).  Post/
+        void lanes carry zero account ids on both sides and count as
+        same-shard — the pending legs they resolve were classified when
+        the pending transfer committed."""
+        from .ops.scrub import mix64_np
+
+        mask = np.uint64(self.shards - 1)
+        dr = mix64_np(
+            batch["debit_account_id_lo"].astype(np.uint64),
+            batch["debit_account_id_hi"].astype(np.uint64),
+        ) & mask
+        cr = mix64_np(
+            batch["credit_account_id_lo"].astype(np.uint64),
+            batch["credit_account_id_hi"].astype(np.uint64),
+        ) & mask
+        cross = int((dr != cr).sum())
+        self.shard_lanes_total += count
+        self.shard_lanes_cross += cross
+        if _obs.enabled:
+            _obs.counter("sharding.lanes").inc(count)
+            _obs.counter("sharding.cross_shard_lanes").inc(cross)
+            _obs.histogram("sharding.cross_shard_pct", "%").observe(
+                100 * cross // max(count, 1)
             )
 
     def _note_balance_bound(self, batch: np.ndarray) -> None:
@@ -1508,6 +1836,10 @@ class TpuStateMachine:
             not self.group_device_commit
             or self._engine is not None
             or self.force_sequential
+            # Sharded mode commits through the blocking per-batch shard_map
+            # dispatch (per-shard lanes ARE the parallelism lever there);
+            # grouped/deferred stacking over the mesh is future work.
+            or self._shard_mesh is not None
             or not (2 <= len(batches) <= self.GROUP_K)
         ):
             return None
@@ -1613,6 +1945,7 @@ class TpuStateMachine:
         if (
             self._engine is not None
             or self.force_sequential
+            or self._shard_mesh is not None  # see commit_group_fast
             or count == 0
             or count > self.batch_lanes
         ):
@@ -1733,6 +2066,10 @@ class TpuStateMachine:
         from .ops import cold as cold_mod
 
         assert self._engine is None, "tiering runs on the device path"
+        assert self._shard_mesh is None, (
+            "cold tiering is a single-device concern (machine init enforces "
+            "the exclusion; this guards direct calls)"
+        )
         if not self._tiering:
             self._tiering = True
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
@@ -1796,6 +2133,34 @@ class TpuStateMachine:
             capacity *= 2
         return capacity
 
+    def _shard_peak_floor(self, which: str, cap: int) -> int:
+        """Under sharding, capacity must also keep the PEAK shard's
+        attempted-insert bound under half its cap/n local region — the
+        per-shard twin of the global load<=0.5 policy (hash skew can
+        overfill one shard while the global count looks fine, and a
+        fast-path probe overflow is fatal)."""
+        if self._ledger_is_sharded and which in self._shard_insert_bounds:
+            peak = int(self._shard_insert_bounds[which].max())
+            while peak * 2 > cap // self.shards:
+                cap *= 2
+        return cap
+
+    def _table_grow(self, table, name: str, capacity: int):
+        """ht.grow, layout-aware: a sharded table rehashes per shard
+        (owners are the low hash bits, so rows never migrate between
+        shards; only local homes change)."""
+        from .ops import hash_table as ht
+
+        if self._ledger_is_sharded:
+            from .parallel import sharded as shard_mod
+
+            if _obs.enabled:
+                _obs.counter("sharding.grows").inc()
+            return shard_mod.grow_sharded_table(
+                table, name, capacity, self._shard_mesh
+            )
+        return ht.grow(table, capacity)
+
     def _grow_if_needed(
         self, accounts: int = 0, transfers: int = 0, posted: int = 0,
         history: int = 0, evict_ok: bool = True,
@@ -1808,19 +2173,19 @@ class TpuStateMachine:
         caller — the deferred dispatch closures run on the lane thread
         while the serving thread keeps advancing _transfers_bound, so a
         live read here would make the growth moment timing-dependent."""
-        from .ops import hash_table as ht
-
         led = self.ledger
-        cap = self._target_capacity(
+        cap = self._shard_peak_floor("accounts", self._target_capacity(
             led.accounts.capacity, self._accounts_bound + accounts
-        )
+        ))
         if cap != led.accounts.capacity:
-            led = led.replace(accounts=ht.grow(led.accounts, cap))
-        cap = self._target_capacity(
+            led = led.replace(
+                accounts=self._table_grow(led.accounts, "accounts", cap)
+            )
+        cap = self._shard_peak_floor("transfers", self._target_capacity(
             led.transfers.capacity,
             transfers_need if transfers_need is not None
             else self._transfers_bound + transfers,
-        )
+        ))
         if cap != led.transfers.capacity:
             hot_max = self.hot_transfers_capacity_max
             if hot_max is not None and cap > hot_max and (
@@ -1838,10 +2203,21 @@ class TpuStateMachine:
                 if hot_max is not None:
                     cap = min(cap, max(hot_max, led.transfers.capacity))
                 if cap != led.transfers.capacity:
-                    led = led.replace(transfers=ht.grow(led.transfers, cap))
-        cap = self._target_capacity(led.posted.capacity, self._posted_bound + posted)
+                    led = led.replace(
+                        transfers=self._table_grow(
+                            led.transfers, "transfers", cap
+                        )
+                    )
+        posted_need = self._posted_bound + posted
+        if self._ledger_is_sharded:
+            # Posted keys (pending timestamps) are not host-computable per
+            # shard; a conservative 2x target (global load <= 0.25) keeps
+            # the peak shard's load under 0.5 except at negligible-tail
+            # skew, and the full path's claim overflow still grows+retries.
+            posted_need *= 2
+        cap = self._target_capacity(led.posted.capacity, posted_need)
         if cap != led.posted.capacity:
-            led = led.replace(posted=ht.grow(led.posted, cap))
+            led = led.replace(posted=self._table_grow(led.posted, "posted", cap))
         if history and self._history_bound + history > led.history.capacity:
             led = led.replace(
                 history=sm.grow_history(led.history, self._history_bound + history)
@@ -1849,12 +2225,15 @@ class TpuStateMachine:
         self.ledger = led
 
     def _grow_flagged(self, kflags: int) -> None:
-        from .ops import hash_table as ht
         from .ops import transfer_full as tf
 
         led = self.ledger
         if kflags & tf.FLAG_GROW_ACCOUNTS:
-            led = led.replace(accounts=ht.grow(led.accounts, led.accounts.capacity * 2))
+            led = led.replace(
+                accounts=self._table_grow(
+                    led.accounts, "accounts", led.accounts.capacity * 2
+                )
+            )
         if kflags & tf.FLAG_GROW_TRANSFERS:
             hot_max = self.hot_transfers_capacity_max
             if hot_max is not None and led.transfers.capacity >= hot_max:
@@ -1866,13 +2245,52 @@ class TpuStateMachine:
                 led = self.ledger
             else:
                 led = led.replace(
-                    transfers=ht.grow(led.transfers, led.transfers.capacity * 2)
+                    transfers=self._table_grow(
+                        led.transfers, "transfers", led.transfers.capacity * 2
+                    )
                 )
         if kflags & tf.FLAG_GROW_POSTED:
-            led = led.replace(posted=ht.grow(led.posted, led.posted.capacity * 2))
+            led = led.replace(
+                posted=self._table_grow(
+                    led.posted, "posted", led.posted.capacity * 2
+                )
+            )
         self.ledger = led
 
     def _sequential(
+        self, operation: str, batch: np.ndarray, timestamp: int
+    ) -> List[Tuple[int, int]]:
+        if self._shard_mesh is not None and self._ledger_is_sharded:
+            # The unschedulable exit of the sharded commit path (linked
+            # chains, in-batch pending refs, history accounts, deep
+            # cascades — exactly the wave scheduler's fallback set): pull
+            # the ledger into the canonical single-device layout, run the
+            # EXISTING exact sequential path unchanged (growth, bounds,
+            # index bookkeeping included — _ledger_is_sharded is off for
+            # the window, so every internal self.ledger assignment stays
+            # single-layout), then re-place the result onto the mesh.
+            # O(rows) host conversions; routed batches are rare by design.
+            from .parallel import sharded as shard_mod
+
+            self.shard_seq_fallbacks += 1
+            if _obs.enabled:
+                _obs.counter("sharding.seq_fallbacks").inc()
+            self._ledger = shard_mod.unshard_ledger(
+                self._ledger, self._shard_mesh
+            )
+            self._ledger_is_sharded = False
+            try:
+                return self._sequential_impl(operation, batch, timestamp)
+            finally:
+                self._ledger = shard_mod.shard_ledger(
+                    self._ledger, self._shard_mesh
+                )
+                self._ledger_is_sharded = True
+                self._canon = None
+                self._refresh_shard_bounds(self._ledger)
+        return self._sequential_impl(operation, batch, timestamp)
+
+    def _sequential_impl(
         self, operation: str, batch: np.ndarray, timestamp: int
     ) -> List[Tuple[int, int]]:
         from .ops import scan_path
@@ -1926,7 +2344,7 @@ class TpuStateMachine:
         dispatch-lane closure, right after its kernel, where self.ledger is
         guaranteed live (a deferred handle's resolve may run while a later
         dispatch has already donated this ledger's buffers)."""
-        if self.config.lazy_index:
+        if self.config.lazy_index or self._shard_mesh is not None:
             if not self.index.stale:
                 self.index.reset()
             self.scans_transfers.reset()
@@ -1940,9 +2358,11 @@ class TpuStateMachine:
             )
 
     def _index_append(self, soa: dict, codes: np.ndarray, count: int) -> None:
-        if self.config.lazy_index:
-            # Bulk-ingest mode: invalidate instead of maintaining; the next
-            # query rebuilds from the table (+cold runs) in one shot.
+        if self.config.lazy_index or self._shard_mesh is not None:
+            # Bulk-ingest mode (and sharded mode, whose per-batch appends
+            # would otherwise probe the sharded layout with single-device
+            # kernels): invalidate instead of maintaining; the next query
+            # rebuilds from the canonical table (+cold runs) in one shot.
             if not self.index.stale:
                 self.index.reset()
             self.scans_transfers.reset()
@@ -1963,7 +2383,7 @@ class TpuStateMachine:
     ) -> None:
         if not self.scans_accounts.indexes:
             return
-        if self.config.lazy_index:
+        if self.config.lazy_index or self._shard_mesh is not None:
             self.scans_accounts.reset()
             return
         ok = np.zeros(self.batch_lanes, dtype=bool)
@@ -1990,7 +2410,7 @@ class TpuStateMachine:
             return self._engine.lookup_accounts(ids)
         lo = jnp.asarray([i & U64_MAX for i in ids], jnp.uint64)
         hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
-        found, cols = sm.lookup_accounts(self.ledger, lo, hi)
+        found, cols = sm.lookup_accounts(self._query_ledger(), lo, hi)
         found = np.asarray(found)
         host = {k: np.asarray(v) for k, v in cols.items()}
         host["reserved"] = np.zeros(len(ids), np.uint32)
@@ -2005,7 +2425,7 @@ class TpuStateMachine:
             return rows[found]  # no cold tier in host mode
         lo = jnp.asarray([i & U64_MAX for i in ids], jnp.uint64)
         hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
-        found, cols = sm.lookup_transfers(self.ledger, lo, hi)
+        found, cols = sm.lookup_transfers(self._query_ledger(), lo, hi)
         found = np.asarray(found)
         host = {k: np.asarray(v) for k, v in cols.items()}
         rows = types.from_soa(host, types.TRANSFER_DTYPE)
@@ -2077,7 +2497,7 @@ class TpuStateMachine:
         # reply (one compiled query program per level layout).
         k = 1 << (QUERY_ROWS_MAX - 1).bit_length()
         valid, tid_lo, tid_hi = self.index.query(
-            self.ledger,
+            self._query_ledger(),
             jnp.uint64(acct_lo), jnp.uint64(acct_hi),
             jnp.uint64(ts_min), jnp.uint64(ts_max),
             jnp.bool_(bool(flags & types.AccountFilterFlags.DEBITS)),
@@ -2094,7 +2514,7 @@ class TpuStateMachine:
         hot-table batch lookup, adjacent-duplicate dedup, cold-spill merge
         (the ScanLookup role, lsm/scan_lookup.zig)."""
         found, cols = sm.lookup_transfers(
-            self.ledger, jnp.asarray(tid_lo), jnp.asarray(tid_hi)
+            self._query_ledger(), jnp.asarray(tid_lo), jnp.asarray(tid_hi)
         )
         idx_valid = np.asarray(valid)
         found = np.asarray(found)
@@ -2154,7 +2574,7 @@ class TpuStateMachine:
         ts_min, ts_max = self._scan_window(timestamp_min, timestamp_max)
         limit = min(limit, QUERY_ROWS_MAX)
         tid_lo, tid_hi = self.scans_transfers.evaluate(
-            expr, self.ledger, ts_min, ts_max, limit, bool(reversed)
+            expr, self._query_ledger(), ts_min, ts_max, limit, bool(reversed)
         )
         if len(tid_lo) == 0:
             return np.zeros(0, dtype=types.TRANSFER_DTYPE)
@@ -2179,7 +2599,7 @@ class TpuStateMachine:
         ts_min, ts_max = self._scan_window(timestamp_min, timestamp_max)
         limit = min(limit, QUERY_ROWS_MAX)
         tid_lo, tid_hi = self.scans_accounts.evaluate(
-            expr, self.ledger, ts_min, ts_max, limit, bool(reversed)
+            expr, self._query_ledger(), ts_min, ts_max, limit, bool(reversed)
         )
         ids = [int(lo) | (int(hi) << 64) for lo, hi in zip(tid_lo, tid_hi)]
         if not ids:
@@ -2240,9 +2660,10 @@ class TpuStateMachine:
         ):
             return np.zeros(0, dtype=types.ACCOUNT_BALANCE_DTYPE)
         flags = int(filt["flags"])
-        k = min(self.ledger.history.capacity, QUERY_ROWS_MAX)
+        qled = self._query_ledger()
+        k = min(qled.history.capacity, QUERY_ROWS_MAX)
         valid, rows = query.scan_history(
-            self.ledger,
+            qled,
             jnp.uint64(acct_lo), jnp.uint64(acct_hi),
             jnp.uint64(ts_min), jnp.uint64(ts_max),
             jnp.bool_(bool(flags & types.AccountFilterFlags.DEBITS)),
@@ -2282,17 +2703,22 @@ class TpuStateMachine:
         # predate bound tracking still trigger growth correctly (one sync at
         # restart is fine).
         led = self.ledger
+
+        def _count(table) -> int:
+            # Layout-agnostic: sharded tables carry per-shard count vectors.
+            return int(np.asarray(table.count).sum())
+
         self._accounts_bound = max(
-            int(state.get("accounts_bound", 0)), int(led.accounts.count)
+            int(state.get("accounts_bound", 0)), _count(led.accounts)
         )
         self._transfers_bound = max(
-            int(state.get("transfers_bound", 0)), int(led.transfers.count)
+            int(state.get("transfers_bound", 0)), _count(led.transfers)
         )
         self._posted_bound = max(
-            int(state.get("posted_bound", 0)), int(led.posted.count)
+            int(state.get("posted_bound", 0)), _count(led.posted)
         )
         self._history_bound = max(
-            int(state.get("history_bound", 0)), int(led.history.count)
+            int(state.get("history_bound", 0)), int(np.asarray(led.history.count))
         )
         self._history_accounts_possible = bool(
             state.get("history_accounts_possible", True)
@@ -2304,6 +2730,12 @@ class TpuStateMachine:
         )
         self._balance_bound = int(state.get("balance_bound", _BOUND_CLAMP))
         manifest = state.get("cold_manifest", [])
+        if manifest and self._shard_mesh is not None:
+            # The mesh path has no bloom/cold resolution: a checkpoint whose
+            # durable manifest says evictions happened cannot serve sharded.
+            raise DeviceStateUnrecoverable(
+                "cold tier active in checkpoint: unsupported under TB_SHARDS"
+            )
         if manifest:
             self._tiering = True
             self.cold.load_manifest(manifest)

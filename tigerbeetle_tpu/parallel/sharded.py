@@ -58,29 +58,10 @@ from ..ops.state_machine import (
     TransferCtx,
 )
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map as _shard_map_impl
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map_impl
-
-# The kwarg disabling the replication/varying-axes check was renamed
-# check_rep -> check_vma across jax versions; detect what this jax takes
-# so the call sites below stay on one spelling.
-import inspect as _inspect
-
-_VARY_KW = (
-    "check_vma"
-    if "check_vma" in _inspect.signature(_shard_map_impl).parameters
-    else "check_rep"
-)
-
-
-def shard_map(f, *, mesh, in_specs, out_specs, check_vma):
-    return _shard_map_impl(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        **{_VARY_KW: check_vma},
-    )
-
+# One shared version-portable wrapper (check_rep -> check_vma rename shim)
+# lives in jaxenv so machine.py, this module, and future mesh callers stay
+# on a single spelling — re-exported here for existing importers.
+from ..jaxenv import shard_map  # noqa: F401  (re-export)
 
 AXIS = "shard"
 
@@ -90,12 +71,17 @@ def make_sharded_ledger(
     accounts_capacity: int,
     transfers_capacity: int,
     posted_capacity: int,
+    history_capacity: int = 1,
 ) -> Ledger:
     """Build a Ledger whose table arrays are sharded over ``mesh`` axis 0.
 
     Capacities are *global* (power of two, divisible by the shard count).
     Table ``count``/``probe_overflow`` become per-shard vectors of length
-    n_shards."""
+    n_shards.  The history log is NOT hash-partitioned (it is an
+    append-ordered log): it stays a real single-device History, replicated
+    over the mesh (spec P()) and written only by the sequential fallback —
+    the sharded kernels route history-touching batches (FLAG_SEQ) instead
+    of applying them."""
     n = mesh.devices.size
     for cap in (accounts_capacity, transfers_capacity, posted_capacity):
         assert cap % n == 0 and (cap & (cap - 1)) == 0
@@ -110,26 +96,42 @@ def make_sharded_ledger(
             probe_overflow=np.zeros((n,), np.bool_),
         )
 
-    # History stays empty on the sharded fast path (history-flagged accounts
-    # are excluded by precondition P1); it exists so the Ledger pytree is
-    # uniform.  One row per shard keeps every leaf shardable over axis 0.
     ledger = Ledger(
         accounts=table(accounts_capacity, ACCOUNT_COLS),
         transfers=table(transfers_capacity, TRANSFER_COLS),
         posted=table(posted_capacity, POSTED_COLS),
-        history=sm.History(
-            cols={
-                name: np.zeros((n,), dt)
-                for name, dt in sm.HISTORY_COLS.items()
-            },
-            count=np.zeros((n,), np.uint64),
+        history=sm.make_history(history_capacity),
+    )
+    shard = NamedSharding(mesh, P(AXIS))
+    repl = NamedSharding(mesh, P())
+    return Ledger(
+        accounts=jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, shard), ledger.accounts
+        ),
+        transfers=jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, shard), ledger.transfers
+        ),
+        posted=jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, shard), ledger.posted
+        ),
+        history=jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, repl), ledger.history
         ),
     )
-    spec = NamedSharding(mesh, P(AXIS))
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, spec), ledger)
 
 
 def _specs_like(tree):
+    """Ledger partition specs: tables shard over axis 0, history (an
+    append-ordered log the mesh kernels never touch) stays replicated."""
+    if isinstance(tree, Ledger):
+        return Ledger(
+            accounts=jax.tree_util.tree_map(lambda _: P(AXIS), tree.accounts),
+            transfers=jax.tree_util.tree_map(
+                lambda _: P(AXIS), tree.transfers
+            ),
+            posted=jax.tree_util.tree_map(lambda _: P(AXIS), tree.posted),
+            history=jax.tree_util.tree_map(lambda _: P(), tree.history),
+        )
     return jax.tree_util.tree_map(lambda _: P(AXIS), tree)
 
 
@@ -245,7 +247,9 @@ def sharded_create_transfers(mesh: Mesh):
     return jax.jit(step, donate_argnames=("ledger",))
 
 
-def sharded_create_transfers_full(mesh: Mesh, max_passes: int = None):
+def sharded_create_transfers_full(
+    mesh: Mesh, max_passes: int = None, use_waves: bool = False
+):
     """The fully-general transfer kernel (two-phase/balancing/limits) over
     the device mesh.  ``max_passes`` mirrors LedgerConfig.jacobi_max_passes
     (defaults to the kernel's budget) so both serving paths honor the knob.
@@ -257,7 +261,16 @@ def sharded_create_transfers_full(mesh: Mesh, max_passes: int = None):
     accounts route (FLAG_SEQ) instead of applying: history is an ordered
     append log, which stays a single-chip structure.
 
-    Returns fn(ledger, batch, count, timestamp) -> (ledger, codes, kflags).
+    ``use_waves`` (STATIC; TB_WAVES at the machine level) arms the
+    conflict-index wave scheduler INSIDE the replicated kernel core: the
+    hazard-lane wave bounds are computed over the shard-local batch view
+    (which is the full replicated batch, so every shard certifies the same
+    bound) and certified batches commit after the proved pass count — the
+    exact docs/waves.md semantics, now on the mesh path.  On, a FOURTH
+    replicated int32[11] wave-profile vector is returned.
+
+    Returns fn(ledger, batch, count, timestamp) -> (ledger, codes, kflags
+    [, wave_vec]).
     """
     from ..ops import transfer_full as _tf
 
@@ -369,7 +382,9 @@ def sharded_create_transfers_full(mesh: Mesh, max_passes: int = None):
             probe_grow=probe_grow,
             accounts_capacity=jnp.uint64(acc.capacity * n_shards),
         )
-        plan = tf._kernel_core(ctx, batch, count, timestamp, max_passes)
+        plan = tf._kernel_core(
+            ctx, batch, count, timestamp, max_passes, use_waves=use_waves
+        )
 
         # History admission: the mesh ledger has no history log — route
         # instead of silently dropping rows.
@@ -436,14 +451,23 @@ def sharded_create_transfers_full(mesh: Mesh, max_passes: int = None):
         out = ledger.replace(
             accounts=accounts, transfers=transfers, posted=posted_out
         )
+        if use_waves:
+            wave_vec = jnp.concatenate([
+                plan.passes.reshape(1), plan.wave_bound.reshape(1),
+                plan.wave_hist,
+            ])
+            return out, plan.codes, kflags, wave_vec
         return out, plan.codes, kflags
 
     def step(ledger, batch, count, timestamp):
+        out_specs = (_specs_like(ledger), P(), P())
+        if use_waves:
+            out_specs = out_specs + (P(),)
         return shard_map(
             local_step,
             mesh=mesh,
             in_specs=(_specs_like(ledger), _replicated_like(batch), P(), P()),
-            out_specs=(_specs_like(ledger), P(), P()),
+            out_specs=out_specs,
             check_vma=False,  # see sharded_create_transfers' justification
         )(ledger, batch, count, timestamp)
 
@@ -516,3 +540,233 @@ def sharded_create_accounts(mesh: Mesh):
         )(ledger, batch, count, timestamp)
 
     return jax.jit(step, donate_argnames=("ledger",))
+
+
+# ---------------------------------------------------------------------------
+# Per-shard scrub lanes (machine.scrub_check under TB_SHARDS)
+# ---------------------------------------------------------------------------
+
+
+def sharded_scrub_digest(mesh: Mesh):
+    """Per-shard scrub fold lanes: uint64[n_shards, 3] where row s is shard
+    s's partial (accounts, transfers, posted) fold over its local partition.
+
+    The scrub folds are wrap-adds over live rows (ops/scrub.py), so the
+    GLOBAL digests are the per-shard lanes summed mod 2^64 — the host
+    compares that sum against the mirror's expectation, and the lanes
+    themselves localize a mismatch to one shard.  ONE readback through the
+    commit-barrier funnel, like the single-device fold."""
+    from ..ops import scrub as scrub_ops
+
+    def local_step(ledger: Ledger):
+        lanes = jnp.stack([
+            scrub_ops._fold_accounts(ledger.accounts),
+            scrub_ops._fold_transfers(ledger.transfers),
+            scrub_ops._fold_posted(ledger.posted),
+        ])
+        return lanes[None, :]  # (1, 3) local -> (n_shards, 3) global
+
+    def step(ledger):
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(_specs_like(ledger),),
+            out_specs=P(AXIS),
+            check_vma=False,  # see sharded_create_transfers' justification
+        )(ledger)
+
+    # Deliberately NOT donated: the scrub must never consume the ledger.
+    return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
+# Host-side layout converters (sequential fallback, checkpoints, queries)
+# ---------------------------------------------------------------------------
+#
+# The sharded and single-device layouts hold identical CONTENT under
+# different slot assignment: single-device homes at mix64(key) & (C-1);
+# sharded homes at shard (mix64 & (n-1)), local slot ((mix64 >> shift) &
+# (C/n - 1)).  These converters re-place every live row host-side with the
+# exact linear-probe discipline of ht.claim_slots for distinct keys
+# (insertion in row order == the batched claim protocol, since unplaced
+# lanes sharing a probe slot always share a home).  Both are deterministic
+# functions of the input layout, so every replica replaying the same commit
+# stream converges to byte-identical canonical arrays (checkpoint file
+# checksums must agree across the cluster).  Cost is O(rows) host work —
+# paid only at sequential fallbacks, checkpoint captures, and the first
+# query after a commit, never on the sharded commit hot path.
+
+
+def _host_rows(table: ht.Table):
+    """(key_lo, key_hi, cols, live_idx) host copies; live rows in slot
+    order (deterministic given the layout), tombstones dropped."""
+    key_lo = np.asarray(table.key_lo)
+    key_hi = np.asarray(table.key_hi)
+    tomb = np.asarray(table.tombstone)
+    live = ((key_lo != 0) | (key_hi != 0)) & ~tomb
+    idx = np.flatnonzero(live)
+    cols = {k: np.asarray(v) for k, v in table.cols.items()}
+    return key_lo, key_hi, cols, idx
+
+
+def _probe_place(homes: np.ndarray, region_base: np.ndarray, region_mask: int,
+                 capacity: int) -> np.ndarray:
+    """Linear-probe placement of distinct keys in row order: row i lands at
+    the first free slot of region_base[i] + ((homes[i] + k) & region_mask).
+    Returns the chosen global slots."""
+    occupied = np.zeros(capacity, bool)
+    slots = np.empty(len(homes), np.int64)
+    for i in range(len(homes)):
+        s = int(homes[i])
+        base = int(region_base[i])
+        while occupied[base + s]:
+            s = (s + 1) & region_mask
+        occupied[base + s] = True
+        slots[i] = base + s
+    return slots
+
+
+def _fill_table(capacity: int, key_lo, key_hi, cols, slots,
+                col_specs) -> ht.Table:
+    out_lo = np.zeros(capacity, np.uint64)
+    out_hi = np.zeros(capacity, np.uint64)
+    out_lo[slots] = key_lo
+    out_hi[slots] = key_hi
+    out_cols = {}
+    for name, dt in col_specs.items():
+        buf = np.zeros(capacity, dt)
+        buf[slots] = cols[name]
+        out_cols[name] = jnp.asarray(buf)
+    return ht.Table(
+        key_lo=jnp.asarray(out_lo),
+        key_hi=jnp.asarray(out_hi),
+        tombstone=jnp.zeros((capacity,), jnp.bool_),
+        cols=out_cols,
+        count=jnp.uint64(len(slots)),
+        probe_overflow=jnp.bool_(False),
+    )
+
+
+_COL_SPECS = {
+    "accounts": ACCOUNT_COLS,
+    "transfers": TRANSFER_COLS,
+    "posted": POSTED_COLS,
+}
+
+
+def unshard_ledger(ledger: Ledger, mesh: Mesh) -> sm.Ledger:
+    """Canonical single-device Ledger with the sharded ledger's exact
+    content (single-device probe layout, scalar counts).  The history log
+    is already single-device (replicated) and passes through unchanged."""
+    from ..ops.scrub import mix64_np
+
+    def un_table(table: ht.Table, name: str) -> ht.Table:
+        cap = table.capacity
+        key_lo, key_hi, cols, idx = _host_rows(table)
+        k_lo, k_hi = key_lo[idx], key_hi[idx]
+        homes = mix64_np(k_lo, k_hi) & np.uint64(cap - 1)
+        slots = _probe_place(
+            homes, np.zeros(len(idx), np.int64), cap - 1, cap
+        )
+        return _fill_table(
+            cap, k_lo, k_hi, {k: v[idx] for k, v in cols.items()}, slots,
+            _COL_SPECS[name],
+        )
+
+    return sm.Ledger(
+        accounts=un_table(ledger.accounts, "accounts"),
+        transfers=un_table(ledger.transfers, "transfers"),
+        posted=un_table(ledger.posted, "posted"),
+        history=sm.History(
+            cols={k: jnp.asarray(np.asarray(v))
+                  for k, v in ledger.history.cols.items()},
+            count=jnp.uint64(int(np.asarray(ledger.history.count))),
+        ),
+    )
+
+
+def _shard_table(table: ht.Table, name: str, mesh: Mesh,
+                 new_capacity: int = None) -> ht.Table:
+    """Host-side (re)placement of one table into the sharded layout at
+    ``new_capacity`` (default: same global capacity) — used by
+    shard_ledger and by growth under sharding."""
+    from ..ops.scrub import mix64_np
+
+    n = mesh.devices.size
+    shift = n.bit_length() - 1
+    cap = new_capacity if new_capacity is not None else table.capacity
+    assert cap % n == 0 and (cap & (cap - 1)) == 0
+    local_cap = cap // n
+    key_lo, key_hi, cols, idx = _host_rows(table)
+    k_lo, k_hi = key_lo[idx], key_hi[idx]
+    h = mix64_np(k_lo, k_hi)
+    owner = (h & np.uint64(n - 1)).astype(np.int64)
+    homes = (h >> np.uint64(shift)) & np.uint64(local_cap - 1)
+    slots = _probe_place(homes, owner * local_cap, local_cap - 1, cap)
+    out = _fill_table(
+        cap, k_lo, k_hi, {k: v[idx] for k, v in cols.items()}, slots,
+        _COL_SPECS[name],
+    )
+    counts = np.bincount(owner, minlength=n).astype(np.uint64)
+    out = out.replace(
+        count=counts, probe_overflow=np.zeros((n,), np.bool_)
+    )
+    spec = NamedSharding(mesh, P(AXIS))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, spec), out)
+
+
+def shard_ledger(single: sm.Ledger, mesh: Mesh) -> Ledger:
+    """Sharded Ledger with the single-device ledger's exact content
+    (owner-partitioned probe layout, per-shard count vectors)."""
+    repl = NamedSharding(mesh, P())
+    return Ledger(
+        accounts=_shard_table(single.accounts, "accounts", mesh),
+        transfers=_shard_table(single.transfers, "transfers", mesh),
+        posted=_shard_table(single.posted, "posted", mesh),
+        history=jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(np.asarray(x)), repl),
+            single.history,
+        ),
+    )
+
+
+def grow_sharded_table(table: ht.Table, name: str, new_capacity: int,
+                       mesh: Mesh) -> ht.Table:
+    """ht.grow for a sharded table: owners are the LOW hash bits so every
+    row stays on its shard; only the local homes rehash (the hash_shift
+    discipline).  Host-side re-placement, same determinism argument as the
+    converters."""
+    assert new_capacity >= table.capacity
+    return _shard_table(table, name, mesh, new_capacity)
+
+
+# ---------------------------------------------------------------------------
+# Jitted step cache (machine.py's serving surface)
+# ---------------------------------------------------------------------------
+
+_STEP_CACHE: Dict[tuple, dict] = {}
+
+
+def machine_steps(mesh: Mesh, max_passes: int) -> dict:
+    """The jitted sharded commit/scrub steps for ``mesh``, cached process-
+    wide by (device ids, max_passes): a VOPR cluster's replicas (or any two
+    machines on one mesh) share ONE set of compiled programs instead of
+    re-tracing per machine.  Kernels are pure, so sharing is sound."""
+    key = (
+        tuple(int(d.id) for d in mesh.devices.flat),
+        mesh.axis_names,
+        int(max_passes),
+    )
+    steps = _STEP_CACHE.get(key)
+    if steps is None:
+        steps = {
+            "accounts": sharded_create_accounts(mesh),
+            "fast": sharded_create_transfers(mesh),
+            "full": sharded_create_transfers_full(mesh, max_passes),
+            "full_waves": sharded_create_transfers_full(
+                mesh, max_passes, use_waves=True
+            ),
+            "scrub": sharded_scrub_digest(mesh),
+        }
+        _STEP_CACHE[key] = steps
+    return steps

@@ -97,6 +97,15 @@ def run_seed(
     # draw from the schedule rng would shift every pinned regression
     # seed's fault schedule.
     hot_cap = random.Random(seed ^ 0xC01D).choice([None, None, None, 128])
+    if os.environ.get("TB_SHARDS", "").isdigit() and int(
+        os.environ["TB_SHARDS"]
+    ) >= 2:
+        # Sharded serving (TB_SHARDS x VOPR): cold tiering is a
+        # single-device concern (no bloom on the mesh path; machine init
+        # enforces the exclusion).  The draw above still consumed its
+        # stream, so arming shards never shifts a pinned seed's schedule —
+        # tiered schedules simply run untiered, like device_faults does.
+        hot_cap = None
     partition_modes = ["isolate_single", "uniform_size", "uniform_partition"]
     # Device fault kind (opt-in; docs/fault_domains.md): schedule drawn
     # from a SEPARATE stream so arming it cannot shift the base schedule,

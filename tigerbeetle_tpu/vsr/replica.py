@@ -1460,7 +1460,11 @@ class Replica:
                 for client, s in self.sessions.items()
             },
         }
-        arrays = checkpoint_mod.ledger_to_arrays(m.ledger)
+        # checkpoint_ledger(): canonical single-device layout — under
+        # TB_SHARDS the live ledger is owner-partitioned, and a checkpoint
+        # must restore into ANY shard config (deterministic conversion, so
+        # replica checkpoint file checksums stay cluster-comparable).
+        arrays = checkpoint_mod.ledger_to_arrays(m.checkpoint_ledger())
         fields = dict(
             view=self.view,
             log_view=getattr(self, "log_view", self.view),

@@ -71,7 +71,8 @@ TIERS = {
             "tests/test_kernels_fast.py", "tests/test_transfer_full.py",
             "tests/test_balancing_vector.py", "tests/test_scan_path.py",
             "tests/test_queries.py", "tests/test_scan_builder.py",
-            "tests/test_sharded.py", "tests/test_group_commit.py",
+            "tests/test_sharded.py", "tests/test_sharded_machine.py",
+            "tests/test_group_commit.py",
             "tests/test_pipeline.py", "tests/test_waves.py",
             "tests/test_host_engine.py", "tests/test_cold_tier.py",
         ],
@@ -126,6 +127,15 @@ TIERS = {
         # asserted in METRICS.json.  Artifact: WAVES_SMOKE.json.
         cmd=["tools/waves_smoke.py"],
     ),
+    "sharded": dict(
+        # Sharded live commit path smoke (docs/sharding.md): TB_SHARDS=0
+        # bit-identity against the pinned PIPELINE_SMOKE reply/digest
+        # identity, sharded-vs-single digest parity on a pinned mixed
+        # workload (shards 0/2/8 incl. the sequential fallback), and the
+        # sharding.* series asserted in METRICS.json.
+        # Artifact: SHARDED_SMOKE.json at the repo root.
+        cmd=["tools/sharded_smoke.py"],
+    ),
     "byzantine": dict(
         # Byzantine fault domain smoke (docs/fault_domains.md): pinned
         # seed with one equivocating/corrupting/lying replica of six
@@ -153,6 +163,15 @@ TIERS = {
             "test_scrub_off_bug_is_caught",
             "tests/test_sharded.py::test_sharded_full_kernel_two_phase_parity",
             "tests/test_sharded.py::test_sharded_full_kernel_random_stream",
+            # Sharded LIVE commit path (PR 8): the machine-mode parity
+            # pass, the cross-shard/zipf/two-phase differential matrix,
+            # the structural surfaces (growth/checkpoint/waves/scrub),
+            # and the pinned VOPR seed under TB_SHARDS=2 — all @slow
+            # (8-device compiles), so they run whole here.
+            "tests/test_sharded_machine.py::test_sharded_machine_parity_mixed",
+            "tests/test_sharded_machine.py::TestShardedDifferential",
+            "tests/test_sharded_machine.py::TestShardedStructural",
+            "tests/test_sharded_machine.py::TestVoprSharded",
             "tests/test_block_repair.py::"
             "test_missing_cold_run_repaired_from_peer",
             "tests/test_scan_builder.py::TestCompositions"
@@ -195,7 +214,7 @@ TIERS = {
 }
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
-    "scrub", "overload", "waves", "byzantine", "integration",
+    "scrub", "overload", "waves", "sharded", "byzantine", "integration",
 ]
 
 

@@ -1,0 +1,204 @@
+"""CI sharded smoke: prove the sharded live commit path end to end.
+
+In-process (CPU-pinned, 8 virtual devices), three proofs with asserted
+artifacts, mirroring the acceptance bar in docs/sharding.md:
+
+1. OFF-PATH IDENTITY — with TB_SHARDS=0 the serving path is bit-identical
+   to pre-sharding: the pipeline bench's pinned workload (the same one
+   tools/pipeline_smoke.py runs) must reproduce the replies_sha and
+   ledger digest recorded in PIPELINE_SMOKE.json.
+2. PARITY — a pinned mixed workload (plain + cross-shard + two-phase +
+   a history-account batch that exercises the sequential fallback)
+   committed through TpuStateMachine at shards 0 / 2 / 8: per-batch
+   results, final digest, and balance snapshots must be identical, and
+   the sharded runs must have actually fallen back at least once.
+3. COUNTERS — the sharded run with the metrics registry enabled must land
+   the sharding.* series (batches, lanes, cross_shard_lanes,
+   cross_shard_pct, seq_fallbacks, shards gauge) in METRICS.json.
+
+Artifact: SHARDED_SMOKE.json at the repo root; the ``sharded`` tier in
+tools/ci.py records pass/fail in CI_LAST.json.
+
+Usage: python tools/sharded_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def mix_batches(n_accounts):
+    """The pinned mixed workload: plain uniform (cross-shard by hash),
+    pending, table post, and one history-account batch (seq fallback)."""
+    from tigerbeetle_tpu import types
+
+    batches = []
+    nid = 1000
+    specs = []
+    for i in range(48):
+        specs.append(types.transfer(
+            id=nid, debit_account_id=1 + i % (n_accounts - 1),
+            credit_account_id=1 + (i + 3) % (n_accounts - 1),
+            amount=5 + i, ledger=1, code=1,
+        ))
+        nid += 1
+    batches.append(types.transfers_array(specs))
+    pend = []
+    specs = []
+    for i in range(16):
+        specs.append(types.transfer(
+            id=nid, debit_account_id=1 + i % (n_accounts - 1),
+            credit_account_id=1 + (i + 5) % (n_accounts - 1),
+            amount=20, ledger=1, code=1, flags=types.TransferFlags.PENDING,
+        ))
+        pend.append(nid)
+        nid += 1
+    batches.append(types.transfers_array(specs))
+    specs = [
+        types.transfer(
+            id=nid + j, pending_id=p, ledger=1, code=1,
+            flags=(
+                types.TransferFlags.POST_PENDING_TRANSFER
+                if j % 2 == 0 else types.TransferFlags.VOID_PENDING_TRANSFER
+            ),
+        )
+        for j, p in enumerate(pend)
+    ]
+    nid += len(pend)
+    batches.append(types.transfers_array(specs))
+    # History-account batch — the ONLY batch touching account n_accounts
+    # (AccountFlags.HISTORY, see run()): the sharded kernel must route it
+    # to the sequential fallback (the unschedulable exit under test) while
+    # every batch above commits sharded.
+    specs = [
+        types.transfer(
+            id=nid + j, debit_account_id=n_accounts,
+            credit_account_id=1 + j, amount=2 + j, ledger=1, code=1,
+        )
+        for j in range(8)
+    ]
+    return batches + [types.transfers_array(specs)]
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["TB_SHARDS"] = "0"  # proof 1 runs the OFF path
+    from tigerbeetle_tpu import jaxenv
+
+    jaxenv.enable_compile_cache()
+    jaxenv.force_cpu(8)
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.config import LedgerConfig
+    from tigerbeetle_tpu.machine import TpuStateMachine
+    from tigerbeetle_tpu.obs.metrics import registry
+
+    summary: dict = {}
+
+    # 1. OFF-PATH IDENTITY (TB_SHARDS=0 == pre-sharding, bit for bit) ------
+    import bench
+
+    entry = bench.run_pipeline_bench(1)
+    with open(os.path.join(REPO, "PIPELINE_SMOKE.json")) as f:
+        pinned = json.load(f)["identity"]
+    assert entry["replies_sha"] == pinned["replies_sha"], (
+        "TB_SHARDS=0 reply stream diverged from the pinned pre-sharding "
+        f"identity: {entry['replies_sha']} != {pinned['replies_sha']}"
+    )
+    assert entry["digest"] == pinned["digest"], (
+        "TB_SHARDS=0 ledger digest diverged from the pinned identity"
+    )
+    summary["off_path"] = {
+        "replies_sha": entry["replies_sha"], "digest": entry["digest"],
+    }
+
+    # 2. PARITY (shards 0 vs 2 vs 8, incl. the sequential fallback) --------
+    n_accounts = 16
+    cfg = LedgerConfig(
+        accounts_capacity_log2=10, transfers_capacity_log2=12,
+        posted_capacity_log2=10,
+    )
+
+    def run(shards):
+        dev = TpuStateMachine(cfg, batch_lanes=128, shards=shards)
+        accounts = types.accounts_array([
+            types.account(
+                id=i + 1, ledger=1, code=10,
+                flags=(
+                    types.AccountFlags.HISTORY
+                    if i + 1 == n_accounts else 0
+                ),
+            )
+            for i in range(n_accounts)
+        ])
+        dev.create_accounts(accounts, wall_clock_ns=1)
+        results = [dev.create_transfers(b) for b in mix_batches(n_accounts)]
+        return dev, results, f"{dev.digest():#x}", dev.balances_snapshot()
+
+    m0, res0, dig0, bal0 = run(0)
+    m2, res2, dig2, bal2 = run(2)
+    m8, res8, dig8, bal8 = run(8)
+    assert res0 == res2 == res8, "sharded-vs-single result divergence"
+    assert dig0 == dig2 == dig8, (dig0, dig2, dig8)
+    assert bal0 == bal2 == bal8, "sharded-vs-single balance divergence"
+    assert m2.shards == 2 and m8.shards == 8, "mode did not engage"
+    assert m2.shard_seq_fallbacks >= 1 and m8.shard_seq_fallbacks >= 1, (
+        "history batch did not exercise the sequential fallback"
+    )
+    assert m2.shard_lanes_cross > 0, "no cross-shard lanes observed"
+    summary["parity"] = {
+        "digest": dig0,
+        "batches": len(res0),
+        "cross_shard_frac_2": round(
+            m2.shard_lanes_cross / m2.shard_lanes_total, 3
+        ),
+        "cross_shard_frac_8": round(
+            m8.shard_lanes_cross / m8.shard_lanes_total, 3
+        ),
+        "seq_fallbacks": m2.shard_seq_fallbacks,
+    }
+
+    # 3. COUNTERS ----------------------------------------------------------
+    registry.enable()
+    try:
+        dev, _res, _dig, _bal = run(2)
+        snap = registry.snapshot()
+        metrics_path = os.path.join(REPO, "METRICS.json")
+        registry.dump(metrics_path)
+    finally:
+        registry.disable()
+    counters = snap["counters"]
+    hists = snap["histograms"]
+    gauges = snap.get("gauges", {})
+    assert counters.get("sharding.batches", 0) > 0, sorted(counters)
+    assert counters.get("sharding.lanes", 0) > 0
+    assert counters.get("sharding.cross_shard_lanes", 0) > 0
+    assert counters.get("sharding.seq_fallbacks", 0) > 0
+    assert "sharding.cross_shard_pct" in hists, sorted(hists)
+    with open(metrics_path) as f:
+        dumped = json.load(f)
+    assert "sharding.batches" in dumped.get("counters", {}), (
+        "sharding counters missing from METRICS.json"
+    )
+    summary["counters"] = {
+        "batches": counters["sharding.batches"],
+        "lanes": counters["sharding.lanes"],
+        "cross_shard_lanes": counters["sharding.cross_shard_lanes"],
+        "seq_fallbacks": counters["sharding.seq_fallbacks"],
+        "shards_gauge": gauges.get("sharding.shards"),
+    }
+
+    summary["green"] = True
+    with open(os.path.join(REPO, "SHARDED_SMOKE.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
