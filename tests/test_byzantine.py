@@ -214,26 +214,37 @@ class TestSourceAuth:
         assert cluster.rejected_frames == {}
 
     def test_prepare_from_non_primary_rejected(self, tmp_path):
+        # The process-global registry must not LEAK enabled past this
+        # test: a later statsd-wired server would flush every counter
+        # accumulated since (hundreds of UDP packets per flush), flooding
+        # unrelated tests' sockets — found when the flood grew enough to
+        # drop test_cluster_net's one load-bearing events datagram.
         registry.enable()
-        before = registry.counter("byzantine.rejected.not_primary").value
-        cluster = make_cluster(tmp_path, seed=8)
-        cluster.run(50)
-        # A prepare claiming replica 2 prepared it in view 0 (primary 0):
-        # ill-formed regardless of transport source.
-        forged = wire.new_header(
-            wire.Command.prepare, cluster=CLUSTER_ID, view=0,
-            parent=1, request_checksum=2, client=3, op=99, commit=0,
-            timestamp=4, request=1,
-            operation=int(wire.Operation.create_accounts),
-        )
-        forged["replica"] = 2
-        cluster.net.send(
-            ("replica", 2), ("replica", 1), wire.encode(forged, b""),
-            cluster.t,
-        )
-        cluster.run(20)
-        after = registry.counter("byzantine.rejected.not_primary").value
-        assert after > before
+        try:
+            before = registry.counter("byzantine.rejected.not_primary").value
+            cluster = make_cluster(tmp_path, seed=8)
+            cluster.run(50)
+            # A prepare claiming replica 2 prepared it in view 0
+            # (primary 0): ill-formed regardless of transport source.
+            forged = wire.new_header(
+                wire.Command.prepare, cluster=CLUSTER_ID, view=0,
+                parent=1, request_checksum=2, client=3, op=99, commit=0,
+                timestamp=4, request=1,
+                operation=int(wire.Operation.create_accounts),
+            )
+            forged["replica"] = 2
+            cluster.net.send(
+                ("replica", 2), ("replica", 1), wire.encode(forged, b""),
+                cluster.t,
+            )
+            cluster.run(20)
+            after = registry.counter(
+                "byzantine.rejected.not_primary"
+            ).value
+            assert after > before
+        finally:
+            registry.reset()
+            registry.disable()
 
 
 # ---------------------------------------------------------------------------

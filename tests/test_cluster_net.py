@@ -170,6 +170,10 @@ def test_cluster_statsd_emission(tmp_path):
     from tigerbeetle_tpu.utils.statsd import StatsD
 
     recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    # Headroom against registry-flush floods (a leaked-enabled global
+    # registry makes every bus loop flush its whole series set here; the
+    # load-bearing events datagram must survive even then).
+    recv.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
     recv.bind(("127.0.0.1", 0))
     recv.settimeout(0.5)
     udp_port = recv.getsockname()[1]
@@ -184,7 +188,10 @@ def test_cluster_statsd_emission(tmp_path):
         finally:
             client.close()
         samples = []
-        deadline = time.time() + 5.0
+        # Generous ceiling for the loaded 1-core CI host (the loop breaks
+        # as soon as both series arrive, so green runs never wait it out;
+        # 5 s flaked in-suite when the periodic flush landed late).
+        deadline = time.time() + 20.0
         while time.time() < deadline:
             try:
                 samples.append(recv.recv(2048).decode())

@@ -139,6 +139,18 @@ def main(argv=None) -> int:
                               "retry/quarantine and device-state recovery. "
                               "0 = off (default; env twin: "
                               "TB_SCRUB_INTERVAL)")
+    p_start.add_argument("--merkle", action="store_true",
+                         help="merkle commitment mode "
+                              "(docs/commitments.md): the scrub substrate "
+                              "becomes the on-device incremental Merkle "
+                              "tree — root-compare checks with no host "
+                              "mirror replay, replay-free verifiable "
+                              "checkpoint roots, and client-verifiable "
+                              "get_proof balance proofs (env twin: "
+                              "TB_MERKLE; needs --scrub-interval >= 1; "
+                              "forces the device commit path — the "
+                              "forest commits to the device pads, which "
+                              "the host engine does not maintain)")
     p_start.add_argument("--no-engine", action="store_true",
                          help="force the device-kernel commit path even "
                               "when the native host engine is available")
@@ -197,6 +209,11 @@ def main(argv=None) -> int:
                         help="arm every replica's scrub mirror at cadence N "
                              "(0 = off; with --device-faults and N=0 the "
                              "run demonstrates the undetected-SDC failure)")
+    p_vopr.add_argument("--merkle", action="store_true",
+                        help="with --scrub-interval: merkle commitment "
+                             "mode, mirror OFF — SDC must be detected by "
+                             "root mismatch and recovered via checkpoint + "
+                             "WAL replay (docs/commitments.md)")
     p_vopr.add_argument("--overload", action="store_true",
                         help="run the OVERLOAD fault kind instead of the "
                              "random schedule: seeded client flood at 2-8x "
@@ -267,13 +284,13 @@ def _cmd_vopr(args) -> int:
 
     if args.tpu and (
         args.overload or args.no_priority
-        or args.byzantine or args.no_verify
+        or args.byzantine or args.no_verify or args.merkle
     ):
         # Same loud-reject discipline as the non-TPU knob checks below:
         # the TPU vopr runs its own random schedule, so silently dropping
         # --overload would report a scenario that never ran.
-        print("error: --overload/--no-priority/--byzantine/--no-verify "
-              "do not apply with --tpu", file=sys.stderr)
+        print("error: --overload/--no-priority/--byzantine/--no-verify/"
+              "--merkle do not apply with --tpu", file=sys.stderr)
         return 2
     if args.tpu:
         from .sim import vopr_tpu
@@ -323,25 +340,31 @@ def _cmd_vopr(args) -> int:
         print("error: --no-verify applies only with --byzantine",
               file=sys.stderr)
         return 2
+    if args.merkle and not args.scrub_interval:
+        print("error: --merkle needs --scrub-interval >= 1 (the commitment "
+              "tree arms at the scrub cadence; docs/commitments.md)",
+              file=sys.stderr)
+        return 2
     if args.byzantine and (
         args.overload or args.device_faults
-        or args.scrub_interval is not None or args.vopr_viz
+        or args.scrub_interval is not None or args.vopr_viz or args.merkle
     ):
         # Same loud-rejection discipline as --overload: the byzantine
         # scenario owns its schedule; silently dropping a knob would
         # report a run that never happened.
         print("error: --overload/--device-faults/--scrub-interval/"
-              "--vopr-viz do not apply with --byzantine", file=sys.stderr)
+              "--merkle/--vopr-viz do not apply with --byzantine",
+              file=sys.stderr)
         return 2
     if args.overload and (
         args.ticks is not None or args.scrub_interval is not None
-        or args.vopr_viz
+        or args.vopr_viz or args.merkle
     ):
         # Loudly reject knobs the overload kind does not take (its tick
         # budget and scrub cadence are fixed by the scenario) rather than
         # silently running with different parameters than the user asked.
-        print("error: --ticks/--scrub-interval/--vopr-viz do not apply "
-              "with --overload", file=sys.stderr)
+        print("error: --ticks/--scrub-interval/--merkle/--vopr-viz do "
+              "not apply with --overload", file=sys.stderr)
         return 2
     _enable_metrics(args.metrics_json)
     first = args.seed if args.seed is not None else secrets.randbits(31)
@@ -381,6 +404,7 @@ def _cmd_vopr(args) -> int:
             ticks=args.ticks if args.ticks is not None else 6_000,
             viz=True if args.vopr_viz else None,
             scrub_interval=args.scrub_interval or 0,
+            merkle=args.merkle,
             device_faults=args.device_faults,
         )
         print(
@@ -530,6 +554,24 @@ def _cmd_start(args) -> int:
         # machine is built inside Replica/VsrReplica).
         os.environ["TB_SHARDS"] = str(max(0, args.shards))
 
+    if args.merkle:
+        env_iv = os.environ.get("TB_SCRUB_INTERVAL", "")
+        interval = args.scrub_interval if args.scrub_interval is not None \
+            else (int(env_iv) if env_iv.isdigit() else 0)
+        if interval <= 0:
+            # Loud-reject discipline (same knob contract as vopr): with no
+            # scrub cadence the commitment tree never arms, and the server
+            # would silently serve with no checks and no proofs.
+            print("error: --merkle needs --scrub-interval >= 1 (or "
+                  "TB_SCRUB_INTERVAL) — the commitment tree arms at the "
+                  "scrub cadence (docs/commitments.md)", file=sys.stderr)
+            return 1
+        if args.engine:
+            print("error: --merkle runs on the device path; --engine "
+                  "commits through the native host engine — pick one",
+                  file=sys.stderr)
+            return 1
+
     import dataclasses as _dc
 
     from .config import PROCESS_DEFAULT
@@ -576,6 +618,7 @@ def _cmd_start(args) -> int:
             args.path, ledger_config=ledger_config, aof_path=args.aof,
             process_config=process_config, host_engine=bool(args.engine),
             scrub_interval=args.scrub_interval,
+            merkle=True if args.merkle else None,
         )
         if args.pipeline_depth is not None:
             replica.pipeline_depth = args.pipeline_depth
@@ -613,11 +656,18 @@ def _cmd_start(args) -> int:
         # Sharding runs on the device path only: the mesh ledger IS the
         # serving authority, never the numpy engine mirror.
         and not (args.shards or 0) >= 2
+        # Merkle commitments live on the device path too: the forest
+        # commits to the device pads (scrub_arm is a no-op in host-engine
+        # mode, where the numpy ledger is already the authority).  The
+        # env twin must behave exactly like the flag.
+        and not args.merkle
+        and os.environ.get("TB_MERKLE", "") != "1"
     )
     replica = Replica(args.path, ledger_config=ledger_config,
                       aof_path=args.aof, hot_transfers_capacity_max=hot_max,
                       process_config=process_config, host_engine=use_engine,
-                      scrub_interval=args.scrub_interval)
+                      scrub_interval=args.scrub_interval,
+                      merkle=True if args.merkle else None)
     if args.pipeline_depth is not None:
         replica.pipeline_depth = args.pipeline_depth
     replica.open()

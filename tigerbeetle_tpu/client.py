@@ -381,6 +381,21 @@ class Client:
         body = self.request(wire.Operation.lookup_transfers, _encode_ids(ids))
         return np.frombuffer(body, dtype=types.TRANSFER_DTYPE)
 
+    def get_proof(self, account_id: int) -> Optional[dict]:
+        """Client-verifiable balance proof (docs/commitments.md): fetch a
+        root-anchored Merkle path for ``account_id`` and VERIFY it locally
+        — the returned dict's account row is cryptographically bound to
+        the server's commitment root, so a tampered reply raises
+        ops.merkle.ProofError instead of returning.  None when the account
+        does not exist or the server runs without merkle commitments."""
+        from .ops.merkle import check_proof
+
+        body = self.request(wire.Operation.get_proof,
+                            _encode_ids([account_id]))
+        if not body:
+            return None
+        return check_proof(body)
+
 
     # -- batch demux (state_machine.zig:114-165, client.zig:45-104) ----------
 

@@ -43,6 +43,7 @@ import numpy as np
 from . import types
 from .config import LedgerConfig
 from .obs.metrics import registry as _obs
+from .ops import merkle as merkle_ops
 from .ops import scrub as scrub_ops
 from .ops import state_machine as sm
 from .ops.scrub import (  # re-exported: the replica's fault-domain surface
@@ -246,6 +247,12 @@ class DeviceCommitHandle:
             out.append(m._compress(row, count))
             m._update_commit_timestamp(row, count, ts)
         m._device_fault_streak = 0
+        if m.scrub_armed:
+            # Advance the scrub cadence in resolve (== op) order.  The
+            # merkle forest already advanced INSIDE the dispatch closure
+            # (device work must ride the ledger chain); only the mirror
+            # replay belongs here.
+            m._scrub_commits += len(self._counts)
         if m._scrub_mirror is not None and self._batches is not None:
             # Advance the authoritative mirror in resolve (== op) order;
             # the digest folds at the next scrub point compare against it.
@@ -481,10 +488,26 @@ class TpuStateMachine:
         self.retry_tick_s = 0.01
         self._retry_prng = _random.Random(0x5C12)  # tblint: ignore[nondet] jitter only
         self._retry_timeout = None
+        # Merkle commitment tree (ops/merkle.py; docs/commitments.md).
+        # TB_MERKLE=1 replaces the scrub check substrate with the on-device
+        # incremental forest: per-commit touched-path updates, root-compare
+        # checks, client-verifiable proofs; the authoritative mirror is
+        # kept only at the TB_SCRUB_INTERVAL=1 paranoid cadence.  All
+        # None/False by default: merkle-off runs take none of these
+        # branches (bit-identical to pre-merkle behavior).
+        self._merkle_enabled: Optional[bool] = None  # lazy (TB_MERKLE)
+        self._scrub_paranoid: Optional[bool] = None  # lazy (TB_SCRUB_PARANOID)
+        self._merkle_forest = None
+        self._merkle_dirty = False
+        self._merkle_steps_cache = None
+        self._canon_tree = None  # (canon ledger ref, np accounts heap)
         # Plain-int event counters (read by obs/vopr_viz and tests without
         # the global metrics registry).
         self.scrub_checks = 0
         self.scrub_mismatches = 0
+        self.merkle_updates = 0
+        self.merkle_rebuilds = 0
+        self.merkle_mismatches = 0
         self.device_recoveries = 0
         self.degraded_to_host_engine = False
         if self._tiering:
@@ -539,8 +562,50 @@ class TpuStateMachine:
         self._scrub_interval = max(0, int(value))
 
     @property
+    def merkle_enabled(self) -> bool:
+        """Merkle commitment mode (TB_MERKLE env; docs/commitments.md).
+        Off (the default) is bit-identical pre-merkle behavior: the scrub
+        fault domain runs the PR 4 host-mirror discipline unchanged."""
+        if self._merkle_enabled is None:
+            import os
+
+            self._merkle_enabled = os.environ.get("TB_MERKLE", "") == "1"
+        return self._merkle_enabled
+
+    @merkle_enabled.setter
+    def merkle_enabled(self, value: bool) -> None:
+        self._merkle_enabled = bool(value)
+
+    @property
+    def scrub_paranoid(self) -> bool:
+        """Merkle mode's mirror retention: keep the authoritative host
+        mirror ALONGSIDE the commitment forest (in-process
+        re-materialization recovery + semantic authority — the PR 4
+        discipline and its ~1.6x replay tax).  Default: exactly at the
+        TB_SCRUB_INTERVAL=1 paranoid cadence; TB_SCRUB_PARANOID=0/1 (or
+        the setter) overrides — 0 at interval 1 gives the cheapest
+        check-ahead-of-every-commit config: root compare only, recovery
+        via checkpoint + WAL replay."""
+        if self._scrub_paranoid is None:
+            import os
+
+            env = os.environ.get("TB_SCRUB_PARANOID", "")
+            if env in ("0", "1"):
+                return env == "1"
+            return self.scrub_interval == 1
+        return self._scrub_paranoid
+
+    @scrub_paranoid.setter
+    def scrub_paranoid(self, value: Optional[bool]) -> None:
+        self._scrub_paranoid = value if value is None else bool(value)
+
+    @property
+    def merkle_armed(self) -> bool:
+        return self._merkle_forest is not None
+
+    @property
     def scrub_armed(self) -> bool:
-        return self._scrub_mirror is not None
+        return self._scrub_mirror is not None or self._merkle_forest is not None
 
     @property
     def scrub_due(self) -> bool:
@@ -548,34 +613,50 @@ class TpuStateMachine:
         # window, so interval 1 verifies the at-rest state ahead of EVERY
         # commit (a flip injected between commits is caught before any
         # commit reads it), interval N ahead of every Nth.
-        return (
-            self._scrub_mirror is not None
-            and not self._scrub_suspect
-            and self._scrub_commits + 1 >= self.scrub_interval
+        armed = self._merkle_forest is not None or (
+            self._scrub_mirror is not None and not self._scrub_suspect
         )
+        return armed and self._scrub_commits + 1 >= self.scrub_interval
 
     def scrub_arm(self) -> bool:
-        """Seed the authoritative host mirror from the CURRENT ledger state
-        and enable the fault domain.  Callers arm only at VERIFIED points:
-        genesis, a digest-checked checkpoint restore + WAL replay, or the
-        end of a recovery.  No-op (returns False) in host-engine mode —
-        there the numpy ledger already IS the authority — or when
-        scrub_interval is 0."""
+        """Enable the device fault domain from the CURRENT ledger state.
+        Callers arm only at VERIFIED points: genesis, a digest-checked
+        checkpoint restore + WAL replay, or the end of a recovery.  No-op
+        (returns False) in host-engine mode — there the numpy ledger
+        already IS the authority — or when scrub_interval is 0.
+
+        Mirror mode (default): seed the authoritative host mirror — every
+        committed batch replays into it, checks compare digest folds.
+        Merkle mode (TB_MERKLE=1, docs/commitments.md): build the
+        on-device commitment forest — commits update touched leaf->root
+        paths, checks compare maintained vs recomputed roots, and the
+        full mirror is kept ONLY at the TB_SCRUB_INTERVAL=1 paranoid
+        cadence (check-ahead-of-every-commit closes the read-before-check
+        window the self-referential tree cannot)."""
         if self._engine is not None or self.scrub_interval <= 0:
             self._scrub_mirror = None
+            self._merkle_forest = None
             return False
+        if self.merkle_enabled:
+            self._merkle_rebuild()
+            keep_mirror = self.scrub_paranoid
+        else:
+            self._merkle_forest = None
+            keep_mirror = True
         self._scrub_mirror = scrub_ops.model_from_ledger(
             self.ledger,
             cold_rows=[np.asarray(r) for r in self.cold.runs],
             prepare_timestamp=self.prepare_timestamp,
             commit_timestamp=self.commit_timestamp,
-        )
+        ) if keep_mirror else None
         self._scrub_suspect = False
         self._scrub_commits = 0
         return True
 
     def scrub_disarm(self) -> None:
         self._scrub_mirror = None
+        self._merkle_forest = None
+        self._merkle_dirty = False
         self._scrub_suspect = False
 
     def inject_device_faults(self, n: int = 1) -> None:
@@ -636,7 +717,6 @@ class TpuStateMachine:
             return
         from .testing import model as M
 
-        self._scrub_commits += 1
         try:
             # Batched column-wise conversion (testing/model.py): one C pass
             # per column instead of ~17 numpy scalar reads per event — the
@@ -655,10 +735,11 @@ class TpuStateMachine:
     def _guarded_commit(self, operation, batch, timestamp, impl):
         """The dispatch-lane funnel for blocking commits: scrub cadence
         check BEFORE the commit reads device state, dispatch retry with
-        jittered exponential backoff on device faults, and the authoritative
-        mirror advanced after success.  Pass-through (zero new branches
-        beyond one None check) when the fault domain is off."""
-        if self._scrub_mirror is None or self._engine is not None or (
+        jittered exponential backoff on device faults, and the commitment
+        substrate (mirror and/or merkle forest) advanced after success.
+        Pass-through (zero new branches beyond one armed check) when the
+        fault domain is off."""
+        if not self.scrub_armed or self._engine is not None or (
             len(batch) == 0
         ):
             return impl(batch, timestamp)
@@ -674,7 +755,9 @@ class TpuStateMachine:
                 )
                 if recovered is not None:
                     return recovered  # degraded: the host engine committed
+        self._scrub_commits += 1
         self._mirror_apply(operation, batch, timestamp)
+        self._merkle_apply(operation, batch)
         return results
 
     def _on_blocking_device_fault(self, operation, batch, timestamp, err):
@@ -707,6 +790,17 @@ class TpuStateMachine:
         if _obs.enabled:
             _obs.counter("device_recovery.dispatch_faults").inc()
         if self._scrub_mirror is None:
+            if self._merkle_forest is not None:
+                # Merkle-only mode: no in-process authority to re-dispatch
+                # from — escalate to the durable-state rebuild
+                # (replica._settle_or_recover aborts the failed group and
+                # runs checkpoint + WAL replay) instead of leaking the raw
+                # device error into the serving path.
+                self._merkle_dirty = True
+                raise DeviceStateUnrecoverable(
+                    "deferred dispatch failed with no mirror armed "
+                    "(merkle mode recovers via checkpoint + WAL replay)"
+                ) from err
             raise err
         self._device_fault_streak += 1
         if self._device_fault_streak >= self.device_fault_limit:
@@ -770,17 +864,23 @@ class TpuStateMachine:
         self.scrub_check()
 
     def scrub_check(self, boundary: bool = False) -> bool:
-        """Compare the on-device fold digests (ops/scrub.scrub_digest — ONE
+        """Integrity check of the at-rest device state.  Mirror mode:
+        compare the on-device fold digests (ops/scrub.scrub_digest — ONE
         readback through the commit-barrier funnel) against the mirror's
-        expectation.  On mismatch: quarantine, re-materialize the device
-        ledger from the mirror, and verify the rebuild took; a rebuild that
-        still diverges marks the state unrecoverable (the replica escalates
-        to checkpoint + WAL replay).  Returns True when the state was
-        already clean.  ``boundary`` marks a checkpoint-boundary check (a
-        divergence there is a hard integrity violation the capture must
-        never bake in — counted separately)."""
+        expectation.  Merkle mode: compare the maintained commitment
+        roots against roots recomputed from the pads (ONE (2, 3) — or
+        per-shard (n, 2, 3) — readback; no mirror, no replay).  On
+        mismatch: quarantine, re-materialize the device ledger from the
+        mirror, and verify the rebuild took; without a mirror (merkle
+        cadence > 1) the mismatch escalates directly to the durable-state
+        rebuild (DeviceStateUnrecoverable -> replica checkpoint + WAL
+        replay).  Returns True when the state was already clean.
+        ``boundary`` marks a checkpoint-boundary check (a divergence there
+        is a hard integrity violation the capture must never bake in —
+        counted separately)."""
         model = self._scrub_mirror
-        if model is None or self._scrub_suspect:
+        mirror_armed = model is not None and not self._scrub_suspect
+        if self._merkle_forest is None and not mirror_armed:
             return True
         assert not self._inflight_handles, (
             "scrub requires a settled pipeline"
@@ -789,18 +889,39 @@ class TpuStateMachine:
         self.scrub_checks += 1
         if _obs.enabled:
             _obs.counter("scrub.checks").inc()
-        want = scrub_ops.mirror_digests(model)
-        try:
-            got = self._scrub_fold_digests()
-            ok = int(got[0]) == want[0] and int(got[2]) == want[2] and (
-                self.cold.count != 0 or int(got[1]) == want[1]
-            )
-        except DEVICE_FAULT_TYPES:
-            # The scrub dispatch itself failed: same quarantine/rebuild
-            # path as a mismatch (the re-digest below is the retry).
-            if _obs.enabled:
-                _obs.counter("device_recovery.dispatch_faults").inc()
-            ok = False
+        ok = True
+        if self._merkle_forest is not None:
+            try:
+                ok = self._merkle_verify()
+            except DEVICE_FAULT_TYPES as err:
+                # The verify dispatch itself failed: without a mirror the
+                # only recovery substrate is durable state — escalate
+                # instead of leaking a raw device error to the serving
+                # path (the mirror path below retries via quarantine).
+                if _obs.enabled:
+                    _obs.counter("device_recovery.dispatch_faults").inc()
+                if not mirror_armed:
+                    self._merkle_dirty = True
+                    raise DeviceStateUnrecoverable(
+                        "device fault during merkle verification "
+                        "(no mirror armed)"
+                    ) from err
+                ok = False
+        want = scrub_ops.mirror_digests(model) if mirror_armed else None
+        if mirror_armed:
+            try:
+                got = self._scrub_fold_digests()
+                ok = ok and (
+                    int(got[0]) == want[0] and int(got[2]) == want[2] and (
+                        self.cold.count != 0 or int(got[1]) == want[1]
+                    )
+                )
+            except DEVICE_FAULT_TYPES:
+                # The scrub dispatch itself failed: same quarantine/rebuild
+                # path as a mismatch (the re-digest below is the retry).
+                if _obs.enabled:
+                    _obs.counter("device_recovery.dispatch_faults").inc()
+                ok = False
         if ok:
             return True
         self.scrub_mismatches += 1
@@ -808,8 +929,23 @@ class TpuStateMachine:
             _obs.counter("scrub.mismatches").inc()
             if boundary:
                 _obs.counter("scrub.boundary_mismatches").inc()
+        if not mirror_armed:
+            # Merkle-only detection: there is no in-process authority to
+            # re-materialize from — route to the fault domain's last
+            # resort (replica.recover_device_state: checkpoint + WAL
+            # replay, then scrub_arm rebuilds the forest from the
+            # recovered state).
+            self._merkle_dirty = True
+            raise DeviceStateUnrecoverable(
+                "merkle root mismatch: device state corrupt and no "
+                "authoritative mirror armed (TB_SCRUB_INTERVAL=1 keeps one)"
+            )
         self.quarantine()
         self._rematerialize_from_mirror()
+        if self._merkle_forest is not None:
+            # The re-materialized ledger is a fresh layout: rebuild the
+            # forest from it before re-verifying.
+            self._merkle_rebuild()
         try:
             got = self._scrub_fold_digests()
         except DEVICE_FAULT_TYPES as err:
@@ -853,6 +989,281 @@ class TpuStateMachine:
             self._d2h_codes(scrub_ops.scrub_digest(self.ledger))
         )
 
+    # -- merkle commitment tree (ops/merkle.py, docs/commitments.md) ---------
+
+    def _merkle_steps(self) -> dict:
+        """Jitted sharded merkle steps for this mesh (process-wide cache,
+        like the commit steps)."""
+        if self._merkle_steps_cache is None:
+            from .parallel import sharded as shard_mod
+
+            self._merkle_steps_cache = shard_mod.merkle_steps(
+                self._shard_mesh
+            )
+        return self._merkle_steps_cache
+
+    def _merkle_rebuild(self) -> None:
+        """Full forest rebuild from the current ledger — O(capacity), paid
+        only at arm points and after non-incremental mutations (growth
+        rehash, sequential fallback, tier moves, recovery installs).  A
+        rebuild resets the detection window: corruption already present in
+        the pads is baked into the fresh tree (same semantics as reseeding
+        the mirror — arm/rebuild only at verified or just-checked points)."""
+        if self._ledger_is_sharded:
+            self._merkle_forest = self._merkle_steps()["build"](self._ledger)
+        else:
+            self._merkle_forest = merkle_ops.build_forest(self.ledger)
+        self._merkle_dirty = False
+        self.merkle_rebuilds += 1
+        if _obs.enabled:
+            _obs.counter("merkle.rebuilds").inc()
+
+    def _merkle_rebuild_if_dirty(self) -> bool:
+        if self._merkle_forest is None or not self._merkle_dirty:
+            return False
+        self._merkle_rebuild()
+        return True
+
+    def _merkle_mark_dirty(self) -> None:
+        if self._merkle_forest is not None:
+            self._merkle_dirty = True
+
+    def _merkle_verify(self) -> bool:
+        """Maintained roots vs roots recomputed from the pads: ONE
+        readback through the commit-barrier funnel ((2, 3) single-device;
+        per-shard (n, 2, 3) lanes under TB_SHARDS, which also localize a
+        mismatch to one shard)."""
+        self._merkle_rebuild_if_dirty()
+        if self._ledger_is_sharded:
+            lanes = np.asarray(self._d2h_codes(
+                self._merkle_steps()["verify"](
+                    self._merkle_forest, self._ledger
+                )
+            ))
+            ok = bool((lanes[:, 0, :] == lanes[:, 1, :]).all())
+        else:
+            lanes = np.asarray(self._d2h_codes(
+                merkle_ops.verify_roots(self._merkle_forest, self.ledger)
+            ))
+            ok = bool((lanes[0] == lanes[1]).all())
+        if _obs.enabled:
+            _obs.counter("merkle.checks").inc()
+        if not ok:
+            self.merkle_mismatches += 1
+            if _obs.enabled:
+                _obs.counter("merkle.mismatches").inc()
+        return ok
+
+    _MERKLE_MIN_LANES = 256
+
+    @staticmethod
+    def _merkle_pad(lo: np.ndarray, hi: np.ndarray, min_lanes: int):
+        """Pad key arrays to power-of-two lane classes (bounded jit
+        variants; zero keys resolve as instant probe misses)."""
+        n = len(lo)
+        lanes = max(min_lanes, 1 << (n - 1).bit_length()) if n else min_lanes
+        p_lo = np.zeros(lanes, np.uint64)
+        p_hi = np.zeros(lanes, np.uint64)
+        p_lo[:n] = lo
+        p_hi[:n] = hi
+        return jnp.asarray(p_lo), jnp.asarray(p_hi)
+
+    def _merkle_apply(self, operation: str, batch: np.ndarray) -> None:
+        """Advance the commitment forest by one committed batch (the
+        blocking paths' post-success hook; deferred dispatches call
+        _merkle_update_transfers_batches INSIDE their lane closure so the
+        device update rides the ledger chain)."""
+        if self._merkle_forest is None or len(batch) == 0:
+            return
+        if self._merkle_rebuild_if_dirty():
+            return  # the rebuild already reflects this batch
+        if operation == "create_accounts":
+            lo, hi = self._merkle_pad(
+                batch["id_lo"].astype(np.uint64),
+                batch["id_hi"].astype(np.uint64),
+                self._MERKLE_MIN_LANES,
+            )
+            if self._ledger_is_sharded:
+                self._merkle_forest = self._merkle_steps()["update_accounts"](
+                    self._merkle_forest, self._ledger, lo, hi
+                )
+            else:
+                self._merkle_forest = merkle_ops.update_accounts(
+                    self._merkle_forest, self.ledger, lo, hi,
+                    max_probe=sm.MAX_PROBE,
+                )
+            self.merkle_updates += 1
+            if _obs.enabled:
+                _obs.counter("merkle.updates").inc()
+        else:
+            self._merkle_update_transfers_batches([batch])
+
+    def _merkle_update_transfers_batches(self, batches) -> None:
+        """ONE touched-path update covering a run of committed
+        create_transfers batches: inserted ids, deduped account sides,
+        pending refs (their posted keys and account sides resolve on
+        device).  Over-approximation is safe — recomputing an untouched
+        leaf writes the identical value."""
+        if self._merkle_forest is None:
+            return
+        if self._merkle_rebuild_if_dirty():
+            return
+        ids_lo = np.concatenate([b["id_lo"] for b in batches])
+        ids_hi = np.concatenate([b["id_hi"] for b in batches])
+        dr_lo = np.concatenate([b["debit_account_id_lo"] for b in batches])
+        dr_hi = np.concatenate([b["debit_account_id_hi"] for b in batches])
+        cr_lo = np.concatenate([b["credit_account_id_lo"] for b in batches])
+        cr_hi = np.concatenate([b["credit_account_id_hi"] for b in batches])
+        flags = np.concatenate([b["flags"] for b in batches])
+        pv = (
+            flags & (types.TransferFlags.POST_PENDING_TRANSFER
+                     | types.TransferFlags.VOID_PENDING_TRANSFER)
+        ) != 0
+        # Dedupe the account side (hot accounts repeat heavily under
+        # zipfian batches; np.unique is sorted => deterministic).
+        acc = np.unique(np.stack([
+            np.concatenate([dr_hi, cr_hi]).astype(np.uint64),
+            np.concatenate([dr_lo, cr_lo]).astype(np.uint64),
+        ], axis=1), axis=0)
+        id_lo, id_hi = self._merkle_pad(
+            ids_lo.astype(np.uint64), ids_hi.astype(np.uint64),
+            self._MERKLE_MIN_LANES,
+        )
+        acc_lo, acc_hi = self._merkle_pad(
+            acc[:, 1], acc[:, 0], self._MERKLE_MIN_LANES
+        )
+        has_pv = bool(pv.any())
+        pend = (
+            np.concatenate([b["pending_id_lo"] for b in batches])[pv],
+            np.concatenate([b["pending_id_hi"] for b in batches])[pv],
+        ) if has_pv else (np.zeros(0, np.uint64), np.zeros(0, np.uint64))
+        pend_lo, pend_hi = self._merkle_pad(
+            pend[0].astype(np.uint64), pend[1].astype(np.uint64),
+            self._MERKLE_MIN_LANES,
+        )
+        if self._ledger_is_sharded:
+            step = self._merkle_steps()[
+                "update_transfers_pv" if has_pv else "update_transfers"
+            ]
+            self._merkle_forest = step(
+                self._merkle_forest, self._ledger, id_lo, id_hi,
+                acc_lo, acc_hi, pend_lo, pend_hi,
+            )
+        else:
+            self._merkle_forest = merkle_ops.update_transfers(
+                self._merkle_forest, self.ledger, id_lo, id_hi,
+                acc_lo, acc_hi, pend_lo, pend_hi,
+                max_probe=sm.MAX_PROBE, has_postvoid=has_pv,
+            )
+        self.merkle_updates += 1
+        if _obs.enabled:
+            _obs.counter("merkle.updates").inc()
+
+    def merkle_roots(self) -> Optional[Tuple[int, int, int]]:
+        """The LIVE maintained commitment roots (accounts, transfers,
+        posted) — under TB_SHARDS the wrap-sum fold of the per-shard
+        subtree roots through the per-shard uint64 readback lanes.  None
+        when merkle mode is not armed.  Callers need a settled pipeline
+        (the replica settles before checks/checkpoints/queries)."""
+        if self._merkle_forest is None:
+            return None
+        self._merkle_rebuild_if_dirty()
+        if self._ledger_is_sharded:
+            lanes = np.asarray(self._d2h_codes(
+                self._merkle_steps()["roots"](self._merkle_forest)
+            ))
+            with np.errstate(over="ignore"):
+                triple = lanes.sum(axis=0, dtype=np.uint64)
+        else:
+            triple = np.asarray(self._d2h_codes(
+                merkle_ops.forest_roots(self._merkle_forest)
+            ))
+        return (int(triple[0]), int(triple[1]), int(triple[2]))
+
+    def merkle_canonical_roots(self) -> Optional[Tuple[int, int, int]]:
+        """Roots over the CANONICAL single-device layout — the
+        shard-config-independent commitment checkpoints serialize and
+        proofs anchor to (== merkle_roots() when sharding is off and the
+        forest is clean)."""
+        if self._merkle_forest is None:
+            return None
+        return merkle_ops.np_ledger_roots(self._query_ledger())
+
+    def get_proof(self, account_id: int) -> Optional[bytes]:
+        """Root-anchored Merkle path for one account (docs/commitments.md
+        proof format): the account row + sibling hashes to the canonical
+        accounts root, client-verifiable via ops.merkle.check_proof.
+        None when the account does not exist or merkle mode is off."""
+        if self._merkle_forest is None or self._engine is not None:
+            return None
+        rows = self.lookup_accounts([account_id])
+        if len(rows) == 0:
+            return None
+        self._merkle_rebuild_if_dirty()
+        lo = np.uint64(account_id & U64_MAX)
+        hi = np.uint64(account_id >> 64)
+        if self._ledger_is_sharded:
+            slot, siblings, root = self._canon_proof_path(lo, hi)
+        else:
+            from .ops import hash_table as ht
+
+            pad = 8  # one size class for the point lookup
+            k_lo = np.zeros(pad, np.uint64)
+            k_hi = np.zeros(pad, np.uint64)
+            k_lo[0], k_hi[0] = lo, hi
+            look = ht.lookup(
+                self.ledger.accounts, jnp.asarray(k_lo), jnp.asarray(k_hi),
+                sm.MAX_PROBE,
+            )
+            found = bool(np.asarray(look.found)[0])
+            if not found:
+                return None
+            slot = int(np.asarray(look.slot)[0])
+            levels = max(0, self.ledger.accounts.capacity.bit_length() - 1)
+            _leaf, sib_dev, root_dev = merkle_ops.gather_path(
+                self._merkle_forest.accounts, jnp.uint64(slot), levels
+            )
+            siblings = np.asarray(sib_dev)
+            root = int(np.asarray(root_dev))
+        if _obs.enabled:
+            _obs.counter("merkle.proofs").inc()
+        return merkle_ops.encode_proof(
+            rows[0].tobytes(), slot, siblings, root
+        )
+
+    def _canon_proof_path(self, lo: np.uint64, hi: np.uint64):
+        """Proof path from a cached host-side tree over the canonical
+        accounts layout (sharded mode: the live per-shard subtrees commit
+        to the sharded layout; proofs and checkpoints anchor to the
+        canonical one).  The cached heap is invalidated with the
+        canonical view itself."""
+        canon = self._query_ledger()
+        cached = self._canon_tree
+        if cached is None or cached[0] is not canon:
+            nodes = merkle_ops.np_tree(
+                merkle_ops.np_table_leaves(canon.accounts, "accounts")
+            )
+            self._canon_tree = cached = (canon, nodes)
+        nodes = cached[1]
+        cap = len(nodes) // 2
+        key_lo = np.asarray(canon.accounts.key_lo)
+        key_hi = np.asarray(canon.accounts.key_hi)
+        slot = int(scrub_ops.mix64_np(
+            np.asarray([lo]), np.asarray([hi])
+        )[0]) & (cap - 1)
+        while not (key_lo[slot] == lo and key_hi[slot] == hi):
+            if key_lo[slot] == 0 and key_hi[slot] == 0 and not bool(
+                np.asarray(canon.accounts.tombstone)[slot]
+            ):
+                raise RuntimeError("account vanished during proof probe")
+            slot = (slot + 1) & (cap - 1)
+        idx = cap + slot
+        siblings = np.empty(max(0, cap.bit_length() - 1), np.uint64)
+        for level in range(len(siblings)):
+            siblings[level] = nodes[idx ^ 1]
+            idx >>= 1
+        return slot, siblings, int(nodes[1])
+
     def quarantine(self) -> None:
         """Quarantine the in-flight device pipeline: drain the FIFO dispatch
         lane (joining any running closure) and invalidate the cached staging
@@ -881,6 +1292,7 @@ class TpuStateMachine:
         # Property assignment: under TB_SHARDS the setter re-places the
         # single-layout materialization onto the mesh.
         self.ledger = scrub_ops.materialize_ledger(model, self.config)
+        self._merkle_mark_dirty()  # fresh layout: forest rebuilds from it
         self._resync_host_state_from_mirror(model)
 
     def _resync_host_state_from_mirror(self, model) -> None:
@@ -931,6 +1343,7 @@ class TpuStateMachine:
                 cfg.posted_capacity, cfg.history_capacity,
             )
         self._canon = None
+        self._merkle_mark_dirty()
         self.commit_timestamp = 0
         self._accounts_bound = self._transfers_bound = 0
         self._posted_bound = self._history_bound = 0
@@ -1711,16 +2124,19 @@ class TpuStateMachine:
     @property
     def waves_enabled(self) -> bool:
         """Conflict-index wave scheduler for the general commit kernel
-        (TB_WAVES env; default off).  Off is bit-for-bit today's path —
-        the kernel compiles the exact pre-waves program.  On, the general
-        kernel computes a per-batch conflict index over the touched
+        (TB_WAVES env; DEFAULT ON since the PR 10 soak — the pinned
+        regression seed set replayed green under TB_WAVES=1 x TB_SHARDS
+        {0, 2}, WAVES_SOAK.json; docs/waves.md records the decision).
+        TB_WAVES=0 is bit-for-bit the pre-waves path — the kernel
+        compiles the exact pre-waves program.  On, the general kernel
+        computes a per-batch conflict index over the touched
         (debit, credit) account slots and commits certified batches after
         a PROVED number of Jacobi passes instead of waiting for the
         stability pass — same codes, same balances (docs/waves.md)."""
         if self._waves_enabled is None:
             import os
 
-            self._waves_enabled = os.environ.get("TB_WAVES", "") == "1"
+            self._waves_enabled = os.environ.get("TB_WAVES", "1") != "0"
         return self._waves_enabled
 
     @waves_enabled.setter
@@ -1891,15 +2307,27 @@ class TpuStateMachine:
                 self._index_append_device(
                     id_lo[j], id_hi[j], codes[j], counts[j],
                 )
+            if self._merkle_forest is not None:
+                # Commitment updates ride the ledger chain on the lane,
+                # PER BATCH: one key-size class per workload shape, so
+                # variable run lengths never hit fresh jit variants
+                # mid-serving (concatenating the run would key the update
+                # program on k — a compile per distinct run length).
+                for j in range(k):
+                    self._merkle_update_transfers_batches([batches[j]])
             return codes, overflow
 
-        armed = self._scrub_mirror is not None
+        armed_mirror = self._scrub_mirror is not None
+        armed = armed_mirror or self._merkle_forest is not None
         result = self._dispatch_lane().submit(dispatch) if deferred else (
             dispatch()
         )
         handle = DeviceCommitHandle(
             self, result, counts, timestamps, stacked=True, stage=stage,
-            batches=list(batches) if armed else None,
+            # Batch retention feeds mirror recovery re-dispatch; the
+            # forest needs no retention (a mismatch escalates to the
+            # durable-state rebuild instead).
+            batches=list(batches) if armed_mirror else None,
         )
         if armed:
             self._inflight_handles.append(handle)
@@ -1983,13 +2411,19 @@ class TpuStateMachine:
                 sm.create_transfers_fast_probed(self.ledger, soa, cnt, ts)
             )
             self._index_append_device(id_lo, id_hi, codes, count)
+            if self._merkle_forest is not None:
+                # Commitment update rides the ledger chain; keys come
+                # from the retained HOST batch (the staged SoA was
+                # donated above).
+                self._merkle_update_transfers_batches([batch])
             return codes, overflow
 
-        armed = self._scrub_mirror is not None
+        armed_mirror = self._scrub_mirror is not None
+        armed = armed_mirror or self._merkle_forest is not None
         fut = self._dispatch_lane().submit(dispatch)
         handle = DeviceCommitHandle(
             self, fut, [count], [timestamp], stacked=False,
-            batches=[batch] if armed else None,
+            batches=[batch] if armed_mirror else None,
         )
         if armed:
             self._inflight_handles.append(handle)
@@ -2057,6 +2491,7 @@ class TpuStateMachine:
             raise RuntimeError("cold rehydration overflowed the hot table")
         self.ledger = self.ledger.replace(transfers=transfers)
         self._transfers_bound += n
+        self._merkle_mark_dirty()  # rows appeared outside a commit batch
 
     def evict_cold(self, frac: Optional[float] = None) -> int:
         """Spill the oldest ~frac of live hot transfers to the cold store.
@@ -2096,6 +2531,7 @@ class TpuStateMachine:
         self._bloom_dev = jnp.asarray(self._bloom_np)
         self._transfers_bound = max(0, self._transfers_bound - len(rows))
         self._evictions += 1
+        self._merkle_mark_dirty()  # rows left the hot table wholesale
         if _obs.enabled:
             # The tier rebalance is this runtime's compaction stage
             # (replica pipeline naming: prefetch/commit/compact/checkpoint).
@@ -2151,6 +2587,10 @@ class TpuStateMachine:
         shards; only local homes change)."""
         from .ops import hash_table as ht
 
+        # Growth rehashes every slot: the commitment forest (whose arrays
+        # are capacity-shaped) rebuilds from the grown layout at the next
+        # update/check (docs/commitments.md "growth rehash").
+        self._merkle_mark_dirty()
         if self._ledger_is_sharded:
             from .parallel import sharded as shard_mod
 
@@ -2323,6 +2763,10 @@ class TpuStateMachine:
             if operation == "create_accounts"
             else scan_path.create_transfers_seq
         )
+        # The scan path may tombstone slots (linked-chain rollback) — a
+        # mutation the touched-key over-approximation cannot see; the
+        # commitment forest rebuilds at the next update/check.
+        self._merkle_mark_dirty()
         self.ledger, codes = kernel(
             self.ledger, soa, jnp.uint64(count), jnp.uint64(timestamp)
         )
@@ -2754,9 +3198,10 @@ class TpuStateMachine:
         self.scans_transfers.reset()
         self.scans_accounts.reset()
         self._index_stale = False
-        if self._scrub_mirror is not None:
+        if self.scrub_armed:
             # The new ledger is digest-verified by the caller (checkpoint
-            # restore / state-sync install): reseed the mirror from it.
+            # restore / state-sync install): reseed the mirror and/or
+            # rebuild the commitment forest from it.
             self.scrub_arm()
 
     # -- parity surface ------------------------------------------------------

@@ -1,0 +1,471 @@
+"""On-device incremental Merkle commitment tree over the ledger pads.
+
+ROADMAP item 3 ("blazingly-fast incremental state commitments", PAPERS.md
+AlDBaran 2508.10493): the flat scrub fold (ops/scrub.py) detects silent
+data corruption but only by replaying every committed batch into a host
+mirror — a measured ~1.6x throughput tax (BENCH_r08
+payload.scrub.overhead_vs_off) that buys detection and recovery but no
+*proofs*.  This module replaces the fold with a real commitment tree and
+drops the per-batch host replay from the check path:
+
+- LEAVES: per-slot row folds — exactly the scrub fold's addends
+  (scrub.leaf_hashes / row_hash_*), so an empty slot commits to 0 and a
+  live slot to the same mix64 value the flat fold summed.  The tree
+  covers the same columns the scrub fold covered (accounts: id +
+  balances + timestamp; transfers: id + amount + timestamp; posted:
+  pending timestamp + fulfillment); history and non-digested columns
+  stay under the per-commit differential oracles.
+- INTERIOR: node = mix64(left, right), stored as ONE uint64[2*capacity]
+  heap per pad (root at [1], children of i at [2i, 2i+1], leaves at
+  [capacity + slot]; cell [0] unused).
+- INCREMENTAL UPDATE (``update_accounts`` / ``update_transfers``): each
+  commit batch refreshes only the touched rows' leaf->root paths —
+  scatter the recomputed leaves, then one segmented recombine per level
+  (log2(capacity) levels), O(batch * log capacity) work, never O(capacity).
+  Touched keys are over-approximated from the batch (created ids, both
+  account sides, pending references resolved ON DEVICE to the pending
+  transfer's posted key and account sides); recomputing an untouched
+  leaf writes back the identical value, so over-approximation is safe.
+- VERIFY (``verify_roots``): recompute the three roots from the pads in
+  one fused reduction and compare against the maintained roots — ONE
+  (2, 3) readback through the commit-barrier funnel.  A bit flip in a
+  pad (or in the tree arrays) makes the pair diverge; machine.scrub_check
+  quarantines exactly like a mirror mismatch, minus the mirror.
+- PROOFS (``encode_proof`` / ``check_proof``): a root-anchored sibling
+  path for one account row, verifiable by any client holding the row and
+  the root (machine.get_proof -> wire Operation.get_proof -> clients).
+
+Host twins (``np_*``) recompute leaves/trees/roots in numpy for the
+checkpoint root (vsr/replica.py serializes the canonical-layout root so
+restores and auditors verify state without replay), the test oracles,
+and client-side proof verification.  The sharded composition (per-shard
+subtrees, canonical root = wrap-sum of per-shard roots) lives in
+parallel/sharded.py.
+
+Threat model vs the scrub mirror (docs/commitments.md): the tree is
+self-referential — it detects corruption of state *at rest* (a flip to
+any row not legitimately re-written between two checks), which is the
+scrub's production threat (HBM bit flips, partial dispatch corruption).
+It cannot detect a flip that a later commit READS and propagates before
+the next check — the authoritative mirror can, which is why
+TB_SCRUB_INTERVAL=1 keeps the full mirror as the paranoid mode (the
+check-before-every-commit cadence closes the read-before-check window).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..u128 import mix64
+from . import hash_table as ht
+from . import state_machine as sm
+from .scrub import (
+    leaf_hashes, mix64_np, row_hash_accounts, row_hash_posted,
+    row_hash_transfers,
+)
+
+U64_MASK = (1 << 64) - 1
+
+# (leaf row-hash, value column names the per-lane leaf gather needs).
+_PAD_HASHERS = {
+    "accounts": row_hash_accounts,
+    "transfers": row_hash_transfers,
+    "posted": row_hash_posted,
+}
+_LEAF_COLS = {
+    "accounts": (
+        "debits_pending_lo", "debits_pending_hi",
+        "debits_posted_lo", "debits_posted_hi",
+        "credits_pending_lo", "credits_pending_hi",
+        "credits_posted_lo", "credits_posted_hi",
+        "timestamp",
+    ),
+    "transfers": ("amount_lo", "amount_hi", "timestamp"),
+    "posted": ("fulfillment",),
+}
+
+
+@struct.dataclass
+class Forest:
+    """The three per-pad Merkle heaps (uint64[2 * capacity] each)."""
+
+    accounts: jax.Array
+    transfers: jax.Array
+    posted: jax.Array
+
+    def pad(self, name: str) -> jax.Array:
+        return getattr(self, name)
+
+
+def tree_from_leaves(leaves: jax.Array) -> jax.Array:
+    """Heap-layout tree from a power-of-two leaf level: concatenated
+    levels root-first — [unused, root, level2 (2), ..., leaves (C)]."""
+    levels = [leaves]
+    while levels[-1].shape[0] > 1:
+        prev = levels[-1]
+        levels.append(mix64(prev[0::2], prev[1::2]))
+    return jnp.concatenate(
+        [jnp.zeros((1,), jnp.uint64)] + levels[::-1]
+    )
+
+
+def root_from_leaves(leaves: jax.Array) -> jax.Array:
+    """The root alone (no heap materialization — the verify reduction)."""
+    while leaves.shape[0] > 1:
+        leaves = mix64(leaves[0::2], leaves[1::2])
+    return leaves[0]
+
+
+def build_forest_impl(ledger: sm.Ledger) -> Forest:
+    return Forest(
+        accounts=tree_from_leaves(
+            leaf_hashes(ledger.accounts, row_hash_accounts)
+        ),
+        transfers=tree_from_leaves(
+            leaf_hashes(ledger.transfers, row_hash_transfers)
+        ),
+        posted=tree_from_leaves(
+            leaf_hashes(ledger.posted, row_hash_posted)
+        ),
+    )
+
+
+# Deliberately NOT donated: a (re)build must never consume the ledger.
+build_forest = jax.jit(build_forest_impl)
+
+
+@jax.jit
+def forest_roots(forest: Forest) -> jax.Array:
+    """uint64[3] = (accounts, transfers, posted) maintained roots."""
+    return jnp.stack([
+        forest.accounts[1], forest.transfers[1], forest.posted[1]
+    ])
+
+
+def verify_roots_impl(forest: Forest, ledger: sm.Ledger) -> jax.Array:
+    """uint64[2, 3]: row 0 the maintained roots, row 1 the roots
+    recomputed from the pads — compared host-side after ONE readback."""
+    recomputed = jnp.stack([
+        root_from_leaves(leaf_hashes(ledger.accounts, row_hash_accounts)),
+        root_from_leaves(leaf_hashes(ledger.transfers, row_hash_transfers)),
+        root_from_leaves(leaf_hashes(ledger.posted, row_hash_posted)),
+    ])
+    return jnp.stack([
+        jnp.stack([forest.accounts[1], forest.transfers[1], forest.posted[1]]),
+        recomputed,
+    ])
+
+
+# NOT donated either side: the verify is a read (the scrub discipline).
+verify_roots = jax.jit(verify_roots_impl)
+
+
+def _leaf_at(table: ht.Table, slot: jax.Array, found: jax.Array,
+             pad: str) -> jax.Array:
+    """Recompute the leaf value at ``slot`` for found lanes (a gather per
+    needed column — the row fold over current table content, so repeated
+    touches of one slot are idempotent)."""
+    safe = jnp.where(found, slot, jnp.uint64(0))
+    cols = {name: table.cols[name][safe] for name in _LEAF_COLS[pad]}
+    key_lo = table.key_lo[safe]
+    key_hi = table.key_hi[safe]
+    live = (key_lo != 0) | (key_hi != 0)
+    h = _PAD_HASHERS[pad](key_lo, key_hi, cols)
+    return jnp.where(live, h, jnp.uint64(0))
+
+
+def touch_tree(nodes: jax.Array, table: ht.Table, key_lo: jax.Array,
+               key_hi: jax.Array, pad: str, max_probe: int,
+               hash_shift: int = 0) -> jax.Array:
+    """Refresh the leaf->root paths for the rows holding ``key`` (probe,
+    recompute leaves, then log2(capacity) level recombines over the
+    touched parents).  Missing keys (rejected lanes, zero padding) are
+    skipped; levels scatter with an out-of-range sentinel so inactive
+    lanes drop.  Lanes sharing a parent all write the identical
+    recomputed value (each level reads the previous level's scatter)."""
+    cap = table.capacity
+    look = ht.lookup(table, key_lo, key_hi, max_probe, hash_shift)
+    do = look.found
+    leaf = _leaf_at(table, look.slot, do, pad)
+    sentinel = jnp.uint64(2 * cap)  # out of range: mode="drop"
+    idx = jnp.where(do, jnp.uint64(cap) + look.slot, sentinel)
+    nodes = nodes.at[idx].set(leaf, mode="drop")
+    parent = idx >> jnp.uint64(1)
+    for _ in range(max(0, cap.bit_length() - 1)):
+        val = mix64(
+            nodes[jnp.where(do, parent * jnp.uint64(2), jnp.uint64(0))],
+            nodes[jnp.where(do, parent * jnp.uint64(2) + jnp.uint64(1),
+                            jnp.uint64(0))],
+        )
+        nodes = nodes.at[jnp.where(do, parent, sentinel)].set(
+            val, mode="drop"
+        )
+        parent = parent >> jnp.uint64(1)
+    return nodes
+
+
+def update_accounts_impl(forest: Forest, ledger: sm.Ledger,
+                         acc_lo, acc_hi, *, max_probe: int,
+                         hash_shift: int = 0) -> Forest:
+    """Touched-path refresh after a create_accounts commit."""
+    return forest.replace(
+        accounts=touch_tree(
+            forest.accounts, ledger.accounts, acc_lo, acc_hi,
+            "accounts", max_probe, hash_shift,
+        )
+    )
+
+
+update_accounts = jax.jit(
+    update_accounts_impl, donate_argnames=("forest",),
+    static_argnames=("max_probe", "hash_shift"),
+)
+
+
+def update_transfers_impl(forest: Forest, ledger: sm.Ledger,
+                          id_lo, id_hi, acc_lo, acc_hi, pend_lo, pend_hi,
+                          *, max_probe: int, has_postvoid: bool,
+                          hash_shift: int = 0) -> Forest:
+    """Touched-path refresh after a create_transfers commit: inserted
+    transfer rows, both account sides, and — when the batch carried
+    post/void lanes — the pending transfer's posted key (its timestamp)
+    and its account sides, resolved ON DEVICE (the host cannot know them
+    without a lookup)."""
+    transfers = touch_tree(
+        forest.transfers, ledger.transfers, id_lo, id_hi,
+        "transfers", max_probe, hash_shift,
+    )
+    posted = forest.posted
+    if has_postvoid:
+        plook = ht.lookup(
+            ledger.transfers, pend_lo, pend_hi, max_probe, hash_shift
+        )
+        safe = jnp.where(plook.found, plook.slot, jnp.uint64(0))
+
+        def pcol(name):
+            return jnp.where(
+                plook.found, ledger.transfers.cols[name][safe], jnp.uint64(0)
+            )
+
+        posted = touch_tree(
+            forest.posted, ledger.posted, pcol("timestamp"),
+            jnp.zeros_like(pend_lo), "posted", max_probe, hash_shift,
+        )
+        acc_lo = jnp.concatenate([
+            acc_lo, pcol("debit_account_id_lo"), pcol("credit_account_id_lo"),
+        ])
+        acc_hi = jnp.concatenate([
+            acc_hi, pcol("debit_account_id_hi"), pcol("credit_account_id_hi"),
+        ])
+    accounts = touch_tree(
+        forest.accounts, ledger.accounts, acc_lo, acc_hi,
+        "accounts", max_probe, hash_shift,
+    )
+    return Forest(accounts=accounts, transfers=transfers, posted=posted)
+
+
+update_transfers = jax.jit(
+    update_transfers_impl, donate_argnames=("forest",),
+    static_argnames=("max_probe", "has_postvoid", "hash_shift"),
+)
+
+
+@functools.partial(jax.jit, static_argnames=("levels",))
+def gather_path(nodes: jax.Array, slot: jax.Array, levels: int) -> tuple:
+    """(leaf, siblings[levels], root) for the leaf at ``slot`` — the
+    device half of get_proof (one tiny readback)."""
+    cap = jnp.uint64(nodes.shape[0] // 2)
+    idx = cap + slot
+    sibs = []
+    for _ in range(levels):
+        sibs.append(nodes[idx ^ jnp.uint64(1)])
+        idx = idx >> jnp.uint64(1)
+    siblings = (
+        jnp.stack(sibs) if sibs else jnp.zeros((0,), jnp.uint64)
+    )
+    return nodes[cap + slot], siblings, nodes[1]
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy) twins: checkpoint roots, test oracles, proof verification
+# ---------------------------------------------------------------------------
+
+
+def _np_table_cols(table: ht.Table, pad: str):
+    key_lo = np.asarray(table.key_lo)
+    key_hi = np.asarray(table.key_hi)
+    cols = {name: np.asarray(table.cols[name]) for name in _LEAF_COLS[pad]}
+    return key_lo, key_hi, cols
+
+
+_NP_ROW_HASH = {
+    "accounts": lambda lo, hi, c: _np_row_accounts(lo, hi, c),
+    "transfers": lambda lo, hi, c: _np_row_transfers(lo, hi, c),
+    "posted": lambda lo, hi, c: _np_row_posted(lo, hi, c),
+}
+
+
+def _np_row_accounts(key_lo, key_hi, cols):
+    with np.errstate(over="ignore"):
+        h = mix64_np(key_lo, key_hi)
+        for f in ("debits_pending", "debits_posted",
+                  "credits_pending", "credits_posted"):
+            h = mix64_np(h ^ cols[f + "_lo"], h ^ cols[f + "_hi"])
+        return mix64_np(h, cols["timestamp"])
+
+
+def _np_row_transfers(key_lo, key_hi, cols):
+    with np.errstate(over="ignore"):
+        h = mix64_np(key_lo, key_hi)
+        h = mix64_np(h ^ cols["amount_lo"], h ^ cols["amount_hi"])
+        return mix64_np(h, cols["timestamp"])
+
+
+def _np_row_posted(key_lo, key_hi, cols):
+    h = mix64_np(key_lo, key_hi)
+    return mix64_np(h, cols["fulfillment"].astype(np.uint64))
+
+
+def np_leaves(key_lo: np.ndarray, key_hi: np.ndarray, cols: Dict, pad: str):
+    live = (key_lo != 0) | (key_hi != 0)
+    h = _NP_ROW_HASH[pad](
+        key_lo.astype(np.uint64), key_hi.astype(np.uint64),
+        {k: np.asarray(v) for k, v in cols.items()},
+    )
+    return np.where(live, h, np.uint64(0))
+
+
+def np_tree(leaves: np.ndarray) -> np.ndarray:
+    """Heap-layout numpy twin of tree_from_leaves."""
+    levels = [leaves.astype(np.uint64)]
+    while len(levels[-1]) > 1:
+        prev = levels[-1]
+        levels.append(mix64_np(prev[0::2], prev[1::2]))
+    return np.concatenate([np.zeros(1, np.uint64)] + levels[::-1])
+
+
+def np_root(leaves: np.ndarray) -> int:
+    x = leaves.astype(np.uint64)
+    while len(x) > 1:
+        x = mix64_np(x[0::2], x[1::2])
+    return int(x[0])
+
+
+def np_table_leaves(table: ht.Table, pad: str) -> np.ndarray:
+    key_lo, key_hi, cols = _np_table_cols(table, pad)
+    return np_leaves(key_lo, key_hi, cols, pad)
+
+
+def np_ledger_roots(ledger: sm.Ledger) -> Tuple[int, int, int]:
+    """(accounts, transfers, posted) roots recomputed host-side from a
+    single-layout ledger — the checkpoint-root writer/verifier and the
+    from-scratch test oracle (no device work, no replay)."""
+    return (
+        np_root(np_table_leaves(ledger.accounts, "accounts")),
+        np_root(np_table_leaves(ledger.transfers, "transfers")),
+        np_root(np_table_leaves(ledger.posted, "posted")),
+    )
+
+
+def np_account_leaf(row: np.void) -> int:
+    """Leaf value from one wire ACCOUNT_DTYPE row (the verifier side of a
+    proof: the client holds the row bytes and the root, nothing else)."""
+    cols = {
+        name: np.asarray([row[name]]).astype(
+            np.uint64 if name != "fulfillment" else np.uint32
+        )
+        for name in _LEAF_COLS["accounts"]
+    }
+    lo = np.asarray([row["id_lo"]], np.uint64)
+    hi = np.asarray([row["id_hi"]], np.uint64)
+    return int(np_leaves(lo, hi, cols, "accounts")[0])
+
+
+# ---------------------------------------------------------------------------
+# Proof wire format (machine.get_proof <-> clients)
+# ---------------------------------------------------------------------------
+
+PROOF_MAGIC = 0x4D505254  # "TRPM"
+PROOF_VERSION = 1
+
+PROOF_HEADER_DTYPE = np.dtype([
+    ("magic", "<u4"),
+    ("version", "<u4"),
+    ("slot", "<u8"),          # leaf slot in the (canonical) accounts pad
+    ("n_siblings", "<u4"),    # log2(capacity)
+    ("reserved", "<u4"),
+    ("root", "<u8"),          # the accounts commitment the path folds to
+])
+
+
+class ProofError(ValueError):
+    """Malformed or non-verifying Merkle proof."""
+
+
+def encode_proof(row_bytes: bytes, slot: int, siblings, root: int) -> bytes:
+    head = np.zeros((), PROOF_HEADER_DTYPE)
+    head["magic"] = PROOF_MAGIC
+    head["version"] = PROOF_VERSION
+    head["slot"] = slot
+    head["n_siblings"] = len(siblings)
+    head["root"] = np.uint64(root & U64_MASK)
+    sib = np.asarray(siblings, np.uint64)
+    return head.tobytes() + bytes(row_bytes) + sib.tobytes()
+
+
+def check_proof(blob: bytes) -> dict:
+    """Parse AND verify a proof; raises ProofError unless the row's leaf
+    folds through the sibling path to the stated root.  Returns
+    {account (np row), root, slot, siblings}."""
+    from .. import types
+
+    head_size = PROOF_HEADER_DTYPE.itemsize
+    row_size = types.ACCOUNT_DTYPE.itemsize
+    if len(blob) < head_size + row_size:
+        raise ProofError("proof truncated")
+    head = np.frombuffer(blob[:head_size], PROOF_HEADER_DTYPE)[0]
+    if int(head["magic"]) != PROOF_MAGIC:
+        raise ProofError("bad proof magic")
+    if int(head["version"]) != PROOF_VERSION:
+        raise ProofError(f"unsupported proof version {int(head['version'])}")
+    n_sib = int(head["n_siblings"])
+    want = head_size + row_size + 8 * n_sib
+    if len(blob) != want:
+        raise ProofError(f"proof size {len(blob)} != expected {want}")
+    row = np.frombuffer(
+        blob[head_size:head_size + row_size], types.ACCOUNT_DTYPE
+    )[0]
+    siblings = np.frombuffer(blob[head_size + row_size:], "<u8")
+    pos = int(head["slot"])
+    if n_sib and pos >> n_sib:
+        raise ProofError("slot out of range for the stated tree depth")
+    node = np.uint64(np_account_leaf(row))
+    for level in range(n_sib):
+        sib = np.uint64(siblings[level])
+        if (pos >> level) & 1:
+            node = _np_combine(sib, node)  # this node is the right child
+        else:
+            node = _np_combine(node, sib)
+    if int(node) != int(head["root"]):
+        raise ProofError(
+            f"proof does not fold to root: {int(node):#x} != "
+            f"{int(head['root']):#x}"
+        )
+    return {
+        "account": row,
+        "root": int(head["root"]),
+        "slot": int(head["slot"]),
+        "siblings": [int(s) for s in siblings],
+    }
+
+
+def _np_combine(left, right) -> np.uint64:
+    return mix64_np(
+        np.asarray([left], np.uint64), np.asarray([right], np.uint64)
+    )[0]

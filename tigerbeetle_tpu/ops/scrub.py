@@ -105,29 +105,46 @@ DEVICE_FAULT_TYPES = _device_fault_types()
 # ---------------------------------------------------------------------------
 
 
+def row_hash_accounts(key_lo, key_hi, cols) -> jax.Array:
+    """Per-row account fold (the scrub fold's per-slot term, and the
+    Merkle leaf value — ops/merkle.py).  ``cols`` may be full columns or
+    already-gathered lanes; shapes follow the inputs."""
+    h = mix64(key_lo, key_hi)
+    for f in _BALANCE_FIELDS:
+        h = mix64(h ^ cols[f + "_lo"], h ^ cols[f + "_hi"])
+    return mix64(h, cols["timestamp"])
+
+
+def row_hash_transfers(key_lo, key_hi, cols) -> jax.Array:
+    h = mix64(key_lo, key_hi)
+    h = mix64(h ^ cols["amount_lo"], h ^ cols["amount_hi"])
+    return mix64(h, cols["timestamp"])
+
+
+def row_hash_posted(key_lo, key_hi, cols) -> jax.Array:
+    h = mix64(key_lo, key_hi)
+    return mix64(h, cols["fulfillment"].astype(jnp.uint64))
+
+
+def leaf_hashes(table: ht.Table, row_hash) -> jax.Array:
+    """uint64[capacity] per-slot live-masked row folds: the scrub fold's
+    addends, and the Merkle tree's leaf level (ops/merkle.py)."""
+    live = (table.key_lo != 0) | (table.key_hi != 0)
+    h = row_hash(table.key_lo, table.key_hi, table.cols)
+    return jnp.where(live, h, jnp.uint64(0))
+
+
 def _fold_accounts(a: ht.Table) -> jax.Array:
     """Bit-identical to ops.state_machine.ledger_digest (docstring)."""
-    live = (a.key_lo != 0) | (a.key_hi != 0)
-    h = mix64(a.key_lo, a.key_hi)
-    for f in _BALANCE_FIELDS:
-        h = mix64(h ^ a.cols[f + "_lo"], h ^ a.cols[f + "_hi"])
-    h = mix64(h, a.cols["timestamp"])
-    return jnp.sum(jnp.where(live, h, jnp.uint64(0)))
+    return jnp.sum(leaf_hashes(a, row_hash_accounts))
 
 
 def _fold_transfers(t: ht.Table) -> jax.Array:
-    live = (t.key_lo != 0) | (t.key_hi != 0)
-    h = mix64(t.key_lo, t.key_hi)
-    h = mix64(h ^ t.cols["amount_lo"], h ^ t.cols["amount_hi"])
-    h = mix64(h, t.cols["timestamp"])
-    return jnp.sum(jnp.where(live, h, jnp.uint64(0)))
+    return jnp.sum(leaf_hashes(t, row_hash_transfers))
 
 
 def _fold_posted(p: ht.Table) -> jax.Array:
-    live = (p.key_lo != 0) | (p.key_hi != 0)
-    h = mix64(p.key_lo, p.key_hi)
-    h = mix64(h, p.cols["fulfillment"].astype(jnp.uint64))
-    return jnp.sum(jnp.where(live, h, jnp.uint64(0)))
+    return jnp.sum(leaf_hashes(p, row_hash_posted))
 
 
 @jax.jit  # deliberately NOT donated: the scrub must never consume the ledger

@@ -580,6 +580,160 @@ def sharded_scrub_digest(mesh: Mesh):
 
 
 # ---------------------------------------------------------------------------
+# Per-shard Merkle subtrees (machine merkle mode under TB_SHARDS)
+# ---------------------------------------------------------------------------
+#
+# The commitment forest (ops/merkle.py) composes with sharding as one
+# subtree per shard over the shard's LOCAL slot layout: heaps carry a
+# leading shard partition (global uint64[n * 2 * local_cap] sharded over
+# the mesh axis), updates touch owner-locally (a non-owned key is simply
+# absent from the local table, so its probe misses and the lane drops),
+# and the canonical live commitment is the per-shard roots folded by
+# wrap-sum — read back through the same per-shard uint64 lanes the scrub
+# fold uses.  Pending references resolve through the _ShardGather psum
+# (the pending transfer's row lives on ONE shard; its posted key and
+# account sides must reach THEIR owners).
+
+
+def merkle_steps(mesh: Mesh) -> Dict[str, object]:
+    """Jitted sharded merkle build/update/verify/roots steps, cached
+    process-wide like machine_steps."""
+    key = (
+        tuple(int(d.id) for d in mesh.devices.flat),
+        mesh.axis_names,
+        "merkle",
+    )
+    steps = _STEP_CACHE.get(key)
+    if steps is not None:
+        return steps
+    from ..ops import merkle as mk
+
+    n_shards = mesh.devices.size
+    shift = n_shards.bit_length() - 1
+
+    def build_local(ledger: Ledger):
+        return mk.build_forest_impl(ledger)
+
+    def build(ledger):
+        return shard_map(
+            build_local,
+            mesh=mesh,
+            in_specs=(_specs_like(ledger),),
+            out_specs=jax.tree_util.tree_map(
+                lambda _: P(AXIS), mk.Forest(0, 0, 0)
+            ),
+            check_vma=False,  # see sharded_create_transfers' justification
+        )(ledger)
+
+    def upd_accounts_local(forest, ledger, lo, hi):
+        return mk.update_accounts_impl(
+            forest, ledger, lo, hi, max_probe=MAX_PROBE, hash_shift=shift
+        )
+
+    def upd_transfers_local(has_postvoid):
+        def fn(forest, ledger, id_lo, id_hi, acc_lo, acc_hi,
+               pend_lo, pend_hi):
+            if has_postvoid:
+                # Resolve pending refs cluster-wide: the row lives on one
+                # shard; psum carries its posted key + account sides to
+                # every shard, whose local touches keep only what they own.
+                p_g = _ShardGather(
+                    ledger.transfers, pend_lo, pend_hi, n_shards, shift
+                )
+                rows = p_g.rows(ledger.transfers)
+
+                def masked(name):
+                    return jnp.where(p_g.found, rows[name], jnp.uint64(0))
+
+                pend_ts = masked("timestamp")
+                acc_lo = jnp.concatenate([
+                    acc_lo, masked("debit_account_id_lo"),
+                    masked("credit_account_id_lo"),
+                ])
+                acc_hi = jnp.concatenate([
+                    acc_hi, masked("debit_account_id_hi"),
+                    masked("credit_account_id_hi"),
+                ])
+                posted = mk.touch_tree(
+                    forest.posted, ledger.posted, pend_ts,
+                    jnp.zeros_like(pend_ts), "posted", MAX_PROBE, shift,
+                )
+            else:
+                posted = forest.posted
+            transfers = mk.touch_tree(
+                forest.transfers, ledger.transfers, id_lo, id_hi,
+                "transfers", MAX_PROBE, shift,
+            )
+            accounts = mk.touch_tree(
+                forest.accounts, ledger.accounts, acc_lo, acc_hi,
+                "accounts", MAX_PROBE, shift,
+            )
+            return mk.Forest(
+                accounts=accounts, transfers=transfers, posted=posted
+            )
+
+        return fn
+
+    def verify_local(forest, ledger):
+        return mk.verify_roots_impl(forest, ledger)[None]  # (1, 2, 3)
+
+    def verify(forest, ledger):
+        return shard_map(
+            verify_local,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P(AXIS), mk.Forest(0, 0, 0)),
+                _specs_like(ledger),
+            ),
+            out_specs=P(AXIS),
+            check_vma=False,  # see sharded_create_transfers' justification
+        )(forest, ledger)
+
+    def roots_local(forest):
+        return jnp.stack([
+            forest.accounts[1], forest.transfers[1], forest.posted[1]
+        ])[None]
+
+    def roots(forest):
+        return shard_map(
+            roots_local,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P(AXIS), mk.Forest(0, 0, 0)),
+            ),
+            out_specs=P(AXIS),
+            check_vma=False,  # see sharded_create_transfers' justification
+        )(forest)
+
+    forest_specs = jax.tree_util.tree_map(lambda _: P(AXIS), mk.Forest(0, 0, 0))
+
+    def wrap_update(fn):
+        def step(forest, ledger, *keys):
+            return shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(forest_specs, _specs_like(ledger))
+                + tuple(P() for _ in keys),
+                out_specs=forest_specs,
+                check_vma=False,  # see sharded_create_transfers
+            )(forest, ledger, *keys)
+
+        return jax.jit(step, donate_argnames=("forest",))
+
+    steps = {
+        # build/verify/roots deliberately NOT donated (reads).
+        "build": jax.jit(build),
+        "verify": jax.jit(verify),
+        "roots": jax.jit(roots),
+        "update_accounts": wrap_update(upd_accounts_local),
+        "update_transfers": wrap_update(upd_transfers_local(False)),
+        "update_transfers_pv": wrap_update(upd_transfers_local(True)),
+    }
+    _STEP_CACHE[key] = steps
+    return steps
+
+
+# ---------------------------------------------------------------------------
 # Host-side layout converters (sequential fallback, checkpoints, queries)
 # ---------------------------------------------------------------------------
 #
@@ -609,11 +763,13 @@ def _host_rows(table: ht.Table):
     return key_lo, key_hi, cols, idx
 
 
-def _probe_place(homes: np.ndarray, region_base: np.ndarray, region_mask: int,
-                 capacity: int) -> np.ndarray:
-    """Linear-probe placement of distinct keys in row order: row i lands at
-    the first free slot of region_base[i] + ((homes[i] + k) & region_mask).
-    Returns the chosen global slots."""
+def _probe_place_ref(homes: np.ndarray, region_base: np.ndarray,
+                     region_mask: int, capacity: int) -> np.ndarray:
+    """Reference linear-probe placement (the original per-row host loop):
+    row i lands at the first free slot of region_base[i] + ((homes[i] + k)
+    & region_mask).  O(rows) interpreted work — kept as the oracle the
+    vectorized _probe_place is pinned bit-identical against
+    (tests/test_sharded.py)."""
     occupied = np.zeros(capacity, bool)
     slots = np.empty(len(homes), np.int64)
     for i in range(len(homes)):
@@ -624,6 +780,59 @@ def _probe_place(homes: np.ndarray, region_base: np.ndarray, region_mask: int,
         occupied[base + s] = True
         slots[i] = base + s
     return slots
+
+
+def _probe_place(homes: np.ndarray, region_base: np.ndarray, region_mask: int,
+                 capacity: int) -> np.ndarray:
+    """Vectorized linear-probe placement, bit-identical to
+    _probe_place_ref (ROADMAP item 1 follow-up: the canonical-view
+    rebuild's per-row host loop was O(live rows) interpreted work — a real
+    tax on the first query after every sharded commit).
+
+    Sequential FCFS insertion satisfies one invariant that pins the
+    assignment uniquely: every slot a row probes PAST holds a row with a
+    smaller row index (it was already there when the later row walked).
+    So the fixpoint of a displacement sweep — every unplaced row proposes
+    to its current probe slot, each slot keeps the smallest row index it
+    has ever been offered (np.minimum.at), losers and stolen-from rows
+    advance — IS the sequential assignment, computed in O(max displacement)
+    vector rounds instead of O(live rows) interpreted probe walks.  The
+    PR 7 claim_slots cost discipline (one upfront (home, lane) ordering
+    per round, occupancy as flat vectors, no per-row Python), applied to
+    the converter's FCFS protocol; tests/test_sharded.py pins parity
+    against the scalar oracle including forced same-home and
+    cross-group-displacement collisions."""
+    n = len(homes)
+    if n == 0:
+        return np.empty(0, np.int64)
+    base = region_base.astype(np.int64)
+    homes64 = homes.astype(np.int64)
+    owner = np.full(capacity, n, np.int64)  # n = unowned sentinel
+    offset = np.zeros(n, np.int64)
+    row_slot = np.full(n, -1, np.int64)
+    active = np.arange(n, dtype=np.int64)
+    while active.size:
+        cur = base[active] + ((homes64[active] + offset[active]) & region_mask)
+        prev = owner[cur].copy()
+        np.minimum.at(owner, cur, active)
+        won = owner[cur] == active
+        row_slot[active[won]] = cur[won]
+        offset[active[~won]] += 1  # lost the proposal: advance one
+        # Stolen-from rows (a smaller index claimed their slot) rejoin one
+        # past the stolen slot.  One victim per slot, winners' slots are
+        # unique, so victims are unique.
+        victims = prev[won]
+        victims = victims[victims < n]
+        if victims.size:
+            offset[victims] = (
+                (row_slot[victims] - base[victims] - homes64[victims])
+                & region_mask
+            ) + 1
+            row_slot[victims] = -1
+            active = np.concatenate([active[~won], victims])
+        else:
+            active = active[~won]
+    return row_slot
 
 
 def _fill_table(capacity: int, key_lo, key_hi, cols, slots,

@@ -48,6 +48,7 @@ _FILTER_FLAGS = {
 OPERATIONS = (
     "create_accounts", "create_transfers", "lookup_accounts",
     "lookup_transfers", "get_account_transfers", "get_account_history",
+    "get_proof",
 )
 
 
@@ -179,6 +180,24 @@ def execute_statement(client: Client, statement: str, out=sys.stdout) -> None:
         rows = client.lookup_transfers(ids)
         for row in rows:
             print(_format_row(row, types.TRANSFER_DTYPE.names), file=out)
+    elif operation == "get_proof":
+        # Root-anchored Merkle balance proof, verified CLIENT-SIDE before
+        # printing (docs/commitments.md): a forged/tampered reply errors
+        # instead of rendering.
+        for obj in objects:
+            ident = int(obj["id"], 0)
+            proof = client.get_proof(ident)
+            if proof is None:
+                print(f"  id={ident}: no proof (absent account or "
+                      "server runs without merkle commitments)", file=out)
+                continue
+            print(
+                f"  id={ident}: VERIFIED against root="
+                f"{proof['root']:#018x} (slot {proof['slot']}, "
+                f"{len(proof['siblings'])} siblings)", file=out,
+            )
+            print(_format_row(proof["account"], types.ACCOUNT_DTYPE.names),
+                  file=out)
     elif operation in ("get_account_transfers", "get_account_history"):
         body = build_filter(objects).tobytes()
         op = (wire.Operation.get_account_transfers

@@ -535,6 +535,7 @@ class SimCluster:
         n_standbys: int = 0,
         viz: bool = False,
         scrub_interval: int = 0,
+        merkle: bool = False,
         overload: Optional[dict] = None,
         byzantine: Optional[dict] = None,
     ) -> None:
@@ -559,6 +560,11 @@ class SimCluster:
         # scrub mirror at cadence N, enabling SDC detection and dispatch
         # recovery under the injectors below.
         self.scrub_interval = scrub_interval
+        # Merkle commitment mode (docs/commitments.md): the scrub check
+        # substrate becomes the on-device tree; at intervals > 1 there is
+        # NO host mirror — SDC must be detected by root mismatch and
+        # recovered through checkpoint + WAL replay.
+        self.merkle = merkle
         # Overload fault domain (docs/fault_domains.md): when set, every
         # replica's ingress rides a BOUNDED admission queue drained with a
         # per-tick dispatch budget — the sim twin of a server whose event
@@ -757,9 +763,15 @@ class SimCluster:
             hash_log=self.hash_logs[i],
             hot_transfers_capacity_max=self.hot_transfers_capacity_max,
             scrub_interval=self.scrub_interval,
+            merkle=self.merkle or None,
         )
         # Virtual time: device-recovery backoff must never wall-sleep.
         replica.machine.retry_tick_s = 0
+        if self.merkle:
+            # The VOPR merkle kind IS the mirror-off proof: even at the
+            # interval-1 cadence, detection must come from root mismatch
+            # and recovery from checkpoint + WAL replay.
+            replica.machine.scrub_paranoid = False
         if self._byz is not None and not self._byz.verify:
             # Negative control: the consensus-level byzantine checks are
             # forced off along with the transport's (see step()).

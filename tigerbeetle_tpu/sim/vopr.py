@@ -51,6 +51,7 @@ def run_seed(
     standbys: Optional[int] = 0,
     viz: Optional[bool] = None,
     scrub_interval: int = 0,
+    merkle: bool = False,
     device_faults: bool = False,
 ) -> VoprResult:
     """One VOPR run: random topology + faults from ``seed``.
@@ -71,7 +72,14 @@ def run_seed(
     ``"sdc"`` / ``"dispatch"`` restricts to one (the load-bearing negative
     control injects SDC alone: with ``scrub_interval`` 0 the flip must
     demonstrably fail the audit/conservation/convergence oracles, proving
-    the scrub — which makes the same seed pass — is what contains it)."""
+    the scrub — which makes the same seed pass — is what contains it).
+
+    ``merkle``: arm the Merkle commitment mode (docs/commitments.md) on
+    every replica.  With ``scrub_interval`` > 1 the host mirror is OFF —
+    SDC must be detected by commitment-root mismatch and recovered via
+    checkpoint + WAL replay (the acceptance proof for ROADMAP item 3);
+    pure scheduling knob, drawn from no rng stream, so arming it never
+    shifts a pinned seed's fault schedule."""
     if viz is None:
         viz = bool(os.environ.get("TB_VOPR_VIZ"))
     rng = random.Random(seed)
@@ -143,6 +151,7 @@ def run_seed(
             n_standbys=standbys,
             viz=viz,
             scrub_interval=scrub_interval,
+            merkle=merkle,
         )
 
         def done(result: VoprResult) -> VoprResult:

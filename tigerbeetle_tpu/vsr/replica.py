@@ -94,6 +94,7 @@ class Replica:
         process_config=None,
         host_engine: bool = False,
         scrub_interval: Optional[int] = None,
+        merkle: Optional[bool] = None,
     ) -> None:
         self.data_path = data_path
         # Optional determinism oracle (utils/hash_log.OpHashLog): per-commit
@@ -154,6 +155,11 @@ class Replica:
             # mirror arms at the end of open(), once the restored state is
             # digest-verified and the WAL replayed.
             self.machine.scrub_interval = scrub_interval
+        if merkle is not None:
+            # Merkle commitment mode (docs/commitments.md): the scrub
+            # substrate becomes the on-device incremental tree; the full
+            # mirror survives only at the interval-1 paranoid cadence.
+            self.machine.merkle_enabled = bool(merkle)
 
         self.cluster = 0
         self.replica = 0
@@ -371,6 +377,23 @@ class Replica:
                 f"checkpoint digest mismatch: ledger {digest:#x} != "
                 f"superblock {sb.ledger_digest:#x}"
             )
+        want = meta.get("merkle_root")
+        if want is not None:
+            # Replay-free commitment verification: recompute the canonical
+            # Merkle roots from the restored arrays (host numpy, no device
+            # work) and compare against the captured commitment.
+            from ..ops import merkle as merkle_mod
+
+            got = merkle_mod.np_ledger_roots(ledger)
+            exp = (
+                int(want["accounts"]), int(want["transfers"]),
+                int(want["posted"]),
+            )
+            if got != exp:
+                raise RuntimeError(
+                    "checkpoint merkle root mismatch: "
+                    f"{[hex(g) for g in got]} != {[hex(e) for e in exp]}"
+                )
 
     def _verify_cold(self, meta) -> tuple:
         """Enumerate damaged cold-tier run files referenced by a
@@ -1216,6 +1239,10 @@ class Replica:
         if operation == wire.Operation.lookup_transfers:
             ids = _decode_ids(body)
             return self.machine.lookup_transfers(ids).tobytes()
+        if operation == wire.Operation.get_proof:
+            ids = _decode_ids(body)
+            proof = self.machine.get_proof(ids[0]) if ids else None
+            return proof if proof is not None else b""
         if operation in (
             wire.Operation.get_account_transfers,
             wire.Operation.get_account_history,
@@ -1267,6 +1294,10 @@ class Replica:
             # AccountFilter is treated as a zeroed (invalid) filter and
             # yields an empty reply (parse_filter_from_input,
             # state_machine.zig:810-820).
+            return
+        if operation == wire.Operation.get_proof:
+            if len(body) != 16:
+                raise InvalidRequest("get_proof body must be one u128 id")
             return
         raise InvalidRequest(f"operation {operation!r} not accepted")
 
@@ -1460,6 +1491,16 @@ class Replica:
                 for client, s in self.sessions.items()
             },
         }
+        if m.merkle_armed:
+            # Commitment root over the CANONICAL layout (shard-config
+            # independent): restores — and any auditor holding the
+            # checkpoint — verify the state against it WITHOUT replay
+            # (docs/commitments.md; _install_checkpoint_ledger checks it).
+            acc_root, tr_root, po_root = m.merkle_canonical_roots()
+            meta["merkle_root"] = {
+                "accounts": acc_root, "transfers": tr_root,
+                "posted": po_root,
+            }
         # checkpoint_ledger(): canonical single-device layout — under
         # TB_SHARDS the live ledger is owner-partitioned, and a checkpoint
         # must restore into ANY shard config (deterministic conversion, so

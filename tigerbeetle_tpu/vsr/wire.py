@@ -89,6 +89,10 @@ class Operation(enum.IntEnum):
     lookup_transfers = VSR_OPERATIONS_RESERVED + 3
     get_account_transfers = VSR_OPERATIONS_RESERVED + 4
     get_account_history = VSR_OPERATIONS_RESERVED + 5
+    # Root-anchored Merkle balance proof for one account id
+    # (docs/commitments.md; requires the server's merkle mode — an empty
+    # reply means "no proof": account absent or commitments off).
+    get_proof = VSR_OPERATIONS_RESERVED + 6
 
 
 # The shared 128-byte frame prefix (message_header.zig:17-66); per-command

@@ -72,7 +72,7 @@ TIERS = {
             "tests/test_balancing_vector.py", "tests/test_scan_path.py",
             "tests/test_queries.py", "tests/test_scan_builder.py",
             "tests/test_sharded.py", "tests/test_sharded_machine.py",
-            "tests/test_group_commit.py",
+            "tests/test_group_commit.py", "tests/test_merkle.py",
             "tests/test_pipeline.py", "tests/test_waves.py",
             "tests/test_host_engine.py", "tests/test_cold_tier.py",
         ],
@@ -136,6 +136,15 @@ TIERS = {
         # Artifact: SHARDED_SMOKE.json at the repo root.
         cmd=["tools/sharded_smoke.py"],
     ),
+    "merkle": dict(
+        # Merkle commitment tree smoke (docs/commitments.md): TB_MERKLE-off
+        # bit-identity against the pinned PIPELINE_SMOKE reply/digest
+        # identity, merkle-armed on-path identity + maintained-root-vs-
+        # numpy-oracle, proof round-trip + tamper rejection, SDC detection
+        # by root mismatch with the mirror off, and the merkle.* series
+        # asserted in METRICS.json.  Artifact: MERKLE_SMOKE.json.
+        cmd=["tools/merkle_smoke.py"],
+    ),
     "byzantine": dict(
         # Byzantine fault domain smoke (docs/fault_domains.md): pinned
         # seed with one equivocating/corrupting/lying replica of six
@@ -188,6 +197,12 @@ TIERS = {
             # Byzantine fault kind: the pinned on/off proof pair (slow:
             # two full 6-replica runs under the open-loop workload).
             "tests/test_byzantine.py::TestVoprByzantine",
+            # Merkle commitments: the shards x pipeline-depth oracle
+            # matrix (slow: sharded compiles) and the pinned VOPR seed
+            # whose SDC flip must be detected by root mismatch with the
+            # mirror off (slow: full sim run + WAL-replay recovery).
+            "tests/test_merkle.py::TestRootOracleMatrix",
+            "tests/test_merkle.py::TestVoprMerkle",
             # Wave scheduler: the pinned VOPR seed re-validated under
             # TB_WAVES=1 (slow: a full sim run), plus the depth-swept
             # limit-account differentials (tier-1 budget audit: the
@@ -214,7 +229,8 @@ TIERS = {
 }
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
-    "scrub", "overload", "waves", "sharded", "byzantine", "integration",
+    "scrub", "merkle", "overload", "waves", "sharded", "byzantine",
+    "integration",
 ]
 
 

@@ -1,0 +1,223 @@
+"""CI merkle smoke: prove the on-device commitment tree end to end.
+
+In-process (CPU-pinned), five proofs with asserted artifacts, mirroring
+the acceptance bar in docs/commitments.md:
+
+1. OFF-PATH IDENTITY — with TB_MERKLE off (the default) the serving path
+   is bit-identical to pre-merkle: the pipeline bench's pinned workload
+   (the same one tools/pipeline_smoke.py runs) must reproduce the
+   replies_sha and ledger digest recorded in PIPELINE_SMOKE.json.
+2. ON-PATH IDENTITY + ROOT-VS-ORACLE — the SAME reduced workload with
+   the tree armed (mirror off) commits identical replies/digest, and the
+   maintained roots equal a from-scratch numpy recompute of the final
+   ledger (ops/merkle.np_ledger_roots).
+3. PROOF ROUND-TRIP — get_proof verifies client-side
+   (ops/merkle.check_proof) and a single flipped byte is REJECTED.
+4. SDC DETECTION, MIRROR OFF — a seeded bit flip into a live balance
+   column is detected by root mismatch at the next check
+   (DeviceStateUnrecoverable: no mirror, recovery is the replica's
+   checkpoint+WAL path) — plus the load-bearing negative: the same flip
+   with nothing armed survives into a diverged digest.
+5. COUNTERS — the merkle.* series (updates, rebuilds, checks, proofs)
+   land in METRICS.json.
+
+Artifact: MERKLE_SMOKE.json at the repo root; the ``merkle`` tier in
+tools/ci.py records pass/fail in CI_LAST.json.
+
+Usage: python tools/merkle_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("TB_MERKLE", None)  # proof 1 runs the OFF path
+    from tigerbeetle_tpu import jaxenv
+
+    jaxenv.enable_compile_cache()
+    jaxenv.force_cpu(1)
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.config import LedgerConfig
+    from tigerbeetle_tpu.machine import (
+        DeviceStateUnrecoverable, TpuStateMachine,
+    )
+    from tigerbeetle_tpu.obs.metrics import registry
+    from tigerbeetle_tpu.ops import merkle as mk
+
+    summary: dict = {}
+
+    # 1. OFF-PATH IDENTITY (TB_MERKLE off == pre-merkle, bit for bit) ------
+    import bench
+
+    entry = bench.run_pipeline_bench(1)
+    with open(os.path.join(REPO, "PIPELINE_SMOKE.json")) as f:
+        pinned = json.load(f)["identity"]
+    assert entry["replies_sha"] == pinned["replies_sha"], (
+        "TB_MERKLE-off reply stream diverged from the pinned pre-merkle "
+        f"identity: {entry['replies_sha']} != {pinned['replies_sha']}"
+    )
+    assert entry["digest"] == pinned["digest"], (
+        "TB_MERKLE-off ledger digest diverged from the pinned identity"
+    )
+    summary["off_path"] = {
+        "replies_sha": entry["replies_sha"], "digest": entry["digest"],
+    }
+
+    # 2. ON-PATH IDENTITY + ROOT-VS-ORACLE ---------------------------------
+    cfg = LedgerConfig(
+        accounts_capacity_log2=10, transfers_capacity_log2=12,
+        posted_capacity_log2=10,
+    )
+    N = 16
+
+    def accounts_batch():
+        return types.accounts_array(
+            [types.account(id=i + 1, ledger=1, code=10) for i in range(N)]
+        )
+
+    def stream(m, fault=None):
+        out = [m.create_accounts(accounts_batch(), wall_clock_ns=1000)]
+        if fault is not None:
+            fault(m)
+        for first, n in ((1000, 24), (2000, 16), (3000, 20)):
+            out.append(m.create_transfers(types.transfers_array([
+                types.transfer(
+                    id=first + i, debit_account_id=1 + i % N,
+                    credit_account_id=1 + (i + 3) % N, amount=3 + i % 5,
+                    ledger=1, code=10,
+                ) for i in range(n)
+            ])))
+        pend = types.transfers_array([
+            types.transfer(
+                id=9000 + i, debit_account_id=1 + i % N,
+                credit_account_id=1 + (i + 5) % N, amount=10, ledger=1,
+                code=10, flags=types.TransferFlags.PENDING,
+            ) for i in range(8)
+        ])
+        out.append(m.create_transfers(pend))
+        post = types.transfers_array([
+            types.transfer(
+                id=9500 + i, pending_id=9000 + i, ledger=1, code=10,
+                flags=(
+                    types.TransferFlags.POST_PENDING_TRANSFER if i % 2 == 0
+                    else types.TransferFlags.VOID_PENDING_TRANSFER
+                ),
+            ) for i in range(8)
+        ])
+        out.append(m.create_transfers(post))
+        return out
+
+    def make(merkle, interval):
+        m = TpuStateMachine(cfg, batch_lanes=64)
+        m.retry_tick_s = 0
+        m.scrub_interval = interval
+        if merkle:
+            m.merkle_enabled = True
+            m.scrub_paranoid = False  # tree only: the mirror stays off
+            assert m.scrub_arm() and m._scrub_mirror is None
+        return m
+
+    off = make(False, 0)
+    res_off = stream(off)
+    on = make(True, 4)
+    res_on = stream(on)
+    assert res_off == res_on, "merkle-armed results diverged"
+    assert off.digest() == on.digest(), "merkle-armed digest diverged"
+    assert on.scrub_check() is True
+    roots = on.merkle_roots()
+    oracle = mk.np_ledger_roots(on.ledger)
+    assert roots == oracle, (
+        f"maintained roots {roots} != from-scratch oracle {oracle}"
+    )
+    summary["root_vs_oracle"] = {
+        "roots": [f"{r:#x}" for r in roots],
+        "updates": on.merkle_updates,
+        "rebuilds": on.merkle_rebuilds,
+    }
+
+    # 3. PROOF ROUND-TRIP + TAMPER REJECTION -------------------------------
+    blob = on.get_proof(3)
+    assert blob, "no proof for a live account"
+    proof = mk.check_proof(blob)
+    assert int(proof["account"]["id_lo"]) == 3
+    assert proof["root"] == roots[0], "proof anchored to a stale root"
+    tampered = bytearray(blob)
+    tampered[mk.PROOF_HEADER_DTYPE.itemsize + 2] ^= 1  # a balance byte
+    try:
+        mk.check_proof(bytes(tampered))
+        raise AssertionError("tampered proof verified")
+    except mk.ProofError:
+        pass
+    summary["proof"] = {
+        "root": f"{proof['root']:#x}", "siblings": len(proof["siblings"]),
+        "tamper_rejected": True,
+    }
+
+    # 4. SDC DETECTION BY ROOT MISMATCH, MIRROR OFF ------------------------
+    victim = make(True, 1)
+    stream(victim)
+    assert victim.inject_sdc_bitflip(random.Random(7))
+    try:
+        victim.scrub_check()
+        raise AssertionError(
+            "a device bit flip passed the root check with the mirror off"
+        )
+    except DeviceStateUnrecoverable:
+        pass
+    assert victim.merkle_mismatches == 1
+    # Load-bearing negative: nothing armed, the SAME flip at the same
+    # stream point survives into a diverged final state.
+    naked = make(False, 0)
+    stream(naked, fault=lambda m: m.inject_sdc_bitflip(random.Random(7)))
+    assert naked.digest() != off.digest(), (
+        "an unchecked bit flip left the digest intact: the smoke's flip "
+        "is not load-bearing"
+    )
+    summary["sdc"] = {
+        "detected_by_root_mismatch": victim.merkle_mismatches,
+        "mirror_was_off": True,
+        "unchecked_flip_diverges": True,
+    }
+
+    # 5. COUNTERS ----------------------------------------------------------
+    registry.enable()
+    try:
+        m = make(True, 2)
+        stream(m)
+        m.scrub_check()
+        assert m.get_proof(1)
+        snap = registry.snapshot()
+        path = os.path.join(REPO, "METRICS.json")
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1)
+    finally:
+        registry.reset()
+        registry.disable()
+    with open(path) as f:
+        series = json.load(f)["counters"]
+    for name in ("merkle.updates", "merkle.rebuilds", "merkle.checks",
+                 "merkle.proofs"):
+        assert series.get(name, 0) >= 1, f"{name} missing from METRICS.json"
+    summary["counters"] = {
+        k: v for k, v in series.items() if k.startswith("merkle.")
+    }
+
+    out = os.path.join(REPO, "MERKLE_SMOKE.json")
+    with open(out, "w") as f:
+        json.dump({"green": True, **summary}, f, indent=1)
+    print(json.dumps({"green": True, **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""VOPR soak for TB_WAVES default-on (ROADMAP item 2 follow-up).
+
+Runs the pinned regression seed set under TB_WAVES=1 x TB_SHARDS {0, 2}
+and records per-seed outcomes in WAVES_SOAK.json — the evidence base for
+flipping the wave scheduler's default (docs/waves.md records the
+decision and, if the default stays off, the measured blocker).
+
+Seed selection (all PINNED — each one regression-pins a real find):
+
+- the standing smoke seeds 1/7/23 + the device-fault seed 42 and the
+  special-schedule seeds 10056/10058/10133/9002 (clock skew, read-fault
+  commit stall, lost uncommitted body, stale WAL fork);
+- the round-4 sweep regressions (stale-prepare/floor-stall/DVC classes);
+- under TB_SHARDS=2 a representative subset (the sharded converters make
+  each run several times slower on the 1-core CI host; the full sharded
+  matrix already rides tests/test_sharded_machine.py's pinned seed).
+
+Usage: python tools/waves_soak.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEEDS = [1, 7, 23, 42, 10056, 10058, 10133, 9002,
+         401021, 400816, 400318, 400396, 400132, 401358, 402046, 500285]
+SEEDS_SHARDED = [1, 42, 10056, 9002]
+QUICK = [1, 42, 10056]
+
+
+def run_config(seeds, shards: int) -> dict:
+    from tigerbeetle_tpu.sim.vopr import EXIT_PASSED, run_seed
+
+    os.environ["TB_WAVES"] = "1"
+    if shards:
+        os.environ["TB_SHARDS"] = str(shards)
+    else:
+        os.environ.pop("TB_SHARDS", None)
+    out = {}
+    for seed in seeds:
+        t0 = time.time()
+        ticks = 8_000 if seed in (10056, 10058, 10133, 9002) else 6_000
+        with tempfile.TemporaryDirectory() as d:
+            r = run_seed(seed, workdir=d, ticks=ticks)
+        out[str(seed)] = {
+            "exit": r.exit_code,
+            "passed": r.exit_code == EXIT_PASSED,
+            "commits": r.commits,
+            "faults": r.faults,
+            "seconds": round(time.time() - t0, 1),
+            **({} if r.exit_code == EXIT_PASSED
+               else {"reason": r.reason[:200]}),
+        }
+        print(f"# TB_WAVES=1 TB_SHARDS={shards} seed={seed}: "
+              f"exit={r.exit_code} ({out[str(seed)]['seconds']}s)",
+              file=sys.stderr)
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="3-seed spot check instead of the full pinned set")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tigerbeetle_tpu import jaxenv
+
+    jaxenv.enable_compile_cache()
+    jaxenv.force_cpu(8)  # the TB_SHARDS=2 leg needs virtual devices
+
+    seeds = QUICK if args.quick else SEEDS
+    seeds_sharded = QUICK if args.quick else SEEDS_SHARDED
+    report = {
+        "shards0": run_config(seeds, 0),
+        "shards2": run_config(seeds_sharded, 2),
+    }
+    all_green = all(
+        v["passed"] for cfg in report.values() for v in cfg.values()
+    )
+    report["green"] = all_green
+    report["quick"] = args.quick
+    report["iso"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    out = os.path.join(REPO, "WAVES_SOAK.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({
+        "green": all_green,
+        "seeds": len(report["shards0"]) + len(report["shards2"]),
+    }))
+    return 0 if all_green else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
