@@ -290,6 +290,85 @@ class TestMerkleProofs:
         off.create_accounts(accounts_batch(), wall_clock_ns=1000)
         assert off.get_proof(1) is None
 
+    def test_transfer_proof_roundtrip_and_tamper(self):
+        m = make_machine()
+        drive_mixes(m)
+        blob = m.get_proof(1000, kind="transfers")
+        proof = mk.check_proof(blob)
+        assert proof["kind"] == "transfers"
+        assert int(proof["row"]["id_lo"]) == 1000
+        assert proof["root"] == m.merkle_roots()[1]
+        # Flip bytes in hash-bound columns (id, amount), in a column the
+        # leaf does NOT cover (debit_account_id — rides as canonical
+        # zero, pinned by the verifier), and in the sibling path: every
+        # single-byte tamper must be rejected.
+        head = mk.PROOF_HEADER_DTYPE.itemsize
+        dr_off = types.TRANSFER_DTYPE.fields["debit_account_id_lo"][1]
+        for off in (head + 2, head + dr_off, len(blob) - 3):
+            bad = bytearray(blob)
+            bad[off] ^= 1
+            with pytest.raises(mk.ProofError):
+                mk.check_proof(bytes(bad))
+        # The row's uncommitted columns are the canonical projection:
+        # all zero in the blob (nothing forgeable rides along).
+        assert int(proof["row"]["debit_account_id_lo"]) == 0
+        assert int(proof["row"]["ledger"]) == 0
+        # A kind swap in the header must not verify either (the leaf
+        # hash domain differs per pad).
+        bad = bytearray(blob)
+        bad[20] ^= 1  # the kind field (header offset 20)
+        with pytest.raises(mk.ProofError):
+            mk.check_proof(bytes(bad))
+
+    def test_posted_proof_binds_pending(self):
+        """A posted-row proof anchors pending transfer 3000's fulfillment
+        to the posted root; its pending_timestamp equals the timestamp in
+        the transfer's OWN proof row — the client-side binding."""
+        m = make_machine()
+        drive_mixes(m)
+        pb = m.get_proof(3000, kind="posted")  # posted (i % 2 == 0)
+        pp = mk.check_proof(pb)
+        assert pp["kind"] == "posted"
+        assert int(pp["row"]["fulfillment"]) == 1  # posted, not voided
+        assert pp["root"] == m.merkle_roots()[2]
+        tp = mk.check_proof(m.get_proof(3000, kind="transfers"))
+        assert int(tp["row"]["timestamp"]) == int(
+            pp["row"]["pending_timestamp"]
+        )
+        vb = mk.check_proof(m.get_proof(3001, kind="posted"))
+        assert int(vb["row"]["fulfillment"]) == 2  # voided
+        # Tampers: the key, the fulfillment word, the RESERVED pad
+        # (unhashed — pinned to canonical zero), and a sibling.
+        head = mk.PROOF_HEADER_DTYPE.itemsize
+        for off in (head + 1, head + 8, head + 12, len(pb) - 2):
+            bad = bytearray(pb)
+            bad[off] ^= 1
+            with pytest.raises(mk.ProofError):
+                mk.check_proof(bytes(bad))
+
+    def test_proof_kind_misses(self):
+        m = make_machine()
+        drive_mixes(m)
+        assert m.get_proof(999_999, kind="transfers") is None
+        # 1000 is a plain transfer: no posted row exists for it.
+        assert m.get_proof(1000, kind="posted") is None
+        with pytest.raises(ValueError):
+            m.get_proof(1, kind="history")
+
+    @pytest.mark.slow
+    def test_proof_kinds_sharded(self):
+        """Transfer/posted proofs under TB_SHARDS anchor to the CANONICAL
+        per-pad trees (same roots as the wrap-summed live subtrees after
+        a clean settle) and verify client-side."""
+        m = make_machine(shards=2)
+        drive_mixes(m)
+        tp = mk.check_proof(m.get_proof(2000, kind="transfers"))
+        assert int(tp["row"]["id_lo"]) == 2000
+        pp = mk.check_proof(m.get_proof(3002, kind="posted"))
+        assert int(pp["row"]["fulfillment"]) == 1
+        canon = mk.np_ledger_roots(m._query_ledger())
+        assert tp["root"] == canon[1] and pp["root"] == canon[2]
+
     def test_wire_get_proof(self, tmp_path):
         """Operation.get_proof through the replica's execute path: a
         verifying proof for a live account, empty replies for absent ids."""
@@ -322,6 +401,32 @@ class TestMerkleProofs:
                 (424242).to_bytes(16, "little"), 0,
             )
             assert empty == b""
+            # 24-byte body: id + u64 kind selector (1 = transfers).
+            r.machine.commit_batch(
+                "create_transfers", plain_batch(7000, 4),
+                r.machine.prepare("create_transfers", 4),
+            )
+            tbody = r._execute_inner(
+                wire.Operation.get_proof,
+                (7000).to_bytes(16, "little") + (1).to_bytes(8, "little"),
+                0,
+            )
+            tproof = mk.check_proof(tbody)
+            assert tproof["kind"] == "transfers"
+            assert int(tproof["row"]["id_lo"]) == 7000
+            # An unknown kind must be rejected BEFORE journaling (every
+            # journaled prepare must replay).
+            from tigerbeetle_tpu.vsr.replica import InvalidRequest
+
+            with pytest.raises(InvalidRequest):
+                r._validate_request(
+                    wire.Operation.get_proof,
+                    (1).to_bytes(16, "little") + (9).to_bytes(8, "little"),
+                )
+            r._validate_request(
+                wire.Operation.get_proof,
+                (1).to_bytes(16, "little") + (2).to_bytes(8, "little"),
+            )
         finally:
             r.close()
 

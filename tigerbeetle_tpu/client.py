@@ -381,20 +381,38 @@ class Client:
         body = self.request(wire.Operation.lookup_transfers, _encode_ids(ids))
         return np.frombuffer(body, dtype=types.TRANSFER_DTYPE)
 
-    def get_proof(self, account_id: int) -> Optional[dict]:
-        """Client-verifiable balance proof (docs/commitments.md): fetch a
-        root-anchored Merkle path for ``account_id`` and VERIFY it locally
-        — the returned dict's account row is cryptographically bound to
-        the server's commitment root, so a tampered reply raises
-        ops.merkle.ProofError instead of returning.  None when the account
-        does not exist or the server runs without merkle commitments."""
-        from .ops.merkle import check_proof
+    def get_proof(self, ident: int, kind: str = "accounts") -> Optional[dict]:
+        """Client-verifiable inclusion proof (docs/commitments.md): fetch
+        a root-anchored Merkle path for ``ident`` and VERIFY it locally —
+        the returned dict's row is cryptographically bound to the server's
+        commitment root, so a tampered reply raises ops.merkle.ProofError
+        instead of returning.  The row is the CANONICAL committed
+        projection: columns the commitment tree does not cover (e.g. a
+        transfer's account sides) ride as zeros and are pinned there by
+        the verifier — fetch them with a lookup.  ``kind`` selects the
+        pad: ``accounts`` (the
+        default; 16-byte body, wire-compatible with PR 10 servers),
+        ``transfers`` (the transfer row), or ``posted`` (the fulfillment
+        record of pending transfer ``ident`` — its row carries the
+        pending timestamp, bindable to that transfer's own proof).  None
+        when the row does not exist or the server runs without merkle
+        commitments."""
+        from .ops.merkle import PROOF_KINDS, check_proof
 
-        body = self.request(wire.Operation.get_proof,
-                            _encode_ids([account_id]))
-        if not body:
+        body = _encode_ids([ident])
+        if kind != "accounts":
+            body += int(PROOF_KINDS[kind]).to_bytes(8, "little")
+        reply = self.request(wire.Operation.get_proof, body)
+        if not reply:
             return None
-        return check_proof(body)
+        proof = check_proof(reply)
+        if proof["kind"] != kind:
+            from .ops.merkle import ProofError
+
+            raise ProofError(
+                f"server answered kind {proof['kind']!r} for {kind!r}"
+            )
+        return proof
 
 
     # -- batch demux (state_machine.zig:114-165, client.zig:45-104) ----------

@@ -107,6 +107,16 @@ _group_fast_dispatch = jax.jit(
 )
 
 
+def _overflow_any(overflow) -> bool:
+    """True if any probe-overflow flag fired.  Accepts the scalar the
+    single-device kernels return, the per-shard uint32 lane vector the
+    sharded probed step returns, or a tuple of either (one per batch of a
+    sharded grouped run)."""
+    if isinstance(overflow, (list, tuple)):
+        return any(_overflow_any(o) for o in overflow)
+    return bool(np.any(np.asarray(overflow)))
+
+
 def pipeline_depth_default() -> int:
     """Commit-pipeline depth (TB_PIPELINE env; default 2).  Depth 1 (and
     TB_PIPELINE=0, "off") disables deferral entirely — the serving path is
@@ -148,17 +158,19 @@ class DeviceCommitHandle:
 
     __slots__ = ("_machine", "_result", "_stacked", "_counts",
                  "_timestamps", "_stage", "_resolved", "join_wait_s",
-                 "_batches", "_recovered")
+                 "_batches", "_recovered", "_deferred")
 
     def __init__(self, machine, result, counts, timestamps,
-                 stacked: bool, stage=None, batches=None) -> None:
+                 stacked: bool, stage=None, batches=None,
+                 deferred: bool = False) -> None:
         self._machine = machine
         self._result = result        # (codes, overflow) | Future of one
-        self._stacked = stacked      # True: leading GROUP_K dim
+        self._stacked = stacked      # True: leading per-batch dim
         self._counts = counts
         self._timestamps = timestamps
         self._stage = stage          # staging buffer set to release on resolve
         self._resolved = False
+        self._deferred = deferred    # counted in the machine's in-flight depth
         self.join_wait_s = 0.0
         # Host-side copies of the dispatched batches: the device fault
         # domain re-dispatches a quarantined run from these after a failed
@@ -180,6 +192,7 @@ class DeviceCommitHandle:
         if self._resolved:
             return
         self._resolved = True
+        self._machine._deferred_done(self)
         self._machine._inflight_untrack(self)
         if hasattr(self._result, "result"):
             try:
@@ -196,6 +209,7 @@ class DeviceCommitHandle:
         assert not self._resolved, "commit handle resolved twice"
         self._resolved = True
         m = self._machine
+        m._deferred_done(self)
         if self._recovered is not None:
             # A device-fault recovery already re-committed this run through
             # the blocking path (machine._recover_inflight): bookkeeping,
@@ -210,6 +224,10 @@ class DeviceCommitHandle:
                     _obs.histogram(
                         "pipeline.resolve_wait_us", "us"
                     ).observe(self.join_wait_s * 1e6)
+                    if m.shards:
+                        _obs.histogram(
+                            "pipeline.shard.resolve_wait_us", "us"
+                        ).observe(self.join_wait_s * 1e6)
             codes_dev, overflow_dev = self._result
             codes, overflow = m._d2h_codes(codes_dev, overflow_dev)
         except DEVICE_FAULT_TYPES as err:
@@ -229,7 +247,7 @@ class DeviceCommitHandle:
                 # back on the free-list, not leak with the handle.
                 m._stage_release(self._stage)
                 self._stage = None
-        if int(overflow):
+        if _overflow_any(overflow):
             # Load-factor management keeps this unreachable; losing inserts
             # silently is the one unacceptable outcome, so fail loud (the
             # deferred check fires one resolve later than the blocking
@@ -237,6 +255,8 @@ class DeviceCommitHandle:
             raise RuntimeError("transfers probe overflow during fast insert")
         if _obs.enabled:
             _obs.counter("pipeline.resolves").inc()
+            if m.shards:
+                _obs.counter("pipeline.shard.resolves").inc()
         # NOTE: index maintenance already happened inside the dispatch
         # closure (machine._index_append_device) — it is device work that
         # must ride the ledger chain; reading self.ledger HERE could see
@@ -479,6 +499,10 @@ class TpuStateMachine:
         self._scrub_suspect = False
         self._scrub_commits = 0        # create_* commits since the last check
         self._inflight_handles: List[DeviceCommitHandle] = []
+        # Deferred dispatches currently in flight on the FIFO lane
+        # (submit/resolve both happen on the serving thread): the
+        # commit-lane occupancy the pipeline.shard.* series report.
+        self._deferred_inflight = 0
         self._injected_device_faults = 0
         self._device_fault_streak = 0  # consecutive failed dispatches
         self.device_fault_limit = 3    # streak that triggers the degrade
@@ -500,7 +524,7 @@ class TpuStateMachine:
         self._merkle_forest = None
         self._merkle_dirty = False
         self._merkle_steps_cache = None
-        self._canon_tree = None  # (canon ledger ref, np accounts heap)
+        self._canon_tree = None  # (canon ledger ref, {pad name: np heap})
         # Plain-int event counters (read by obs/vopr_viz and tests without
         # the global metrics registry).
         self.scrub_checks = 0
@@ -704,6 +728,32 @@ class TpuStateMachine:
             self._inflight_handles.remove(handle)
         except ValueError:
             pass  # never tracked (fault domain off) or already recovered
+
+    def _deferred_done(self, handle) -> None:
+        if handle._deferred:
+            handle._deferred = False
+            self._deferred_inflight = max(0, self._deferred_inflight - 1)
+
+    def _deferred_submitted(self, lanes: int, owners=None) -> None:
+        """Commit-lane occupancy accounting for one deferred dispatch
+        (serving thread, at submit).  Under TB_SHARDS the pipeline.shard.*
+        series record per-shard lane occupancy: every shard executes every
+        deferred batch (replicated dispatch), so ``inflight`` IS the
+        per-shard commit-lane depth, and the per-shard lane counters
+        (from the host-side owner bincount) expose insert skew."""
+        self._deferred_inflight += 1
+        if not _obs.enabled:
+            return
+        if self.shards:
+            _obs.counter("pipeline.shard.dispatches").inc()
+            _obs.histogram("pipeline.shard.inflight", "handles").observe(
+                self._deferred_inflight
+            )
+            _obs.counter("pipeline.shard.lanes").inc(lanes)
+            if owners is not None:
+                for s, c in enumerate(owners.tolist()):
+                    if c:
+                        _obs.counter(f"pipeline.shard.lanes.{s}").inc(c)
 
     def _mirror_apply(self, operation: str, batch: np.ndarray,
                       timestamp: int) -> None:
@@ -1189,74 +1239,122 @@ class TpuStateMachine:
             return None
         return merkle_ops.np_ledger_roots(self._query_ledger())
 
-    def get_proof(self, account_id: int) -> Optional[bytes]:
-        """Root-anchored Merkle path for one account (docs/commitments.md
-        proof format): the account row + sibling hashes to the canonical
-        accounts root, client-verifiable via ops.merkle.check_proof.
-        None when the account does not exist or merkle mode is off."""
+    def get_proof(self, ident: int, kind: str = "accounts") -> Optional[bytes]:
+        """Root-anchored Merkle inclusion proof for one row
+        (docs/commitments.md proof format), client-verifiable via
+        ops.merkle.check_proof.  Kinds:
+
+        - ``accounts``: the account row + sibling path to the canonical
+          accounts root (the PR 10 surface, wire-compatible).
+        - ``transfers``: the transfer row + path to the transfers root.
+          Only hot-pad rows have leaves — a cold-evicted transfer yields
+          None (the tree commits to the pads, not the spill).
+        - ``posted``: the fulfillment record of PENDING transfer
+          ``ident``: the posted pad is keyed by the pending transfer's
+          timestamp, which the proof row carries so a client can bind it
+          to that transfer's own proof (its row holds id + timestamp).
+
+        None when the row does not exist in the pad or merkle is off."""
         if self._merkle_forest is None or self._engine is not None:
             return None
-        rows = self.lookup_accounts([account_id])
-        if len(rows) == 0:
-            return None
+        if kind not in merkle_ops.PROOF_KINDS:
+            raise ValueError(f"unknown proof kind {kind!r}")
+        lo = np.uint64(ident & U64_MAX)
+        hi = np.uint64(ident >> 64)
+        row_bytes = None
+        if kind == "accounts":
+            rows = self.lookup_accounts([ident])
+            if len(rows) == 0:
+                return None
+            row_bytes = rows[0].tobytes()
+        elif kind == "transfers":
+            rows = self.lookup_transfers([ident])
+            if len(rows) == 0:
+                return None
+            row_bytes = rows[0].tobytes()
+        else:  # posted: resolve the pending id to its pad key (timestamp)
+            rows = self.lookup_transfers([ident])
+            if len(rows) == 0:
+                return None
+            lo = np.uint64(int(rows[0]["timestamp"]))
+            hi = np.uint64(0)
         self._merkle_rebuild_if_dirty()
-        lo = np.uint64(account_id & U64_MAX)
-        hi = np.uint64(account_id >> 64)
         if self._ledger_is_sharded:
-            slot, siblings, root = self._canon_proof_path(lo, hi)
+            path = self._canon_proof_path(lo, hi, kind)
+            if path is None:
+                return None
+            slot, siblings, root = path
+            table = getattr(self._query_ledger(), kind)
         else:
             from .ops import hash_table as ht
 
+            table = getattr(self.ledger, kind)
             pad = 8  # one size class for the point lookup
             k_lo = np.zeros(pad, np.uint64)
             k_hi = np.zeros(pad, np.uint64)
             k_lo[0], k_hi[0] = lo, hi
             look = ht.lookup(
-                self.ledger.accounts, jnp.asarray(k_lo), jnp.asarray(k_hi),
-                sm.MAX_PROBE,
+                table, jnp.asarray(k_lo), jnp.asarray(k_hi), sm.MAX_PROBE
             )
-            found = bool(np.asarray(look.found)[0])
-            if not found:
+            if not bool(np.asarray(look.found)[0]):
                 return None
             slot = int(np.asarray(look.slot)[0])
-            levels = max(0, self.ledger.accounts.capacity.bit_length() - 1)
+            levels = max(0, table.capacity.bit_length() - 1)
             _leaf, sib_dev, root_dev = merkle_ops.gather_path(
-                self._merkle_forest.accounts, jnp.uint64(slot), levels
+                self._merkle_forest.pad(kind), jnp.uint64(slot), levels
             )
             siblings = np.asarray(sib_dev)
             root = int(np.asarray(root_dev))
+        if kind == "posted":
+            prow = np.zeros((), merkle_ops.PROOF_POSTED_DTYPE)
+            prow["pending_timestamp"] = lo
+            # One-element readback of the pad's value column at the slot.
+            prow["fulfillment"] = int(np.asarray(
+                table.cols["fulfillment"][slot]
+            ))
+            row_bytes = prow.tobytes()
         if _obs.enabled:
             _obs.counter("merkle.proofs").inc()
         return merkle_ops.encode_proof(
-            rows[0].tobytes(), slot, siblings, root
+            row_bytes, slot, siblings, root, kind=kind
         )
 
-    def _canon_proof_path(self, lo: np.uint64, hi: np.uint64):
+    def _canon_proof_path(self, lo: np.uint64, hi: np.uint64,
+                          pad_name: str = "accounts"):
         """Proof path from a cached host-side tree over the canonical
-        accounts layout (sharded mode: the live per-shard subtrees commit
-        to the sharded layout; proofs and checkpoints anchor to the
-        canonical one).  The cached heap is invalidated with the
-        canonical view itself."""
+        layout of ``pad_name`` (sharded mode: the live per-shard subtrees
+        commit to the sharded layout; proofs and checkpoints anchor to
+        the canonical one).  The cached heaps — one per pad, built
+        lazily — are invalidated with the canonical view itself.
+        Returns (slot, siblings, root), or None when the key is absent."""
         canon = self._query_ledger()
         cached = self._canon_tree
         if cached is None or cached[0] is not canon:
+            self._canon_tree = cached = (canon, {})
+        table = getattr(canon, pad_name)
+        nodes = cached[1].get(pad_name)
+        if nodes is None:
             nodes = merkle_ops.np_tree(
-                merkle_ops.np_table_leaves(canon.accounts, "accounts")
+                merkle_ops.np_table_leaves(table, pad_name)
             )
-            self._canon_tree = cached = (canon, nodes)
-        nodes = cached[1]
+            cached[1][pad_name] = nodes
         cap = len(nodes) // 2
-        key_lo = np.asarray(canon.accounts.key_lo)
-        key_hi = np.asarray(canon.accounts.key_hi)
+        key_lo = np.asarray(table.key_lo)
+        key_hi = np.asarray(table.key_hi)
+        tomb = np.asarray(table.tombstone)
         slot = int(scrub_ops.mix64_np(
             np.asarray([lo]), np.asarray([hi])
         )[0]) & (cap - 1)
+        probes = 0
         while not (key_lo[slot] == lo and key_hi[slot] == hi):
             if key_lo[slot] == 0 and key_hi[slot] == 0 and not bool(
-                np.asarray(canon.accounts.tombstone)[slot]
+                tomb[slot]
             ):
-                raise RuntimeError("account vanished during proof probe")
+                return None  # absent from the canonical pad
             slot = (slot + 1) & (cap - 1)
+            probes += 1
+            if probes > cap:
+                return None
         idx = cap + slot
         siblings = np.empty(max(0, cap.bit_length() - 1), np.uint64)
         for level in range(len(siblings)):
@@ -1563,6 +1661,17 @@ class TpuStateMachine:
             self.ledger, codes_f = self._shard_steps["fast"](
                 self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
             )
+            if self.pipeline_depth > 1 or self.group_device_commit:
+                # The async sharded engine dispatches the PROBED sharded
+                # step — deferred at depth >= 2 AND blocking grouped runs
+                # (commit_group_fast routes through it at any depth); a
+                # client must never pay its compile mid-request.  Batch
+                # is not donated, so the cached zero template is safe.
+                r = self._shard_steps["fast_probed"](
+                    self.ledger, soa_t, jnp.uint64(0), jnp.uint64(1)
+                )
+                self.ledger = r[0]
+                np.asarray(r[1]), np.asarray(r[2])
             step = self._shard_steps[
                 "full_waves" if self.waves_enabled else "full"
             ]
@@ -1999,13 +2108,15 @@ class TpuStateMachine:
         )
 
     def _note_shard_inserts(self, which: str, batch: np.ndarray,
-                            count: int) -> None:
+                            count: int):
         """Advance the per-shard attempted-insert bound for ``which`` by
         this batch's id owners (over-approximation, like the global
         bounds: rejected lanes still count).  Called BEFORE the growth
-        decision, mirroring the global bound+count discipline."""
+        decision, mirroring the global bound+count discipline.  Returns
+        the per-shard owner counts (None off the mesh) — the deferred
+        dispatch path records them as pipeline.shard.* lane occupancy."""
         if self._shard_mesh is None or count == 0:
-            return
+            return None
         from .ops.scrub import mix64_np
 
         owners = (
@@ -2014,9 +2125,9 @@ class TpuStateMachine:
                 batch["id_hi"][:count].astype(np.uint64),
             ) & np.uint64(self.shards - 1)
         ).astype(np.int64)
-        self._shard_insert_bounds[which] += np.bincount(
-            owners, minlength=self.shards
-        )
+        counts = np.bincount(owners, minlength=self.shards)
+        self._shard_insert_bounds[which] += counts
+        return counts
 
     def _refresh_shard_bounds(self, ledger) -> None:
         """Re-floor the per-shard bounds at the actual live per-shard
@@ -2252,10 +2363,6 @@ class TpuStateMachine:
             not self.group_device_commit
             or self._engine is not None
             or self.force_sequential
-            # Sharded mode commits through the blocking per-batch shard_map
-            # dispatch (per-shard lanes ARE the parallelism lever there);
-            # grouped/deferred stacking over the mesh is future work.
-            or self._shard_mesh is not None
             or not (2 <= len(batches) <= self.GROUP_K)
         ):
             return None
@@ -2278,6 +2385,13 @@ class TpuStateMachine:
             # Replay/backup parity with commit_batch's clock catch-up.
             self.prepare_timestamp = timestamps[-1]
         self._scrub_maybe_check()  # no-op unless armed, due, and lane idle
+        if self._ledger_is_sharded:
+            # Grouped stacking over the mesh (docs/sharding.md
+            # composition): K per-batch shard_map dispatches inside ONE
+            # lane closure, ONE deferred readback for the whole run.
+            return self._commit_group_fast_sharded(
+                batches, timestamps, counts, deferred
+            )
         k = len(batches)
         stacked, stage = self._stage_group(batches)
         cnt = jnp.asarray(
@@ -2328,12 +2442,84 @@ class TpuStateMachine:
             # forest needs no retention (a mismatch escalates to the
             # durable-state rebuild instead).
             batches=list(batches) if armed_mirror else None,
+            deferred=deferred,
         )
+        if deferred:
+            self._deferred_submitted(sum(counts))
         if armed:
             self._inflight_handles.append(handle)
         if deferred:
             return handle
         return handle.resolve()  # ONE D2H for the whole group
+
+    def _commit_group_fast_sharded(self, batches, timestamps, counts,
+                                   deferred):
+        """Grouped/deferred commit stacking over the mesh (the async
+        sharded engine, docs/sharding.md composition section): the run's
+        batches are staged H2D on the serving thread, then ONE dispatch-
+        lane closure drives the cached ``sharded.machine_steps``
+        fast_probed program once per batch — per-batch shard_map dispatch
+        (the scan-grouped single-device program would re-trace per mesh
+        layout; the per-shard lanes are the parallelism lever here) with
+        the ledger chain threaded through, growth snapshotted at submit,
+        and ONE deferred D2H readback (codes + per-shard overflow lanes)
+        for the whole run.  Results are bit-identical to committing the
+        run batch by batch through the blocking sharded fast path."""
+        k = len(batches)
+        total = 0
+        owner_sum = np.zeros(max(self.shards, 1), np.int64)
+        for b, c in zip(batches, counts):
+            self._note_cross_shard(b, c)
+            owners = self._note_shard_inserts("transfers", b, c)
+            if owners is not None:
+                owner_sum += owners
+            total += c
+        soas = [self._pad_soa(b) for b in batches]  # serving-thread staging
+        cnts = [jnp.uint64(c) for c in counts]
+        tss = [jnp.uint64(t) for t in timestamps]
+        # Submit-time growth snapshot (see commit_group_fast / the
+        # shard_bounds note in _grow_if_needed).
+        need = self._transfers_bound + total
+        self._transfers_bound += total
+        snap = {name: v.copy()
+                for name, v in self._shard_insert_bounds.items()}
+        step = self._shard_steps["fast_probed"]
+
+        def dispatch():
+            self._grow_if_needed(transfers_need=need, shard_bounds=snap)
+            codes_out, ovf_out = [], []
+            for j in range(k):
+                self.ledger, codes, overflow = step(
+                    self.ledger, soas[j], cnts[j], tss[j]
+                )
+                self._index_append_device(
+                    soas[j]["id_lo"], soas[j]["id_hi"], codes, counts[j]
+                )
+                if self._merkle_forest is not None:
+                    self._merkle_update_transfers_batches([batches[j]])
+                codes_out.append(codes)
+                ovf_out.append(overflow)
+            if _obs.enabled:
+                _obs.counter("sharding.batches").inc(k)
+            return tuple(codes_out), tuple(ovf_out)
+
+        armed_mirror = self._scrub_mirror is not None
+        armed = armed_mirror or self._merkle_forest is not None
+        result = self._dispatch_lane().submit(dispatch) if deferred else (
+            dispatch()
+        )
+        handle = DeviceCommitHandle(
+            self, result, list(counts), list(timestamps), stacked=True,
+            batches=list(batches) if armed_mirror else None,
+            deferred=deferred,
+        )
+        if deferred:
+            self._deferred_submitted(total, owner_sum)
+        if armed:
+            self._inflight_handles.append(handle)
+        if deferred:
+            return handle
+        return handle.resolve()  # ONE D2H for the whole run
 
     def _commit_fast(
         self, batch: np.ndarray, timestamp: int, count: int
@@ -2368,12 +2554,13 @@ class TpuStateMachine:
         body, same codes, same bookkeeping — only the readback timing
         moves: the probed kernel variant carries the overflow flag in a
         fresh output buffer so resolve() works even after a later dispatch
-        donated this ledger (see sm.create_transfers_fast_probed)."""
+        donated this ledger (see sm.create_transfers_fast_probed; under
+        TB_SHARDS the sharded fast_probed step plays the same role with
+        per-shard overflow lanes)."""
         count = len(batch)
         if (
             self._engine is not None
             or self.force_sequential
-            or self._shard_mesh is not None  # see commit_group_fast
             or count == 0
             or count > self.batch_lanes
         ):
@@ -2395,36 +2582,61 @@ class TpuStateMachine:
             _obs.histogram("ops.batch_fill_pct", "%").observe(
                 100 * count // self.batch_lanes
             )
+        owners = None
+        if self._ledger_is_sharded:
+            self._note_cross_shard(batch, count)
+            owners = self._note_shard_inserts("transfers", batch, count)
         soa = self._pad_soa(batch)  # staged on the serving thread
         cnt, ts = jnp.uint64(count), jnp.uint64(timestamp)
         # Snapshot the growth target pre-submit (see _grow_if_needed).
         need = self._transfers_bound + count
         self._transfers_bound += count
+        if self._ledger_is_sharded:
+            snap = {name: v.copy()
+                    for name, v in self._shard_insert_bounds.items()}
+            step = self._shard_steps["fast_probed"]
 
-        def dispatch():
-            self._grow_if_needed(transfers_need=need)
-            # The probed kernel donates BOTH the ledger and the staged SoA
-            # (the pad columns become scratch instead of pinned inputs);
-            # index maintenance uses the passed-through id columns — the
-            # donated ``soa`` dict must not be touched after this call.
-            self.ledger, codes, overflow, id_lo, id_hi = (
-                sm.create_transfers_fast_probed(self.ledger, soa, cnt, ts)
-            )
-            self._index_append_device(id_lo, id_hi, codes, count)
-            if self._merkle_forest is not None:
-                # Commitment update rides the ledger chain; keys come
-                # from the retained HOST batch (the staged SoA was
-                # donated above).
-                self._merkle_update_transfers_batches([batch])
-            return codes, overflow
+            def dispatch():
+                # The sharded probed step donates only the ledger (the
+                # replicated batch may alias pooled host buffers); the
+                # overflow lanes ride a fresh output.
+                self._grow_if_needed(transfers_need=need, shard_bounds=snap)
+                self.ledger, codes, overflow = step(self.ledger, soa, cnt, ts)
+                self._index_append_device(
+                    soa["id_lo"], soa["id_hi"], codes, count
+                )
+                if self._merkle_forest is not None:
+                    self._merkle_update_transfers_batches([batch])
+                if _obs.enabled:
+                    _obs.counter("sharding.batches").inc()
+                return codes, overflow
+        else:
+            def dispatch():
+                self._grow_if_needed(transfers_need=need)
+                # The probed kernel donates BOTH the ledger and the staged
+                # SoA (the pad columns become scratch instead of pinned
+                # inputs); index maintenance uses the passed-through id
+                # columns — the donated ``soa`` dict must not be touched
+                # after this call.
+                self.ledger, codes, overflow, id_lo, id_hi = (
+                    sm.create_transfers_fast_probed(self.ledger, soa, cnt, ts)
+                )
+                self._index_append_device(id_lo, id_hi, codes, count)
+                if self._merkle_forest is not None:
+                    # Commitment update rides the ledger chain; keys come
+                    # from the retained HOST batch (the staged SoA was
+                    # donated above).
+                    self._merkle_update_transfers_batches([batch])
+                return codes, overflow
 
         armed_mirror = self._scrub_mirror is not None
         armed = armed_mirror or self._merkle_forest is not None
         fut = self._dispatch_lane().submit(dispatch)
         handle = DeviceCommitHandle(
             self, fut, [count], [timestamp], stacked=False,
-            batches=[batch] if armed_mirror else None,
+            batches=[batch] if armed_mirror else None, deferred=True,
         )
+        self._deferred_submitted(count, owners)
         if armed:
             self._inflight_handles.append(handle)
         return handle
@@ -2569,14 +2781,21 @@ class TpuStateMachine:
             capacity *= 2
         return capacity
 
-    def _shard_peak_floor(self, which: str, cap: int) -> int:
+    def _shard_peak_floor(self, which: str, cap: int, bounds=None) -> int:
         """Under sharding, capacity must also keep the PEAK shard's
         attempted-insert bound under half its cap/n local region — the
         per-shard twin of the global load<=0.5 policy (hash skew can
         overfill one shard while the global count looks fine, and a
-        fast-path probe overflow is fatal)."""
-        if self._ledger_is_sharded and which in self._shard_insert_bounds:
-            peak = int(self._shard_insert_bounds[which].max())
+        fast-path probe overflow is fatal).
+
+        ``bounds`` overrides the live per-shard bounds: deferred dispatch
+        closures pass a submit-time snapshot so the growth moment never
+        depends on how far the serving thread raced ahead (the sharded
+        twin of the transfers_need snapshot)."""
+        if bounds is None:
+            bounds = self._shard_insert_bounds
+        if self._ledger_is_sharded and which in bounds:
+            peak = int(bounds[which].max())
             while peak * 2 > cap // self.shards:
                 cap *= 2
         return cap
@@ -2604,7 +2823,7 @@ class TpuStateMachine:
     def _grow_if_needed(
         self, accounts: int = 0, transfers: int = 0, posted: int = 0,
         history: int = 0, evict_ok: bool = True,
-        transfers_need: Optional[int] = None,
+        transfers_need: Optional[int] = None, shard_bounds=None,
     ) -> None:
         """Keep every table's load factor under 0.5 using host-side row
         bounds (no device sync; bounds only overestimate).
@@ -2612,11 +2831,13 @@ class TpuStateMachine:
         ``transfers_need``: an explicit row target snapshotted by the
         caller — the deferred dispatch closures run on the lane thread
         while the serving thread keeps advancing _transfers_bound, so a
-        live read here would make the growth moment timing-dependent."""
+        live read here would make the growth moment timing-dependent.
+        ``shard_bounds`` is the per-shard twin (a submit-time snapshot of
+        _shard_insert_bounds) for the same reason."""
         led = self.ledger
         cap = self._shard_peak_floor("accounts", self._target_capacity(
             led.accounts.capacity, self._accounts_bound + accounts
-        ))
+        ), bounds=shard_bounds)
         if cap != led.accounts.capacity:
             led = led.replace(
                 accounts=self._table_grow(led.accounts, "accounts", cap)
@@ -2625,7 +2846,7 @@ class TpuStateMachine:
             led.transfers.capacity,
             transfers_need if transfers_need is not None
             else self._transfers_bound + transfers,
-        ))
+        ), bounds=shard_bounds)
         if cap != led.transfers.capacity:
             hot_max = self.hot_transfers_capacity_max
             if hot_max is not None and cap > hot_max and (

@@ -377,14 +377,35 @@ def np_account_leaf(row: np.void) -> int:
     """Leaf value from one wire ACCOUNT_DTYPE row (the verifier side of a
     proof: the client holds the row bytes and the root, nothing else)."""
     cols = {
-        name: np.asarray([row[name]]).astype(
-            np.uint64 if name != "fulfillment" else np.uint32
-        )
+        name: np.asarray([row[name]]).astype(np.uint64)
         for name in _LEAF_COLS["accounts"]
     }
     lo = np.asarray([row["id_lo"]], np.uint64)
     hi = np.asarray([row["id_hi"]], np.uint64)
     return int(np_leaves(lo, hi, cols, "accounts")[0])
+
+
+def np_transfer_leaf(row: np.void) -> int:
+    """Leaf value from one wire TRANSFER_DTYPE row (transfer proofs)."""
+    cols = {
+        name: np.asarray([row[name]]).astype(np.uint64)
+        for name in _LEAF_COLS["transfers"]
+    }
+    lo = np.asarray([row["id_lo"]], np.uint64)
+    hi = np.asarray([row["id_hi"]], np.uint64)
+    return int(np_leaves(lo, hi, cols, "transfers")[0])
+
+
+def np_posted_leaf(row: np.void) -> int:
+    """Leaf value from one PROOF_POSTED_DTYPE row: the posted pad is
+    keyed by the pending transfer's timestamp, its one value column the
+    fulfillment word (1 = posted, 2 = voided)."""
+    cols = {
+        "fulfillment": np.asarray([row["fulfillment"]]).astype(np.uint32)
+    }
+    lo = np.asarray([row["pending_timestamp"]], np.uint64)
+    hi = np.zeros(1, np.uint64)
+    return int(np_leaves(lo, hi, cols, "posted")[0])
 
 
 # ---------------------------------------------------------------------------
@@ -397,55 +418,121 @@ PROOF_VERSION = 1
 PROOF_HEADER_DTYPE = np.dtype([
     ("magic", "<u4"),
     ("version", "<u4"),
-    ("slot", "<u8"),          # leaf slot in the (canonical) accounts pad
+    ("slot", "<u8"),          # leaf slot in the (canonical) pad
     ("n_siblings", "<u4"),    # log2(capacity)
-    ("reserved", "<u4"),
-    ("root", "<u8"),          # the accounts commitment the path folds to
+    ("kind", "<u4"),          # PROOF_KINDS (was reserved=0 == accounts)
+    ("root", "<u8"),          # the pad commitment the path folds to
 ])
+
+# Which pad a proof anchors to.  Kind 0 keeps the PR 10 wire bytes
+# (the field was reserved-as-zero), so old account proofs still verify.
+PROOF_KINDS = {"accounts": 0, "transfers": 1, "posted": 2}
+_PROOF_KIND_NAMES = {v: k for k, v in PROOF_KINDS.items()}
+
+# The posted pad has no wire dtype: a proof row is the pad's content —
+# the key (the pending transfer's timestamp; bind it to a pending id via
+# that transfer's OWN proof, whose row carries id + timestamp) and the
+# fulfillment word.
+PROOF_POSTED_DTYPE = np.dtype([
+    ("pending_timestamp", "<u8"),
+    ("fulfillment", "<u4"),
+    ("reserved", "<u4"),
+])
+
+_PROOF_LEAF = {
+    "accounts": np_account_leaf,
+    "transfers": np_transfer_leaf,
+    "posted": np_posted_leaf,
+}
+
+# Row columns the leaf hash actually covers (the scrub-fold columns,
+# _LEAF_COLS + the key).  A proof row carries ONLY these: every other
+# column is zeroed at encode and PINNED to zero at verify — a byte the
+# fold does not authenticate must not ride a blob that claims
+# "reject-any-tampered-byte", or a MITM could rewrite it (e.g. a
+# transfer's debit/credit accounts) inside a "verified" proof.
+_PROOF_AUTH_COLS = {
+    "accounts": ("id_lo", "id_hi") + _LEAF_COLS["accounts"],
+    "transfers": ("id_lo", "id_hi") + _LEAF_COLS["transfers"],
+    "posted": ("pending_timestamp", "fulfillment"),
+}
+
+
+def canonical_proof_row(row: np.void, kind: str) -> np.ndarray:
+    """The committed projection of ``row``: leaf-covered columns kept,
+    everything else zero.  Both the prover (encode) and the verifier
+    (check_proof rejects non-canonical rows) use this."""
+    out = np.zeros((), proof_row_dtype(kind))
+    for name in _PROOF_AUTH_COLS[kind]:
+        out[name] = row[name]
+    return out
+
+
+def proof_row_dtype(kind: str) -> np.dtype:
+    from .. import types
+
+    return {
+        "accounts": types.ACCOUNT_DTYPE,
+        "transfers": types.TRANSFER_DTYPE,
+        "posted": PROOF_POSTED_DTYPE,
+    }[kind]
 
 
 class ProofError(ValueError):
     """Malformed or non-verifying Merkle proof."""
 
 
-def encode_proof(row_bytes: bytes, slot: int, siblings, root: int) -> bytes:
+def encode_proof(row_bytes: bytes, slot: int, siblings, root: int,
+                 kind: str = "accounts") -> bytes:
     head = np.zeros((), PROOF_HEADER_DTYPE)
     head["magic"] = PROOF_MAGIC
     head["version"] = PROOF_VERSION
     head["slot"] = slot
     head["n_siblings"] = len(siblings)
+    head["kind"] = PROOF_KINDS[kind]
     head["root"] = np.uint64(root & U64_MASK)
     sib = np.asarray(siblings, np.uint64)
-    return head.tobytes() + bytes(row_bytes) + sib.tobytes()
+    row = np.frombuffer(bytes(row_bytes), proof_row_dtype(kind))[0]
+    return head.tobytes() + canonical_proof_row(row, kind).tobytes() \
+        + sib.tobytes()
 
 
 def check_proof(blob: bytes) -> dict:
     """Parse AND verify a proof; raises ProofError unless the row's leaf
     folds through the sibling path to the stated root.  Returns
-    {account (np row), root, slot, siblings}."""
-    from .. import types
-
+    {kind, row (np row of proof_row_dtype(kind)), root, slot, siblings};
+    account proofs also keep the legacy ``account`` key."""
     head_size = PROOF_HEADER_DTYPE.itemsize
-    row_size = types.ACCOUNT_DTYPE.itemsize
-    if len(blob) < head_size + row_size:
+    if len(blob) < head_size:
         raise ProofError("proof truncated")
     head = np.frombuffer(blob[:head_size], PROOF_HEADER_DTYPE)[0]
     if int(head["magic"]) != PROOF_MAGIC:
         raise ProofError("bad proof magic")
     if int(head["version"]) != PROOF_VERSION:
         raise ProofError(f"unsupported proof version {int(head['version'])}")
+    kind = _PROOF_KIND_NAMES.get(int(head["kind"]))
+    if kind is None:
+        raise ProofError(f"unknown proof kind {int(head['kind'])}")
+    row_size = proof_row_dtype(kind).itemsize
     n_sib = int(head["n_siblings"])
     want = head_size + row_size + 8 * n_sib
     if len(blob) != want:
         raise ProofError(f"proof size {len(blob)} != expected {want}")
     row = np.frombuffer(
-        blob[head_size:head_size + row_size], types.ACCOUNT_DTYPE
+        blob[head_size:head_size + row_size], proof_row_dtype(kind)
     )[0]
+    if canonical_proof_row(row, kind).tobytes() != blob[
+        head_size:head_size + row_size
+    ]:
+        # A nonzero byte in a column the leaf hash does not cover: the
+        # fold below could not detect it, so canonical form is enforced
+        # instead — every blob byte is hash-bound or pinned to zero.
+        raise ProofError("proof row carries unauthenticated nonzero bytes")
     siblings = np.frombuffer(blob[head_size + row_size:], "<u8")
     pos = int(head["slot"])
     if n_sib and pos >> n_sib:
         raise ProofError("slot out of range for the stated tree depth")
-    node = np.uint64(np_account_leaf(row))
+    node = np.uint64(_PROOF_LEAF[kind](row))
     for level in range(n_sib):
         sib = np.uint64(siblings[level])
         if (pos >> level) & 1:
@@ -457,12 +544,16 @@ def check_proof(blob: bytes) -> dict:
             f"proof does not fold to root: {int(node):#x} != "
             f"{int(head['root']):#x}"
         )
-    return {
-        "account": row,
+    out = {
+        "kind": kind,
+        "row": row,
         "root": int(head["root"]),
         "slot": int(head["slot"]),
         "siblings": [int(s) for s in siblings],
     }
+    if kind == "accounts":
+        out["account"] = row  # legacy key (PR 10 callers)
+    return out
 
 
 def _np_combine(left, right) -> np.uint64:

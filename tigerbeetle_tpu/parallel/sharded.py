@@ -164,12 +164,19 @@ class _ShardGather:
         return {k: jax.lax.psum(v, AXIS) for k, v in local.items()}
 
 
-def sharded_create_transfers(mesh: Mesh):
+def sharded_create_transfers(mesh: Mesh, probed: bool = False):
     """Build the jitted sharded create_transfers step for ``mesh``.
 
     Returns fn(ledger, batch, count, timestamp) -> (ledger, codes), with the
     ledger sharded per make_sharded_ledger and batch/count/timestamp
-    replicated."""
+    replicated.
+
+    ``probed`` (STATIC) additionally returns the per-shard transfers
+    probe_overflow lanes widened into a FRESH uint32[n_shards] output —
+    the sharded twin of sm.create_transfers_fast_probed: a deferred
+    readback handle must be able to fetch the overflow flag after a later
+    dispatch on the FIFO lane has donated this ledger, and riding the
+    codes readback it costs zero extra syncs (docs/commit_pipeline.md)."""
     n_shards = mesh.devices.size
     shift = n_shards.bit_length() - 1
 
@@ -228,14 +235,22 @@ def sharded_create_transfers(mesh: Mesh):
             ok & ex_g.owner_mask, rows, MAX_PROBE, hash_shift=shift,
         )
 
-        return ledger.replace(accounts=accounts, transfers=transfers), codes
+        out = ledger.replace(accounts=accounts, transfers=transfers)
+        if probed:
+            # Fresh (non-aliasing) per-shard overflow lanes: local (1,)
+            # widens to the global uint32[n_shards] vector.
+            return out, codes, transfers.probe_overflow.astype(jnp.uint32)
+        return out, codes
 
     def step(ledger, batch, count, timestamp):
+        out_specs = (_specs_like(ledger), P())
+        if probed:
+            out_specs = out_specs + (P(AXIS),)
         return shard_map(
             local_step,
             mesh=mesh,
             in_specs=(_specs_like(ledger), _replicated_like(batch), P(), P()),
-            out_specs=(_specs_like(ledger), P()),
+            out_specs=out_specs,
             # vma-checking is off because ht.lookup's probe while_loop mixes
             # replicated (keys) and shard-varying (table) carry values; the
             # library kernels are backend-agnostic and cannot pvary-annotate.
@@ -971,6 +986,9 @@ def machine_steps(mesh: Mesh, max_passes: int) -> dict:
         steps = {
             "accounts": sharded_create_accounts(mesh),
             "fast": sharded_create_transfers(mesh),
+            # Deferred-dispatch twin (overflow as a fresh output): the
+            # commit-pipeline lane under TB_SHARDS dispatches this one.
+            "fast_probed": sharded_create_transfers(mesh, probed=True),
             "full": sharded_create_transfers_full(mesh, max_passes),
             "full_waves": sharded_create_transfers_full(
                 mesh, max_passes, use_waves=True

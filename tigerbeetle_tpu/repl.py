@@ -181,22 +181,26 @@ def execute_statement(client: Client, statement: str, out=sys.stdout) -> None:
         for row in rows:
             print(_format_row(row, types.TRANSFER_DTYPE.names), file=out)
     elif operation == "get_proof":
-        # Root-anchored Merkle balance proof, verified CLIENT-SIDE before
-        # printing (docs/commitments.md): a forged/tampered reply errors
-        # instead of rendering.
+        # Root-anchored Merkle inclusion proof, verified CLIENT-SIDE
+        # before printing (docs/commitments.md): a forged/tampered reply
+        # errors instead of rendering.  ``kind=accounts|transfers|posted``
+        # selects the pad (default accounts).
+        from .ops.merkle import proof_row_dtype
+
         for obj in objects:
             ident = int(obj["id"], 0)
-            proof = client.get_proof(ident)
+            kind = obj.get("kind", "accounts")
+            proof = client.get_proof(ident, kind=kind)
             if proof is None:
-                print(f"  id={ident}: no proof (absent account or "
+                print(f"  id={ident} kind={kind}: no proof (absent row or "
                       "server runs without merkle commitments)", file=out)
                 continue
             print(
-                f"  id={ident}: VERIFIED against root="
+                f"  id={ident} kind={kind}: VERIFIED against root="
                 f"{proof['root']:#018x} (slot {proof['slot']}, "
                 f"{len(proof['siblings'])} siblings)", file=out,
             )
-            print(_format_row(proof["account"], types.ACCOUNT_DTYPE.names),
+            print(_format_row(proof["row"], proof_row_dtype(kind).names),
                   file=out)
     elif operation in ("get_account_transfers", "get_account_history"):
         body = build_filter(objects).tobytes()

@@ -720,9 +720,10 @@ class Replica:
         barrier.
 
         1. fsync/compute overlap — ops and prepare headers are assigned
-           first, the LEADING device run dispatches, and only then are the
+           first, the LEADING device runs dispatch (the whole prefix up
+           to the first non-deferrable op), and only then are the
            group's WAL writes + fsync issued: the journal IO of group N
-           runs while group N's device dispatch is in flight.  Safe: the
+           runs while group N's device dispatches are in flight.  Safe: the
            device ledger is volatile (durable state only moves at
            checkpoints, which settle the pipeline first), and no reply is
            released before both the fsync and the execution — a crash in
@@ -783,11 +784,23 @@ class Replica:
             if _obs.enabled:
                 _obs.gauge("pipeline.depth").set(self.pipeline_depth)
                 _obs.counter("pipeline.groups").inc()
-            lead = runs.get(0)
-            if lead is not None:
-                handle = self._dispatch_run(lead)
-                if handle is not None:
-                    self._pipeline_track(lead, handle, result_bodies, skip)
+            # The LEADING PREFIX of device runs — every run up to the
+            # first non-deferrable op — dispatches here, before the WAL
+            # writes and before the previous group's readbacks come due:
+            # while the serving thread sits in group N-1's resolves
+            # (15 ms apiece through a remote tunnel), the lane executes
+            # ALL of group N's prefix, not just its first run.  Op order
+            # is preserved: only consecutive leading runs dispatch early
+            # (a run past a non-deferrable op still dispatches at its own
+            # position in phase A, after that op's barrier drain).
+            j = 0
+            while j in runs:
+                run = runs[j]
+                handle = self._dispatch_run(run)
+                if handle is None:
+                    break  # refused: its ops execute inline in phase A
+                self._pipeline_track(run, handle, result_bodies, skip)
+                j += len(run)
         finally:
             for message in messages:
                 self.journal.write_prepare(message, sync=False)
@@ -797,6 +810,11 @@ class Replica:
         def drain(reason: str) -> None:
             if inflight and _obs.enabled:
                 _obs.counter(f"pipeline.stall.{reason}").inc()
+                if self.machine.shards:
+                    # Per-shard commit-lane stall twin: every shard's lane
+                    # drains together (replicated dispatch), so one series
+                    # covers the mesh (docs/observability.md).
+                    _obs.counter(f"pipeline.shard.stall.{reason}").inc()
             while inflight:
                 self._pipeline_retire()
 
@@ -1240,8 +1258,14 @@ class Replica:
             ids = _decode_ids(body)
             return self.machine.lookup_transfers(ids).tobytes()
         if operation == wire.Operation.get_proof:
-            ids = _decode_ids(body)
-            proof = self.machine.get_proof(ids[0]) if ids else None
+            # Body: one u128 id (accounts, the PR 10 wire shape) or
+            # id + a u64 kind selector (0 accounts / 1 transfers /
+            # 2 posted) — validated in _validate_request.
+            lanes = np.frombuffer(body, dtype="<u8")
+            ident = int(lanes[0]) | (int(lanes[1]) << 64)
+            kind = _PROOF_KIND_BY_CODE[int(lanes[2])] if len(lanes) > 2 \
+                else "accounts"
+            proof = self.machine.get_proof(ident, kind=kind)
             return proof if proof is not None else b""
         if operation in (
             wire.Operation.get_account_transfers,
@@ -1296,8 +1320,17 @@ class Replica:
             # state_machine.zig:810-820).
             return
         if operation == wire.Operation.get_proof:
-            if len(body) != 16:
-                raise InvalidRequest("get_proof body must be one u128 id")
+            # 16 B: one u128 id (accounts — PR 10 shape); 24 B: id + u64
+            # kind selector.  Every journaled prepare must replay, so the
+            # kind is validated HERE, not at execute.
+            if len(body) not in (16, 24):
+                raise InvalidRequest(
+                    "get_proof body must be one u128 id (+ u64 kind)"
+                )
+            if len(body) == 24:
+                kind = int(np.frombuffer(body[16:], "<u8")[0])
+                if kind not in _PROOF_KIND_BY_CODE:
+                    raise InvalidRequest(f"unknown proof kind {kind}")
             return
         raise InvalidRequest(f"operation {operation!r} not accepted")
 
@@ -1812,6 +1845,9 @@ _OP_NAMES = {
     wire.Operation.create_accounts: "create_accounts",
     wire.Operation.create_transfers: "create_transfers",
 }
+
+# Wire kind selector for get_proof (ops/merkle.py PROOF_KINDS).
+_PROOF_KIND_BY_CODE = {0: "accounts", 1: "transfers", 2: "posted"}
 
 
 def _encode_results(results: List[Tuple[int, int]]) -> bytes:

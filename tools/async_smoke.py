@@ -1,0 +1,138 @@
+"""CI async-sharded smoke: prove the composed TB_PIPELINE x TB_SHARDS
+commit engine end to end (docs/commit_pipeline.md + docs/sharding.md
+composition sections).
+
+In-process (CPU-pinned, 8 virtual devices), two proofs with asserted
+artifacts:
+
+1. COMPOSED IDENTITY — the pipeline bench's pinned workload (the same
+   one tools/pipeline_smoke.py and tools/sharded_smoke.py anchor to)
+   replayed under TB_SHARDS=2 at depths {1, 2, 4} must reproduce the
+   replies_sha AND ledger digest recorded in PIPELINE_SMOKE.json (cross-
+   checked against SHARDED_SMOKE.json's off-path pin): grouped/deferred
+   commit stacking over the mesh is performance-only at every
+   (depth x shard) point.
+2. OCCUPANCY COUNTERS — the depth-2 sharded run with the metrics
+   registry enabled must land the pipeline.shard.* series (dispatches ==
+   resolves, the inflight histogram, total + per-shard lane counters) in
+   METRICS.json, so BENCH_r11+ can read the composition forensics the
+   docs describe.
+
+Artifacts: ASYNC_SMOKE.json (summary) + METRICS.json at the repo root;
+the ``async`` tier in tools/ci.py records pass/fail in CI_LAST.json.
+
+Usage: python tools/async_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["TB_SHARDS"] = "2"
+    from tigerbeetle_tpu import jaxenv
+
+    jaxenv.enable_compile_cache()
+    jaxenv.force_cpu(8)
+
+    from tigerbeetle_tpu.obs.metrics import registry
+
+    import bench
+
+    with open(os.path.join(REPO, "PIPELINE_SMOKE.json")) as f:
+        pinned = json.load(f)["identity"]
+    with open(os.path.join(REPO, "SHARDED_SMOKE.json")) as f:
+        sharded_pin = json.load(f)["off_path"]
+    assert sharded_pin["replies_sha"] == pinned["replies_sha"], (
+        "PIPELINE_SMOKE and SHARDED_SMOKE disagree about the pinned "
+        "workload — regenerate both before the async tier"
+    )
+
+    summary: dict = {
+        "pinned_replies_sha": pinned["replies_sha"],
+        "pinned_digest": pinned["digest"],
+        "entries": {},
+    }
+
+    def check(depth, entry):
+        assert entry["replies_sha"] == pinned["replies_sha"], (
+            f"TB_SHARDS=2 depth={depth} reply stream diverged from the "
+            f"pinned identity: {entry['replies_sha']} != "
+            f"{pinned['replies_sha']}"
+        )
+        assert entry["digest"] == pinned["digest"], (
+            f"TB_SHARDS=2 depth={depth} ledger digest diverged from the "
+            "pinned identity"
+        )
+        summary["entries"][str(depth)] = {
+            "tx_s": entry["tx_s"], "p50_ms": entry["p50_ms"],
+            "pipeline": entry.get("pipeline"),
+        }
+
+    # 1. COMPOSED IDENTITY at depths 1 and 4 (blocking and deferred). ----
+    for depth in (1, 4):
+        check(depth, bench.run_pipeline_bench(depth))
+
+    # 2. Depth 2 runs with the registry armed: identity AND counters. ----
+    registry.reset()
+    registry.enable()
+    try:
+        entry2 = bench.run_pipeline_bench(2)
+        snap = registry.snapshot()
+        metrics_path = os.path.join(REPO, "METRICS.json")
+        registry.dump(metrics_path)
+    finally:
+        registry.reset()
+        registry.disable()
+    check(2, entry2)
+
+    counters = snap["counters"]
+    hists = snap["histograms"]
+    assert counters.get("pipeline.shard.dispatches", 0) > 0, sorted(
+        k for k in counters if k.startswith("pipeline")
+    )
+    assert counters["pipeline.shard.resolves"] == counters[
+        "pipeline.shard.dispatches"
+    ]
+    assert counters.get("pipeline.shard.lanes", 0) > 0
+    per_shard = {
+        k: v for k, v in counters.items()
+        if k.startswith("pipeline.shard.lanes.")
+    }
+    assert per_shard and sum(per_shard.values()) == counters[
+        "pipeline.shard.lanes"
+    ], per_shard
+    assert "pipeline.shard.inflight" in hists, sorted(hists)
+    with open(metrics_path) as f:
+        dumped = json.load(f)
+    assert "pipeline.shard.dispatches" in dumped.get("counters", {}), (
+        "pipeline.shard counters missing from METRICS.json"
+    )
+    summary["counters"] = {
+        "shard_dispatches": counters["pipeline.shard.dispatches"],
+        "shard_resolves": counters["pipeline.shard.resolves"],
+        "shard_lanes": counters["pipeline.shard.lanes"],
+        "shard_lanes_per_shard": per_shard,
+        "shard_inflight_max": hists["pipeline.shard.inflight"].get("max"),
+        "shard_stalls": {
+            k: v for k, v in counters.items()
+            if k.startswith("pipeline.shard.stall.")
+        },
+    }
+
+    summary["green"] = True
+    with open(os.path.join(REPO, "ASYNC_SMOKE.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

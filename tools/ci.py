@@ -73,7 +73,8 @@ TIERS = {
             "tests/test_queries.py", "tests/test_scan_builder.py",
             "tests/test_sharded.py", "tests/test_sharded_machine.py",
             "tests/test_group_commit.py", "tests/test_merkle.py",
-            "tests/test_pipeline.py", "tests/test_waves.py",
+            "tests/test_pipeline.py", "tests/test_async_sharded.py",
+            "tests/test_waves.py",
             "tests/test_host_engine.py", "tests/test_cold_tier.py",
         ],
         extra=["-m", "not slow"],
@@ -145,6 +146,15 @@ TIERS = {
         # asserted in METRICS.json.  Artifact: MERKLE_SMOKE.json.
         cmd=["tools/merkle_smoke.py"],
     ),
+    "async": dict(
+        # Async sharded commit engine smoke (docs/commit_pipeline.md +
+        # docs/sharding.md composition): the pinned pipeline workload
+        # replayed under TB_SHARDS=2 at depths {1,2,4} must reproduce
+        # PIPELINE_SMOKE/SHARDED_SMOKE's pinned replies_sha + digest,
+        # and the pipeline.shard.* occupancy counters must land in
+        # METRICS.json.  Artifact: ASYNC_SMOKE.json at the repo root.
+        cmd=["tools/async_smoke.py"],
+    ),
     "byzantine": dict(
         # Byzantine fault domain smoke (docs/fault_domains.md): pinned
         # seed with one equivocating/corrupting/lying replica of six
@@ -181,6 +191,16 @@ TIERS = {
             "tests/test_sharded_machine.py::TestShardedDifferential",
             "tests/test_sharded_machine.py::TestShardedStructural",
             "tests/test_sharded_machine.py::TestVoprSharded",
+            # Async sharded commit engine (PR 11): the composed
+            # depth x shard x merkle matrix, the grouped/deferred mesh
+            # differentials, the pipeline.shard.* metrics proof, and the
+            # pinned VOPR seed under TB_PIPELINE=2 x TB_SHARDS=2 — all
+            # @slow (sharded shard_map compiles), so they run whole here.
+            "tests/test_async_sharded.py::TestMachineComposition",
+            "tests/test_async_sharded.py::test_pipeline_shard_metrics_recorded",
+            "tests/test_async_sharded.py::TestReplicaComposition",
+            "tests/test_async_sharded.py::TestVoprComposed",
+            "tests/test_merkle.py::TestMerkleProofs::test_proof_kinds_sharded",
             "tests/test_block_repair.py::"
             "test_missing_cold_run_repaired_from_peer",
             "tests/test_scan_builder.py::TestCompositions"
@@ -229,8 +249,8 @@ TIERS = {
 }
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
-    "scrub", "merkle", "overload", "waves", "sharded", "byzantine",
-    "integration",
+    "scrub", "merkle", "overload", "waves", "sharded", "async",
+    "byzantine", "integration",
 ]
 
 
