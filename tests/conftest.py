@@ -25,3 +25,27 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 jaxenv.enable_compile_cache()
 
 jaxenv.force_cpu(8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _obs_registry_leak_guard(request):
+    """The process-global obs registry must be DISABLED when every test
+    ends (the PR 10 metrics-registry leak class: a leaked enable() taxes
+    every later test and mixes foreign series into the next snapshot).
+    Cost when clean: one attribute read per test.  On a leak: disable,
+    reset, and fail the offending test — use registry.enabled_scope() or
+    try/finally disable()+reset()."""
+    yield
+    from tigerbeetle_tpu.obs.metrics import registry
+
+    if registry.enabled:
+        registry.disable()
+        registry.reset()
+        pytest.fail(
+            f"{request.node.nodeid} leaked the process-global obs "
+            "registry ENABLED at teardown — wrap enable() in "
+            "registry.enabled_scope() (obs/metrics.py) or try/finally "
+            "disable()+reset()"
+        )

@@ -1,0 +1,44 @@
+"""lane-race fixtures: unlocked closure writes vs locked/suppressed ones
+(basename machine.py puts this file in the rule's scope)."""
+
+import concurrent.futures
+import threading
+
+
+class Machine:
+    def __init__(self):
+        self._lane = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        self._state_lock = threading.Lock()
+        self.ledger = 0
+        self.counter = 0
+        self.guarded = 0
+
+    def commit_deferred(self, batch):
+        def dispatch():
+            self.ledger = self.ledger + batch  # BAD: serving thread reads it
+            self.counter += 1  # BAD: serving thread reads it
+            with self._state_lock:
+                self.guarded += 1  # locked: no finding
+            return self.ledger
+
+        return self._lane.submit(dispatch)
+
+    def commit_suppressed(self, batch):
+        def dispatch():
+            self.ledger = self.ledger + batch  # tblint: ignore[lane-race] FIFO join in resolve()
+            return self.ledger
+
+        return self._lane.submit(dispatch)
+
+    def serving_read(self):
+        total = self.ledger + self.counter
+        with self._state_lock:
+            total += self.guarded
+        return total
+
+    def local_only_closure(self, batch):
+        def dispatch():
+            self._scratch_only_here = batch  # touched nowhere else: clean
+            return batch
+
+        return self._lane.submit(dispatch)

@@ -1,0 +1,52 @@
+"""size-class fixtures: data-dependent jit input shapes and static args
+vs the padded/rounded size-class idiom."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANES = 64
+
+
+def _update_impl(keys, values):
+    return keys + values
+
+
+_update = jax.jit(_update_impl)
+
+
+def _multi_impl(ledger, k):
+    return ledger * k
+
+
+_multi = jax.jit(_multi_impl, static_argnames=("k",))
+
+
+def volatile_shape(batches):
+    n = len(batches)
+    keys = np.zeros(n, np.uint64)  # shape keyed on run length
+    out = _update(keys, keys)  # BAD: fresh program per distinct n
+    return out
+
+
+def volatile_static_arg(ledger, batches):
+    k = len(batches)
+    return _multi(ledger, k)  # BAD: recompile per run length
+
+
+def padded_size_class(self, batches):
+    n = len(batches)
+    lanes = max(1, 1 << (n - 1).bit_length()) if n else 1
+    keys = np.zeros(lanes, np.uint64)  # rounded: stable classes
+    return _update(keys, keys)  # clean: bit_length() rounding
+
+
+def padded_to_config(self, batch):
+    keys = np.zeros(self.batch_lanes, np.uint64)  # config constant
+    return _update(keys, keys)  # clean: attribute-padded
+
+
+def suppressed_volatile_shape(batches):
+    n = len(batches)
+    keys = np.zeros(n, np.uint64)
+    return _update(keys, keys)  # tblint: ignore[size-class] one-shot tool path

@@ -1,0 +1,54 @@
+"""shard-rep fixtures: replicated shard_map outputs with and without the
+required collective."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+AXIS = "shard"
+
+
+def bad_body(table, keys):
+    local = jnp.take(table, keys)
+    return table, local  # per-shard value at a replicated position
+
+
+def bad_step(mesh, table, keys):
+    return shard_map(
+        bad_body,
+        mesh=mesh,
+        in_specs=(P(AXIS), P()),
+        out_specs=(P(AXIS), P()),  # BAD: local never passed through psum
+        check_vma=False,
+    )(table, keys)
+
+
+def good_body(table, keys):
+    local = jnp.take(table, keys)
+    combined = jax.lax.psum(local, AXIS)
+    return table, combined
+
+
+def good_step(mesh, table, keys):
+    return shard_map(
+        good_body,
+        mesh=mesh,
+        in_specs=(P(AXIS), P()),
+        out_specs=(P(AXIS), P()),  # clean: psum makes it replicated
+        check_vma=False,
+    )(table, keys)
+
+
+def suppressed_body(table, keys):
+    local = jnp.take(table, keys)
+    return table, local  # tblint: ignore[shard-rep] uniform by construction
+
+
+def suppressed_step(mesh, table, keys):
+    return shard_map(
+        suppressed_body,
+        mesh=mesh,
+        in_specs=(P(AXIS), P()),
+        out_specs=(P(AXIS), P()),
+        check_vma=False,
+    )(table, keys)

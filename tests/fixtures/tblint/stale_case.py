@@ -1,0 +1,8 @@
+"""stale-suppression fixture: a suppression that silences nothing (the
+line below violates no rule), flagged ONLY by --check-suppressions."""
+
+harmless = 1  # tblint: ignore[swallow] nothing to swallow here
+
+
+def also_harmless():
+    return harmless

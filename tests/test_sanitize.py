@@ -1,0 +1,263 @@
+"""TB_SANITIZE runtime sanitizer (tigerbeetle_tpu/sanitize.py): every
+check proven to (a) stay quiet on a clean run and (b) catch one
+intentionally-injected violation of its class.
+
+The machine-level cells build a real TpuStateMachine with TB_SANITIZE=1
+(the flag is read at construction) and drive the grouped commit path the
+sanitizer instruments: staging-pool poisoning on release, the cached
+zero-template guard, and the post-warmup recompile tripwire."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tigerbeetle_tpu import sanitize as san
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LedgerConfig
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.obs.metrics import registry
+
+LANES = 64
+CFG = LedgerConfig(
+    accounts_capacity_log2=10, transfers_capacity_log2=12,
+    posted_capacity_log2=10,
+)
+N_ACCOUNTS = 16
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counts():
+    san._reset_counts()
+    yield
+    san._reset_counts()
+
+
+def make_sanitized_machine(monkeypatch, **kwargs) -> TpuStateMachine:
+    monkeypatch.setenv("TB_SANITIZE", "1")
+    m = TpuStateMachine(CFG, batch_lanes=LANES, **kwargs)
+    assert m._sanitize
+    accs = types.accounts_array([
+        types.account(id=i + 1, ledger=1, code=10)
+        for i in range(N_ACCOUNTS)
+    ])
+    assert m.create_accounts(accs, wall_clock_ns=1000) == []
+    return m
+
+
+def transfer_batch(first_id: int, n: int) -> np.ndarray:
+    return types.transfers_array([
+        types.transfer(
+            id=first_id + i,
+            debit_account_id=1 + i % (N_ACCOUNTS - 1),
+            credit_account_id=2 + i % (N_ACCOUNTS - 2),
+            amount=1 + i, ledger=1, code=1,
+        )
+        for i in range(n)
+    ])
+
+
+def commit_group(m: TpuStateMachine, first_id: int, k: int = 2,
+                 n: int = 8):
+    batches = [transfer_batch(first_id + 100 * j, n) for j in range(k)]
+    tss = [m.prepare("create_transfers", n, 0) for _ in batches]
+    res = m.commit_group_fast(batches, tss)
+    assert res is not None, "run was not groupable"
+    assert all(r == [] for r in res), res
+    return res
+
+
+# -- poisoning primitives ----------------------------------------------------
+
+def test_poison_roundtrip():
+    buf = np.arange(32, dtype=np.uint64).reshape(4, 8)
+    assert not san.is_poisoned(buf)
+    assert san.poison([buf]) == 1
+    assert san.is_poisoned(buf)
+    assert buf.view(np.uint8).min() == san.SENTINEL_BYTE
+    with pytest.raises(san.SanitizeError, match="use-after-donate"):
+        san.assert_not_poisoned(buf, where="staging column")
+    assert san.counts()["use_after_donate"] == 1
+    buf[0, 0] = 7  # any real write un-poisons
+    san.assert_not_poisoned(buf)
+
+
+def test_poison_counters_land_in_registry(monkeypatch):
+    monkeypatch.setenv("TB_SANITIZE", "1")
+    with registry.enabled_scope():
+        san.poison([np.zeros(4, np.uint32)])
+        assert registry.counter("sanitize.donation_poisons").value == 1
+    assert not registry.enabled
+
+
+def test_registry_series_gated_on_sanitize_env(monkeypatch):
+    """A compile_tripwire armed by a plain bench run (TB_SANITIZE unset)
+    must not make METRICS.json claim the sanitizer ran: only the
+    module-local count records."""
+    monkeypatch.delenv("TB_SANITIZE", raising=False)
+    with registry.enabled_scope():
+        san.poison([np.zeros(4, np.uint32)])
+        assert "sanitize.donation_poisons" not in registry.snapshot()[
+            "counters"
+        ]
+    assert san.counts()["donation_poisons"] == 1
+
+
+# -- machine: staging-pool poisoning ----------------------------------------
+
+def test_stage_release_poisons_and_reuse_is_clean(monkeypatch):
+    m = make_sanitized_machine(monkeypatch)
+    m.group_device_commit = True
+    m.warmup()
+    commit_group(m, 10_000, n=8)
+    assert san.counts().get("donation_poisons", 0) > 0
+    assert m._stage_pool, "released staging set should be pooled"
+    for bufs, dirty in m._stage_pool:
+        for col in bufs.values():
+            assert san.is_poisoned(col)
+        assert all(d == m.batch_lanes for d in dirty), (
+            "poisoned lanes must be marked dirty for the next occupant"
+        )
+    # Reuse of the poisoned set must be invisible in results: the next
+    # grouped run (different counts) zeroes the sentinel tails.
+    commit_group(m, 20_000, n=5)
+    lk = m.lookup_transfers([10_000, 20_000])
+    assert [int(r["id_lo"]) for r in lk] == [10_000, 20_000]
+
+
+def test_stage_release_does_not_poison_when_off(monkeypatch):
+    monkeypatch.delenv("TB_SANITIZE", raising=False)
+    m = TpuStateMachine(CFG, batch_lanes=LANES)
+    assert not m._sanitize
+    stage = m._stage_acquire()
+    m._stage_release(stage)
+    assert not any(san.is_poisoned(b) for b in stage[0].values())
+
+
+# -- machine: cached-template guard ------------------------------------------
+
+def test_template_guard_catches_injected_donation(monkeypatch):
+    m = make_sanitized_machine(monkeypatch)
+    ts = m.prepare("create_transfers", 4, 0)
+    assert m.commit_batch("create_transfers",
+                          transfer_batch(30_000, 4), ts) == []
+    m._pad_soa(np.zeros(0, dtype=types.TRANSFER_DTYPE))  # builds the cache
+    assert m._pad_soa_zero, "zero template should be cached"
+    key = next(iter(m._pad_soa_zero))
+    # Injected violation: a kernel 'donated' the template (scratch bytes).
+    m._pad_soa_zero[key]["amount_lo"] = jnp.ones(LANES, jnp.uint64)
+    with pytest.raises(san.SanitizeError, match="donated to a kernel"):
+        m._pad_soa(np.zeros(0, dtype=types.TRANSFER_DTYPE))
+    assert san.counts()["template_corruptions"] == 1
+
+
+# -- recompile tripwire ------------------------------------------------------
+
+def test_compile_tripwire_fires_on_forced_recompile():
+    from tigerbeetle_tpu import jaxenv
+
+    assert jaxenv.instrument_compiles(), "compile listener unavailable"
+
+    @jax.jit
+    def _fresh(x):
+        return x * 3 + 1
+
+    with pytest.raises(san.SanitizeError, match="recompile tripwire"):
+        with san.compile_tripwire("test region", raise_on_trip=True):
+            _fresh(jnp.ones((41,), jnp.uint32)).block_until_ready()
+    assert san.counts()["recompiles"] >= 1
+
+
+def test_compile_tripwire_quiet_on_warm_program():
+    @jax.jit
+    def _warmed(x):
+        return x + 2
+
+    _warmed(jnp.ones((23,), jnp.uint32)).block_until_ready()  # compile now
+    with san.compile_tripwire("warm region", raise_on_trip=True) as report:
+        _warmed(jnp.ones((23,), jnp.uint32)).block_until_ready()
+    assert report.compiles == 0
+
+
+def test_serving_recompile_check_warns_and_rebaselines(monkeypatch, capsys):
+    m = make_sanitized_machine(monkeypatch)
+    m.warmup()
+    assert m._sanitize_compile_base is not None
+    from tigerbeetle_tpu import jaxenv
+
+    # Injected violation: pretend warmup's baseline predates compiles.
+    m._sanitize_compile_base = jaxenv.compile_count() - 3
+    m._sanitize_recompile_check("unit region")
+    assert san.counts()["recompiles"] == 3
+    assert "SANITIZE: 3 XLA compile(s)" in capsys.readouterr().err
+    # Re-baselined: a second check is quiet.
+    m._sanitize_recompile_check("unit region")
+    assert san.counts()["recompiles"] == 3
+
+
+def test_serving_recompile_check_strict_raises(monkeypatch):
+    m = make_sanitized_machine(monkeypatch)
+    m.warmup()
+    monkeypatch.setenv("TB_SANITIZE_STRICT", "1")
+    from tigerbeetle_tpu import jaxenv
+
+    m._sanitize_compile_base = jaxenv.compile_count() - 1
+    with pytest.raises(san.SanitizeError, match="recompile tripwire"):
+        m._sanitize_recompile_check("strict region")
+
+
+def test_read_path_first_use_compile_not_attributed_to_serving(monkeypatch):
+    """A first lookup after warmup jit-compiles its READ kernel; the
+    serving tripwire must absorb it (not strict-raise out of the next
+    commit, not pollute sanitize.recompiles)."""
+    m = make_sanitized_machine(monkeypatch)
+    m.warmup()
+    monkeypatch.setenv("TB_SANITIZE_STRICT", "1")
+    m.lookup_accounts([1, 2])       # first-use compile of the read path
+    before = san.counts().get("recompiles", 0)
+    ts = m.prepare("create_transfers", 4, 0)
+    assert m.commit_batch("create_transfers",
+                          transfer_batch(70_000, 4), ts) == []
+    assert san.counts().get("recompiles", 0) == before
+
+
+def test_steady_serving_has_zero_recompiles(monkeypatch):
+    """The acceptance shape: after warmup + one warm group, further
+    same-shape grouped commits compile NOTHING (strict tripwire armed)."""
+    m = make_sanitized_machine(monkeypatch)
+    m.group_device_commit = True
+    m.warmup()
+    commit_group(m, 40_000, n=8)     # warm group: first-use index/scan jits
+    m._sanitize_arm_tripwire()       # re-baseline at the steady state
+    monkeypatch.setenv("TB_SANITIZE_STRICT", "1")
+    before = san.counts().get("recompiles", 0)
+    commit_group(m, 50_000, n=8)
+    commit_group(m, 60_000, n=8)
+    assert san.counts().get("recompiles", 0) == before
+
+
+# -- registry leak guard -----------------------------------------------------
+
+def test_registry_guard_trips_on_leaked_enable():
+    registry.enable()
+    with pytest.raises(san.SanitizeError, match="registry leak"):
+        san.assert_registry_disabled("test scope")
+    # The guard disarmed the leak so it cannot cascade.
+    assert not registry.enabled
+    assert san.counts()["registry_leaks"] == 1
+
+
+def test_registry_guard_quiet_when_disabled():
+    assert not registry.enabled
+    san.assert_registry_disabled("test scope")
+    assert "registry_leaks" not in san.counts()
+
+
+def test_enabled_scope_always_disables():
+    with pytest.raises(RuntimeError, match="boom"):
+        with registry.enabled_scope():
+            assert registry.enabled
+            raise RuntimeError("boom")
+    assert not registry.enabled
+    assert registry.snapshot()["counters"] == {}

@@ -15,12 +15,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "tblint")
 
 from tools import tblint  # noqa: E402  (conftest puts REPO on sys.path)
-from tools.tblint.core import iter_rules  # noqa: E402
+from tools.tblint.core import (  # noqa: E402
+    check_suppressions, iter_files, iter_rules,
+)
 
 # Every registered rule must be exercised by the fixtures.
 ALL_RULE_IDS = {
     "traced-branch", "concretize", "host-sync", "nondet", "u128-limb",
     "wide-literal", "layout-drift", "swallow", "unrolled-loop",
+    # tbsan semantic suite (PR 12):
+    "donation", "size-class", "lane-race", "shard-rep",
 }
 
 
@@ -85,13 +89,36 @@ def test_every_rule_has_a_suppression_case():
 
 
 def test_real_tree_is_clean():
-    """The package and tools must stay lint-clean — this is the same gate
-    tools/ci.py's lint tier enforces."""
-    findings = tblint.run([
-        os.path.join(REPO, "tigerbeetle_tpu"),
-        os.path.join(REPO, "tools"),
-    ])
+    """The package, tools, tests, and bench.py must stay lint-clean AND
+    free of stale suppressions — the same gate tools/ci.py's lint tier
+    enforces (tests/fixtures holds the deliberate violations and is
+    excluded)."""
+    files = iter_files(
+        [
+            os.path.join(REPO, "tigerbeetle_tpu"),
+            os.path.join(REPO, "tools"),
+            os.path.join(REPO, "tests"),
+            os.path.join(REPO, "bench.py"),
+        ],
+        exclude=[os.path.join(REPO, "tests", "fixtures")],
+    )
+    findings = check_suppressions(files)
     assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_check_suppressions_flags_stale():
+    """The stale fixture's do-nothing suppression is flagged ONLY in
+    --check-suppressions mode; used suppressions and bare/placeholder
+    doc examples are not."""
+    normal = {(f.path, f.rule) for f in tblint.run([FIXTURES])}
+    assert not any(r == "stale-suppression" for _, r in normal)
+    stale = [
+        f for f in check_suppressions([FIXTURES])
+        if f.rule == "stale-suppression"
+    ]
+    assert [
+        (f.path.split("fixtures/tblint/", 1)[1], f.line) for f in stale
+    ] == [("stale_case.py", 4)], [f.render() for f in stale]
 
 
 def test_cli_exit_codes_and_json():

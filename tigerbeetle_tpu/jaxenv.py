@@ -32,7 +32,7 @@ from typing import List, Optional
 __all__ = [
     "force_cpu", "ensure_backend", "child_env", "current_platform",
     "COMPILE_CACHE_DIR", "enable_compile_cache", "instrument_compiles",
-    "shard_map",
+    "compile_count", "shard_map",
 ]
 
 # Set when force_cpu had to settle for fewer virtual devices than requested
@@ -108,6 +108,20 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma):
 
 _COMPILE_LISTENER_INSTALLED = False
 
+# Monotonic count of XLA backend compiles in THIS process, maintained by
+# the instrument_compiles listener UNCONDITIONALLY (one int add per
+# compile — compiles are rare by definition).  Unlike the jit.compiles
+# registry series this does not require the obs registry to be enabled,
+# so the bench recompile tripwire and the TB_SANITIZE serving check can
+# diff it around timed regions with zero arming ceremony.
+_COMPILE_COUNT = 0
+
+
+def compile_count() -> int:
+    """Process-wide XLA backend compile count (0 until instrument_compiles
+    has been installed — callers diff deltas, so the base is irrelevant)."""
+    return _COMPILE_COUNT
+
 
 def instrument_compiles() -> bool:
     """Feed jit compile accounting into the obs metrics registry.
@@ -130,6 +144,11 @@ def instrument_compiles() -> bool:
     from .obs.metrics import registry
 
     def _on_duration(event: str, duration: float, **kwargs) -> None:
+        global _COMPILE_COUNT
+        if event.endswith("backend_compile_duration"):
+            # The bare count is maintained even with the registry off —
+            # compile_count() feeds the recompile tripwires.
+            _COMPILE_COUNT += 1
         if not registry.enabled:
             return
         if event.endswith("backend_compile_duration"):
@@ -142,7 +161,7 @@ def instrument_compiles() -> bool:
 
     try:
         monitoring.register_event_duration_secs_listener(_on_duration)
-    except Exception:  # tblint: ignore[swallow] private-API probe
+    except Exception:  # private-API probe: degrade to "no hook"
         return False
     _COMPILE_LISTENER_INSTALLED = True
     return True

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import sanitize as _san
 from . import types
 from .config import LedgerConfig
 from .obs.metrics import registry as _obs
@@ -487,6 +488,23 @@ class TpuStateMachine:
         self._stage_pool: List[tuple] = []  # free staging sets (_stage_acquire)
         self._pad_soa_zero: dict = {}
         self._lane = None  # FIFO dispatch-lane executor (see _dispatch_lane)
+        # TB_SANITIZE=1 (sanitize.py, test/CI-only): poison released
+        # staging sets, guard the cached zero templates, and trip on
+        # post-warmup recompiles in the serving path.  One bool read at
+        # init; sanitize-off runs take none of the branches.
+        self._sanitize = _san.enabled()
+        # jaxenv.compile_count() as of the last known-legitimate compile
+        # point (warmup / growth); None until warmup() arms it.
+        self._sanitize_compile_base: Optional[int] = None
+        # One-readback grace window after a growth rehash: the grown
+        # capacity is a new shape class, so the next dispatch's compiles
+        # are legitimate — the tripwire re-baselines instead of tripping.
+        self._sanitize_grace = False
+        # Set alongside: once ANY capacity changed post-warmup, kernel
+        # variants not yet exercised at the new capacity may legitimately
+        # first-compile much later (e.g. the first two-phase batch after
+        # a growth), so strict raising downgrades to warn-until-re-arm.
+        self._sanitize_soft = False
         # Device fault domain (ops/scrub.py; docs/fault_domains.md).  Armed
         # by scrub_arm() when scrub_interval > 0: the mirror is the
         # authoritative host twin (ReferenceStateMachine) every committed
@@ -510,7 +528,7 @@ class TpuStateMachine:
         # tick of backoff sleeps retry_tick_s seconds; the sim pins it to 0
         # (virtual time).  The prng feeds ONLY sleep jitter, never state.
         self.retry_tick_s = 0.01
-        self._retry_prng = _random.Random(0x5C12)  # tblint: ignore[nondet] jitter only
+        self._retry_prng = _random.Random(0x5C12)  # jitter only, never state
         self._retry_timeout = None
         # Merkle commitment tree (ops/merkle.py; docs/commitments.md).
         # TB_MERKLE=1 replaces the scrub check substrate with the on-device
@@ -564,6 +582,18 @@ class TpuStateMachine:
         if _obs.enabled:
             _obs.counter("ops.dispatch").inc()
             _obs.histogram("ops.dispatch_wait_us", "us").observe(wait * 1e6)
+        if (self._sanitize and self._sanitize_compile_base is not None
+                and self._deferred_inflight == 0):
+            # Recompile tripwire: every commit funnels through this
+            # readback, so a post-warmup compile (PR 10's size-class bug)
+            # is caught one dispatch after it happened, with the count.
+            # Checked ONLY at pipeline-quiescent readbacks: a still-
+            # running lane closure may be mid-growth, with its compile
+            # already counted but its grace flag not yet visible — every
+            # closure's flags ARE visible here via its resolve() join.
+            # (_deferred_inflight is serving-thread-only: submit and
+            # resolve both happen there.)
+            self._sanitize_recompile_check("serving commit path")
         return out if overflow is None else (out, overflow)
 
     # -- device fault domain (ops/scrub.py, docs/fault_domains.md) -----------
@@ -1468,7 +1498,7 @@ class TpuStateMachine:
         if _obs.enabled:
             _obs.counter("device_recovery.retries").inc()
         if self.retry_tick_s > 0:
-            _time.sleep(ticks * self.retry_tick_s)  # tblint: ignore[nondet] backoff sleep
+            _time.sleep(ticks * self.retry_tick_s)  # backoff sleep, not state
 
     def _degrade_to_host_engine(self, err) -> None:
         """After device_fault_limit consecutive dispatch failures: stop
@@ -1637,15 +1667,81 @@ class TpuStateMachine:
             self.scans_accounts.reset()
             self._index_stale = False
 
+    def _sanitize_arm_tripwire(self) -> None:
+        """TB_SANITIZE: baseline the compile count at a known-legitimate
+        compile point (end of warmup, after a growth rehash).  Serving
+        dispatches past this point must not compile; _d2h_codes checks."""
+        if not self._sanitize:
+            return
+        from . import jaxenv
+
+        if not jaxenv.instrument_compiles():
+            # No listener -> compile_count() is frozen and every delta
+            # would be a vacuous 0.  Stay DISARMED (base None) and say so,
+            # rather than reporting the serving path compile-free.
+            _san._warn_unarmed("serving commit path")
+            self._sanitize_compile_base = None
+            return
+        self._sanitize_compile_base = jaxenv.compile_count()
+        self._sanitize_grace = False
+        self._sanitize_soft = False
+
+    def _sanitize_absorb_compiles(self) -> None:
+        """Fold compiles made by a NON-commit entry point (first lookup/
+        query/digest after warmup jit-compiles its kernel) into the
+        tripwire baseline: they are first-use compiles of read paths, not
+        serving-commit recompiles, and must not be attributed to (or
+        strict-raise out of) the next commit's readback."""
+        if self._sanitize and self._sanitize_compile_base is not None:
+            from . import jaxenv
+
+            self._sanitize_compile_base = jaxenv.compile_count()
+
+    def _sanitize_recompile_check(self, where: str) -> None:
+        from . import jaxenv
+
+        cur = jaxenv.compile_count()
+        if self._sanitize_grace:
+            # First readback after a growth rehash: new capacity = new
+            # shape class, its compiles are legitimate.  Re-baseline.
+            self._sanitize_grace = False
+            self._sanitize_compile_base = cur
+            return
+        delta = cur - self._sanitize_compile_base
+        if delta > 0:
+            # Re-baseline FIRST so a strict raise (or a burst of late
+            # compiles) reports once, not once per readback.  Strict
+            # raising is downgraded to the warning (_sanitize_soft, set at
+            # a growth or the history-flag flip) and whenever the device
+            # fault domain is armed: scrub/merkle check kernels compile
+            # lazily at their first cadence point, post-warmup by design.
+            self._sanitize_compile_base = cur
+            strict_ok = not (
+                self._sanitize_soft
+                or self._scrub_mirror is not None
+                or self._merkle_forest is not None
+            )
+            _san.recompile_trip(where, delta, strict_ok=strict_ok)
+
     def warmup(self) -> None:
         """Force-compile the hot commit kernels with zero-count batches so
         the first client request doesn't pay tens of seconds of jit latency
         (the CLI calls this before announcing ``listening``).  The kernels
         are functional — results are discarded, state is untouched.
 
+        Under TB_SANITIZE the end of warmup arms the serving recompile
+        tripwire: from here on, a commit dispatch that compiles is a
+        size-class bug (warn; raise under TB_SANITIZE_STRICT).
+
         In host-engine mode there is nothing to compile; instead pre-fault
         the numpy tables (lazily-mapped pages would otherwise fault inside
         the serving hot loop)."""
+        try:
+            self._warmup_impl()
+        finally:
+            self._sanitize_arm_tripwire()
+
+    def _warmup_impl(self) -> None:
         if self._engine is not None:
             self._host_led.prefault()
             return
@@ -1771,6 +1867,13 @@ class TpuStateMachine:
             # (create_transfers_fast_probed's contract).
             key = (batch.dtype, self.pipeline_depth)
             cached = self._pad_soa_zero.get(key)
+            if cached is not None and self._sanitize:
+                # A template handed to a batch-donating kernel without a
+                # copy shows up as nonzero columns HERE, at the next
+                # commit — not at the next digest mismatch.
+                _san.template_guard(
+                    cached, where=f"_pad_soa_zero[{key!r}]"
+                )
             if cached is None:
                 padded = np.zeros(self.batch_lanes, dtype=batch.dtype)
                 cached = {
@@ -1851,6 +1954,11 @@ class TpuStateMachine:
         self._note_shard_inserts("accounts", batch, count)
         self._grow_if_needed(accounts=count)
         if bool((batch["flags"] & types.AccountFlags.HISTORY).any()):
+            if not self._history_accounts_possible and self._sanitize:
+                # The has_history=True kernel variants first-compile at
+                # the next transfer dispatch (warmup deliberately skips
+                # them) — a legitimate compile, not a size-class bug.
+                self._sanitize_soft = True
             self._history_accounts_possible = True
         if bool((batch["flags"] & _LIMIT_FLAGS).any()):
             self._limit_accounts_possible = True
@@ -2316,6 +2424,15 @@ class TpuStateMachine:
         return (bufs, [0] * self.GROUP_K)
 
     def _stage_release(self, stage) -> None:
+        if self._sanitize:
+            # Donation poisoning: anything still reading this set after
+            # release (the runtime use-after-donate) sees 0xA5 garbage,
+            # not stale plausible rows.  Mark every lane dirty so the
+            # next _stage_group occupant zeroes its full tail.
+            bufs, dirty = stage
+            _san.poison(bufs.values())
+            for j in range(len(dirty)):
+                dirty[j] = self.batch_lanes
         self._stage_pool.append(stage)
 
     def _stage_group(self, batches: List[np.ndarray]):
@@ -2414,7 +2531,11 @@ class TpuStateMachine:
             # FIFO lane preserves the ledger chain (the appends need THIS
             # ledger live).
             self._grow_if_needed(transfers_need=need)
-            self.ledger, codes, overflow, id_lo, id_hi = _group_fast_dispatch(
+            # The ONE-worker FIFO lane orders every ledger write, and the
+            # serving thread reads self.ledger only after resolve()'s join
+            # (or lane.shutdown(wait=True) in reset paths).
+            (self.ledger, codes, overflow,  # tblint: ignore[lane-race] FIFO+join
+             id_lo, id_hi) = _group_fast_dispatch(
                 self.ledger, stacked, cnt, tss
             )
             for j in range(k):
@@ -2489,7 +2610,9 @@ class TpuStateMachine:
             self._grow_if_needed(transfers_need=need, shard_bounds=snap)
             codes_out, ovf_out = [], []
             for j in range(k):
-                self.ledger, codes, overflow = step(
+                # Same handoff as the single-device closure above: ONE
+                # FIFO lane worker, serving-thread reads behind the join.
+                self.ledger, codes, overflow = step(  # tblint: ignore[lane-race] FIFO+join
                     self.ledger, soas[j], cnts[j], tss[j]
                 )
                 self._index_append_device(
@@ -2618,7 +2741,8 @@ class TpuStateMachine:
                 # inputs); index maintenance uses the passed-through id
                 # columns — the donated ``soa`` dict must not be touched
                 # after this call.
-                self.ledger, codes, overflow, id_lo, id_hi = (
+                (self.ledger, codes, overflow,  # tblint: ignore[lane-race] FIFO+join
+                 id_lo, id_hi) = (
                     sm.create_transfers_fast_probed(self.ledger, soa, cnt, ts)
                 )
                 self._index_append_device(id_lo, id_hi, codes, count)
@@ -2810,6 +2934,14 @@ class TpuStateMachine:
         # are capacity-shaped) rebuilds from the grown layout at the next
         # update/check (docs/commitments.md "growth rehash").
         self._merkle_mark_dirty()
+        if self._sanitize and self._sanitize_compile_base is not None:
+            # The grown capacity is a NEW shape class: the grow kernel and
+            # the next commit dispatch legitimately compile.  Open the
+            # one-readback grace window, and downgrade strict raising for
+            # the rest of this arm period (variants not yet run at the new
+            # capacity first-compile arbitrarily later).
+            self._sanitize_grace = True
+            self._sanitize_soft = True
         if self._ledger_is_sharded:
             from .parallel import sharded as shard_mod
 
@@ -2883,6 +3015,9 @@ class TpuStateMachine:
             led = led.replace(
                 history=sm.grow_history(led.history, self._history_bound + history)
             )
+            if self._sanitize and self._sanitize_compile_base is not None:
+                self._sanitize_grace = True  # new history capacity class
+                self._sanitize_soft = True
         self.ledger = led
 
     def _grow_flagged(self, kflags: int) -> None:
@@ -3016,11 +3151,25 @@ class TpuStateMachine:
             return
         lane = jnp.arange(self.batch_lanes, dtype=jnp.uint64)
         ok_dev = (codes_dev == 0) & (lane < jnp.uint64(count))
+        watching = self._sanitize and self._sanitize_compile_base is not None
+
+        def _index_events():
+            return self.index.shape_class_events + sum(
+                ix.shape_class_events
+                for ix in self.scans_transfers.indexes.values()
+            )
+
+        ev0 = _index_events() if watching else 0
         self.index.append_batch(self.ledger, id_lo, id_hi, ok_dev)
         if self.scans_transfers.indexes:
             self.scans_transfers.append_batch(
                 self.ledger, id_lo, id_hi, ok_dev
             )
+        if watching and _index_events() != ev0:
+            # A Bentley–Saxe carry reached a NEW power-of-two level: its
+            # first merge/fill legitimately jit-compiles (bounded:
+            # log(rows) levels ever).  Same grace as a table growth.
+            self._sanitize_grace = True
 
     def _index_append(self, soa: dict, codes: np.ndarray, count: int) -> None:
         if self.config.lazy_index or self._shard_mesh is not None:
@@ -3077,6 +3226,7 @@ class TpuStateMachine:
         hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
         found, cols = sm.lookup_accounts(self._query_ledger(), lo, hi)
         found = np.asarray(found)
+        self._sanitize_absorb_compiles()  # read-path first-use jit
         host = {k: np.asarray(v) for k, v in cols.items()}
         host["reserved"] = np.zeros(len(ids), np.uint32)
         rows = types.from_soa(host, types.ACCOUNT_DTYPE)
@@ -3092,6 +3242,7 @@ class TpuStateMachine:
         hi = jnp.asarray([i >> 64 for i in ids], jnp.uint64)
         found, cols = sm.lookup_transfers(self._query_ledger(), lo, hi)
         found = np.asarray(found)
+        self._sanitize_absorb_compiles()  # read-path first-use jit
         host = {k: np.asarray(v) for k, v in cols.items()}
         rows = types.from_soa(host, types.TRANSFER_DTYPE)
         if self.cold.count and not found.all():
@@ -3458,4 +3609,6 @@ class TpuStateMachine:
         )
 
     def digest(self) -> int:
-        return int(sm.ledger_digest(self.ledger))
+        out = int(sm.ledger_digest(self.ledger))
+        self._sanitize_absorb_compiles()  # read-path first-use jit
+        return out

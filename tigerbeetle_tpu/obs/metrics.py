@@ -32,6 +32,7 @@ the same discipline as the tracer's slot cap.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -153,6 +154,25 @@ class Registry:
 
     def disable(self) -> None:
         self.enabled = False
+
+    @contextlib.contextmanager
+    def enabled_scope(self, reset: bool = True):
+        """Context manager: enable for the block, ALWAYS disable (and by
+        default reset) on exit.  The registry is process-global, so a
+        leaked enable() taxes every later test and mixes foreign series
+        into the next snapshot — the PR 10 leak class the TB_SANITIZE
+        registry guard (sanitize.assert_registry_disabled) and the
+        autouse test fixture now police.  Use this instead of a bare
+        enable() in tests and tools."""
+        if reset:
+            self.reset()
+        self.enable()
+        try:
+            yield self
+        finally:
+            self.disable()
+            if reset:
+                self.reset()
 
     def reset(self) -> None:
         """Drop every series (tests; the registry is process-global)."""

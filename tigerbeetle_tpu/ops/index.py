@@ -223,6 +223,11 @@ class TransferIndex:
         # its cold-tier runs here): the stale-rebuild fallback must cover
         # them too, or evicted transfers silently vanish from queries.
         self.extra_rows_provider = None
+        # Monotonic count of NEW level allocations: each new level is a
+        # fresh power-of-two shape class whose first merge/fill jit-
+        # compiles (bounded: log(rows) levels).  The machine's TB_SANITIZE
+        # recompile tripwire diffs this to forgive exactly those compiles.
+        self.shape_class_events = 0
 
     # -- maintenance --------------------------------------------------------
 
@@ -236,6 +241,7 @@ class TransferIndex:
             self.dr_levels.append(_sentinel_level(cap))
             self.cr_levels.append(_sentinel_level(cap))
             self.occupied.append(False)
+            self.shape_class_events += 1  # new size class: first-use jits
 
     def append_batch(
         self, ledger: sm.Ledger, id_lo: jax.Array, id_hi: jax.Array,

@@ -242,6 +242,10 @@ class FieldIndex:
         # Born stale: materialization happens against a table that may
         # already hold objects.
         self.stale = True
+        # New-level allocations (power-of-two shape classes whose first
+        # merge jit-compiles) — the TransferIndex twin's counter; the
+        # machine's TB_SANITIZE tripwire forgives exactly these.
+        self.shape_class_events = 0
 
     def reset(self) -> None:
         self.levels, self.occupied = [], []
@@ -252,6 +256,7 @@ class FieldIndex:
             cap = self.base << len(self.occupied)
             self.levels.append(ix._sentinel_level(cap))
             self.occupied.append(False)
+            self.shape_class_events += 1  # new size class: first-use jits
 
     def capacity(self) -> int:
         return sum(self.base << j for j in range(len(self.occupied))) or self.base

@@ -1703,11 +1703,16 @@ class Replica:
         self._ckpt_error = None
 
         def work():
+            # Handoff protocol: the serving thread reads _ckpt_result/
+            # _ckpt_error only in _checkpoint_poll, strictly AFTER
+            # t.is_alive() goes False — thread termination is the
+            # happens-before edge, so these two writes need no lock.
             try:
                 state = self._checkpoint_write(arrays, meta, fields)
-                self._ckpt_result = (state, fields["cold_garbage"])
+                garbage = fields["cold_garbage"]
+                self._ckpt_result = (state, garbage)  # tblint: ignore[lane-race] is_alive gate
             except Exception as err:  # noqa: BLE001 — surfaced at poll
-                self._ckpt_error = err
+                self._ckpt_error = err  # tblint: ignore[lane-race] is_alive gate
 
         t = threading.Thread(
             target=work, name="tb-checkpoint", daemon=True

@@ -10,8 +10,10 @@ ones green):
 
   tidy         lint/ban/citation checks (seconds)
   lint         tools/tblint static analysis over tigerbeetle_tpu + tools
-               (tracer safety, VOPR determinism, u128/wire invariants);
-               fails on any finding
+               + tests + bench.py (tracer safety, VOPR determinism,
+               u128/wire invariants, donation/size-class/lane-race/
+               shard-rep discipline); fails on any finding or any stale
+               suppression (--check-suppressions)
   unit         pure-host logic: wire, types, config, hash-table, u128,
                bindings drift, LSM, backpressure, model (fast: target <5 min
                on the 1-core bench host)
@@ -50,9 +52,13 @@ TIERS = {
         extra=[],
     ),
     "lint": dict(
-        # Static analysis, not pytest: exits non-zero on any new finding.
+        # Static analysis, not pytest: exits non-zero on any new finding
+        # OR any stale suppression.  Covers tests/ and bench.py too
+        # (tests/fixtures holds the deliberate violations and is pruned).
         # (tests/test_tblint.py separately proves the rules themselves.)
-        cmd=["-m", "tools.tblint", "tigerbeetle_tpu", "tools"],
+        cmd=["-m", "tools.tblint", "--check-suppressions",
+             "--exclude", "tests/fixtures",
+             "tigerbeetle_tpu", "tools", "tests", "bench.py"],
     ),
     "unit": dict(
         files=[
@@ -155,6 +161,15 @@ TIERS = {
         # METRICS.json.  Artifact: ASYNC_SMOKE.json at the repo root.
         cmd=["tools/async_smoke.py"],
     ),
+    "sanitize": dict(
+        # TB_SANITIZE runtime sanitizer smoke (docs/tblint.md): steady
+        # serving under the sanitizer must observe ZERO XLA compiles
+        # (strict tripwire armed) with the staging pool sentinel-
+        # poisoned, one injected violation of each check must be caught,
+        # a pinned VOPR seed must run green, and the sanitize.* counters
+        # must land in METRICS.json.  Artifact: SANITIZE_SMOKE.json.
+        cmd=["tools/sanitize_smoke.py"],
+    ),
     "byzantine": dict(
         # Byzantine fault domain smoke (docs/fault_domains.md): pinned
         # seed with one equivocating/corrupting/lying replica of six
@@ -250,7 +265,7 @@ TIERS = {
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
     "scrub", "merkle", "overload", "waves", "sharded", "async",
-    "byzantine", "integration",
+    "sanitize", "byzantine", "integration",
 ]
 
 

@@ -7,14 +7,15 @@ import json
 import sys
 from typing import List, Optional
 
-from .core import iter_files, iter_rules, run
+from .core import check_suppressions, iter_files, iter_rules, run
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tools.tblint",
         description="Repo-native static analysis: JAX tracer safety, VOPR "
-                    "determinism, u128/wire invariants.",
+                    "determinism, u128/wire invariants, donation/size-class/"
+                    "lane-race/shard-replication discipline.",
     )
     p.add_argument("paths", nargs="*", default=["tigerbeetle_tpu"],
                    help="files or directories to lint")
@@ -24,6 +25,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="print the rule catalogue and exit")
     p.add_argument("--rule", action="append", dest="only_rules",
                    metavar="ID", help="run only the named rule(s)")
+    p.add_argument("--exclude", action="append", default=[],
+                   metavar="PATH", help="prune a subtree from the sweep "
+                   "(e.g. tests/fixtures — deliberate violations)")
+    p.add_argument("--check-suppressions", action="store_true",
+                   help="also flag `# tblint: ignore[RULE]` comments that "
+                   "no longer silence any finding (stale-suppression)")
     args = p.parse_args(argv)
 
     rules = iter_rules()
@@ -44,8 +51,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # Expand once; run() treats an explicit file list as-is, so the tree
     # is walked a single time.
-    files = iter_files(args.paths)
-    findings = run(files, rules=rules)
+    files = iter_files(args.paths, exclude=args.exclude)
+    if args.check_suppressions:
+        findings = check_suppressions(files, rules=rules)
+    else:
+        findings = run(files, rules=rules)
     n_files = len(files)
     if args.as_json:
         print(json.dumps({

@@ -154,18 +154,31 @@ def _load_rules() -> None:
 _SKIP_DIRS = {"__pycache__", "node_modules", ".git", ".jax_cache"}
 
 
-def iter_files(paths: Sequence[str]) -> List[str]:
+def iter_files(paths: Sequence[str],
+               exclude: Sequence[str] = ()) -> List[str]:
     """Expand files/directories into the sorted list of lintable files
-    (*.py everywhere, plus *.h for the layout cross-check)."""
+    (*.py everywhere, plus *.h for the layout cross-check).  ``exclude``
+    prunes whole subtrees by path prefix — the CI sweep over tests/ must
+    not lint the deliberate violations under tests/fixtures/tblint/."""
+    excl = tuple(os.path.abspath(e) + os.sep for e in exclude)
+
+    def excluded(path: str) -> bool:
+        return (os.path.abspath(path) + os.sep).startswith(excl) if excl \
+            else False
+
     out = set()
     for p in paths:
         if os.path.isfile(p):
-            out.add(p)
+            if not excluded(p):
+                out.add(p)
             continue
+        if excluded(p):
+            continue  # a walk root INSIDE an excluded subtree
         for dirpath, dirnames, filenames in os.walk(p):
             dirnames[:] = sorted(
                 d for d in dirnames
                 if d not in _SKIP_DIRS and not d.startswith(".")
+                and not excluded(os.path.join(dirpath, d))
             )
             for name in sorted(filenames):
                 if name.endswith((".py", ".h")):
@@ -174,15 +187,29 @@ def iter_files(paths: Sequence[str]) -> List[str]:
 
 
 def run(paths: Sequence[str],
-        rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+        rules: Optional[Sequence[Rule]] = None,
+        used_suppressions: Optional[set] = None,
+        state_out: Optional[ProjectState] = None) -> List[Finding]:
     """Lint ``paths``; returns findings sorted by (path, line, col, rule).
 
     Suppression comments (``# tblint: ignore[RULE]``) are applied here, so
-    rules never need to know about them.
+    rules never need to know about them.  ``used_suppressions``, when
+    passed, collects the (abs path, line) of every suppression comment
+    that actually silenced a finding; ``state_out`` receives the parsed
+    per-file contexts — check_suppressions reads both back so the stale
+    sweep never re-reads or re-parses a file.
     """
     active = list(rules) if rules is not None else iter_rules()
-    state = ProjectState()
+    state = state_out if state_out is not None else ProjectState()
     findings: List[Finding] = []
+
+    def drop(ctx: FileContext, f: Finding) -> bool:
+        if not ctx.suppressed(f.rule, f.line):
+            return False
+        if used_suppressions is not None:
+            used_suppressions.add((ctx.path, f.line))
+        return True
+
     for path in iter_files(paths):
         ctx = FileContext(path)
         state.add(ctx)
@@ -197,15 +224,46 @@ def run(paths: Sequence[str],
             if not rule.applies(ctx):
                 continue
             for f in rule.check(ctx):
-                if not ctx.suppressed(f.rule, f.line):
+                if not drop(ctx, f):
                     findings.append(f)
     for rule in active:
         for f in rule.finalize(state):
             ctx = state.by_path.get(os.path.abspath(f.path)) or next(
                 (c for c in state.contexts if c.display_path == f.path), None
             )
-            if ctx is not None and ctx.suppressed(f.rule, f.line):
+            if ctx is not None and drop(ctx, f):
                 continue
             findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def check_suppressions(paths: Sequence[str],
+                       rules: Optional[Sequence[Rule]] = None,
+                       ) -> List[Finding]:
+    """Run the full lint, then flag every ``# tblint: ignore[RULE]``
+    comment that silenced NOTHING as a ``stale-suppression`` finding.
+
+    Only suppressions naming at least one *registered* rule id are
+    considered: bare ``ignore`` comments and docstring examples naming
+    placeholder ids (``RULE``, ``RULE-ID``) cannot be judged and are
+    skipped.  Returns the lint findings + the stale ones, sorted."""
+    active = list(rules) if rules is not None else iter_rules()
+    known = {r.id for r in active}
+    used: set = set()
+    state = ProjectState()
+    findings = run(paths, rules=active, used_suppressions=used,
+                   state_out=state)
+    for ctx in state.contexts:
+        for line, names in sorted(ctx.suppressions.items()):
+            if names is ALL_RULES or not (set(names) & known):
+                continue
+            if (ctx.path, line) in used:
+                continue
+            findings.append(Finding(
+                "stale-suppression", ctx.display_path, line, 0,
+                f"suppression ignore[{', '.join(sorted(names))}] no longer "
+                "silences any finding — delete it (or fix the rule name)",
+            ))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -173,6 +173,153 @@ def module_jit_info(ctx) -> JitInfo:
     return ctx.cache["jit_info"]
 
 
+class WrapperInfo:
+    """One module-local jitted callable as seen from its CALL sites.
+
+    ``name`` is the name call sites use (the assignment target of
+    ``g = jax.jit(f, ...)``, or the decorated function's own name);
+    ``params`` the wrapped function's positional parameter names in order;
+    ``donated`` / ``static`` the subsets named by ``donate_argnames``/
+    ``donate_argnums`` and ``static_argnames``/``static_argnums``.
+    """
+
+    __slots__ = ("name", "params", "donated", "static")
+
+    def __init__(self, name: str, params: List[str],
+                 donated: Set[str], static: Set[str]) -> None:
+        self.name = name
+        self.params = params
+        self.donated = donated
+        self.static = static
+
+    def donated_args(self, call: ast.Call) -> List[Tuple[str, ast.AST]]:
+        """(param name, argument expr) pairs landing on donated params."""
+        out: List[Tuple[str, ast.AST]] = []
+        for i, arg in enumerate(call.args):
+            if i < len(self.params) and self.params[i] in self.donated:
+                out.append((self.params[i], arg))
+        for kw in call.keywords:
+            if kw.arg in self.donated:
+                out.append((kw.arg, kw.value))
+        return out
+
+    def static_args(self, call: ast.Call) -> List[Tuple[str, ast.AST]]:
+        out: List[Tuple[str, ast.AST]] = []
+        for i, arg in enumerate(call.args):
+            if i < len(self.params) and self.params[i] in self.static:
+                out.append((self.params[i], arg))
+        for kw in call.keywords:
+            if kw.arg in self.static:
+                out.append((kw.arg, kw.value))
+        return out
+
+
+def _named_params(call: ast.Call, params: List[str],
+                  names_kw: str, nums_kw: str) -> Set[str]:
+    """Resolve a donate_/static_ argnames+argnums kwarg pair to param
+    names (shared shape with _static_params, which predates this)."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == names_kw:
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    out.add(node.value)
+        elif kw.arg == nums_kw:
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                    if 0 <= node.value < len(params):
+                        out.add(params[node.value])
+    return out
+
+
+def _wrapped_fn_name(call: ast.Call, functions: Dict[str, ast.FunctionDef]
+                     ) -> Optional[str]:
+    """The module-local function a jit(...) call wraps, if resolvable."""
+    for arg in call.args:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id in functions:
+                return sub.id
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+def analyze_wrappers(tree: ast.AST) -> Dict[str, WrapperInfo]:
+    """Map call-site name -> WrapperInfo for every module-local jitted
+    callable whose donation/static surface is statically visible:
+
+    - ``g = jax.jit(f, donate_argnames=..., static_argnames=...)``
+      (including helper wrappers: ANY assigned call that carries a
+      donate_/static_ kwarg and wraps a module-local function name);
+    - ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated functions
+      (registered under their own name).
+    """
+    functions: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions.setdefault(node.name, node)
+
+    def info_from_call(name: str, call: ast.Call,
+                       fn: ast.FunctionDef) -> WrapperInfo:
+        params = _param_names(fn)
+        return WrapperInfo(
+            name, params,
+            _named_params(call, params, "donate_argnames", "donate_argnums"),
+            _named_params(call, params, "static_argnames", "static_argnums"),
+        )
+
+    out: Dict[str, WrapperInfo] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            is_jitcall = _is_trace_entry(call.func) or any(
+                kw.arg in ("donate_argnames", "donate_argnums",
+                           "static_argnames", "static_argnums")
+                for kw in call.keywords
+            )
+            if not is_jitcall:
+                continue
+            wrapped = _wrapped_fn_name(call, functions)
+            if wrapped is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = info_from_call(
+                        tgt.id, call, functions[wrapped]
+                    )
+    for name, fn in functions.items():
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and (
+                _is_trace_entry(dec.func)
+                or (_terminal_name(dec.func) == "partial"
+                    and any(_is_trace_entry(a) for a in dec.args))
+            ):
+                # Registered even with empty donate/static surfaces: the
+                # size-class rule must see calls of a plain
+                # @partial(jax.jit) kernel exactly like a bare @jax.jit.
+                out.setdefault(name, WrapperInfo(
+                    name, _param_names(fn),
+                    _named_params(dec, _param_names(fn),
+                                  "donate_argnames", "donate_argnums"),
+                    _named_params(dec, _param_names(fn),
+                                  "static_argnames", "static_argnums"),
+                ))
+            elif _is_trace_entry(dec):
+                out.setdefault(
+                    name, WrapperInfo(name, _param_names(fn), set(), set())
+                )
+    return out
+
+
+def module_wrappers(ctx) -> Dict[str, WrapperInfo]:
+    """Cached analyze_wrappers for a FileContext."""
+    if "jit_wrappers" not in ctx.cache:
+        ctx.cache["jit_wrappers"] = analyze_wrappers(ctx.tree)
+    return ctx.cache["jit_wrappers"]
+
+
 def _annotation_kind(ann: Optional[ast.AST]) -> Optional[bool]:
     """True = array-ish, False = static-ish, None = unknown."""
     if ann is None:

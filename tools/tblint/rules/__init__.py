@@ -2,10 +2,14 @@
 
 from . import (  # noqa: F401
     determinism,
+    donation,
     excepts,
     hostsync,
+    lanerace,
     layout,
     loops,
+    shardrep,
+    sizeclass,
     tracer,
     u128_rules,
 )

@@ -1,0 +1,268 @@
+"""donation: buffer-donation and staging-pool aliasing discipline.
+
+``donate_argnames`` hands a buffer to XLA to scribble over; three misuses
+have each needed a prose proof somewhere in this repo's dispatch funnels
+(machine.py / parallel/sharded.py, PR 7/11):
+
+1. USE-AFTER-DONATE — the donated value is read again after the call
+   without being rebound from the call's result.  XLA is free to have
+   reused the buffer: the read returns garbage (or raises a deleted-buffer
+   error, backend-dependent).
+2. DONATING A POOLED/CACHED BUFFER — a cached zero-count template or a
+   pooled staging set handed to a donating parameter gets consumed; the
+   next commit that pulls it from the pool reads scratch.  (The contract
+   note on machine._pad_soa: a template handed to a batch-donating kernel
+   must be copied first.)
+3. DONATING A STAGING ALIAS — ``jax.device_put`` of a pooled numpy staging
+   buffer may alias it zero-copy on XLA-CPU (the machine._stage_group
+   note); donating the resulting device array lets XLA scribble into the
+   pool behind the dirty-row tracking's back.
+
+The analysis is module-local and name-level: jitgraph.analyze_wrappers
+resolves which call-site names donate which parameters; pooled buffers are
+names bound from ``*_stage_*`` helpers or subscripts of pool/template/
+cache attributes (``self._stage_pool``, ``self._pad_soa_zero``, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import _root_name, _terminal_name, module_wrappers
+
+#: Attribute-name fragments marking a pool / cached-template container.
+POOL_ATTR_FRAGMENTS = ("pool", "template", "_zero", "cache", "stage")
+
+#: Call-name fragments whose result is a pooled staging buffer (set).
+POOL_CALL_FRAGMENTS = ("stage_acquire", "stage_group")
+
+
+def _expr_key(expr: ast.AST) -> Optional[str]:
+    """Stable key for a donate-trackable value: a bare local name, or a
+    ``self.<attr>`` read.  Anything else is untracked."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return f"self.{expr.attr}"
+    return None
+
+
+def _is_pool_attr(expr: ast.AST) -> bool:
+    """self._stage_pool[...], self._pad_soa_zero[key], obj.template_cache."""
+    if isinstance(expr, ast.Subscript):
+        return _is_pool_attr(expr.value)
+    if isinstance(expr, ast.Attribute):
+        return any(f in expr.attr for f in POOL_ATTR_FRAGMENTS)
+    return False
+
+
+def _is_pool_call(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    name = _terminal_name(expr.func) or ""
+    return any(f in name for f in POOL_CALL_FRAGMENTS)
+
+
+class _FnScan:
+    """One linear pass over a function body, in source order."""
+
+    def __init__(self, rule: "DonationRule", ctx: FileContext,
+                 fn: ast.FunctionDef) -> None:
+        self.rule = rule
+        self.ctx = ctx
+        self.fn = fn
+        self.wrappers = module_wrappers(ctx)
+        self.pooled: Set[str] = set()     # names bound to pooled buffers
+        self.findings: List[Finding] = []
+        # (key, donate line): donated values awaiting a rebind or a use.
+        self.donated_live: dict = {}
+
+    def _mentions_pooled(self, expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in self.pooled:
+                return True
+            if isinstance(sub, (ast.Attribute, ast.Subscript)) and \
+                    _is_pool_attr(sub):
+                return True
+        return False
+
+    def _bind(self, target: ast.AST, pooled: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.pooled.add if pooled else self.pooled.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, pooled)
+        key = _expr_key(target)
+        if key is not None:
+            self.donated_live.pop(key, None)
+
+    def _value_pooled(self, value: ast.AST) -> bool:
+        if _is_pool_call(value) or _is_pool_attr(value):
+            return True
+        if isinstance(value, ast.Call):
+            name = _terminal_name(value.func)
+            root = _root_name(value.func)
+            # device_put/asarray of a pooled numpy buffer may alias it
+            # zero-copy on XLA-CPU: the result stays "pooled".
+            if name in ("device_put", "asarray") and root in (
+                "jax", "jnp", "np", "numpy",
+            ):
+                return any(self._mentions_pooled(a) for a in value.args[:1])
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return any(self._value_pooled(e) for e in value.elts)
+        if isinstance(value, ast.Name):
+            return value.id in self.pooled
+        return False
+
+    def _check_call(self, call: ast.Call, stmt_targets: Set[str]) -> None:
+        func_name = None
+        if isinstance(call.func, ast.Name):
+            func_name = call.func.id
+        elif isinstance(call.func, ast.Attribute):
+            # self._shard_steps["fast"] / sm.create_transfers are not
+            # module-local names; only bare-Name callees resolve.
+            return
+        info = self.wrappers.get(func_name)
+        if info is None or not info.donated:
+            return
+        for pname, arg in info.donated_args(call):
+            if self._mentions_pooled(arg):
+                self.findings.append(Finding(
+                    self.rule.id, self.ctx.display_path,
+                    arg.lineno, arg.col_offset,
+                    f"pooled/cached buffer donated to {func_name}"
+                    f"({pname}=): the pool's next user reads XLA scratch "
+                    "— copy before donating",
+                ))
+                continue
+            key = _expr_key(arg)
+            if key is None:
+                continue
+            if key in stmt_targets:
+                continue  # rebound from the result in the same statement
+            self.donated_live[key] = (call.lineno, func_name, pname)
+
+    def _check_use(self, expr: ast.AST) -> None:
+        """Flag loads of a still-live donated key."""
+        for sub in ast.walk(expr):
+            key = None
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                key = sub.id
+            elif (isinstance(sub, ast.Attribute)
+                  and isinstance(sub.ctx, ast.Load)
+                  and isinstance(sub.value, ast.Name)
+                  and sub.value.id == "self"):
+                key = f"self.{sub.attr}"
+            if key is not None and key in self.donated_live:
+                dline, fname, pname = self.donated_live.pop(key)
+                self.findings.append(Finding(
+                    self.rule.id, self.ctx.display_path,
+                    sub.lineno, sub.col_offset,
+                    f"use after donate: {key} was donated to {fname}"
+                    f"({pname}=) at line {dline}; XLA may have reused the "
+                    "buffer — rebind from the call's result instead",
+                ))
+
+    # -- statement walk ------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        self._walk_body(self.fn.body)
+        return self.findings
+
+    def _walk_body(self, body) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt)
+
+    def _stmt_target_keys(self, stmt) -> Set[str]:
+        keys: Set[str] = set()
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                key = _expr_key(e)
+                if key is not None:
+                    keys.add(key)
+        return keys
+
+    def _walk_stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs get their own scan
+        if isinstance(stmt, (ast.If, ast.While)):
+            # Compound statements: check only the head expression here;
+            # the bodies are walked statement-by-statement below so a
+            # rebind inside a branch is seen before later uses.
+            self._check_use(stmt.test)
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._check_use(stmt.iter)
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_use(item.context_expr)
+            self._walk_body(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body)
+            for h in stmt.handlers:
+                self._walk_body(h.body)
+            self._walk_body(stmt.orelse)
+            self._walk_body(stmt.finalbody)
+            return
+        targets = self._stmt_target_keys(stmt)
+        # Uses first (RHS reads happen before the rebind takes effect),
+        # except the donating call's own arguments.
+        donating_calls = []
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                info = self.wrappers.get(sub.func.id)
+                if info is not None and info.donated:
+                    donating_calls.append(sub)
+        self._check_use(stmt)
+        for call in donating_calls:
+            self._check_call(call, targets)
+        if isinstance(stmt, ast.Assign):
+            pooled = self._value_pooled(stmt.value)
+            for t in stmt.targets:
+                self._bind(t, pooled)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, self._value_pooled(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            key = _expr_key(stmt.target)
+            if key is not None:
+                self.donated_live.pop(key, None)
+
+
+@register
+class DonationRule(Rule):
+    id = "donation"
+    summary = ("use-after-donate, donating a pooled/cached buffer, or "
+               "donating a device_put staging alias")
+    rationale = (
+        "A donated buffer becomes XLA scratch: reading it afterward, or "
+        "donating a cached template / pooled staging buffer (which "
+        "device_put may alias zero-copy on XLA-CPU), silently corrupts "
+        "the next commit that touches the pool — the bug class PR 7/11 "
+        "carry prose proofs against."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not module_wrappers(ctx):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_FnScan(self, ctx, node).run())
+        return out

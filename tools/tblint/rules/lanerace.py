@@ -1,0 +1,158 @@
+"""lane-race: lock discipline between lane/background closures and the
+serving thread.
+
+machine.py runs deferred dispatches on a single-worker FIFO executor
+("the dispatch lane") and vsr/replica.py runs checkpoint writes and WAL
+fsyncs on background threads.  A closure submitted to either mutates
+``self`` attributes CONCURRENTLY with the serving thread; every such
+attribute needs one of: a lock (``with self._x_lock:``), a join-before-
+read handoff, or an explicit suppression citing the handoff (the FIFO
+lane's resolve() join, the checkpoint poll's is_alive() gate).
+
+The rule finds, per class: nested functions handed to another thread
+(``<executor>.submit(fn)``, ``Thread(target=fn)``) and the ``self.X``
+attributes they WRITE outside a lock; any other method of the class that
+touches the same attribute (read or write) outside a lock makes the pair
+a finding, anchored at the closure's write.  One finding per
+(closure, attribute)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import _terminal_name
+
+
+def _lock_spans(fn: ast.AST) -> List[Tuple[int, int]]:
+    """(lineno, end_lineno) of every ``with self.<lock-ish>:`` body."""
+    spans = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Attribute) and "lock" in expr.attr:
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+    return spans
+
+
+def _in_spans(line: int, spans: List[Tuple[int, int]]) -> bool:
+    return any(lo <= line <= hi for lo, hi in spans)
+
+
+def _self_attr_writes(fn: ast.FunctionDef) -> Dict[str, ast.Attribute]:
+    """attr name -> first unlocked ``self.X = / op=`` write site."""
+    spans = _lock_spans(fn)
+    out: Dict[str, ast.Attribute] = {}
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, (ast.Store, ast.Del))
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and not _in_spans(node.lineno, spans)):
+            out.setdefault(node.attr, node)
+    return out
+
+
+def _self_attr_touches(fn: ast.FunctionDef,
+                       skip: Optional[ast.FunctionDef] = None) -> Set[str]:
+    """All ``self.X`` attribute names touched (load or store) outside a
+    lock, excluding the subtree of ``skip`` (the closure under test)."""
+    spans = _lock_spans(fn)
+    skip_range = None
+    if skip is not None:
+        skip_range = (skip.lineno, skip.end_lineno or skip.lineno)
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and not _in_spans(node.lineno, spans)):
+            if skip_range and skip_range[0] <= node.lineno <= skip_range[1]:
+                continue
+            out.add(node.attr)
+    return out
+
+
+def _threaded_closures(method: ast.FunctionDef) -> List[ast.FunctionDef]:
+    """Nested defs of ``method`` that are handed to another thread:
+    ``<anything>.submit(fn)`` or ``Thread(target=fn)``."""
+    nested = {n.name: n for n in ast.walk(method)
+              if isinstance(n, ast.FunctionDef) and n is not method}
+    if not nested:
+        return []
+    picked: List[ast.FunctionDef] = []
+    for node in ast.walk(method):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name == "submit":
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in nested:
+                    picked.append(nested.pop(arg.id))
+        elif name == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name) \
+                        and kw.value.id in nested:
+                    picked.append(nested.pop(kw.value.id))
+    return picked
+
+
+@register
+class LaneRaceRule(Rule):
+    id = "lane-race"
+    summary = ("self attribute written in a dispatch-lane/background-thread "
+               "closure and touched from serving-thread methods without a "
+               "lock")
+    rationale = (
+        "Lane closures and background threads mutate machine/replica "
+        "state concurrently with the serving thread; an unlocked shared "
+        "attribute is a torn read or lost update waiting for a slow "
+        "dispatch.  Guard with a lock or document the join/handoff that "
+        "orders the accesses (resolve()'s FIFO join, the checkpoint "
+        "poll's is_alive gate) in a suppression reason."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and (
+            ctx.basename == "machine.py" or "vsr" in ctx.parts
+        )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            closures = []  # (owner method, closure fn)
+            for m in methods:
+                for c in _threaded_closures(m):
+                    closures.append((m, c))
+            if not closures:
+                continue
+            for owner, closure in closures:
+                writes = _self_attr_writes(closure)
+                if not writes:
+                    continue
+                for other in methods:
+                    touched = _self_attr_touches(
+                        other, skip=closure if other is owner else None
+                    )
+                    for attr in sorted(set(writes) & touched):
+                        site = writes.pop(attr)
+                        out.append(Finding(
+                            self.id, ctx.display_path,
+                            site.lineno, site.col_offset,
+                            f"self.{attr} is written on the "
+                            f"{closure.name}() lane/background closure "
+                            f"and touched from {cls.name}.{other.name}() "
+                            "without a lock — lock it or document the "
+                            "join/handoff in a suppression reason",
+                        ))
+                    if not writes:
+                        break
+        return out

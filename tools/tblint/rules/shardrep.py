@@ -1,0 +1,209 @@
+"""shard-rep: replicated shard_map outputs must come from collectives.
+
+Inside a ``shard_map`` body every value is per-shard unless proven
+otherwise; an output declared replicated (``out_specs=P()``) that derives
+from a shard-varying input WITHOUT passing through a collective
+(``psum``/``pmax``/``all_gather``) is a different value on every shard —
+and with ``check_vma=False`` (this repo's standing setting, because the
+library kernels cannot pvary-annotate) jax will NOT catch it: whichever
+shard's buffer wins materializes, silently, as "the" result.
+
+Name-level taint over the body function: parameters whose in_spec names a
+mesh axis (``P(AXIS)``, ``P('shard')``) are VARYING; collectives cleanse;
+a return element at a replicated out_specs position that is still varying
+is a finding.  Specs the analysis cannot read statically (helper-built
+spec trees) are treated as unknown — the rule errs toward silence."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..core import FileContext, Finding, Rule, register
+from ..jitgraph import _terminal_name
+
+#: collectives whose result is identical on every shard of the axis
+_CLEANSING = {"psum", "pmean", "pmax", "pmin", "all_gather",
+              "psum_scatter", "axis_index"}
+
+# Spec classification results.
+REPLICATED, VARYING, UNKNOWN = "replicated", "varying", "unknown"
+
+
+def _classify_spec(expr: ast.AST) -> str:
+    """P() / P(None) -> replicated; P('x') / P(AXIS) -> varying;
+    helper calls named *replicated* -> replicated; else unknown."""
+    if isinstance(expr, ast.Call):
+        name = _terminal_name(expr.func) or ""
+        if name == "P" or name.endswith("PartitionSpec"):
+            args = [a for a in expr.args
+                    if not (isinstance(a, ast.Constant) and a.value is None)]
+            return VARYING if args else REPLICATED
+        if "replicated" in name:
+            return REPLICATED
+    return UNKNOWN
+
+
+def _spec_list(expr: Optional[ast.AST]) -> List[str]:
+    if expr is None:
+        return []
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return [_classify_spec(e) for e in expr.elts]
+    return [_classify_spec(expr)]
+
+
+class _Taint:
+    """Forward shard-varying taint through the body function."""
+
+    def __init__(self, fn: ast.FunctionDef, varying_params: Set[str]) -> None:
+        self.varying: Set[str] = set(varying_params)
+        self.returns: List[ast.Return] = []
+        self._walk(fn.body)
+
+    def expr_varying(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            name = _terminal_name(expr.func)
+            if name in _CLEANSING:
+                return False  # collective: replicated across the axis
+            return any(self.expr_varying(a) for a in expr.args) or any(
+                self.expr_varying(kw.value) for kw in expr.keywords
+            )
+        if isinstance(expr, ast.Name):
+            return expr.id in self.varying
+        if isinstance(expr, ast.Attribute):
+            return self.expr_varying(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.expr_varying(expr.value) or \
+                self.expr_varying(expr.slice)
+        if isinstance(expr, ast.BinOp):
+            return self.expr_varying(expr.left) or \
+                self.expr_varying(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_varying(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.expr_varying(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            return self.expr_varying(expr.left) or any(
+                self.expr_varying(c) for c in expr.comparators
+            )
+        if isinstance(expr, ast.IfExp):
+            return (self.expr_varying(expr.test)
+                    or self.expr_varying(expr.body)
+                    or self.expr_varying(expr.orelse))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.expr_varying(e) for e in expr.elts)
+        return False
+
+    def _bind(self, target: ast.AST, varying: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.varying.add if varying
+             else self.varying.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, varying)
+
+    def _walk(self, body) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                v = self.expr_varying(stmt.value)
+                for t in stmt.targets:
+                    self._bind(t, v)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._bind(stmt.target, self.expr_varying(stmt.value))
+            elif isinstance(stmt, ast.AugAssign):
+                if self.expr_varying(stmt.value):
+                    self._bind(stmt.target, True)
+            elif isinstance(stmt, ast.Return):
+                self.returns.append(stmt)
+            elif isinstance(stmt, (ast.If, ast.While, ast.For)):
+                if isinstance(stmt, ast.For):
+                    self._bind(stmt.target, self.expr_varying(stmt.iter))
+                self._walk(stmt.body)
+                self._walk(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body)
+                for h in stmt.handlers:
+                    self._walk(h.body)
+                self._walk(stmt.orelse)
+                self._walk(stmt.finalbody)
+
+
+@register
+class ShardReplicationRule(Rule):
+    id = "shard-rep"
+    summary = ("shard_map output declared replicated (out_specs=P()) but "
+               "derived from a shard-varying input without a collective")
+    rationale = (
+        "With check_vma=False (this repo's standing setting) jax cannot "
+        "verify replication: a per-shard value returned at a P() output "
+        "position silently materializes one arbitrary shard's buffer as "
+        "'the' result.  Replicated outputs must flow through psum/"
+        "all_gather or derive from replicated operands."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        functions = {
+            n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        if not functions:
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _terminal_name(node.func) == "shard_map"
+                    and node.args):
+                continue
+            body_name = node.args[0]
+            if not (isinstance(body_name, ast.Name)
+                    and body_name.id in functions):
+                continue
+            body = functions[body_name.id]
+            in_specs = out_specs = None
+            for kw in node.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = kw.value
+                elif kw.arg == "out_specs":
+                    out_specs = kw.value
+            in_kinds = _spec_list(in_specs)
+            out_kinds = _spec_list(out_specs)
+            if not out_kinds:
+                continue
+            params = [a.arg for a in body.args.posonlyargs + body.args.args]
+            if in_specs is not None and not isinstance(
+                in_specs, (ast.Tuple, ast.List)
+            ) and len(in_kinds) == 1:
+                # jax broadcast form: a single spec applies to EVERY arg.
+                in_kinds = in_kinds * len(params)
+            varying = {
+                p for p, kind in zip(params, in_kinds) if kind == VARYING
+            }
+            if not varying:
+                continue
+            taint = _Taint(body, varying)
+            for ret in taint.returns:
+                if ret.value is None:
+                    continue
+                elts = (ret.value.elts
+                        if isinstance(ret.value, ast.Tuple)
+                        else [ret.value])
+                for i, elt in enumerate(elts):
+                    kind = out_kinds[i] if i < len(out_kinds) else (
+                        out_kinds[-1] if len(out_kinds) == 1 else UNKNOWN
+                    )
+                    if kind == REPLICATED and taint.expr_varying(elt):
+                        out.append(Finding(
+                            self.id, ctx.display_path,
+                            elt.lineno, elt.col_offset,
+                            f"output {i} of shard_map body "
+                            f"{body.name}() is declared replicated "
+                            "(out_specs=P()) but derives from a shard-"
+                            "varying input with no psum/all_gather — "
+                            "each shard returns a different value",
+                        ))
+        return out
