@@ -47,3 +47,32 @@ def ok_patterns(items, seed):
 def suppressed(items):
     s = set(items)
     return list(s)  # tblint: ignore[nondet]
+
+
+def bad_dict_extremal(ballots):
+    # Key-based selection over a dict view: ties fall to insertion
+    # (arrival) order, not protocol state (PR 13 canonical-hashing fix).
+    best = max(ballots.values(), key=lambda b: b.view)  # finding: nondet
+    worst = min(ballots.items(), key=lambda kv: kv[1].op)  # finding: nondet
+    return best, worst
+
+
+def bad_values_snapshot(pending):
+    out = []
+    for frame in list(pending.values()):  # finding: nondet (arrival order)
+        out.append(frame)
+    return out
+
+
+def ok_dict_extremal(ballots, pending):
+    best = max(sorted(ballots.items()))  # ok: sorted normalizes
+    newest = max(ballots.values())  # ok: no key= — total value order
+    out = [pending[k] for k in sorted(pending)]  # ok: sorted keys
+    return best, newest, out
+
+
+def suppressed_dict(ballots, pending):
+    a = max(ballots.values(), key=lambda b: b.view)  # tblint: ignore[nondet]
+    for frame in list(pending.values()):  # tblint: ignore[nondet]
+        a = frame
+    return a

@@ -1,0 +1,465 @@
+"""tbmc: the exhaustive small-scope model checker (sim/mc.py, docs/tbmc.md).
+
+Covers the three tentpole layers and their contracts:
+
+- EXTRACT: the snapshot()/restore() protocol-state capsule round-trips
+  bit-identically for every replica status (normal / view-change /
+  recovering / state-sync armed), a pinned VOPR seed replays green with
+  snapshot/restore interposed every N ticks, and the incremental
+  canonical hash equals the full recompute along a random event walk.
+- EXPLORE: tiny scopes are exhaustively clean, the POR sleep sets and
+  canonical dedup do not change verdicts (por on/off spot-check), and
+  each seeded protocol mutation yields a safety counterexample while the
+  unmutated control at the SAME scope is exhaustively clean.
+- REPLAY: a counterexample schedule replays bit-identically through
+  replay_schedule / `vopr --replay-schedule` (flag-exclusive, PR 5/6
+  discipline), and replaying it WITHOUT the mutation does not reproduce
+  (the defense breaks the schedule).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from tigerbeetle_tpu.sim.mc import (
+    MUTATIONS, McCluster, McScope, ModelChecker, _enc, check,
+    replay_schedule,
+)
+from tigerbeetle_tpu.sim.network import FifoNet
+from tigerbeetle_tpu.sim.vopr import run_seed
+from tigerbeetle_tpu.vsr.consensus import NORMAL, RECOVERING, VIEW_CHANGE
+
+CID = 1009  # first (and only) scripted client id at n_clients=1
+
+
+def capsule_digest(capsule: dict) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    _enc(h.update, capsule)
+    return h.digest()
+
+
+def make_harness(tmp_path, scope: McScope, mutations=()) -> McCluster:
+    harness = McCluster(scope, str(tmp_path), tuple(mutations))
+    harness.bootstrap()
+    return harness
+
+
+# -- EXTRACT: the capsule -----------------------------------------------------
+
+
+class TestCapsuleRoundTrip:
+    """snapshot() -> mutate -> restore() is bit-identical per status."""
+
+    def _roundtrip(self, replica) -> None:
+        before = replica.snapshot()
+        digest = capsule_digest(before)
+        # Smash a representative slice of every capsule group.
+        replica.view += 3
+        replica.commit_min += 1
+        replica.headers.pop(max(replica.headers), None)
+        replica._anchors[999] = 1
+        replica._ticks += 17
+        replica.prng.random()
+        replica._prepare_timeout.attempts += 2
+        replica.restore(before)
+        after = replica.snapshot()
+        assert capsule_digest(after) == digest
+        # The capsule stays reusable (restore deep-copies on the way in).
+        replica.headers[123456] = None
+        assert 123456 not in before["containers"]["headers"]
+
+    def test_normal(self, tmp_path):
+        harness = make_harness(tmp_path, McScope(timeout_budget=0))
+        replica = harness.cluster.replicas[1]
+        assert replica.status == NORMAL
+        self._roundtrip(replica)
+
+    def test_view_change(self, tmp_path):
+        harness = make_harness(tmp_path, McScope())
+        harness.apply_event(("timeout", 2, "suspect"))
+        replica = harness.cluster.replicas[2]
+        assert replica.status == VIEW_CHANGE
+        self._roundtrip(replica)
+
+    def test_recovering(self, tmp_path):
+        harness = make_harness(tmp_path, McScope())
+        harness.apply_event(("crash", 1))
+        harness.apply_event(("restart", 1))
+        replica = harness.cluster.replicas[1]
+        assert replica.status == RECOVERING
+        self._roundtrip(replica)
+
+    def test_state_sync_armed(self, tmp_path):
+        harness = make_harness(tmp_path, McScope())
+        replica = harness.cluster.replicas[2]
+        replica.sync_target = {"checkpoint_op": 19, "total": 3}
+        replica._sync_peer = 0
+        replica.sync_buffer.extend(b"\x5a" * 64)
+        self._roundtrip(replica)
+        assert replica.sync_target == {"checkpoint_op": 19, "total": 3}
+
+    def test_superblock_sequence_is_state_not_history(self, tmp_path):
+        """The capsule carries the SuperBlock OBJECT's in-memory state:
+        checkpoint() bumps ``sequence`` from it, so a restore() that left
+        it stale made the next view-persist's sequence count every
+        install the instance ever ran — exploration history leaking into
+        the canonical hash (the ~400x view-change state-space blowup the
+        hashing pass surfaced; docs/tbmc.md "Determinism notes")."""
+        harness = make_harness(tmp_path, McScope())
+        replica = harness.cluster.replicas[1]
+        capsule = replica.snapshot()
+        seq = replica.superblock.state.sequence
+        # Two installs on the live instance, then backtrack.
+        replica._persist_view()
+        replica._persist_view()
+        assert replica.superblock.state.sequence == seq + 2
+        replica.restore(capsule)
+        assert replica.superblock.state.sequence == seq
+        # The next install must continue from the RESTORED sequence.
+        replica._persist_view()
+        assert replica.superblock.state.sequence == seq + 1
+        assert replica._sb_state.sequence == seq + 1
+
+    def test_restore_into_fresh_instance(self, tmp_path):
+        """The restart-into-state path: a capsule taken from one replica
+        instance restores onto a freshly constructed one."""
+        harness = make_harness(tmp_path, McScope())
+        cl = harness.cluster
+        capsule = cl.replicas[1].snapshot()
+        digest = capsule_digest(capsule)
+        cl.crash(1)
+        cl.restart(1)
+        cl.replicas[1].restore(capsule)
+        assert capsule_digest(cl.replicas[1].snapshot()) == digest
+
+    def test_capsule_requires_matching_ledger_without_mc_restore(
+            self, tmp_path):
+        """With a machine that cannot restore folded ledger state (the
+        production TpuStateMachine), the capsule asserts the live digest
+        matches (executed state does not travel, docs/tbmc.md)."""
+
+        class _FrozenLedger:
+            prepare_timestamp = 0
+            commit_timestamp = 0
+
+            @staticmethod
+            def digest():
+                return 0xFEED
+
+        harness = make_harness(tmp_path, McScope())
+        replica = harness.cluster.replicas[1]
+        capsule = replica.snapshot()
+        capsule["machine"] = {
+            "folded_digest": 0xBAD,
+            "prepare_timestamp": 0,
+            "commit_timestamp": 0,
+        }
+        live = replica.machine
+        replica.machine = _FrozenLedger()
+        try:
+            with pytest.raises(RuntimeError, match="folds the ledger"):
+                replica.restore(capsule)
+            capsule["machine"]["folded_digest"] = 0xFEED
+            replica.restore(capsule)  # matching digest: accepted
+        finally:
+            replica.machine = live
+
+
+def test_vopr_seed_green_with_snapshot_interpose(tmp_path):
+    """A pinned VOPR seed must replay bit-identically with every live
+    replica's protocol state round-tripped through snapshot()/restore()
+    every 64 ticks — the capsule captures the full state surface."""
+    base = run_seed(7, workdir=str(tmp_path / "a"), ticks=3_000)
+    interposed = run_seed(7, workdir=str(tmp_path / "b"), ticks=3_000,
+                          snapshot_interpose=64)
+    assert base.exit_code == 0
+    assert interposed.exit_code == 0
+    assert (base.reason, base.ticks, base.commits, base.faults) == (
+        interposed.reason, interposed.ticks, interposed.commits,
+        interposed.faults,
+    )
+
+
+def test_incremental_canonical_hash_matches_full(tmp_path):
+    """Along a random legal event walk, updating only the touched
+    replicas' canonical blobs must equal the full recompute — the
+    explorer's incremental-hash contract."""
+    scope = McScope(ops_per_client=2, crash_budget=1, timeout_budget=2,
+                    drop_budget=1)
+    harness = make_harness(tmp_path, scope)
+    rng = random.Random(7)
+    parts = harness.canon_parts()
+    key = harness.canonical_key(parts)
+    steps = 0
+    for _ in range(600):
+        events = harness.enabled_events()
+        if not events:
+            break
+        event = rng.choice(events)
+        harness.apply_event(event)
+        for i in McCluster.touched_replicas(event):
+            parts[i] = harness.canon_blob(i)
+        assert parts == harness.canon_parts(), f"stale blob after {event}"
+        new_key = harness.canonical_key(parts)
+        assert new_key == harness.canonical_key()
+        key = new_key
+        steps += 1
+    assert steps >= 20  # the walk went somewhere before quiescing
+    assert key
+
+
+def test_snapshot_restore_replays_canonical_key(tmp_path):
+    """restore() brings back the exact canonical key, including after
+    further divergence (the DFS backtracking contract)."""
+    scope = McScope(ops_per_client=1, timeout_budget=1)
+    harness = make_harness(tmp_path, scope)
+    capsule = harness.snapshot()
+    key = harness.canonical_key()
+    for event in harness.enabled_events()[:3]:
+        harness.restore(capsule)
+        harness.apply_event(event)
+        assert harness.canonical_key() != b""
+    harness.restore(capsule)
+    assert harness.canonical_key() == key
+
+
+# -- the FifoNet ---------------------------------------------------------------
+
+
+class TestFifoNet:
+    def test_fifo_per_link_and_busy_links_sorted(self):
+        net = FifoNet()
+        a, b = ("replica", 0), ("replica", 1)
+        net.send(a, b, b"one")
+        net.send(a, b, b"two")
+        net.send(b, a, b"three")
+        assert net.busy_links() == [(a, b), (b, a)]
+        assert net.pop(a, b) == b"one"
+        assert net.pop(a, b) == b"two"
+        assert (a, b) not in net.links
+        assert net.in_flight == 1
+
+    def test_coalesce_absorbs_byte_twins(self):
+        net = FifoNet()
+        a, b = ("replica", 0), ("replica", 1)
+        net.send(a, b, b"dup")
+        net.send(a, b, b"dup")
+        assert net.coalesced == 1
+        assert net.in_flight == 1
+        net2 = FifoNet(coalesce=False)
+        net2.send(a, b, b"dup")
+        net2.send(a, b, b"dup")
+        assert net2.in_flight == 2
+
+    def test_snapshot_restore(self):
+        net = FifoNet()
+        a, b = ("replica", 0), ("client", 5)
+        net.send(a, b, b"x")
+        cap = net.snapshot()
+        net.pop(a, b)
+        assert net.in_flight == 0
+        net.restore(cap)
+        assert net.pop(a, b) == b"x"
+
+    def test_drop_if_filters_at_send(self):
+        net = FifoNet()
+        net.drop_if = lambda src, dst: True
+        net.send(("replica", 0), ("replica", 1), b"gone")
+        assert net.in_flight == 0
+        assert net.dropped == 1
+
+
+# -- EXPLORE -------------------------------------------------------------------
+
+
+def test_tiny_scope_exhaustive_and_clean():
+    scope = McScope(ops_per_client=1, crash_budget=0, timeout_budget=0,
+                    max_states=5_000)
+    report = check(scope)
+    assert report.exhaustive
+    assert report.violation is None
+    assert report.states > 10
+    assert report.deduped > 0
+
+
+def test_por_and_dedup_do_not_change_the_verdict():
+    """Sleep-set POR + canonical dedup are reductions, not scope cuts:
+    verdicts match with POR disabled, and the no-POR run explores at
+    least as many states."""
+    scope = McScope(ops_per_client=1, crash_budget=0, drop_budget=1,
+                    byz_budget=1, timeout_budget=0, max_states=50_000)
+    fast = ModelChecker(scope).run()
+    slow = ModelChecker(scope, por=False).run()
+    assert fast.exhaustive and slow.exhaustive
+    assert fast.violation is None and slow.violation is None
+    assert slow.states >= fast.states
+    # Same discipline on a violating scope: both must find it.
+    vfast = ModelChecker(scope, ("not_primary",)).run()
+    vslow = ModelChecker(scope, ("not_primary",), por=False).run()
+    assert vfast.violation is not None and vslow.violation is not None
+    assert vfast.violation["kind"] == vslow.violation["kind"]
+
+
+def test_budget_dominance_dedup_is_conservative():
+    """A state revisited with strictly more fuel is re-explored (not
+    deduped away): the byz-armed scope must still find its violation
+    even though fault-first ordering reaches many states budget-first."""
+    scope = McScope(ops_per_client=1, crash_budget=0, drop_budget=1,
+                    byz_budget=1, timeout_budget=0, max_states=50_000)
+    report = ModelChecker(scope, ("not_primary",)).run()
+    assert report.violation is not None
+    assert report.violation["kind"] == "agreement"
+
+
+class TestMutationProofs:
+    """Each seeded protocol mutation yields a machine-checked safety
+    counterexample; the unmutated control at the SAME scope is
+    exhaustively clean (tools/mc_smoke.py runs the full pinned set)."""
+
+    def test_anchor_certify_falls_to_piggyback_execution(self):
+        scope = McScope(ops_per_client=2, crash_budget=0, timeout_budget=0,
+                        max_states=20_000)
+        report = check(scope, ("anchor_certify",))
+        assert report.violation is not None
+        assert report.violation["kind"] == "certified_commit"
+        control = check(scope)
+        assert control.exhaustive and control.violation is None
+
+    def test_not_primary_falls_to_equivocation(self):
+        scope = McScope(ops_per_client=1, crash_budget=0, byz_budget=1,
+                        drop_budget=1, timeout_budget=0, max_states=50_000)
+        report = check(scope, ("not_primary",))
+        assert report.violation is not None
+        assert report.violation["kind"] == "agreement"
+        control = check(scope)
+        assert control.exhaustive and control.violation is None
+
+
+# -- REPLAY --------------------------------------------------------------------
+
+
+def _anchor_certify_counterexample():
+    scope = McScope(ops_per_client=2, crash_budget=0, timeout_budget=0,
+                    max_states=20_000)
+    report = check(scope, ("anchor_certify",))
+    assert report.violation is not None
+    return report.counterexample()
+
+
+def test_counterexample_replays_bit_identically():
+    data = _anchor_certify_counterexample()
+    result = replay_schedule(data)
+    assert result["error"] is None
+    assert result["reproduced"] is True
+    assert result["identical"] is True
+    assert result["state_key"] == data["state_key"]
+
+
+def test_counterexample_does_not_reproduce_without_the_mutation():
+    """The passes-with-defenses half: the same schedule under the
+    unmutated protocol must NOT reproduce the violation — either an
+    event becomes illegal (divergence) or the walk ends clean."""
+    data = dict(_anchor_certify_counterexample(), mutations=[])
+    result = replay_schedule(data)
+    assert result["reproduced"] is False
+
+
+def test_counterexample_json_round_trips_through_disk(tmp_path):
+    data = _anchor_certify_counterexample()
+    path = tmp_path / "ce.json"
+    path.write_text(json.dumps(data))
+    result = replay_schedule(str(path))
+    assert result["reproduced"] and result["identical"]
+
+
+class TestReplayCli:
+    """`vopr --replay-schedule`: the CLI counterexample-replay path."""
+
+    def _cli(self, *argv):
+        return subprocess.run(
+            [sys.executable, "-m", "tigerbeetle_tpu", "vopr", *argv],
+            capture_output=True, text=True, timeout=600,
+        )
+
+    def test_replay_identity(self, tmp_path):
+        data = _anchor_certify_counterexample()
+        path = tmp_path / "ce.json"
+        path.write_text(json.dumps(data))
+        proc = self._cli("--replay-schedule", str(path))
+        assert proc.returncode == 0, proc.stderr
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload["reproduced"] and payload["identical"]
+
+    def test_exclusive_with_other_vopr_flags(self, tmp_path):
+        path = tmp_path / "ce.json"
+        path.write_text("{}")
+        for extra in (["--ticks", "100"], ["--seed", "1"],
+                      ["--byzantine"], ["--merkle"]):
+            proc = self._cli("--replay-schedule", str(path), *extra)
+            assert proc.returncode == 2, (extra, proc.stderr)
+            assert "exclusive" in proc.stderr
+
+    def test_tampered_schedule_fails_loudly(self, tmp_path):
+        data = _anchor_certify_counterexample()
+        data["state_key"] = "00" * 20
+        path = tmp_path / "ce.json"
+        path.write_text(json.dumps(data))
+        proc = self._cli("--replay-schedule", str(path))
+        assert proc.returncode == 1
+        assert "state key differs" in proc.stderr
+
+
+# -- the guided hunt -----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_vc_quorum_guided_hunt_and_defense_replay():
+    """The quorum off-by-one: guided from the pinned deterministic
+    prefix (commit at {0,1} with replica 2 deprived, then the racy
+    escalation), the mutated protocol exhibits an agreement violation;
+    the same schedule without the mutation does not reproduce."""
+    prefix = [
+        ("client", CID, 0), ("deliver", "client", CID, "replica", 0),
+        ("deliver", "replica", 0, "replica", 1),
+        ("drop", "replica", 1, "replica", 2),
+        ("deliver", "replica", 1, "replica", 0),
+        ("deliver", "replica", 0, "client", CID),
+        ("timeout", 2, "suspect"), ("timeout", 2, "vc_escalate"),
+        ("deliver", "replica", 2, "replica", 1),
+        ("deliver", "replica", 2, "replica", 1),
+        ("client", CID, 2), ("deliver", "client", CID, "replica", 2),
+        ("timeout", 2, "prepare"),
+        ("deliver", "replica", 2, "replica", 1),
+        ("deliver", "replica", 2, "replica", 1),
+        ("deliver", "replica", 2, "replica", 1),
+    ]
+    scope = McScope(ops_per_client=2, crash_budget=0, drop_budget=1,
+                    timeout_budget=3, timeout_quiescent_only=False,
+                    timeout_kinds=("prepare",), depth_max=10,
+                    max_states=200_000)
+    report = check(scope, ("vc_quorum",), prefix=prefix)
+    assert report.violation is not None
+    assert report.violation["kind"] == "agreement"
+    data = report.counterexample()
+    result = replay_schedule(data)
+    assert result["reproduced"] and result["identical"]
+    undefended = dict(data, mutations=[])
+    assert replay_schedule(undefended)["reproduced"] is False
+
+
+def test_scope_json_round_trip():
+    scope = McScope(timeout_kinds=("prepare", "suspect"), drop_budget=2)
+    assert McScope.from_json(json.loads(json.dumps(scope.to_json()))) == scope
+
+
+def test_mutations_are_frozen_set_of_known_names(tmp_path):
+    assert set(MUTATIONS) == {"not_primary", "anchor_certify", "vc_quorum"}
+    with pytest.raises(AssertionError):
+        McCluster(McScope(), str(tmp_path), ("no_such_mutation",))

@@ -237,6 +237,13 @@ def main(argv=None) -> int:
                              "consensus ingress verification OFF — the "
                              "negative control that demonstrably fails "
                              "the safety oracle")
+    p_vopr.add_argument("--replay-schedule", default=None, metavar="FILE",
+                        help="re-execute a tbmc counterexample schedule "
+                             "(sim/mc.py, docs/tbmc.md) bit-identically "
+                             "and verify the recorded violation + state "
+                             "key reproduce; exclusive with every other "
+                             "vopr knob (the schedule file pins scope, "
+                             "mutations, and events)")
 
     p_bench = sub.add_parser("benchmark", help="client-driven load benchmark")
     p_bench.add_argument("--addresses", default=None,
@@ -281,6 +288,42 @@ def _cmd_vopr(args) -> int:
     import secrets
 
     from .sim.vopr import EXIT_CORRECTNESS
+
+    if args.replay_schedule is not None:
+        # Loudly exclusive (the PR 5/6 flag discipline): the schedule
+        # file pins the scope, mutations, and every event — any other
+        # knob would silently describe a run that never happened.
+        if (
+            args.seed is not None or args.count != 1
+            or args.ticks is not None or args.tpu
+            or args.overload or args.no_priority
+            or args.byzantine or args.no_verify
+            or args.device_faults or args.scrub_interval is not None
+            or args.merkle or args.vopr_viz or args.bug is not None
+            or args.clusters != 4096 or args.steps != 400
+        ):
+            print("error: --replay-schedule is exclusive with every other "
+                  "vopr flag (the schedule file pins scope, mutations, and "
+                  "events)", file=sys.stderr)
+            return 2
+        _enable_metrics(args.metrics_json)
+        from .sim.mc import replay_schedule
+
+        result = replay_schedule(args.replay_schedule)
+        print(json.dumps(result))
+        if result["error"]:
+            print(f"error: replay diverged: {result['error']}",
+                  file=sys.stderr)
+            return 1
+        if not result["reproduced"]:
+            print("error: recorded violation did not reproduce",
+                  file=sys.stderr)
+            return 1
+        if not result["identical"]:
+            print("error: violation reproduced but the canonical state "
+                  "key differs", file=sys.stderr)
+            return 1
+        return 0
 
     if args.tpu and (
         args.overload or args.no_priority

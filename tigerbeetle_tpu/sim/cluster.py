@@ -538,8 +538,13 @@ class SimCluster:
         merkle: bool = False,
         overload: Optional[dict] = None,
         byzantine: Optional[dict] = None,
+        machine_factory=None,
     ) -> None:
         self.workdir = workdir
+        # Pluggable state-machine factory (vsr/replica.py): the model
+        # checker (sim/mc.py) runs this same cluster — the production
+        # consensus code — over its digest-chain machine stand-in.
+        self.machine_factory = machine_factory
         self.n = n_replicas
         # Non-voting stream consumers at indexes [n, n + n_standbys)
         # (constants.zig:31-35); they journal + commit via the prepare
@@ -764,6 +769,7 @@ class SimCluster:
             hot_transfers_capacity_max=self.hot_transfers_capacity_max,
             scrub_interval=self.scrub_interval,
             merkle=self.merkle or None,
+            machine_factory=self.machine_factory,
         )
         # Virtual time: device-recovery backoff must never wall-sleep.
         replica.machine.retry_tick_s = 0
@@ -901,58 +907,75 @@ class SimCluster:
             return wire.u128(h, "client") == sid
         return False
 
+    def dispatch(self, src, dst, message: bytes) -> None:
+        """Deliver ONE frame to its destination process: decode, transport
+        source-auth, byzantine observation, admission, handler, route.
+        This is the single-event cluster step — step() folds the packet
+        simulator's due frames through it, and the model checker
+        (sim/mc.py) replays explicit per-frame schedules through exactly
+        the same path (docs/tbmc.md)."""
+        unverified = self._byz is not None and not self._byz.verify
+        kind, ident = dst
+        if kind == "replica":
+            if not self.alive[ident]:
+                return
+            try:
+                if unverified:
+                    # NEGATIVE CONTROL ONLY: parse without checksum or
+                    # source verification (wire.decode_unverified).
+                    h, command, body = wire.decode_unverified(message)
+                else:
+                    h, command, body = wire.decode(message)
+            except ValueError as err:
+                # Corrupt frame: dropped like a bad TCP peer — and
+                # counted by reason (drop-and-count discipline).
+                self._ingress_reject(getattr(err, "reason", "decode"))
+                return
+            if not unverified and not self._source_ok(src, h, command):
+                self._ingress_reject("impersonation")
+                return
+            if self._byz is not None and ident == self._byz.replica:
+                self._byz.observe_ingress(
+                    h, command, body, message, self.t
+                )
+            if self.overload is not None:
+                self._admit(ident, h, command, body)
+                return
+            try:
+                out = self.replicas[ident].on_message(h, command, body)
+            except JournalWriteFailure:
+                # Persistently misdirected medium: fail-stop — the
+                # replica crashes (and may be restarted by the fault
+                # schedule); the cluster must survive it.
+                self.crash(ident)
+                return
+            self._route(dst, out)
+        else:
+            client = self.clients.get(ident)
+            if client is None:
+                return
+            try:
+                if unverified:
+                    h, command, body = wire.decode_unverified(message)
+                else:
+                    h, command, body = wire.decode(message)
+            except ValueError as err:
+                self._ingress_reject(getattr(err, "reason", "decode"))
+                return
+            client.on_message(h, command, body, self.t)
+
+    def tick_replica(self, i: int) -> None:
+        """Run one replica tick and route its output — the timer half of
+        the cluster step (step() and the model checker share it)."""
+        try:
+            self._route(("replica", i), self.replicas[i].tick())
+        except JournalWriteFailure:
+            self.crash(i)
+
     def step(self) -> None:
         self.t += 1
-        unverified = self._byz is not None and not self._byz.verify
         for src, dst, message in self.net.deliver(self.t):
-            kind, ident = dst
-            if kind == "replica":
-                if not self.alive[ident]:
-                    continue
-                try:
-                    if unverified:
-                        # NEGATIVE CONTROL ONLY: parse without checksum or
-                        # source verification (wire.decode_unverified).
-                        h, command, body = wire.decode_unverified(message)
-                    else:
-                        h, command, body = wire.decode(message)
-                except ValueError as err:
-                    # Corrupt frame: dropped like a bad TCP peer — and
-                    # counted by reason (drop-and-count discipline).
-                    self._ingress_reject(getattr(err, "reason", "decode"))
-                    continue
-                if not unverified and not self._source_ok(src, h, command):
-                    self._ingress_reject("impersonation")
-                    continue
-                if self._byz is not None and ident == self._byz.replica:
-                    self._byz.observe_ingress(
-                        h, command, body, message, self.t
-                    )
-                if self.overload is not None:
-                    self._admit(ident, h, command, body)
-                    continue
-                try:
-                    out = self.replicas[ident].on_message(h, command, body)
-                except JournalWriteFailure:
-                    # Persistently misdirected medium: fail-stop — the
-                    # replica crashes (and may be restarted by the fault
-                    # schedule); the cluster must survive it.
-                    self.crash(ident)
-                    continue
-                self._route(dst, out)
-            else:
-                client = self.clients.get(ident)
-                if client is None:
-                    continue
-                try:
-                    if unverified:
-                        h, command, body = wire.decode_unverified(message)
-                    else:
-                        h, command, body = wire.decode(message)
-                except ValueError as err:
-                    self._ingress_reject(getattr(err, "reason", "decode"))
-                    continue
-                client.on_message(h, command, body, self.t)
+            self.dispatch(src, dst, message)
         if self._byz is not None and self.alive[self._byz.replica]:
             for dst, message in self._byz.inject(self.t):
                 self.net.send(
@@ -962,10 +985,7 @@ class SimCluster:
             self._drain_admission()
         for i in range(self.total):
             if self.alive[i]:
-                try:
-                    self._route(("replica", i), self.replicas[i].tick())
-                except JournalWriteFailure:
-                    self.crash(i)
+                self.tick_replica(i)
         for cid, client in self.clients.items():
             self._route(("client", cid), client.tick(self.t))
         if self.viz is not None:

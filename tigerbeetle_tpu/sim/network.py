@@ -154,3 +154,68 @@ class PacketSimulator:
     @property
     def in_flight(self) -> int:
         return len(self._queue)
+
+
+class FifoNet:
+    """Deterministic per-link FIFO network for the model checker
+    (sim/mc.py, docs/tbmc.md).
+
+    Each directed (src, dst) link is an ordered queue: delivery within a
+    link is FIFO — the TCP bus's per-connection ordering guarantee — and
+    WHICH link delivers next is the model checker's exploration dimension
+    (every cross-link interleaving is an explicit event).  No delays, no
+    seeded loss: drops/partitions are explicit events too.
+
+    ``coalesce``: a frame byte-identical to one already queued on its link
+    is absorbed — periodic retransmissions (SVC re-broadcasts, RSVs with
+    the mc-deterministic nonce, repair re-requests) then cannot grow the
+    state space unboundedly; delivering the queued copy subsumes them.
+    """
+
+    def __init__(self, coalesce: bool = True) -> None:
+        self.coalesce = coalesce
+        self.links: Dict[Tuple[Addr, Addr], List[bytes]] = {}
+        # Optional drop predicate installed by the harness (partitions):
+        # frames failing it are dropped AT SEND, like PacketSimulator's.
+        self.drop_if = None
+        self.sent = 0
+        self.dropped = 0
+        self.delivered = 0
+        self.coalesced = 0
+
+    def send(self, src: Addr, dst: Addr, message: bytes, now: int = 0) -> None:
+        self.sent += 1
+        if self.drop_if is not None and self.drop_if(src, dst):
+            self.dropped += 1
+            return
+        queue = self.links.setdefault((src, dst), [])
+        if self.coalesce and message in queue:
+            self.coalesced += 1
+            return
+        queue.append(message)
+
+    def pop(self, src: Addr, dst: Addr) -> bytes:
+        """Remove and return the head frame of a link (FIFO)."""
+        queue = self.links[(src, dst)]
+        message = queue.pop(0)
+        if not queue:
+            del self.links[(src, dst)]
+        self.delivered += 1
+        return message
+
+    def peek(self, src: Addr, dst: Addr) -> bytes:
+        return self.links[(src, dst)][0]
+
+    def busy_links(self) -> List[Tuple[Addr, Addr]]:
+        """Non-empty links in canonical (sorted-key) order."""
+        return sorted(self.links)
+
+    @property
+    def in_flight(self) -> int:
+        return sum(len(q) for q in self.links.values())
+
+    def snapshot(self) -> dict:
+        return {k: list(v) for k, v in self.links.items()}
+
+    def restore(self, capsule: dict) -> None:
+        self.links = {k: list(v) for k, v in capsule.items()}

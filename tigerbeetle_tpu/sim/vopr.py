@@ -53,6 +53,7 @@ def run_seed(
     scrub_interval: int = 0,
     merkle: bool = False,
     device_faults: bool = False,
+    snapshot_interpose: int = 0,
 ) -> VoprResult:
     """One VOPR run: random topology + faults from ``seed``.
 
@@ -79,7 +80,13 @@ def run_seed(
     SDC must be detected by commitment-root mismatch and recovered via
     checkpoint + WAL replay (the acceptance proof for ROADMAP item 3);
     pure scheduling knob, drawn from no rng stream, so arming it never
-    shifts a pinned seed's fault schedule."""
+    shifts a pinned seed's fault schedule.
+
+    ``snapshot_interpose`` (tbmc capsule proof, docs/tbmc.md): every N
+    ticks, each live replica's protocol state is round-tripped through
+    ``snapshot()``/``restore()``.  Draws nothing, schedules nothing — a
+    pinned seed must replay bit-identically with it armed, proving the
+    capsule captures the full protocol-state surface."""
     if viz is None:
         viz = bool(os.environ.get("TB_VOPR_VIZ"))
     rng = random.Random(seed)
@@ -191,6 +198,14 @@ def run_seed(
         try:
             for t in range(ticks):
                 cluster.step()
+                if snapshot_interpose and t % snapshot_interpose == 0:
+                    # Capsule identity interpose (see docstring): a true
+                    # round-trip changes nothing, so the seed's schedule
+                    # and digests stay bit-identical.
+                    for replica, live in zip(cluster.replicas,
+                                             cluster.alive):
+                        if live:
+                            replica.restore(replica.snapshot())
                 if dev_rng is not None:
                     # Device fault kind — actuated AFTER the schedule rng
                     # below never sees it (separate stream, no draws from

@@ -164,6 +164,13 @@ class VsrReplica(Replica):
         # Plain equivocation-detection count (registry-independent): the
         # VOPR byzantine kind reads it for its proof artifacts.
         self.byzantine_detections = 0
+        # Model-checker hooks (sim/mc.py, docs/tbmc.md) — inert by default:
+        # ``mc_mutations`` arms a seeded protocol mutation (tbmc's
+        # passes-with-defenses / fails-without discipline); the
+        # deterministic nonce makes request_start_view a pure function of
+        # (replica, view) so canonical-state dedup survives RSV retries.
+        self.mc_mutations: frozenset = frozenset()
+        self.mc_deterministic_nonce = False
         # Content anchors (op -> canonical header checksum) learned from
         # SOURCE-AUTHENTICATED origins only: commit heartbeats
         # (commit_checksum) and installed view-change windows.  Backups
@@ -325,7 +332,14 @@ class VsrReplica(Replica):
 
     @property
     def quorum_view_change(self) -> int:
-        return quorums(self.replica_count)[1]
+        q = quorums(self.replica_count)[1]
+        if "vc_quorum" in self.mc_mutations:
+            # Seeded mutation (tools/tbmc): the classic off-by-one — view
+            # changes complete one vote short, so canonical selection can
+            # miss a committed op and refill it (mc.py exhibits a
+            # machine-checked counterexample at the pinned scope).
+            return max(1, q - 1)
+        return q
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -514,6 +528,7 @@ class VsrReplica(Replica):
             return []
         if (
             self.ingress_verify
+            and "not_primary" not in self.mc_mutations
             and command in self._PRIMARY_ORIGIN_COMMANDS
             and int(h["replica"]) != self.primary_index(int(h["view"]))
         ):
@@ -1040,7 +1055,10 @@ class VsrReplica(Replica):
         commit_op = int(h["commit"])
         if want:
             self._note_anchor(commit_op, want)
-        if self.ingress_verify and want and commit_op > self.commit_min:
+        if (
+            self.ingress_verify and want and commit_op > self.commit_min
+            and "anchor_certify" not in self.mc_mutations
+        ):
             mine = self.headers.get(commit_op)
             if mine is not None and wire.header_checksum(mine) != want:
                 self.byzantine_detections += 1
@@ -1080,6 +1098,10 @@ class VsrReplica(Replica):
         source-authenticated anchor (see _anchors).  Walking DOWN from the
         anchor, any non-linking header is a detected fork: evicted, with
         the canonical checksum recorded for repair-by-checksum."""
+        if "anchor_certify" in self.mc_mutations:
+            # Seeded mutation (tools/tbmc): certified commits compiled out
+            # — backups execute whatever chains locally, anchored or not.
+            return True
         for a in sorted(o for o in self._anchors if o >= op):
             if a > self.op:
                 break  # no headers past our head to walk from
@@ -1507,9 +1529,17 @@ class VsrReplica(Replica):
                 # the entire voting history — refuse to invent a canonical
                 # log (safety over liveness; view-change timeouts retry).
                 return []
+        # Donor selection iterates SORTED items: ties on (log_view, op)
+        # used to fall to dict insertion order — DVC *arrival* order — so
+        # two replicas in identical protocol states could adopt
+        # differently-sourced (content-identical) suffixes, and the tbmc
+        # canonical-state hash could not collapse them.  At equal
+        # (log_view, op) both logs carry that log_view's canonical suffix,
+        # so the lowest-replica tie-break is safe by construction.
         canonical = max(
-            donors.values(), key=lambda d: (d["log_view"], d["op"])
-        )
+            sorted(donors.items()),
+            key=lambda kv: (kv[1]["log_view"], kv[1]["op"]),
+        )[1]
         self.commit_max = max(
             [d["commit"] for d in dvcs.values()] + [self.commit_max]
         )
@@ -1808,7 +1838,15 @@ class VsrReplica(Replica):
         # The nonce pairs the SV response to THIS request so a stale
         # same-view snapshot cannot be installed (message_header.zig
         # StartView.nonce; ADVICE round-1).
-        self._rsv_nonce = self.prng.getrandbits(64)
+        if self.mc_deterministic_nonce:
+            # Model-checker mode (sim/mc.py): a prng draw would make two
+            # otherwise identical states hash apart, so the nonce is a
+            # pure function of (replica, view) — still unique per pairing.
+            self._rsv_nonce = ((self.replica + 1) << 32) | (
+                view & 0xFFFF_FFFF
+            )
+        else:
+            self._rsv_nonce = self.prng.getrandbits(64)
         req = wire.new_header(
             wire.Command.request_start_view,
             cluster=self.cluster,
@@ -2755,8 +2793,14 @@ class VsrReplica(Replica):
                 # retry the pipeline commit before resending.
                 self._maybe_commit_pipeline(out)
                 # Timeout fallback: re-broadcast unquorumed prepares to all
-                # backups (the ring is the fast path, this is the safety net).
-                for entry in list(self.pipeline.values()):
+                # backups (the ring is the fast path, this is the safety
+                # net).  Op-sorted, not insertion-ordered: _repipeline
+                # re-inserts repaired mid-suffix entries out of order, and
+                # resend emission order must be a function of protocol
+                # state, not arrival history (tbmc canonical hashing).
+                for entry in [
+                    self.pipeline[o] for o in sorted(self.pipeline)
+                ]:
                     if len(entry.ok_from) >= self.quorum_replication:
                         continue
                     read = self.journal.read_prepare(entry.op)
@@ -2919,3 +2963,249 @@ class VsrReplica(Replica):
                     out.extend(self._begin_view_change(self.view + 1))
 
         return out
+
+    # -- protocol-state capsule (sim/mc.py; docs/tbmc.md) ---------------------
+    #
+    # snapshot()/restore() capture EVERY field the consensus state machine
+    # reads: a cluster step becomes a pure function of (capsule, event).
+    # The ledger is folded to its digest — a capsule restores protocol
+    # state bit-identically, and either the machine supports mc_snapshot/
+    # mc_restore (the model checker's DigestMachine) or restore() asserts
+    # the live ledger already sits at the capsule's digest (the production
+    # TpuStateMachine: protocol state travels, executed state does not).
+    # This is also the exact state surface a MAC/signature layer must
+    # cover (ROADMAP item 4).
+
+    _MC_SCALARS = (
+        "cluster", "replica", "replica_count", "standby_count",
+        "view", "log_view", "status", "op", "commit_min", "commit_max",
+        "op_checkpoint", "parent_checksum", "_verify_floor", "_log_suspect",
+        "_log_adopted_op", "byzantine_detections", "_dvc_sent_for",
+        "_new_view_pending", "_pending_finish", "_sync_peer", "_rsv_nonce",
+        "_repair_rotation", "commit_budget", "commit_budget_stopped",
+        "overload_control", "ingress_verify", "blocks_repaired",
+    )
+    # Pure-time counters and retry-arm state: behavior-relevant only
+    # through WHICH timers are due — which the model checker replaces with
+    # explicit mc_fire events — so mc.py excludes this group from the
+    # canonical state hash (symmetric interleavings collapse) while the
+    # capsule still round-trips it bit-identically.
+    _MC_TIME = (
+        "_ticks", "_last_ping", "_last_commit_sent", "_last_primary_word",
+        "_primary_gap_ewma", "_probe_sent_at", "_pong_standdowns",
+        "_floor_stall", "_abdicate_commit_mark", "_abdicate_ticks",
+        "_vc_started", "_vc_escalations", "_last_sync_req",
+        "_heartbeat_jitter", "_recovering_since", "_last_tick_mono",
+    )
+    _MC_CONTAINERS = (
+        "headers", "stash", "missing", "_nacks", "_anchors", "pipeline",
+        "svc_from", "dvc_from", "sessions", "sync_target", "_block_repair",
+        "_cold_fetch", "_sb_state",
+    )
+    _MC_TIMEOUTS = (
+        "_prepare_timeout", "_vc_timeout", "_rsv_timeout", "_repair_timeout",
+    )
+    # Lazily-created attributes (e.g. _repair_rotation) must restore to
+    # ABSENT, not None — their getattr defaults are load-bearing.
+    _MC_MISSING = "__mc_missing__"
+
+    def snapshot(self) -> dict:
+        """Deep-copied protocol-state capsule; see section docstring."""
+        import copy
+
+        machine = self.machine
+        if hasattr(machine, "mc_snapshot"):
+            machine_cap = machine.mc_snapshot()
+        else:
+            machine_cap = {
+                "folded_digest": machine.digest(),
+                "prepare_timestamp": machine.prepare_timestamp,
+                "commit_timestamp": machine.commit_timestamp,
+            }
+        clock_cap = None
+        if self.clock is not None:
+            clock_cap = {
+                "samples": copy.deepcopy(self.clock.samples),
+                "epoch_start_monotonic": self.clock.epoch_start_monotonic,
+                "offset_ns": self.clock.offset_ns,
+                "synchronized": self.clock._synchronized,
+            }
+        missing = self._MC_MISSING
+        return {
+            "scalars": {
+                k: getattr(self, k, missing) for k in self._MC_SCALARS
+            },
+            "time": {k: getattr(self, k, missing) for k in self._MC_TIME},
+            "containers": {
+                k: copy.deepcopy(getattr(self, k, None))
+                for k in self._MC_CONTAINERS
+            },
+            "sync_buffer": bytes(self.sync_buffer),
+            "timeouts": {
+                k: (t.attempts, t._last, t._interval)
+                for k in self._MC_TIMEOUTS
+                for t in (getattr(self, k),)
+            },
+            "rtt": self.rtt.estimate,
+            "prng": self.prng.getstate(),
+            # The SuperBlock OBJECT's in-memory state, not just the
+            # replica's _sb_state cache: checkpoint() bumps sequence from
+            # ``superblock.state``, so leaving it out made the next
+            # view-persist's sequence a function of EXPLORATION HISTORY
+            # (how many installs ever ran on this instance), not of the
+            # restored state — a canonical-hash dedup killer the model
+            # checker surfaced as a state-space explosion.
+            "superblock": copy.deepcopy(self.superblock.state),
+            "clock": clock_cap,
+            "machine": machine_cap,
+        }
+
+    def restore(self, capsule: dict) -> None:
+        """Reinstate a snapshot() capsule bit-identically (the capsule is
+        deep-copied on the way in, so it stays reusable).  Works on the
+        live instance or a freshly constructed one (the model checker's
+        restart-into-state path); with a machine that cannot restore
+        folded ledger state, the live digest must already match."""
+        import copy
+
+        # Order matters on a fresh instance: identity scalars first (the
+        # clock needs replica/replica_count), then the clock rebuild
+        # (_init_clock draws jitter from the prng), then the time fields
+        # and prng state, which overwrite whatever the rebuild drew.
+        missing = self._MC_MISSING
+
+        def put(k, v):
+            if v is missing or (isinstance(v, str) and v == missing):
+                if hasattr(self, k):
+                    delattr(self, k)
+            else:
+                setattr(self, k, v)
+
+        for k, v in capsule["scalars"].items():
+            put(k, v)
+        clock_cap = capsule["clock"]
+        if clock_cap is not None:
+            if self.clock is None:
+                self._init_clock()
+            self.clock.replica_count = self.replica_count
+            self.clock.replica = self.replica
+            self.clock.samples = copy.deepcopy(clock_cap["samples"])
+            self.clock.epoch_start_monotonic = (
+                clock_cap["epoch_start_monotonic"]
+            )
+            self.clock.offset_ns = clock_cap["offset_ns"]
+            self.clock._synchronized = clock_cap["synchronized"]
+            self.time_ns = self._primary_now
+        for k, v in capsule["time"].items():
+            put(k, v)
+        for k, v in capsule["containers"].items():
+            put(k, copy.deepcopy(v))
+        self.sync_buffer = bytearray(capsule["sync_buffer"])
+        self.prng.setstate(capsule["prng"])
+        for k, (attempts, last, interval) in capsule["timeouts"].items():
+            t = getattr(self, k)
+            t.attempts, t._last, t._interval = attempts, last, interval
+        self.rtt.estimate = capsule["rtt"]
+        self.superblock.state = copy.deepcopy(capsule["superblock"])
+        machine_cap = capsule["machine"]
+        if hasattr(self.machine, "mc_restore"):
+            self.machine.mc_restore(machine_cap)
+        else:
+            live = self.machine.digest()
+            want = machine_cap["folded_digest"]
+            if live != want:
+                raise RuntimeError(
+                    "capsule folds the ledger to its digest: restore() "
+                    f"needs the live ledger at {want:#x}, found {live:#x} "
+                    "(docs/tbmc.md — executed state does not travel)"
+                )
+            self.machine.prepare_timestamp = machine_cap["prepare_timestamp"]
+            self.machine.commit_timestamp = machine_cap["commit_timestamp"]
+
+    # -- explicit timeout events (sim/mc.py) ----------------------------------
+
+    MC_TIMEOUT_KINDS = (
+        "commit_hb", "prepare", "repair", "suspect",
+        "vc_resend", "vc_escalate", "rsv", "recover_campaign",
+    )
+
+    def mc_enabled_timeouts(self) -> List[str]:
+        """Timeout kinds that could act in the current status — the model
+        checker's enumerable timer alphabet (virtual time is abstracted:
+        WHICH timer fires is the exploration dimension, not when)."""
+        kinds: List[str] = []
+        if self.replica_count == 1 or self.clock is None:
+            return kinds
+        repairable = bool(
+            self.missing or self.stash or self._header_gaps()
+        )
+        if self.status == NORMAL and self.is_primary:
+            kinds.append("commit_hb")
+            if self.pipeline:
+                kinds.append("prepare")
+            if repairable:
+                kinds.append("repair")
+        elif self.status == NORMAL:
+            if not self.is_standby:
+                kinds.append("suspect")
+            if repairable or self.commit_max > self.op:
+                kinds.append("repair")
+        elif self.status == VIEW_CHANGE:
+            kinds.extend(("vc_resend", "vc_escalate"))
+        elif self.status == RECOVERING:
+            kinds.append("rsv")
+            if not self.is_standby:
+                kinds.append("recover_campaign")
+        return kinds
+
+    def mc_fire(self, kind: str) -> List[Msg]:
+        """Force exactly the named timer due and run one tick() — every
+        other timer is quieted, so the tick's output is a deterministic
+        function of the protocol capsule and ``kind`` alone."""
+        assert kind in self.MC_TIMEOUT_KINDS, kind
+        # Virtual time leaps between model-checker events; the exact span
+        # is irrelevant (every timer below is re-armed explicitly).
+        self._ticks += 1000
+        t = self._ticks + 1  # the value tick() observes after increment
+
+        def due(tm) -> None:
+            tm._last = t - max(1, tm._interval)
+
+        for name in self._MC_TIMEOUTS:
+            getattr(self, name)._last = t  # quiet
+        self._last_ping = t
+        self._last_commit_sent = t
+        self._last_primary_word = t
+        self._probe_sent_at = None
+        self._recovering_since = t
+        self._vc_started = t
+        if kind == "commit_hb":
+            self._last_commit_sent = t - COMMIT_HEARTBEAT
+        elif kind == "prepare":
+            due(self._prepare_timeout)
+        elif kind == "repair":
+            due(self._repair_timeout)
+        elif kind == "suspect":
+            # Fold the two-stage suspicion (silence budget + unanswered
+            # probe) into one campaign event.  The +1000 leap above keeps
+            # t comfortably past the largest possible budget, so the
+            # silence window is always satisfiable without clamping to 0.
+            self._last_primary_word = t - (
+                PRIMARY_BUDGET_CAP + NORMAL_HEARTBEAT
+                + self._heartbeat_jitter + 1
+            )
+            self._probe_sent_at = t - PROBE_GRACE
+        elif kind == "vc_resend":
+            due(self._vc_timeout)
+        elif kind == "vc_escalate":
+            self._vc_started = t - (
+                VIEW_CHANGE_ESCALATE << min(self._vc_escalations, 4)
+            )
+        elif kind == "rsv":
+            due(self._rsv_timeout)
+        elif kind == "recover_campaign":
+            due(self._rsv_timeout)
+            self._recovering_since = t - (
+                NORMAL_HEARTBEAT + self._heartbeat_jitter
+            )
+        return self.tick()

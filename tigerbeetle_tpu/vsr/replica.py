@@ -95,6 +95,7 @@ class Replica:
         host_engine: bool = False,
         scrub_interval: Optional[int] = None,
         merkle: Optional[bool] = None,
+        machine_factory=None,
     ) -> None:
         self.data_path = data_path
         # Optional determinism oracle (utils/hash_log.OpHashLog): per-commit
@@ -138,7 +139,11 @@ class Replica:
             self.aof = AOF(aof_path)
         self.superblock = SuperBlock(self.storage)
         self.journal = Journal(self.storage)
-        self.machine = TpuStateMachine(
+        # ``machine_factory`` (default: the real TpuStateMachine) lets the
+        # model checker (sim/mc.py) substitute its digest-chain stand-in —
+        # the consensus/journal/session layers are what get explored, the
+        # ledger folds to its digest (docs/tbmc.md).
+        self.machine = (machine_factory or TpuStateMachine)(
             self.ledger_config, batch_lanes=batch_lanes,
             # Always derived from the data file (not from the CLI flag): a
             # restart WITHOUT --hot-transfers-log2-max must still be able to
@@ -1349,7 +1354,14 @@ class Replica:
         ):
             # Evict the session with the lowest session number (oldest
             # register commit) — client_sessions.zig eviction policy.
-            victim = min(self.sessions.values(), key=lambda s: s.session)
+            # Selection over SORTED items: session numbers are unique
+            # (one commit op per registration), but the choice must be a
+            # function of state, never of dict arrival order (tblint
+            # nondet dict-selection rule; docs/tbmc.md determinism notes).
+            victim = min(
+                sorted(self.sessions.items()),
+                key=lambda kv: kv[1].session,
+            )[1]
             del self.sessions[victim.client]
         existing = self.sessions.get(session.client)
         if existing is not None:

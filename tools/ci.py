@@ -23,6 +23,9 @@ ones green):
                traced+metered serving run, mini-bench with TB_TRACE +
                --metrics-json; asserts the artifacts parse and carry the
                expected span/series names
+  mc           tbmc model-checker smoke (tools/mc_smoke.py): exhaustive-
+               clean at the pinned scope, all three protocol mutations
+               caught, counterexample replay identity, mc.* metrics
   integration  subprocess/black-box: TCP servers, cluster e2e, native
                clients, demos, longhaul (includes @slow)
 
@@ -92,7 +95,7 @@ TIERS = {
             "tests/test_fuzz.py", "tests/test_block_repair.py",
             "tests/test_cold_consensus.py", "tests/test_storage_direct.py",
             "tests/test_scrub.py", "tests/test_overload.py",
-            "tests/test_byzantine.py",
+            "tests/test_byzantine.py", "tests/test_mc.py",
         ],
         extra=["-m", "not slow"],
     ),
@@ -170,6 +173,16 @@ TIERS = {
         # must land in METRICS.json.  Artifact: SANITIZE_SMOKE.json.
         cmd=["tools/sanitize_smoke.py"],
     ),
+    "mc": dict(
+        # tbmc model-checker smoke (docs/tbmc.md): the unmutated protocol
+        # exhaustively clean at the pinned scope (3 replicas, 2 ops,
+        # 1 crash, 1 timer; states-explored recorded), all three seeded
+        # protocol mutations caught with clean unmutated controls, one
+        # counterexample replayed bit-identically through
+        # `vopr --replay-schedule`, and the mc.* series asserted in
+        # METRICS.json.  Artifact: MC_SMOKE.json at the repo root.
+        cmd=["tools/mc_smoke.py"],
+    ),
     "byzantine": dict(
         # Byzantine fault domain smoke (docs/fault_domains.md): pinned
         # seed with one equivocating/corrupting/lying replica of six
@@ -245,6 +258,10 @@ TIERS = {
             "tests/test_waves.py::TestVoprWaves",
             "tests/test_waves.py::TestWavesDifferential::"
             "test_zipf_mix_with_limits_vs_model",
+            # tbmc model checker: the guided vc_quorum hunt + defense
+            # replay (@slow: a full guided state-space walk + two
+            # schedule replays through fresh McClusters).
+            "tests/test_mc.py::test_vc_quorum_guided_hunt_and_defense_replay",
             # Tier-1 budget audit (PR 5): the 5 slowest tier-1 tests moved
             # to @slow; they run whole here so the full matrix still
             # covers them.
@@ -265,7 +282,7 @@ TIERS = {
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
     "scrub", "merkle", "overload", "waves", "sharded", "async",
-    "sanitize", "byzantine", "integration",
+    "sanitize", "byzantine", "mc", "integration",
 ]
 
 

@@ -14,7 +14,19 @@ of seed-addressable bug reports.  Three source families break it:
   (``sorted``/``sum``/``min``/``max``/``len``/``any``/``all``/set-to-set).
   Dict iteration is insertion-ordered since 3.7 and is deliberately NOT
   flagged — determinism there reduces to deterministic insertion, which
-  the other families already police.
+  the other families already police.  Two dict patterns ARE flagged,
+  both fixed in vsr/consensus.py by the tbmc canonical-hashing pass
+  (docs/tbmc.md "Determinism notes"):
+
+  - ``max(d.values(), key=...)`` / ``min(d.values()/d.items(), key=...)``
+    — key-based selection returns the FIRST extremal element in
+    iteration order, so ties fall to insertion (arrival) history, not
+    protocol state.  Select over ``sorted(d.items())`` or make the key
+    total (include a unique tie-break).
+  - ``for ... in list(d.values())`` — the snapshot-then-mutate idiom:
+    the defensive copy freezes ARRIVAL order, and re-inserted entries
+    (repair/requeue paths) then emit out of state order.  Iterate
+    ``sorted(d)`` keys instead.
 """
 
 from __future__ import annotations
@@ -142,8 +154,30 @@ class _SetIterVisitor(ast.NodeVisitor):
             "(sorted(...)) or use an ordered structure",
         ))
 
+    def _dict_view(self, e: ast.AST) -> Optional[str]:
+        """'values'/'items' when ``e`` is a bare ``<expr>.values()`` /
+        ``<expr>.items()`` call (not already wrapped in sorted())."""
+        if (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+                and e.func.attr in ("values", "items") and not e.args):
+            return e.func.attr
+        return None
+
     def visit_Call(self, node: ast.Call) -> None:
         name = _terminal_name(node.func)
+        if name in ("max", "min") and any(
+            kw.arg == "key" for kw in node.keywords
+        ):
+            for arg in node.args:
+                view = self._dict_view(arg)
+                if view is not None:
+                    self.findings.append(Finding(
+                        self.rule_id, self.ctx.display_path,
+                        node.lineno, node.col_offset,
+                        f"{name}(..{view}(), key=...) ties on dict "
+                        "insertion (arrival) order, not protocol state; "
+                        "select over sorted(d.items()) or make the key "
+                        "total with a unique tie-break",
+                    ))
         if name in _ORDER_INSENSITIVE:
             for arg in node.args:
                 if isinstance(arg, (ast.GeneratorExp, ast.ListComp)):
@@ -163,6 +197,17 @@ class _SetIterVisitor(ast.NodeVisitor):
     def visit_For(self, node: ast.For) -> None:
         if self._is_set_expr(node.iter) and id(node.iter) not in self._exempt:
             self._flag(node, "for-loop")
+        it = node.iter
+        if (isinstance(it, ast.Call) and _terminal_name(it.func) == "list"
+                and len(it.args) == 1
+                and self._dict_view(it.args[0]) == "values"):
+            self.findings.append(Finding(
+                self.rule_id, self.ctx.display_path,
+                it.lineno, it.col_offset,
+                "iterating list(d.values()) freezes ARRIVAL order — "
+                "re-inserted entries (repair/requeue) then emit out of "
+                "state order; iterate [d[k] for k in sorted(d)] instead",
+            ))
         self.generic_visit(node)
 
     def _visit_comp(self, node) -> None:

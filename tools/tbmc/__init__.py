@@ -1,0 +1,7 @@
+"""tbmc — exhaustive small-scope model checker CLI (docs/tbmc.md).
+
+The engine lives in tigerbeetle_tpu/sim/mc.py; this package is the
+operator surface: run a scope (optionally mutated), print the report,
+and dump any counterexample as a schedule `vopr --replay-schedule`
+re-executes bit-identically.
+"""

@@ -1,0 +1,141 @@
+"""tbmc CLI: run the exhaustive small-scope model checker.
+
+Usage:
+  python -m tools.tbmc                         # pinned clean scope
+  python -m tools.tbmc --mutation vc_quorum    # find a counterexample
+  python -m tools.tbmc --ops 2 --crash 1 --timeouts 4 --depth 24
+  python -m tools.tbmc --mutation not_primary --out CE.json
+  python -m tigerbeetle_tpu vopr --replay-schedule CE.json
+
+Exit codes mirror the VOPR's (sim/vopr.py): 0 = clean (exhaustive at the
+scope, or bounds hit with --allow-capped), 129 = a safety counterexample
+was found (and written to --out when given), 3 = state cap hit without
+--allow-capped, 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO)
+
+EXIT_CLEAN = 0
+EXIT_USAGE = 2
+EXIT_CAPPED = 3
+EXIT_COUNTEREXAMPLE = 129
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tigerbeetle_tpu.sim.mc import (
+        MUTATIONS, McScope, ModelChecker,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="tbmc",
+        description="exhaustive small-scope model checker for the VSR "
+                    "consensus + certified-commit protocol (docs/tbmc.md)",
+    )
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--clients", type=int, default=1)
+    p.add_argument("--ops", type=int, default=2,
+                   help="scripted ops per client (after registration)")
+    p.add_argument("--crash", type=int, default=1, help="crash budget")
+    p.add_argument("--byz", type=int, default=0,
+                   help="forged-frame injection budget")
+    p.add_argument("--drops", type=int, default=0, help="drop budget")
+    p.add_argument("--partitions", type=int, default=0,
+                   help="partition-toggle budget")
+    p.add_argument("--timeouts", type=int, default=0,
+                   help="explicit timer-fire budget (0 = no timer events: "
+                        "the default matches the smoke's acceptance "
+                        "scope, which exhausts in seconds)")
+    p.add_argument("--sends", type=int, default=1,
+                   help="sends per client request (resends above 1)")
+    p.add_argument("--max-view", type=int, default=2)
+    p.add_argument("--depth", type=int, default=20)
+    p.add_argument("--max-states", type=int, default=200_000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mutation", choices=MUTATIONS, action="append",
+                   default=None,
+                   help="arm a seeded protocol mutation (repeatable); the "
+                        "checker must find a counterexample")
+    p.add_argument("--timeout-kinds", default=None, metavar="K1,K2",
+                   help="restrict the timer alphabet to these kinds "
+                        "(default: all of VsrReplica.MC_TIMEOUT_KINDS); "
+                        "a targeted hunt's scope bound — run the "
+                        "unmutated control at the SAME restriction")
+    p.add_argument("--racy-timers", action="store_true",
+                   help="let timers fire at NON-quiescent states too "
+                        "(drops the slow-timer scope assumption; widens "
+                        "the scope — mutation hunts use it to reach "
+                        "timer-vs-frame races, docs/tbmc.md)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="write the counterexample schedule JSON here")
+    p.add_argument("--allow-capped", action="store_true",
+                   help="exit 0 even when the state cap was hit (the run "
+                        "is then bounded, not exhaustive)")
+    args = p.parse_args(argv)
+
+    scope = McScope(
+        n_replicas=args.replicas,
+        n_clients=args.clients,
+        ops_per_client=args.ops,
+        crash_budget=args.crash,
+        byz_budget=args.byz,
+        drop_budget=args.drops,
+        partition_budget=args.partitions,
+        timeout_budget=args.timeouts,
+        timeout_quiescent_only=not args.racy_timers,
+        timeout_kinds=(
+            tuple(args.timeout_kinds.split(","))
+            if args.timeout_kinds else None
+        ),
+        client_sends=args.sends,
+        max_view=args.max_view,
+        depth_max=args.depth,
+        max_states=args.max_states,
+        seed=args.seed,
+    )
+    mutations = tuple(args.mutation or ())
+    report = ModelChecker(scope, mutations).run()
+    summary = {
+        "scope": scope.to_json(),
+        "mutations": list(mutations),
+        "exhaustive": report.exhaustive,
+        "states": report.states,
+        "deduped": report.deduped,
+        "por_pruned": report.por_pruned,
+        "bound_pruned": report.bound_pruned,
+        "stack_peak": report.stack_peak,
+        "elapsed_s": report.elapsed_s,
+        "violation": report.violation,
+        "schedule_len": (
+            len(report.schedule) if report.schedule is not None else None
+        ),
+    }
+    print(json.dumps(summary))
+    if report.violation is not None:
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report.counterexample(), f, indent=1)
+            print(f"# counterexample written to {args.out} — replay with: "
+                  f"python -m tigerbeetle_tpu vopr --replay-schedule "
+                  f"{args.out}", file=sys.stderr)
+        return EXIT_COUNTEREXAMPLE
+    if not report.exhaustive and not args.allow_capped:
+        print(f"# state cap {scope.max_states} hit before the scope was "
+              "exhausted; raise --max-states or shrink the scope",
+              file=sys.stderr)
+        return EXIT_CAPPED
+    return EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())
