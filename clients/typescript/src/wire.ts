@@ -37,6 +37,11 @@ export const OFF_REP_COMMIT = 216;
 export const OFF_REP_TIMESTAMP = 224;
 export const OFF_REP_REQUEST = 232;
 export const OFF_REP_OPERATION = 236;
+// Canonical accounts commitment root at the reply's commit point (carved
+// from reserved padding; 0 = server runs without merkle commitments).
+// Clients track it for continuous ledger auditing and cross-check
+// get_proof anchors against it.
+export const OFF_REP_ROOT = 237;
 
 // Eviction (message_header.zig Eviction: client u128 at the command area).
 // reason: 0 legacy/unknown, 1 no-session (re-register + retry),

@@ -1,0 +1,565 @@
+"""Merkle-anchored incremental state sync (docs/state_sync.md).
+
+Four layers, matching the feature's trust chain:
+
+1. statesync codec/tree units — pack/verify round trips, tamper
+   rejection, whole-state checksum sensitivity (numpy only, fast).
+2. Wire + reply-root surface — new command dtypes, the REPLY root carve,
+   machine.commitment_root semantics, client-side root auditing.
+3. Scripted consensus edges — the stranded-sync rotation regression
+   (killed responder under checkpoint-refresh heartbeats), resumption
+   edge cases (responder re-checkpoints mid-transfer, offset-mismatch
+   chunk rejection), and the loud cold-manifest refusal at a sharded
+   rejoiner.
+4. Pinned VOPR catch-up seeds (@slow; ci integration tier) — crash a
+   backup mid-open-loop-flood, advance >= 2 checkpoints, heal: green
+   under the incremental transport AND under forced fallback; a lying
+   responder detected + rotated with verification on, and the SAME
+   schedule demonstrably installing divergent state with it off.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LedgerConfig
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.ops import merkle as merkle_ops
+from tigerbeetle_tpu.sim import PacketSimulator, SimCluster
+from tigerbeetle_tpu.vsr import checkpoint as checkpoint_mod
+from tigerbeetle_tpu.vsr import statesync, wire
+from tigerbeetle_tpu.vsr.consensus import SYNC_RESEND, SYNCING
+
+SMALL = LedgerConfig(
+    accounts_capacity_log2=8, transfers_capacity_log2=9,
+    posted_capacity_log2=8, history_capacity_log2=8, max_probe=256,
+)
+
+
+def small_machine(merkle=False):
+    m = TpuStateMachine(ledger_config=SMALL, batch_lanes=8)
+    if merkle:
+        m.merkle_enabled = True
+        m.scrub_interval = 4
+        m.scrub_paranoid = False
+        m.scrub_arm()
+    return m
+
+
+def seed_machine(m, n_accounts=6, n_transfers=8):
+    accs = types.accounts_array([
+        types.account(id=i + 1, ledger=1, code=1)
+        for i in range(n_accounts)
+    ])
+    m.commit_batch("create_accounts", accs, 1_000)
+    trs = types.transfers_array([
+        types.transfer(
+            id=100 + i, debit_account_id=1 + (i % n_accounts),
+            credit_account_id=1 + ((i + 1) % n_accounts), amount=5 + i,
+            ledger=1, code=1,
+        )
+        for i in range(n_transfers)
+    ])
+    m.commit_batch("create_transfers", trs, 2_000)
+    return m
+
+
+@pytest.fixture(scope="module")
+def arrays_and_trees():
+    m = seed_machine(small_machine())
+    arrays = checkpoint_mod.ledger_to_arrays(m.checkpoint_ledger())
+    return m, arrays, statesync.build_trees(arrays)
+
+
+class TestStatesyncCodec:
+    def test_trees_match_merkle_oracle(self, arrays_and_trees):
+        m, arrays, trees = arrays_and_trees
+        roots = merkle_ops.np_ledger_roots(m.checkpoint_ledger())
+        assert (
+            int(trees["accounts"][1]),
+            int(trees["transfers"][1]),
+            int(trees["posted"][1]),
+        ) == tuple(roots)
+
+    def test_np_digest_matches_machine(self, arrays_and_trees):
+        m, arrays, _ = arrays_and_trees
+        assert statesync.np_digest(arrays) == m.digest()
+
+    def test_roots_pack_round_trip_and_tamper(self, arrays_and_trees):
+        _, arrays, trees = arrays_and_trees
+        body = statesync.pack_roots(arrays, trees, {"x": 1})
+        info = statesync.unpack_roots(body)
+        assert info is not None
+        for pad in statesync.PADS:
+            assert info["pads"][pad]["root"] == int(trees[pad][1])
+            assert info["pads"][pad]["capacity"] == (
+                statesync.pad_capacity(arrays, pad)
+            )
+        assert info["meta"] == {"x": 1}
+        assert info["schema"] == statesync.schema(arrays)
+        # The schema fingerprint survives a JSON round trip bit-equal
+        # (the wire carries JSON: tuples would silently never match).
+        import json
+
+        assert json.loads(json.dumps(info["schema"])) == (
+            statesync.schema(arrays)
+        )
+        # Any flipped payload byte (a lying/corrupt summary) is rejected
+        # wholesale — either the zlib/npz framing breaks or the top
+        # frontier no longer folds to the stated root.
+        bad = bytearray(body)
+        bad[len(bad) // 2] ^= 0x40
+        assert statesync.unpack_roots(bytes(bad)) is None
+
+    def test_children_descent_verifies_and_rejects(self, arrays_and_trees):
+        _, arrays, trees = arrays_and_trees
+        tree = trees["transfers"]
+        nodes = np.asarray([1, 2, 3], np.uint64)
+        want = {1: int(tree[1]), 2: int(tree[2]), 3: int(tree[3])}
+        values = statesync.children(tree, nodes)
+        assert statesync.verify_children(values, nodes, want)
+        evil = values.copy()
+        evil[3] ^= np.uint64(1)
+        assert not statesync.verify_children(evil, nodes, want)
+        assert not statesync.verify_children(values[:-1], nodes, want)
+
+    def test_rows_round_trip_verify_and_tamper(self, arrays_and_trees):
+        _, arrays, trees = arrays_and_trees
+        pad = "transfers"
+        cap = statesync.pad_capacity(arrays, pad)
+        tree = trees[pad]
+        slots = np.flatnonzero(arrays[f"{pad}/key_lo"] != 0).astype(
+            np.uint64
+        )
+        assert len(slots) > 0
+        blob = statesync.pack_rows(arrays, pad, slots)
+        rows = statesync.unpack_rows(arrays, pad, slots, blob)
+        want = {cap + int(s): int(tree[cap + int(s)]) for s in slots}
+        assert statesync.verify_rows(rows, pad, slots, want, cap)
+        # A lying responder rewriting an amount re-encodes valid frames;
+        # only the leaf hash can catch it.
+        bad = dict(rows)
+        bad[f"{pad}/cols/amount_lo"] = rows[f"{pad}/cols/amount_lo"] + 1
+        assert not statesync.verify_rows(bad, pad, slots, want, cap)
+        # Truncated payloads are a shape error, not a crash.
+        assert statesync.unpack_rows(arrays, pad, slots, blob[:-3]) is None
+
+    def test_history_round_trip(self, arrays_and_trees):
+        _, arrays, _ = arrays_and_trees
+        count = int(arrays["history/count"])
+        blob = statesync.pack_history(arrays, 0, count)
+        back = statesync.unpack_history(arrays, count, blob)
+        assert back is not None
+        for k in statesync.history_keys(arrays):
+            np.testing.assert_array_equal(back[k], arrays[k][:count])
+
+    def test_arrays_checksum_is_byte_sensitive(self, arrays_and_trees):
+        _, arrays, _ = arrays_and_trees
+        base = statesync.arrays_checksum(arrays)
+        clone = {k: v.copy() for k, v in arrays.items()}
+        assert statesync.arrays_checksum(clone) == base
+        # A flip in a column the LEAF HASH DOES NOT COVER still changes
+        # the whole-state checksum — the install gate that makes
+        # incremental and full rejoins byte-identical by construction.
+        clone["transfers/cols/user_data_64"][0] ^= np.uint64(1)
+        assert statesync.arrays_checksum(clone) != base
+
+    def test_frontier_folds_to_root(self, arrays_and_trees):
+        _, arrays, trees = arrays_and_trees
+        for pad in statesync.PADS:
+            cap = statesync.pad_capacity(arrays, pad)
+            depth = statesync.top_depth(cap)
+            top = statesync.frontier(trees[pad], depth)
+            assert len(top) == 1 << depth
+            assert statesync.fold_frontier(top) == int(trees[pad][1])
+
+
+class TestWireSurface:
+    def test_sync_command_dtypes(self):
+        for cmd in (
+            wire.Command.request_sync_roots, wire.Command.sync_roots,
+            wire.Command.request_sync_subtree, wire.Command.sync_subtree,
+        ):
+            assert wire.COMMAND_DTYPES[cmd].itemsize == wire.HEADER_SIZE
+            assert cmd in wire.SOURCE_AUTHENTICATED_COMMANDS
+        h = wire.new_header(
+            wire.Command.sync_roots, checkpoint_op=7, commit_max=9,
+            ledger_digest=11, state_checksum=(1 << 80) | 13,
+        )
+        back, cmd, body = wire.decode(wire.encode(h, b"xyz"))
+        assert cmd == wire.Command.sync_roots
+        assert wire.u128(back, "state_checksum") == (1 << 80) | 13
+        assert body == b"xyz"
+
+    def test_reply_root_carve(self):
+        assert wire.REPLY_DTYPE.fields["root"][1] == 237
+        h = wire.new_header(wire.Command.reply, root=0xDEAD)
+        back, _cmd, _ = wire.decode(wire.encode(h))
+        assert int(back["root"]) == 0xDEAD
+        # A legacy (pre-root) frame decodes root == 0.
+        legacy = wire.new_header(wire.Command.reply)
+        back2, _, _ = wire.decode(wire.encode(legacy))
+        assert int(back2["root"]) == 0
+
+
+class TestCommitmentRoot:
+    def test_zero_when_merkle_off(self):
+        m = seed_machine(small_machine())
+        assert m.commitment_root() == 0
+
+    def test_matches_canonical_accounts_root(self):
+        m = seed_machine(small_machine(merkle=True))
+        root = m.commitment_root()
+        assert root != 0
+        assert root == merkle_ops.np_ledger_roots(m.checkpoint_ledger())[0]
+        # Advancing state moves the root.
+        more = types.transfers_array([
+            types.transfer(id=900, debit_account_id=1, credit_account_id=2,
+                           amount=3, ledger=1, code=1)
+        ])
+        m.commit_batch("create_transfers", more, 3_000)
+        root2 = m.commitment_root()
+        assert root2 != root
+        assert root2 == merkle_ops.np_ledger_roots(m.checkpoint_ledger())[0]
+
+
+class TestClientRootAudit:
+    def _client(self):
+        from tigerbeetle_tpu.client import Client
+
+        return Client([("127.0.0.1", 1)], cluster=0, client_id=3)
+
+    def _reply(self, commit, root):
+        return wire.new_header(wire.Command.reply, commit=commit, root=root)
+
+    def test_tracks_freshest_nonzero_root(self):
+        c = self._client()
+        c._observe_reply_root(self._reply(5, 0xAA))
+        assert (c.last_root, c.last_root_commit) == (0xAA, 5)
+        # Zero (merkle off / replay-stored reply) never overwrites.
+        c._observe_reply_root(self._reply(9, 0))
+        assert (c.last_root, c.last_root_commit) == (0xAA, 5)
+        c._observe_reply_root(self._reply(9, 0xBB))
+        assert (c.last_root, c.last_root_commit) == (0xBB, 9)
+        # A stale re-served reply for an older commit does not regress.
+        c._observe_reply_root(self._reply(6, 0xCC))
+        assert (c.last_root, c.last_root_commit) == (0xBB, 9)
+
+    def test_get_proof_cross_checks_header_root(self):
+        from tigerbeetle_tpu.ops.merkle import ProofError
+
+        m = seed_machine(small_machine(merkle=True))
+        proof_blob = m.get_proof(1)
+        assert proof_blob
+        good_root = m.commitment_root()
+
+        c = self._client()
+
+        def fake_request(operation, body, *, _root_holder=[good_root]):
+            c._observe_reply_root(self._reply(4, _root_holder[0]))
+            c._last_reply_header = self._reply(4, _root_holder[0])
+            return proof_blob
+
+        c.request = fake_request
+        proof = c.get_proof(1)
+        assert proof["root"] == good_root
+        assert c.root_audits == 1
+
+        def lying_request(operation, body):
+            c._last_reply_header = self._reply(4, good_root ^ 1)
+            return proof_blob
+
+        c.request = lying_request
+        with pytest.raises(ProofError, match="header root"):
+            c.get_proof(1)
+
+
+def _reply_root_of(replica, client_id):
+    session = replica.sessions[client_id]
+    h, _ = wire.decode_header(session.reply_bytes[:wire.HEADER_SIZE])
+    return int(h["root"])
+
+
+def test_reply_header_carries_root_solo(tmp_path):
+    """A merkle-armed solo replica stamps the canonical accounts root
+    into every reply header; merkle off stamps 0 (bit-identical legacy
+    wire)."""
+    cluster = SimCluster(
+        str(tmp_path), n_replicas=1, n_clients=1, seed=5,
+        requests_per_client=3,
+        net=PacketSimulator(seed=6),
+        merkle=True, scrub_interval=4,
+    )
+    ok = cluster.run_until(
+        lambda: cluster.clients_done(), max_ticks=20_000
+    )
+    assert ok
+    replica = cluster.replicas[0]
+    client_id = next(iter(cluster.clients))
+    root = _reply_root_of(replica, client_id)
+    assert root != 0
+    assert root == replica.machine.commitment_root()
+
+
+def test_reply_header_root_zero_when_merkle_off(tmp_path):
+    cluster = SimCluster(
+        str(tmp_path), n_replicas=1, n_clients=1, seed=5,
+        requests_per_client=3,
+        net=PacketSimulator(seed=6),
+    )
+    ok = cluster.run_until(
+        lambda: cluster.clients_done(), max_ticks=20_000
+    )
+    assert ok
+    client_id = next(iter(cluster.clients))
+    assert _reply_root_of(cluster.replicas[0], client_id) == 0
+
+
+# ---------------------------------------------------------------------------
+# Scripted consensus edges
+# ---------------------------------------------------------------------------
+
+
+def _quiet_cluster(tmp_path, seed=31):
+    """A formatted 3-replica cluster with no client traffic: the scripted
+    edge tests drive one replica's handlers directly."""
+    return SimCluster(
+        str(tmp_path), n_replicas=3, n_clients=1, seed=seed,
+        requests_per_client=0, net=PacketSimulator(seed=seed + 1),
+    )
+
+
+def _heartbeat(replica, checkpoint_op, commit=0):
+    h = wire.new_header(
+        wire.Command.commit,
+        cluster=replica.cluster, view=replica.view,
+        commit=commit, checkpoint_op=checkpoint_op,
+    )
+    h["replica"] = replica.primary_index()
+    return h
+
+
+class TestStrandedSyncWedge:
+    def test_refresh_storm_still_rotates_dead_responder(self, tmp_path):
+        """The stranded-sync wedge (ISSUE 15 satellite): a syncing replica
+        whose pinned responder dies mid-transfer used to poll the corpse
+        forever when checkpoint-refresh heartbeats kept resetting the
+        resend clock (each refresh re-requested from the SAME peer and
+        pushed the rotation timeout away).  The progress clock now drives
+        rotation: refreshes are not progress, so the dead peer is rotated
+        away from within one resend interval of stall."""
+        cluster = _quiet_cluster(tmp_path)
+        cluster.run(5)
+        r = cluster.replicas[2]
+        r.sync_mode_force = "full"  # transport-independent regression
+        dead = 0
+        r._sync_peer = dead
+        r._enter_sync(5)
+        assert r.sync_target is not None and r.status == SYNCING
+        targets = []
+        ckpt = 5
+        for tick in range(1, 6 * SYNC_RESEND):
+            if tick % 10 == 0:
+                # The cluster checkpoints again under flood: refresh
+                # heartbeats arrive FASTER than the resend interval —
+                # the exact storm that used to starve rotation forever.
+                ckpt += 1
+                out = r.on_commit(_heartbeat(r, ckpt), b"")
+            else:
+                out = r.tick()
+            for dst, _msg in out:
+                if dst[0] == "replica":
+                    targets.append(dst[1])
+        assert any(t != dead for t in targets), (
+            f"sync requests never rotated off the dead responder: "
+            f"{sorted(set(targets))}"
+        )
+
+    def test_refresh_repins_target_and_restarts_fetch(self, tmp_path):
+        """The resumption edge at the old consensus.py:1034: a responder
+        checkpointing AGAIN mid-transfer resets the target and restarts
+        the fetch from offset 0 (the responder only serves its exact
+        current checkpoint)."""
+        cluster = _quiet_cluster(tmp_path, seed=33)
+        cluster.run(5)
+        r = cluster.replicas[2]
+        r.sync_mode_force = "full"
+        r._enter_sync(5)
+        r.sync_buffer.extend(b"\xAA" * 100)  # mid-transfer
+        out = r.on_commit(_heartbeat(r, 7), b"")
+        assert r.sync_target["checkpoint_op"] == 7
+        assert len(r.sync_buffer) == 0
+        (dst, msg), = out
+        h, cmd, _ = wire.decode(msg)
+        assert cmd == wire.Command.request_sync_checkpoint
+        assert int(h["offset"]) == 0
+        assert int(h["checkpoint_op"]) == 7
+
+    def test_offset_mismatch_chunk_rejected(self, tmp_path):
+        """A chunk whose offset does not match the buffer (reordered or
+        replayed) must not be appended — the replica re-requests from its
+        actual offset."""
+        cluster = _quiet_cluster(tmp_path, seed=34)
+        cluster.run(5)
+        r = cluster.replicas[2]
+        r.sync_mode_force = "full"
+        r._enter_sync(5)
+        r.sync_buffer.extend(b"\xBB" * 64)
+        chunk = wire.new_header(
+            wire.Command.sync_checkpoint,
+            cluster=r.cluster, view=r.view,
+            checkpoint_op=5, offset=999, total=4096, file_checksum=1,
+            commit_max=5,
+        )
+        out = r.on_sync_checkpoint(chunk, b"\xCC" * 32)
+        assert bytes(r.sync_buffer) == b"\xBB" * 64  # nothing appended
+        (dst, msg), = out
+        h, cmd, _ = wire.decode(msg)
+        assert cmd == wire.Command.request_sync_checkpoint
+        assert int(h["offset"]) == 64
+
+
+def test_unsupported_peers_degrade_to_full_transfer(tmp_path):
+    """Mixed-version safety: a merkle-armed rejoiner whose peers never
+    answer request_sync_roots (merkle-off peers, or pre-sync-roots
+    builds that drop the unknown command) must degrade to the existing
+    full-checkpoint path — counted, never wedged."""
+    cluster = _quiet_cluster(tmp_path, seed=36)
+    cluster.run(5)
+    r = cluster.replicas[2]
+    r.machine.merkle_enabled = True  # the rejoiner wants incremental
+    out = r._enter_sync(5)
+    assert r.sync_target["mode"] == "roots"
+    (dst, msg), = out
+    _, cmd, _ = wire.decode(msg)
+    assert cmd == wire.Command.request_sync_roots
+    # Nobody answers: tick until the unanswered-rounds budget degrades.
+    full_seen = False
+    for _ in range(40 * SYNC_RESEND):
+        for _dst, m in r.tick():
+            _, cmd, _ = wire.decode(m)
+            if cmd == wire.Command.request_sync_checkpoint:
+                full_seen = True
+        if full_seen:
+            break
+    assert full_seen, "never degraded to the full-checkpoint transfer"
+    assert r.sync_target["mode"] == "full"
+    assert r.sync_stats["fallbacks"] >= 1
+    # STICKY episode (review find): a checkpoint-refresh must NOT
+    # re-enter the roots flow after a fallback — among merkle-off peers
+    # under a flood, resetting the unanswered-rounds budget every
+    # refresh would livelock the rejoin.
+    out = r.on_commit(_heartbeat(r, 9), b"")
+    assert r.sync_target["mode"] == "full"
+    assert r.sync_target["checkpoint_op"] == 9
+    (dst, msg), = out
+    _, cmd, _ = wire.decode(msg)
+    assert cmd == wire.Command.request_sync_checkpoint
+
+
+def test_unpack_roots_rejects_forged_history_shapes(arrays_and_trees):
+    """Review find: responder-supplied history shapes must be bounded in
+    unpack_roots — a forged summary must be rejected, not crash the
+    requester past the verification chain (MemoryError / broadcast
+    errors at finalize)."""
+    import io
+    import zlib
+
+    import numpy as np
+
+    _, arrays, trees = arrays_and_trees
+    body = statesync.pack_roots(arrays, trees, {})
+    raw = zlib.decompress(body)
+    z = dict(np.load(io.BytesIO(raw)))
+
+    def repack(**overrides):
+        payload = dict(z)
+        payload.update(overrides)
+        buf = io.BytesIO()
+        np.savez(buf, **payload)
+        return zlib.compress(buf.getvalue(), 6)
+
+    assert statesync.unpack_roots(repack()) is not None  # control
+    # history_count > history_capacity: broadcast crash at finalize.
+    assert statesync.unpack_roots(repack(**{
+        "history/count": np.uint64(int(z["history/capacity"]) + 1),
+    })) is None
+    # Absurd capacity: allocation bomb.
+    assert statesync.unpack_roots(repack(**{
+        "history/capacity": np.uint64(1 << 40),
+        "history/count": np.uint64(1 << 40),
+    })) is None
+
+
+@pytest.mark.slow
+def test_cold_manifest_refused_loudly_at_sharded_rejoiner():
+    """Satellite edge: a checkpoint whose durable manifest says cold-tier
+    evictions happened cannot install into a sharded machine — the
+    refusal must be a loud DeviceStateUnrecoverable, not a silent wedge
+    (the sync install path propagates it as a crash-find)."""
+    from tigerbeetle_tpu.machine import DeviceStateUnrecoverable
+
+    m = seed_machine(TpuStateMachine(
+        ledger_config=SMALL, batch_lanes=8, shards=2,
+    ))
+    state = m.host_state()
+    state["cold_manifest"] = [
+        {"basename": "spill.run.1", "checksum": "00" * 16, "rows": 4}
+    ]
+    with pytest.raises(DeviceStateUnrecoverable, match="TB_SHARDS"):
+        m.restore_host_state(state)
+
+
+# ---------------------------------------------------------------------------
+# Pinned VOPR catch-up seeds (@slow; listed in the ci integration tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestVoprCatchup:
+    SEED = 42
+
+    def test_incremental_rejoin_green(self, tmp_path):
+        from tigerbeetle_tpu.sim.vopr import run_catchup_seed
+
+        res = run_catchup_seed(self.SEED, workdir=str(tmp_path))
+        assert res.exit_code == 0, res.reason
+        assert res.sync_mode == "incremental", res.sync_stats
+        assert res.sync_stats["fallbacks"] == 0
+        assert res.sync_stats["rows_installed"] > 0
+        assert res.ops_advanced >= 2 * 23  # two TEST_MIN checkpoint intervals
+
+    def test_forced_fallback_green(self, tmp_path):
+        from tigerbeetle_tpu.sim.vopr import run_catchup_seed
+
+        res = run_catchup_seed(
+            self.SEED, workdir=str(tmp_path), force_full=True
+        )
+        assert res.exit_code == 0, res.reason
+        assert res.sync_mode == "full", res.sync_stats
+        assert res.sync_stats["bytes_full"] > 0
+
+    def test_lying_responder_detected_and_rotated(self, tmp_path):
+        from tigerbeetle_tpu.sim.vopr import run_catchup_seed
+
+        res = run_catchup_seed(
+            self.SEED, workdir=str(tmp_path), lying_responder=True
+        )
+        assert res.exit_code == 0, res.reason
+        assert res.sync_stats["chunk_retries"] >= 1, res.sync_stats
+        # Detection never installed a corrupt chunk: the run stays green.
+
+    def test_lying_responder_verify_off_fails_convergence(self, tmp_path):
+        from tigerbeetle_tpu.sim.vopr import EXIT_PASSED, run_catchup_seed
+
+        res = run_catchup_seed(
+            self.SEED, workdir=str(tmp_path), lying_responder=True,
+            verify=False,
+        )
+        # The scrub-off discipline: with verification off the SAME
+        # schedule demonstrably installs divergent state and fails the
+        # state-convergence oracle.
+        assert res.exit_code != EXIT_PASSED, (
+            "verify-off lying-responder run converged — verification "
+            "is not what carries safety?"
+        )

@@ -95,11 +95,17 @@ def build_golden() -> dict:
         request_checksum=0xABCDEF, context=1, client=0xC11E17, op=9,
         commit=9, timestamp=1234, request=1,
         operation=int(wire.Operation.create_transfers),
+        # Commitment root riding the reply header (carved from reserved
+        # padding; docs/commitments.md) — nonzero here so the TS offline
+        # suite proves it parses the exact bytes a merkle-armed server
+        # stamps.
+        root=0x1122_3344_5566_7788,
         size=wire.HEADER_SIZE + len(body),
     )
     reply = {
         "frame_hex": wire.encode(reply_h, body).hex(),
         "request_checksum": str(0xABCDEF), "op": 9,
+        "root": str(0x1122_3344_5566_7788),
         "results": [[0, 21], [1, 46]],
     }
 
@@ -229,6 +235,7 @@ def test_ts_wire_offsets_match_python():
         "OFF_REP_TIMESTAMP": rep["timestamp"],
         "OFF_REP_REQUEST": rep["request"],
         "OFF_REP_OPERATION": rep["operation"],
+        "OFF_REP_ROOT": rep["root"],
         "OFF_EVICT_CLIENT": 128,
     }
     for name, off in want.items():

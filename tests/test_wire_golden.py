@@ -38,11 +38,12 @@ Prepare (message_header.zig:502-553):
     208 client u128, 224 op u64, 232 commit u64, 240 timestamp u64,
     248 request u32, 252 operation u8, 253 reserved [3]u8.
 
-Reply (message_header.zig:724-758):
+Reply (message_header.zig:724-758, + the commitment-root carve):
     128 request_checksum u128, 144 request_checksum_padding u128,
     160 context u128, 176 context_padding u128, 192 client u128,
     208 op u64, 216 commit u64, 224 timestamp u64, 232 request u32,
-    236 operation u8, 237 reserved [19]u8.
+    236 operation u8, 237 root u64 (carved from reserved; 0 = no
+    commitments — legacy frames decode identically), 245 reserved [11]u8.
 
 Checksums (message_header.zig:101-124): checksum_body = AEGIS(body);
 checksum = AEGIS(header_bytes[16:256]) — set AFTER checksum_body so the
@@ -157,7 +158,7 @@ def test_dtype_offsets_match_reference_layout():
         "request_checksum_padding": 144, "context_lo": 160,
         "context_hi": 168, "context_padding": 176, "client_lo": 192,
         "client_hi": 200, "op": 208, "commit": 216, "timestamp": 224,
-        "request": 232, "operation": 236, "reserved": 237,
+        "request": 232, "operation": 236, "root": 237, "reserved": 245,
     })
     for dtype, want in (
         (wire.REQUEST_DTYPE, request_offsets),

@@ -237,6 +237,24 @@ def main(argv=None) -> int:
                              "consensus ingress verification OFF — the "
                              "negative control that demonstrably fails "
                              "the safety oracle")
+    p_vopr.add_argument("--catchup", action="store_true",
+                        help="run the CATCH-UP scenario: crash one backup "
+                             "mid-open-loop-flood in a merkle-armed "
+                             "cluster, advance >= 2 checkpoints, heal — "
+                             "the rejoiner must converge byte-identically "
+                             "via Merkle-anchored incremental state sync "
+                             "(docs/state_sync.md)")
+    p_vopr.add_argument("--force-full", action="store_true",
+                        help="with --catchup: pin the rejoiner to the "
+                             "full-checkpoint transfer (the proven-"
+                             "identical fallback control)")
+    p_vopr.add_argument("--lying-responder", action="store_true",
+                        help="with --catchup: the rejoiner's default "
+                             "responder serves corrupted subtree rows "
+                             "under valid checksums; root verification "
+                             "must reject + rotate (add --no-verify for "
+                             "the install-divergent-state negative "
+                             "control)")
     p_vopr.add_argument("--replay-schedule", default=None, metavar="FILE",
                         help="re-execute a tbmc counterexample schedule "
                              "(sim/mc.py, docs/tbmc.md) bit-identically "
@@ -298,6 +316,7 @@ def _cmd_vopr(args) -> int:
             or args.ticks is not None or args.tpu
             or args.overload or args.no_priority
             or args.byzantine or args.no_verify
+            or args.catchup or args.force_full or args.lying_responder
             or args.device_faults or args.scrub_interval is not None
             or args.merkle or args.vopr_viz or args.bug is not None
             or args.clusters != 4096 or args.steps != 400
@@ -328,12 +347,13 @@ def _cmd_vopr(args) -> int:
     if args.tpu and (
         args.overload or args.no_priority
         or args.byzantine or args.no_verify or args.merkle
+        or args.catchup or args.force_full or args.lying_responder
     ):
         # Same loud-reject discipline as the non-TPU knob checks below:
         # the TPU vopr runs its own random schedule, so silently dropping
         # --overload would report a scenario that never ran.
         print("error: --overload/--no-priority/--byzantine/--no-verify/"
-              "--merkle do not apply with --tpu", file=sys.stderr)
+              "--merkle/--catchup do not apply with --tpu", file=sys.stderr)
         return 2
     if args.tpu:
         from .sim import vopr_tpu
@@ -369,7 +389,9 @@ def _cmd_vopr(args) -> int:
             return 0 if n > 0 else 1  # the oracle must catch a known bug
         return EXIT_CORRECTNESS if n > 0 else 0
 
-    from .sim.vopr import run_byzantine_seed, run_overload_seed, run_seed
+    from .sim.vopr import (
+        run_byzantine_seed, run_catchup_seed, run_overload_seed, run_seed,
+    )
 
     if args.bug is not None or args.clusters != 4096 or args.steps != 400:
         print("error: --clusters/--steps/--bug apply only with --tpu",
@@ -379,9 +401,25 @@ def _cmd_vopr(args) -> int:
         print("error: --no-priority applies only with --overload",
               file=sys.stderr)
         return 2
-    if args.no_verify and not args.byzantine:
-        print("error: --no-verify applies only with --byzantine",
-              file=sys.stderr)
+    if args.no_verify and not (args.byzantine or args.catchup):
+        print("error: --no-verify applies only with --byzantine or "
+              "--catchup", file=sys.stderr)
+        return 2
+    if (args.force_full or args.lying_responder) and not args.catchup:
+        print("error: --force-full/--lying-responder apply only with "
+              "--catchup", file=sys.stderr)
+        return 2
+    if args.catchup and (
+        args.overload or args.byzantine or args.device_faults
+        or args.scrub_interval is not None or args.merkle
+        or args.vopr_viz or args.ticks is not None
+    ):
+        # The catch-up scenario owns its schedule (merkle is ALWAYS armed
+        # there — it is the incremental transport's precondition); loudly
+        # reject knobs it does not take.
+        print("error: --overload/--byzantine/--device-faults/"
+              "--scrub-interval/--merkle/--vopr-viz/--ticks do not apply "
+              "with --catchup", file=sys.stderr)
         return 2
     if args.merkle and not args.scrub_interval:
         print("error: --merkle needs --scrub-interval >= 1 (the commitment "
@@ -413,6 +451,21 @@ def _cmd_vopr(args) -> int:
     first = args.seed if args.seed is not None else secrets.randbits(31)
     worst = 0
     for seed in range(first, first + args.count):
+        if args.catchup:
+            result = run_catchup_seed(
+                seed,
+                force_full=args.force_full,
+                lying_responder=args.lying_responder,
+                verify=not args.no_verify,
+            )
+            print(
+                f"seed={result.seed} exit={result.exit_code} "
+                f"rejoiner={result.rejoiner} mode={result.sync_mode} "
+                f"ops_advanced={result.ops_advanced} "
+                f"sync={result.sync_stats}: {result.reason}"
+            )
+            worst = max(worst, result.exit_code)
+            continue
         if args.byzantine:
             result = run_byzantine_seed(
                 seed,

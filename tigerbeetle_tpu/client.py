@@ -91,6 +91,18 @@ class Client:
         )
         self._sleep = time.sleep
         self._now = time.monotonic
+        # Continuous ledger auditing (docs/commitments.md): every reply
+        # header carries the server's canonical accounts commitment root
+        # (0 = commitments off).  The client tracks the freshest
+        # (commit, root) pair it has accepted and cross-checks every
+        # verified account proof's anchor against its own reply's root —
+        # a server that anchors a proof to a root it did not commit to in
+        # the SAME reply is lying, and the call raises instead of
+        # returning "verified" data.
+        self.last_root = 0
+        self.last_root_commit = -1
+        self.root_audits = 0
+        self._last_reply_header = None
 
     RETRY_TICK_S = 0.05
     # Server retry-after hints (busy frames) are in CONSENSUS ticks
@@ -261,6 +273,7 @@ class Client:
                     # Progress: the next failure backs off from the base.
                     self._reconnect_backoff.reset(0)
                     self._busy_backoff.reset(0)
+                    self._observe_reply_root(h)
                     return h, body
             except (ConnectionError, OSError, ValueError):
                 self.close()
@@ -272,6 +285,22 @@ class Client:
                 self.failover_count += 1
                 ticks = self._reconnect_backoff.next_backoff()
                 self._sleep(ticks * self.RETRY_TICK_S)
+
+    def _observe_reply_root(self, h: np.ndarray) -> None:
+        """Track the commitment root riding an accepted reply header.
+        Roots advance with the commit number (the ledger changes, so the
+        root changes); the client keeps the freshest pair for the
+        get_proof cross-check and for caller-side monotonicity audits.
+        0 (commitments off / legacy frame / replay-stored reply) is
+        skipped — zero never overwrites an observed root."""
+        self._last_reply_header = h
+        root = int(h["root"]) if "root" in (h.dtype.names or ()) else 0
+        if root == 0:
+            return
+        commit = int(h["commit"])
+        if commit >= self.last_root_commit:
+            self.last_root = root
+            self.last_root_commit = commit
 
     # -- session protocol -----------------------------------------------------
 
@@ -397,7 +426,7 @@ class Client:
         pending timestamp, bindable to that transfer's own proof).  None
         when the row does not exist or the server runs without merkle
         commitments."""
-        from .ops.merkle import PROOF_KINDS, check_proof
+        from .ops.merkle import PROOF_KINDS, ProofError, check_proof
 
         body = _encode_ids([ident])
         if kind != "accounts":
@@ -407,11 +436,27 @@ class Client:
             return None
         proof = check_proof(reply)
         if proof["kind"] != kind:
-            from .ops.merkle import ProofError
-
             raise ProofError(
                 f"server answered kind {proof['kind']!r} for {kind!r}"
             )
+        # Continuous ledger auditing: a get_proof executes at a settled
+        # commit point, so the accounts root its own reply header carries
+        # MUST equal the root an accounts proof folds to — a mismatch
+        # means the server anchored the proof to a ledger other than the
+        # one it replied from.
+        header = self._last_reply_header
+        if kind == "accounts" and header is not None:
+            header_root = (
+                int(header["root"])
+                if "root" in (header.dtype.names or ()) else 0
+            )
+            if header_root and header_root != proof["root"]:
+                raise ProofError(
+                    f"proof root {proof['root']:#x} != reply header root "
+                    f"{header_root:#x}"
+                )
+            if header_root:
+                self.root_audits += 1
         return proof
 
 

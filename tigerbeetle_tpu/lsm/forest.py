@@ -499,6 +499,21 @@ class Forest:
 
     # -- sync materialization & GC -------------------------------------------
 
+    def canonical_arrays(self, op: int) -> Tuple[Dict[str, np.ndarray], dict]:
+        """(arrays, meta) of the DURABLE state at checkpoint ``op`` —
+        base + runs replayed from disk (the incremental state-sync
+        responder's source; docs/state_sync.md).  Reading the manifest's
+        files, not ``self.prev``, keeps the served state consistent with
+        the adopted checkpoint even while an async checkpoint write for a
+        NEWER op is still in flight on the background thread."""
+        assert op == max(
+            [self.manifest.base_op] + [r.op for r in self.manifest.runs]
+        ), "can only serve the latest checkpoint"
+        arrays, meta = self._load_base_arrays()
+        for ref in self.manifest.runs:
+            meta = self._apply_run(arrays, self._load_run(ref))
+        return arrays, meta
+
     def materialize_file(self, op: int) -> Tuple[str, int]:
         """Write a single full snapshot for checkpoint ``op`` (state-sync
         responder: a lagging replica wants one blob, not base+runs)."""
@@ -513,9 +528,7 @@ class Forest:
         if os.path.exists(path + ".ok"):
             with open(path + ".ok") as f:
                 return path, int(f.read(), 16)
-        arrays, meta = self._load_base_arrays()
-        for ref in self.manifest.runs:
-            meta = self._apply_run(arrays, self._load_run(ref))
+        arrays, meta = self.canonical_arrays(op)
         arrays["meta"] = np.frombuffer(
             json.dumps(meta or {}).encode(), dtype=np.uint8
         ).copy()

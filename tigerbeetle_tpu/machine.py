@@ -1269,6 +1269,63 @@ class TpuStateMachine:
             return None
         return merkle_ops.np_ledger_roots(self._query_ledger())
 
+    def commitment_root(self) -> int:
+        """The canonical ACCOUNTS-pad commitment root of the current
+        committed state — the audit anchor the replica stamps into every
+        reply header (wire.REPLY_DTYPE ``root``; docs/commitments.md) and
+        the root client-held account proofs fold to.  0 when commitments
+        are not armed (merkle off / host engine), which is also what
+        legacy frames decode, so the field is skippable end to end.
+
+        Single-device mode reads the maintained forest root (one scalar
+        readback — the single-device layout IS the canonical one).
+        Under TB_SHARDS the canonical root lives in the host tree cache
+        get_proof maintains; REBUILDING it costs a full unshard plus an
+        O(capacity) hash pass, which must never ride the per-reply hot
+        path — so sharded replies stamp the root only when the cache is
+        already fresh (a get_proof just built it — exactly the reply the
+        client cross-checks) and 0 otherwise, which clients skip by
+        contract.  Under grouped/pipelined commit the value may reflect
+        a commit point slightly AFTER the op being replied to (the lane
+        holds the whole wave): the contract is at-or-after, which a
+        get_proof reply — always a group boundary, served from settled
+        state — meets exactly."""
+        if self._merkle_forest is None or self._engine is not None:
+            return 0
+        self._merkle_rebuild_if_dirty()
+        if self._ledger_is_sharded:
+            # Cache-fresh check WITHOUT touching _query_ledger() (that
+            # would itself trigger the O(capacity) unshard per commit).
+            canon = self._canon
+            cached = self._canon_tree
+            if (
+                canon is None or cached is None
+                or cached[0] is not canon
+                or "accounts" not in cached[1]
+            ):
+                return 0
+            return int(cached[1]["accounts"][1])
+        # The forest object is swapped wholesale by commit closures (an
+        # immutable pytree per batch), so this read sees SOME committed
+        # forest, never a torn one.
+        return int(np.asarray(self._merkle_forest.accounts[1]))
+
+    def _canon_tree_nodes(self, pad_name: str) -> np.ndarray:
+        """The cached canonical host-side tree heap for ``pad_name``
+        (shared by sharded get_proof paths and commitment_root),
+        invalidated with the canonical view itself."""
+        canon = self._query_ledger()
+        cached = self._canon_tree
+        if cached is None or cached[0] is not canon:
+            self._canon_tree = cached = (canon, {})
+        nodes = cached[1].get(pad_name)
+        if nodes is None:
+            nodes = merkle_ops.np_tree(
+                merkle_ops.np_table_leaves(getattr(canon, pad_name), pad_name)
+            )
+            cached[1][pad_name] = nodes
+        return nodes
+
     def get_proof(self, ident: int, kind: str = "accounts") -> Optional[bytes]:
         """Root-anchored Merkle inclusion proof for one row
         (docs/commitments.md proof format), client-verifiable via
@@ -1357,17 +1414,8 @@ class TpuStateMachine:
         the canonical one).  The cached heaps — one per pad, built
         lazily — are invalidated with the canonical view itself.
         Returns (slot, siblings, root), or None when the key is absent."""
-        canon = self._query_ledger()
-        cached = self._canon_tree
-        if cached is None or cached[0] is not canon:
-            self._canon_tree = cached = (canon, {})
-        table = getattr(canon, pad_name)
-        nodes = cached[1].get(pad_name)
-        if nodes is None:
-            nodes = merkle_ops.np_tree(
-                merkle_ops.np_table_leaves(table, pad_name)
-            )
-            cached[1][pad_name] = nodes
+        nodes = self._canon_tree_nodes(pad_name)
+        table = getattr(self._query_ledger(), pad_name)
         cap = len(nodes) // 2
         key_lo = np.asarray(table.key_lo)
         key_hi = np.asarray(table.key_hi)

@@ -200,6 +200,9 @@ class DigestMachine:
 
     # -- the surface consensus/replica actually touch ------------------------
 
+    def commitment_root(self) -> int:
+        return 0  # no commitments in the folded-digest stand-in
+
     def prepare(self, operation: str, count: int,
                 wall_clock_ns: int = 0) -> int:
         # Byte-for-byte the real machine's timestamp assignment

@@ -546,6 +546,267 @@ def run_byzantine_seed(
 
 
 @dataclasses.dataclass
+class CatchupResult(VoprResult):
+    """VoprResult + the catch-up (state sync) kind's accounting."""
+
+    rejoiner: int = -1
+    sync_mode: Optional[str] = None      # transport the rejoin used
+    sync_stats: Optional[dict] = None    # the rejoiner's sync accounting
+    ops_advanced: int = 0                # committed ops the cluster moved past
+                                         # the rejoiner while it was down
+                                         # (>= 2 checkpoint intervals by the
+                                         # scenario precondition)
+    # Whole-state checksums of the rejoiner's and one never-crashed
+    # peer's final canonical arrays (statesync.arrays_checksum): equal
+    # iff the rejoin landed BYTE-identical state — stronger than the
+    # digest convergence oracle (which folds accounts only) and the
+    # smoke's identity proof for both transports.  (Two runs of the same
+    # seed under DIFFERENT transports legitimately diverge after the
+    # restart — the transports exchange different messages, so
+    # post-install commit timestamps differ; byte identity is a
+    # within-run claim.)
+    state_checksum: Optional[int] = None
+    peer_state_checksum: Optional[int] = None
+
+
+def run_catchup_seed(
+    seed: int,
+    workdir: Optional[str] = None,
+    force_full: bool = False,
+    lying_responder: bool = False,
+    verify: bool = True,
+    settle_ticks: int = 60_000,
+    ledger_config=None,
+) -> CatchupResult:
+    """The CATCH-UP scenario (docs/state_sync.md): crash one BACKUP
+    mid-open-loop-flood, let the cluster advance >= 2 checkpoints past
+    its state, heal, and require the rejoiner to converge to
+    byte-identical digests with every oracle green.
+
+    - default: the rejoiner runs the Merkle-anchored incremental sync
+      (the cluster is merkle-armed) and ``sync_mode`` records that the
+      incremental transport actually served the rejoin;
+    - ``force_full=True``: the same schedule with the rejoiner pinned to
+      the full-checkpoint transfer (sync_mode_force) — the
+      proven-identical fallback control;
+    - ``lying_responder=True``: the rejoiner's DEFAULT responder (the
+      primary) serves corrupted sync_subtree row payloads under VALID
+      frame checksums — a lying peer, not a noisy wire.  With
+      ``verify=True`` root verification must reject every corrupt chunk
+      (sync_stats["chunk_retries"] > 0), rotate to the honest peer, and
+      still converge green;
+    - ``verify=False`` (with the liar) is the NEGATIVE CONTROL, the
+      scrub-off discipline: verification off, the same corrupt chunks
+      install, and the run must demonstrably fail the state-convergence
+      oracle (exit 129).
+
+    Every knob draws from streams separate from run_seed's, so pinned
+    catch-up seeds replay bit-identically."""
+    import random as _random
+
+    from ..config import TEST_MIN
+    from ..vsr import wire as _wire
+    from ..vsr.consensus import NORMAL
+    from .openloop import OpenLoopGen
+
+    interval = TEST_MIN.vsr_checkpoint_interval
+    CRASH_AT = 400
+    RESTART_DEADLINE = 12_000     # precondition cap: 2 checkpoints of flood
+    gen = OpenLoopGen(
+        seed ^ 0x09E7,
+        n_clients=10,
+        hot_accounts=32,
+        arrival="poisson",
+        rate=0.08,
+        start_tick=40,
+        horizon=3_500,
+        batch=4,
+    )
+
+    def go(workdir: str) -> CatchupResult:
+        cluster = SimCluster(
+            workdir,
+            n_replicas=3,
+            n_clients=1,
+            seed=seed,
+            requests_per_client=4,
+            net=PacketSimulator(seed=seed + 1, delay_mean=2, delay_max=8),
+            ledger_config=ledger_config,
+            # Merkle commitments cluster-wide: the incremental transport's
+            # precondition (and the scenario's point).
+            scrub_interval=8,
+            merkle=True,
+        )
+        gen.attach(cluster)
+        rejoiner = -1
+        liar = -1
+
+        def result(code: int, reason: str, advanced: int = 0) -> CatchupResult:
+            commits = max(
+                (r.commit_min for r in cluster.replicas if r is not None),
+                default=0,
+            )
+            res = CatchupResult(
+                seed, code, reason, cluster.t, commits,
+                1 + int(lying_responder),
+            )
+            res.rejoiner = rejoiner
+            res.ops_advanced = advanced
+            r = cluster.replicas[rejoiner] if rejoiner >= 0 else None
+            if r is not None:
+                res.sync_mode = r.sync_stats.get("mode")
+                res.sync_stats = dict(r.sync_stats)
+                if code == EXIT_PASSED:
+                    from ..vsr import checkpoint as _ckpt
+                    from ..vsr import statesync as _ss
+
+                    res.state_checksum = _ss.arrays_checksum(
+                        _ckpt.ledger_to_arrays(
+                            r.machine.checkpoint_ledger()
+                        )
+                    )
+                    peer = next(
+                        (p for i, (p, a) in enumerate(
+                            zip(cluster.replicas, cluster.alive)
+                        ) if a and p is not None and i != rejoiner),
+                        None,
+                    )
+                    if peer is not None:
+                        res.peer_state_checksum = _ss.arrays_checksum(
+                            _ckpt.ledger_to_arrays(
+                                peer.machine.checkpoint_ledger()
+                            )
+                        )
+            if _obs.enabled:
+                _obs.counter("sync.vopr.runs").inc()
+                if res.sync_stats:
+                    _obs.counter("sync.vopr.chunk_retries").inc(
+                        res.sync_stats.get("chunk_retries", 0)
+                    )
+            return res
+
+        def wrap_liar(replica) -> None:
+            """Corrupt every sync_subtree ROW payload this responder
+            serves, re-encoded under VALID checksums: a lying responder,
+            indistinguishable from honest at the transport layer — only
+            root verification can catch it."""
+            orig = replica.on_request_sync_subtree
+
+            def lying(h, body, _orig=orig):
+                out = _orig(h, body)
+                evil = []
+                for dst, msg in out:
+                    hh, cmd, payload = _wire.decode(msg)
+                    if (
+                        cmd == _wire.Command.sync_subtree
+                        and int(hh["kind"]) == _wire.SYNC_ROWS
+                        and payload
+                    ):
+                        bad = bytes(b ^ 0x01 for b in payload)
+                        evil.append((dst, _wire.encode(hh.copy(), bad)))
+                    else:
+                        evil.append((dst, msg))
+                return evil
+
+            replica.on_request_sync_subtree = lying
+
+        try:
+            for _ in range(CRASH_AT):
+                cluster.step()
+            live = [
+                r for r, a in zip(cluster.replicas, cluster.alive) if a
+            ]
+            view = max(r.view for r in live)
+            primary = live[0].primary_index(view)
+            rejoiner = (primary + 1) % cluster.n
+            ckpt_at_crash = max(r.op_checkpoint for r in live)
+            cluster.crash(rejoiner)
+            # Flood on: the cluster must advance >= 2 checkpoints past the
+            # crashed replica's state (the catch-up precondition).
+            target_ckpt = ckpt_at_crash + 2 * interval
+            while cluster.t < RESTART_DEADLINE:
+                cluster.step()
+                live_ckpts = [
+                    r.op_checkpoint
+                    for r, a in zip(cluster.replicas, cluster.alive) if a
+                ]
+                if live_ckpts and min(live_ckpts) >= target_ckpt:
+                    break
+            else:
+                return result(
+                    EXIT_LIVENESS,
+                    f"cluster did not advance 2 checkpoints past "
+                    f"{ckpt_at_crash} within {RESTART_DEADLINE} ticks "
+                    f"(precondition, not a protocol fault)",
+                )
+            advanced = min(
+                r.op_checkpoint
+                for r, a in zip(cluster.replicas, cluster.alive) if a
+            ) - ckpt_at_crash
+            if lying_responder:
+                live_now = [
+                    (i, r)
+                    for i, (r, a) in enumerate(
+                        zip(cluster.replicas, cluster.alive)
+                    )
+                    if a and r is not None
+                ]
+                cur_view = max(r.view for _, r in live_now)
+                liar = live_now[0][1].primary_index(cur_view)
+                if cluster.replicas[liar] is not None:
+                    wrap_liar(cluster.replicas[liar])
+            cluster.restart(rejoiner)
+            r = cluster.replicas[rejoiner]
+            if force_full:
+                r.sync_mode_force = "full"
+            r.sync_verify = verify
+            ok = cluster.run_until(
+                lambda: cluster.clients_done() and cluster.converged(),
+                max_ticks=settle_ticks,
+            )
+            if not ok:
+                live2 = [
+                    r2 for r2, a in zip(cluster.replicas, cluster.alive)
+                    if a
+                ]
+                if len({r2.commit_min for r2 in live2}) == 1 and all(
+                    r2.status == NORMAL for r2 in live2
+                ):
+                    # Same commit, different state: the convergence oracle
+                    # names the divergence (the verify-off liar's proof).
+                    cluster.check_converged()
+                states = [
+                    (r2.status, r2.view, r2.commit_min, r2.op)
+                    if r2 else None
+                    for r2 in cluster.replicas
+                ]
+                return result(
+                    EXIT_LIVENESS,
+                    f"no convergence after {settle_ticks} settle ticks: "
+                    f"{states}",
+                    advanced,
+                )
+            cluster.check_converged()
+            cluster.check_conservation()
+            return result(EXIT_PASSED, "passed", advanced)
+        except AssertionError as err:
+            return result(EXIT_CORRECTNESS, f"oracle violation: {err}")
+        except Exception as err:  # noqa: BLE001 — a crash IS a find
+            import traceback
+
+            tb = traceback.format_exc().strip().splitlines()
+            return result(
+                EXIT_CORRECTNESS,
+                f"crash: {type(err).__name__}: {err} @ {tb[-3:]}",
+            )
+
+    if workdir is not None:
+        return go(workdir)
+    with tempfile.TemporaryDirectory() as d:
+        return go(d)
+
+
+@dataclasses.dataclass
 class OverloadResult(VoprResult):
     """VoprResult + the overload fault kind's accounting."""
 

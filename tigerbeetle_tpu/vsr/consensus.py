@@ -87,6 +87,17 @@ REPAIR_INTERVAL = 15
 SYNC_RESEND = 30
 BLOCK_REPAIR_RESEND = 20     # per-chunk block-repair timeout before rotating
 
+# Merkle-anchored incremental state sync (docs/state_sync.md).
+SYNC_ROOTS_ATTEMPTS = 3      # unanswered sync_roots rounds PER PEER before
+                             # degrading to the full-checkpoint path (covers
+                             # merkle-off peers and version skew: an old
+                             # responder never answers the new command)
+SYNC_VERIFY_FAILURES = 3     # failed subtree/row verifications (lying or
+                             # bit-flipped chunks) before degrading to full
+SYNC_DIVERGENCE_MAX = 0.5    # diverging fraction of the top frontier above
+                             # which descent cannot win (cold start, long
+                             # absence): go straight to the full transfer
+
 # request_blocks/block kind codes <-> forest file kinds.
 _BLOCK_KIND_CODE = {
     "manifest": wire.BLOCK_KIND_MANIFEST,
@@ -214,6 +225,30 @@ class VsrReplica(Replica):
         # Explicit sync responder (block-repair fallback: primary unknown,
         # rotate through peers); None = target the current view's primary.
         self._sync_peer: Optional[int] = None
+        # Merkle-anchored incremental catch-up (docs/state_sync.md).
+        # sync_mode_force="full" (TB_SYNC_MODE=full / --sync-mode full /
+        # the VOPR forced-fallback control) pins the legacy full-checkpoint
+        # transfer; sync_verify=False is the NEGATIVE CONTROL ONLY (the
+        # scrub-off discipline): subtree/row/state verification off, so a
+        # seeded lying responder demonstrably installs divergent state.
+        self.sync_mode_force: Optional[str] = (
+            "full" if os.environ.get("TB_SYNC_MODE") == "full" else None
+        )
+        self.sync_verify = True
+        self.sync_divergence_max = SYNC_DIVERGENCE_MAX
+        # Plain accounting (registry-independent; the VOPR catch-up kind
+        # and tools/sync_smoke.py assert on it): lifetime totals plus the
+        # mode the LAST completed install used.
+        self.sync_stats = {
+            "mode": None, "bytes_incremental": 0, "bytes_full": 0,
+            "subtrees_shipped": 0, "rows_installed": 0,
+            "chunk_retries": 0, "fallbacks": 0,
+        }
+        # Requester-side descent state (big numpy arrays — deliberately
+        # OUTSIDE the mc capsule: reconstructible by re-entering the roots
+        # flow) and the responder-side per-checkpoint pack cache.
+        self._sync_local: Optional[dict] = None
+        self._sync_pack_cache: Optional[object] = None
 
         # Peer block repair (grid_blocks_missing.zig's role): damaged
         # checkpoint files being refetched before the replica can open.
@@ -258,6 +293,13 @@ class VsrReplica(Replica):
         # escalation window (phase-lock breaking); resets on progress.
         self._vc_escalations = 0
         self._last_sync_req = 0
+        # Tick of the last ACCEPTED sync payload byte: the stall detector
+        # that drives responder rotation.  Distinct from _last_sync_req —
+        # a checkpoint-refresh (on_commit) re-pins the target and re-sends
+        # WITHOUT touching this clock, so a dead responder is still
+        # rotated away from even while refreshes keep arriving (the
+        # stranded-sync wedge; see _enter_sync(refresh=True)).
+        self._sync_progress = 0
         self._heartbeat_jitter = 0
         self._recovering_since = 0
         # Event-loop starvation guard state (tick() liveness fairness).
@@ -560,6 +602,10 @@ class VsrReplica(Replica):
             wire.Command.pong: self.on_pong,
             wire.Command.request_sync_checkpoint: self.on_request_sync_checkpoint,
             wire.Command.sync_checkpoint: self.on_sync_checkpoint,
+            wire.Command.request_sync_roots: self.on_request_sync_roots,
+            wire.Command.sync_roots: self.on_sync_roots,
+            wire.Command.request_sync_subtree: self.on_request_sync_subtree,
+            wire.Command.sync_subtree: self.on_sync_subtree,
             wire.Command.request_blocks: self.on_request_blocks,
             wire.Command.block: self.on_block,
             wire.Command.request_reply: self.on_request_reply,
@@ -1029,15 +1075,17 @@ class VsrReplica(Replica):
         if self.status == SYNCING:
             # Keep the sync target fresh: if the primary checkpointed again
             # mid-fetch, restart against the new snapshot (the responder
-            # only serves its exact current checkpoint).
+            # only serves its exact current checkpoint).  refresh=True:
+            # the restart must NOT reset the progress/resend clocks — a
+            # refresh is not progress, and under a sustained flood (a new
+            # checkpoint every ~interval ops) resetting them here starved
+            # the stall rotation forever while the pinned responder was
+            # dead (the stranded-sync wedge).
             new_ckpt = int(h["checkpoint_op"])
             if self.sync_target is not None and (
                 new_ckpt > self.sync_target["checkpoint_op"]
             ):
-                self.sync_target = {"checkpoint_op": new_ckpt, "total": None}
-                self.sync_buffer = bytearray()
-                self._last_sync_req = self._ticks
-                return self._request_sync_chunk()
+                return self._enter_sync(new_ckpt, refresh=True)
             return []
         if view > self.view or self.status == RECOVERING:
             return self._request_start_view(view)
@@ -2305,18 +2353,69 @@ class VsrReplica(Replica):
         )
         return self._enter_sync(0)
 
-    def _enter_sync(self, checkpoint_op: int) -> List[Msg]:
+    def _enter_sync(self, checkpoint_op: int, *, refresh: bool = False) -> List[Msg]:
         """The ONLY sync-entry point (targeted or latest): sync-entry
         invariants hold on every path — notably abandoning any pending view
         finish, or _finish_view_change(stale view) would regress self.view
-        after the sync installs."""
+        after the sync installs.
+
+        Picks the transport: Merkle-anchored incremental catch-up
+        (docs/state_sync.md) when this replica runs commitments and is not
+        forced full; the byte-exact full-checkpoint transfer otherwise.
+        ``refresh=True`` (a checkpoint-refresh restart, on_commit) keeps
+        the resend/progress clocks UNTOUCHED so a dead pinned responder is
+        still rotated away from even while refreshes keep arriving."""
         self._new_view_pending = None
         self._pending_finish = None
         self.status = SYNCING
-        self.sync_target = {"checkpoint_op": checkpoint_op, "total": None}
         self.sync_buffer = bytearray()
-        self._last_sync_req = self._ticks
+        self._sync_local = None
+        prev = self.sync_target if refresh else None
+        if not refresh:
+            self._last_sync_req = self._ticks
+            self._sync_progress = self._ticks
+        if prev is not None and prev.get("mode", "full") == "full":
+            # A fallback (or an initial full choice) is STICKY for the
+            # whole sync episode: a refresh must not re-enter the roots
+            # flow — among merkle-off peers under a sustained flood that
+            # would reset the unanswered-rounds budget every refresh and
+            # livelock the rejoin (the refresh twin of the stranded-sync
+            # wedge).
+            self.sync_target = {
+                "checkpoint_op": checkpoint_op, "total": None,
+                "mode": "full",
+            }
+            return self._request_sync_chunk()
+        if self._sync_incremental_wanted():
+            self.sync_target = {
+                "checkpoint_op": checkpoint_op, "total": None,
+                "mode": "roots",
+                # Attempt/failure budgets survive refreshes for the same
+                # reason the full choice does: each unanswered round must
+                # COUNT, however often the cluster checkpoints.
+                "roots_attempts": (
+                    prev.get("roots_attempts", 0) if prev else 0
+                ),
+                "verify_failures": (
+                    prev.get("verify_failures", 0) if prev else 0
+                ),
+                "descend_attempts": (
+                    prev.get("descend_attempts", 0) if prev else 0
+                ),
+            }
+            return self._request_sync_roots()
+        self.sync_target = {
+            "checkpoint_op": checkpoint_op, "total": None, "mode": "full",
+        }
         return self._request_sync_chunk()
+
+    def _sync_incremental_wanted(self) -> bool:
+        """Attempt the incremental path iff this replica runs Merkle
+        commitments (the np trees need the leaf contract armed cluster-
+        wide) and nothing forces the proven full transfer."""
+        if self.sync_mode_force == "full":
+            return False
+        return bool(getattr(self.machine, "merkle_enabled", False))
 
     def _maybe_start_sync(self, primary_checkpoint_op: int) -> List[Msg]:
         """If the primary's checkpoint is beyond our journal *head*, our WAL
@@ -2406,6 +2505,10 @@ class VsrReplica(Replica):
     def on_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
         if self.sync_target is None:
             return []
+        if self.sync_target.get("mode", "full") != "full":
+            # A stale full-path chunk (e.g. from before an incremental
+            # retry) must not pollute the descent state.
+            return []
         if self._cold_fetch is not None:
             # Snapshot already fully fetched; a late/duplicate chunk must
             # not re-trigger the install (it would reset the in-progress
@@ -2420,11 +2523,15 @@ class VsrReplica(Replica):
         if int(h["offset"]) != len(self.sync_buffer):
             return self._request_sync_chunk()
         self.sync_buffer.extend(body)
+        self.sync_stats["bytes_full"] += len(body)
+        if _obs.enabled:
+            _obs.counter("sync.bytes_full").inc(len(body))
         self.sync_target["total"] = int(h["total"])
         self.sync_target["file_checksum"] = wire.u128(h, "file_checksum")
         self.sync_target["commit_max"] = int(h["commit_max"])
         if len(self.sync_buffer) < self.sync_target["total"]:
             self._last_sync_req = self._ticks
+            self._sync_progress = self._ticks
             return self._request_sync_chunk()
         return self._install_sync_checkpoint()
 
@@ -2432,6 +2539,537 @@ class VsrReplica(Replica):
         return (
             self._sync_peer if self._sync_peer is not None
             else self.primary_index()
+        )
+
+    # -- Merkle-anchored incremental catch-up (docs/state_sync.md) ------------
+    #
+    # Requester flow: request_sync_roots -> (verify top frontiers) ->
+    # batched binary descent over DIVERGING interior nodes only
+    # (request_sync_subtree kind=descend; each children pair verified
+    # against its already-verified parent) -> diverging LEAF rows fetched
+    # in budget-sized batches (kind=rows; each row re-hashed against its
+    # verified leaf) -> append-only history tail (kind=history) -> the
+    # reconstructed state must hash to the responder's advertised
+    # whole-state checksum before installing through the SAME tail the
+    # full path uses (_install_sync_state).  Any verification failure
+    # rotates the responder and re-requests; any structural mismatch
+    # (capacity/schema/cold/divergence threshold) degrades to the proven
+    # full-checkpoint transfer — a mixed-version cluster never wedges.
+
+    def _sync_rotate_peer(self) -> None:
+        self._sync_peer = self._next_peer(
+            self._sync_peer if self._sync_peer is not None
+            else self.primary_index()
+        )
+
+    def _sync_obs(self, name: str, n: int = 1) -> None:
+        if _obs.enabled:
+            _obs.counter(name).inc(n)
+
+    def _sync_pack_for(self, op: int):
+        """Responder-side per-checkpoint pack (canonical arrays + trees +
+        install gates), built once and cached until the checkpoint moves."""
+        from . import statesync
+
+        cached = self._sync_pack_cache
+        if cached is not None and cached.op == op:
+            return cached
+        try:
+            arrays, meta = self.forest.canonical_arrays(op)
+        except (OSError, RuntimeError, AssertionError, ValueError, KeyError):
+            return None
+        pack = statesync.SyncPack(op, arrays, meta)
+        self._sync_pack_cache = pack
+        return pack
+
+    def _request_sync_roots(self) -> List[Msg]:
+        req = self._hdr(
+            wire.Command.request_sync_roots,
+            checkpoint_op=self.sync_target["checkpoint_op"],
+        )
+        return [(("replica", self._sync_responder()), wire.encode(req))]
+
+    def on_request_sync_roots(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if self.op_checkpoint == 0 or not getattr(
+            self.machine, "merkle_enabled", False
+        ):
+            # Merkle-off responders stay silent: the requester counts the
+            # unanswered rounds and degrades to the full path, exactly as
+            # it does for a pre-sync-roots peer (version skew).
+            return []
+        want = int(h["checkpoint_op"])
+        if want and want != self.op_checkpoint:
+            return []
+        pack = self._sync_pack_for(self.op_checkpoint)
+        if pack is None:
+            return []
+        if len(pack.roots_body) > self.config.message_body_size_max:
+            # Pathological summary (e.g. an enormous session table): stay
+            # silent rather than ship an oversized frame; the requester
+            # falls back to the chunked full transfer.
+            return []
+        resp = self._hdr(
+            wire.Command.sync_roots,
+            checkpoint_op=pack.op,
+            commit_max=self.commit_min,
+            ledger_digest=pack.digest,
+            state_checksum=pack.state_checksum,
+        )
+        return [(("replica", int(h["replica"])),
+                 wire.encode(resp, pack.roots_body))]
+
+    def on_sync_roots(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        from . import checkpoint as ckpt_mod
+        from . import statesync
+
+        target = self.sync_target
+        if target is None or target.get("mode") != "roots":
+            return []
+        checkpoint_op = int(h["checkpoint_op"])
+        if target["checkpoint_op"] == 0:
+            target["checkpoint_op"] = checkpoint_op
+        if checkpoint_op != target["checkpoint_op"]:
+            return []
+        info = statesync.unpack_roots(body)
+        if info is None:
+            # Malformed or forged summary (top frontier not folding to the
+            # stated roots): reject-and-refetch from a rotated peer.
+            return self._sync_verify_failed("roots")
+        self.sync_stats["bytes_incremental"] += len(body)
+        self._sync_obs("sync.bytes_incremental", len(body))
+        self._sync_progress = self._ticks
+        # Structural gates: anything the descent cannot reconcile routes
+        # to the byte-exact full transfer (docs/state_sync.md fallback
+        # matrix) instead of wedging or installing garbage.
+        if info["meta"].get("machine", {}).get("cold_manifest"):
+            return self._sync_fallback("cold_manifest")
+        arrays = ckpt_mod.ledger_to_arrays(self.machine.checkpoint_ledger())
+        if statesync.schema(arrays) != info["schema"]:
+            return self._sync_fallback("schema")
+        for pad in statesync.PADS:
+            if statesync.pad_capacity(arrays, pad) != (
+                info["pads"][pad]["capacity"]
+            ):
+                return self._sync_fallback("capacity")
+        hist_keys = statesync.history_keys(arrays)
+        local_hist = int(arrays["history/count"])
+        if local_hist > info["history_count"]:
+            return self._sync_fallback("history_regression")
+        # Compare our trees' top frontiers against the verified summary:
+        # clean subtrees are skipped wholesale, diverging positions seed
+        # the descent queues (leaf positions go straight to row fetch).
+        trees = statesync.build_trees(arrays)
+        want: Dict[str, Dict[int, int]] = {}
+        diff: Dict[str, list] = {}
+        rows_needed: Dict[str, list] = {}
+        diverging = 0
+        for pad in statesync.PADS:
+            cap = info["pads"][pad]["capacity"]
+            depth = statesync.top_depth(cap)
+            theirs = info["pads"][pad]["top"]
+            mine = statesync.frontier(trees[pad], depth)
+            base = 1 << depth
+            want[pad] = {}
+            diff[pad] = []
+            rows_needed[pad] = []
+            for i in range(len(theirs)):
+                tv = int(theirs[i])
+                if tv == int(mine[i]):
+                    continue
+                diverging += 1
+                pos = base + i
+                want[pad][pos] = tv
+                if base == cap:  # the top frontier IS the leaf level
+                    rows_needed[pad].append(pos - cap)
+                else:
+                    diff[pad].append(pos)
+        # What a full transfer of this state would ship (the responder
+        # materializes DENSE arrays): the descent aborts to the full path
+        # the moment its own projected bill exceeds the divergence
+        # threshold's share of this — cold starts and long absences
+        # degrade after a few cheap interior rounds instead of shipping
+        # the whole ledger twice, row by row.
+        full_est = sum(
+            info["pads"][pad]["capacity"]
+            * statesync.row_bytes(arrays, pad)
+            for pad in statesync.PADS
+        ) + info["history_count"] * statesync.history_row_bytes(arrays)
+        self._sync_local = {
+            "arrays": arrays,
+            "trees": trees,
+            "info": info,
+            "want": want,
+            "diff": diff,
+            "rows_needed": rows_needed,
+            "row_patches": {pad: [] for pad in statesync.PADS},
+            "history": {
+                "start": local_hist,
+                "next": local_hist,
+                "total": info["history_count"],
+                "chunks": [],
+            },
+            "hist_keys": hist_keys,
+            "outstanding": None,
+            "bytes": len(body),
+            "full_est": full_est,
+        }
+        target["mode"] = "descend"
+        target["commit_max"] = int(h["commit_max"])
+        target["ledger_digest"] = int(h["ledger_digest"])
+        target["state_checksum"] = wire.u128(h, "state_checksum")
+        self._debug(
+            "sync_roots", checkpoint_op=checkpoint_op,
+            diverging_top=diverging, full_est=full_est,
+        )
+        return self._sync_request_next()
+
+    def _sync_batch_limits(self) -> Tuple[int, int]:
+        """(descend nodes per request, history rows per request) under the
+        message body budget (requests carry 8 B/node, replies 16 B/node)."""
+        budget = self.config.message_body_size_max
+        return max(1, budget // 16), budget
+
+    def _sync_request_next(self) -> List[Msg]:
+        """Issue the next batched request of the descent, or finalize.
+        Work items are consumed only when their VERIFIED reply arrives, so
+        a rotation retransmits the same batch to the next peer."""
+        from . import statesync
+        from .checksum import checksum as _checksum
+
+        sl = self._sync_local
+        if sl is None:
+            return self._sync_fallback("lost_state")
+        target = self.sync_target
+        ckpt = target["checkpoint_op"]
+        nodes_max, budget = self._sync_batch_limits()
+        # Projected bill so far: session bytes + the rows already known
+        # diverging + a floor for the interior still to resolve.  Crossing
+        # the threshold's share of the full-transfer estimate means the
+        # descent cannot win — degrade before shipping the ledger twice.
+        projected = sl["bytes"] + sum(
+            len(sl["rows_needed"][pad])
+            * statesync.row_bytes(sl["arrays"], pad)
+            for pad in statesync.PADS
+        ) + 32 * sum(len(sl["diff"][pad]) for pad in statesync.PADS)
+        if projected > self.sync_divergence_max * sl["full_est"]:
+            return self._sync_fallback("divergence")
+        for pad_i, pad in enumerate(statesync.PADS):
+            if sl["diff"][pad]:
+                nodes = np.asarray(
+                    sl["diff"][pad][:nodes_max], dtype="<u8"
+                )
+                payload = nodes.tobytes()
+                sl["outstanding"] = {
+                    "pad": pad_i, "kind": wire.SYNC_DESCEND,
+                    "list": nodes, "count": len(nodes), "start": 0,
+                    "list_checksum": _checksum(payload) & ((1 << 64) - 1),
+                }
+                req = self._hdr(
+                    wire.Command.request_sync_subtree,
+                    checkpoint_op=ckpt, count=len(nodes), pad=pad_i,
+                    kind=wire.SYNC_DESCEND,
+                )
+                return [(("replica", self._sync_responder()),
+                         wire.encode(req, payload))]
+        for pad_i, pad in enumerate(statesync.PADS):
+            if sl["rows_needed"][pad]:
+                per_row = statesync.row_bytes(sl["arrays"], pad)
+                rows_max = max(1, budget // max(1, per_row))
+                slots = np.asarray(
+                    sorted(sl["rows_needed"][pad][:rows_max]), dtype="<u8"
+                )
+                payload = slots.tobytes()
+                sl["outstanding"] = {
+                    "pad": pad_i, "kind": wire.SYNC_ROWS,
+                    "list": slots, "count": len(slots), "start": 0,
+                    "list_checksum": _checksum(payload) & ((1 << 64) - 1),
+                }
+                req = self._hdr(
+                    wire.Command.request_sync_subtree,
+                    checkpoint_op=ckpt, count=len(slots), pad=pad_i,
+                    kind=wire.SYNC_ROWS,
+                )
+                return [(("replica", self._sync_responder()),
+                         wire.encode(req, payload))]
+        hist = sl["history"]
+        if hist["next"] < hist["total"]:
+            per_row = statesync.history_row_bytes(sl["arrays"])
+            count = max(1, budget // per_row)
+            sl["outstanding"] = {
+                "pad": statesync.HISTORY_PAD, "kind": wire.SYNC_HISTORY,
+                "list": None, "count": count, "start": hist["next"],
+                "list_checksum": 0,
+            }
+            req = self._hdr(
+                wire.Command.request_sync_subtree,
+                checkpoint_op=ckpt, count=count, pad=statesync.HISTORY_PAD,
+                kind=wire.SYNC_HISTORY, start=hist["next"],
+            )
+            return [(("replica", self._sync_responder()),
+                     wire.encode(req))]
+        return self._sync_finalize()
+
+    def on_request_sync_subtree(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        from . import statesync
+        from .checksum import checksum as _checksum
+
+        if self.op_checkpoint == 0 or not getattr(
+            self.machine, "merkle_enabled", False
+        ):
+            return []
+        if int(h["checkpoint_op"]) != self.op_checkpoint:
+            return []
+        pack = self._sync_pack_for(self.op_checkpoint)
+        if pack is None:
+            return []
+        kind = int(h["kind"])
+        pad_i = int(h["pad"])
+        budget = self.config.message_body_size_max
+        requester = ("replica", int(h["replica"]))
+        if kind == wire.SYNC_HISTORY:
+            start = int(h["start"])
+            total = int(pack.arrays["history/count"])
+            per_row = statesync.history_row_bytes(pack.arrays)
+            count = min(
+                max(1, int(h["count"])), max(1, budget // per_row),
+                max(0, total - start),
+            )
+            payload = statesync.pack_history(pack.arrays, start, count)
+            resp = self._hdr(
+                wire.Command.sync_subtree,
+                checkpoint_op=pack.op, start=start, total=total,
+                count=count, pad=statesync.HISTORY_PAD,
+                kind=wire.SYNC_HISTORY, list_checksum=0,
+            )
+            return [(requester, wire.encode(resp, payload))]
+        if pad_i >= len(statesync.PADS) or kind not in (
+            wire.SYNC_DESCEND, wire.SYNC_ROWS
+        ):
+            return []
+        pad = statesync.PADS[pad_i]
+        cap = statesync.pad_capacity(pack.arrays, pad)
+        if len(body) % 8 != 0:
+            return []  # malformed node/slot list
+        items = np.frombuffer(body, dtype="<u8")
+        if len(items) != int(h["count"]) or len(items) == 0:
+            return []
+        list_checksum = _checksum(body) & ((1 << 64) - 1)
+        if kind == wire.SYNC_DESCEND:
+            if len(items) > budget // 16 or int(items.max()) >= cap or (
+                int(items.min()) < 1
+            ):
+                return []
+            payload = statesync.children(pack.trees[pad], items).tobytes()
+        else:
+            if int(items.max()) >= cap:
+                return []
+            payload = statesync.pack_rows(pack.arrays, pad, items)
+            if len(payload) > budget:
+                return []  # malformed over-budget request
+        resp = self._hdr(
+            wire.Command.sync_subtree,
+            checkpoint_op=pack.op, count=len(items), pad=pad_i, kind=kind,
+            list_checksum=list_checksum,
+        )
+        return [(requester, wire.encode(resp, payload))]
+
+    def on_sync_subtree(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        from . import statesync
+
+        target = self.sync_target
+        sl = self._sync_local
+        if target is None or target.get("mode") != "descend" or sl is None:
+            return []
+        if int(h["checkpoint_op"]) != target["checkpoint_op"]:
+            return []
+        out = sl["outstanding"]
+        if out is None:
+            return []
+        if int(h["pad"]) != out["pad"] or int(h["kind"]) != out["kind"]:
+            return []  # stale reply for an earlier request
+        if int(h["list_checksum"]) != out["list_checksum"]:
+            return []  # a delayed duplicate answering a DIFFERENT list
+        if out["kind"] != wire.SYNC_HISTORY and (
+            int(h["count"]) != out["count"]
+        ):
+            return []  # history replies may clamp count; others may not
+        kind = out["kind"]
+        self.sync_stats["bytes_incremental"] += len(body)
+        sl["bytes"] += len(body)
+        self._sync_obs("sync.bytes_incremental", len(body))
+        self._sync_progress = self._ticks
+        self._last_sync_req = self._ticks
+        target["descend_attempts"] = 0  # progress re-arms the budget
+        if kind == wire.SYNC_DESCEND:
+            pad = statesync.PADS[out["pad"]]
+            nodes = out["list"]
+            if len(body) != 16 * len(nodes):
+                # Malformed/truncated children list (incl. non-multiple-
+                # of-8 bodies np.frombuffer would raise on): a lying
+                # chunk, not a crash.
+                return self._sync_verify_failed("children_shape")
+            values = np.frombuffer(body, dtype="<u8")
+            if self.sync_verify and not statesync.verify_children(
+                values, nodes, sl["want"][pad]
+            ):
+                return self._sync_verify_failed("children")
+            tree = sl["trees"][pad]
+            cap = sl["info"]["pads"][pad]["capacity"]
+            # Consume the batch, enqueue only DIVERGING children.
+            del sl["diff"][pad][: len(nodes)]
+            for i, node in enumerate(nodes):
+                for side in (0, 1):
+                    child = 2 * int(node) + side
+                    theirs = int(values[2 * i + side])
+                    if theirs == int(tree[child]):
+                        continue
+                    sl["want"][pad][child] = theirs
+                    if child >= cap:
+                        sl["rows_needed"][pad].append(child - cap)
+                    else:
+                        sl["diff"][pad].append(child)
+            sl["outstanding"] = None
+            return self._sync_request_next()
+        if kind == wire.SYNC_ROWS:
+            pad = statesync.PADS[out["pad"]]
+            slots = out["list"]
+            cap = sl["info"]["pads"][pad]["capacity"]
+            rows = statesync.unpack_rows(sl["arrays"], pad, slots, body)
+            if rows is None:
+                return self._sync_verify_failed("rows_shape")
+            if self.sync_verify and not statesync.verify_rows(
+                rows, pad, slots, sl["want"][pad], cap
+            ):
+                return self._sync_verify_failed("rows")
+            served = set(int(s) for s in slots)
+            sl["rows_needed"][pad] = [
+                s for s in sl["rows_needed"][pad] if s not in served
+            ]
+            sl["row_patches"][pad].append((slots, rows))
+            self.sync_stats["subtrees_shipped"] += 1
+            self.sync_stats["rows_installed"] += len(slots)
+            self._sync_obs("sync.subtrees_shipped")
+            self._sync_obs("sync.rows_installed", len(slots))
+            sl["outstanding"] = None
+            return self._sync_request_next()
+        # SYNC_HISTORY
+        hist = sl["history"]
+        start = int(h["start"])
+        count = int(h["count"])
+        if start != hist["next"]:
+            return []
+        if int(h["total"]) != hist["total"]:
+            # The responder's history length contradicts the verified
+            # summary: treat as a lying/stale chunk.
+            return self._sync_verify_failed("history_total")
+        if count <= 0 or start + count > hist["total"]:
+            # A forged count past the verified total would blow the
+            # bounded install slice at finalize — reject it here.
+            return self._sync_verify_failed("history_shape")
+        chunk = statesync.unpack_history(sl["arrays"], count, body)
+        if chunk is None:
+            return self._sync_verify_failed("history_shape")
+        hist["chunks"].append((start, count, chunk))
+        hist["next"] = start + count
+        sl["outstanding"] = None
+        return self._sync_request_next()
+
+    def _sync_verify_failed(self, what: str) -> List[Msg]:
+        """A lying or bit-flipped chunk: never installed — reject, count,
+        rotate to the next peer, and retransmit the SAME batch (work is
+        consumed only on verified replies).  Persistent failure degrades
+        to the full transfer."""
+        target = self.sync_target
+        self.sync_stats["chunk_retries"] += 1
+        self._sync_obs("sync.chunk_retries")
+        self._debug("sync_chunk_rejected", what=what)
+        target["verify_failures"] = target.get("verify_failures", 0) + 1
+        if target["verify_failures"] > SYNC_VERIFY_FAILURES:
+            return self._sync_fallback("verify_failures")
+        self._sync_rotate_peer()
+        if target.get("mode") == "descend" and self._sync_local is not None:
+            self._sync_local["outstanding"] = None
+            return self._sync_request_next()
+        return self._request_sync_roots()
+
+    def _sync_fallback(self, reason: str) -> List[Msg]:
+        """Degrade to the byte-exact full-checkpoint transfer (the choice
+        is logged and counted; docs/state_sync.md fallback matrix)."""
+        self.sync_stats["fallbacks"] += 1
+        self._sync_obs("sync.fallbacks")
+        self._sync_obs(f"sync.fallback.{reason}")
+        self._debug("sync_fallback", reason=reason)
+        op = self.sync_target["checkpoint_op"] if self.sync_target else 0
+        self._sync_local = None
+        self.sync_target = {
+            "checkpoint_op": op, "total": None, "mode": "full",
+        }
+        self.sync_buffer = bytearray()
+        self._last_sync_req = self._ticks
+        self._sync_progress = self._ticks
+        return self._request_sync_chunk()
+
+    def _sync_finalize(self) -> List[Msg]:
+        """Descent drained: reconstruct the responder's checkpoint state
+        from our own state + the verified patches, gate on the whole-state
+        checksum, serialize our own checkpoint blob, and install through
+        the same tail as the full path."""
+        from . import checkpoint as ckpt_mod
+        from . import statesync
+
+        sl = self._sync_local
+        target = self.sync_target
+        op = target["checkpoint_op"]
+        info = sl["info"]
+        arrays = {
+            k: np.array(v, copy=True) for k, v in sl["arrays"].items()
+        }
+        for pad in statesync.PADS:
+            for slots, rows in sl["row_patches"][pad]:
+                idx = slots.astype(np.int64)
+                for key, vals in rows.items():
+                    arrays[key][idx] = vals
+            arrays[f"{pad}/count"] = np.array(info["pads"][pad]["count"])
+            arrays[f"{pad}/probe_overflow"] = np.array(
+                info["pads"][pad]["probe_overflow"]
+            )
+        # History: the responder's capacity + our verified prefix + the
+        # fetched append-only tail.
+        hist = sl["history"]
+        hcap = info["history_capacity"]
+        for key in sl["hist_keys"]:
+            old = sl["arrays"][key]
+            grown = np.zeros((hcap,) + old.shape[1:], dtype=old.dtype)
+            keep = min(hist["start"], hcap, old.shape[0])
+            grown[:keep] = old[:keep]
+            arrays[key] = grown
+        for start, count, chunk in hist["chunks"]:
+            for key, vals in chunk.items():
+                arrays[key][start:start + count] = vals
+        arrays["history/count"] = np.array(
+            np.uint64(hist["total"])
+        )
+        if self.sync_verify:
+            got = statesync.arrays_checksum(arrays)
+            if got != target.get("state_checksum"):
+                # The tree's covered columns could not explain the whole
+                # divergence (or a bug/liar slipped through): NEVER
+                # install — fetch the byte-exact blob instead.
+                return self._sync_fallback("state_checksum")
+        ledger = ckpt_mod.arrays_to_ledger(arrays)
+        meta = info["meta"]
+        _path, file_checksum = ckpt_mod.save_arrays(
+            self.data_path, op, ckpt_mod.sparsify_arrays(arrays), meta
+        )
+        self.sync_stats["mode"] = "incremental"
+        self._sync_obs("sync.mode.incremental")
+        self._debug(
+            "sync_incremental_install", checkpoint_op=op,
+            bytes=self.sync_stats["bytes_incremental"],
+            rows=self.sync_stats["rows_installed"],
+        )
+        return self._install_sync_state(
+            ledger, meta, op, file_checksum, target.get("commit_max", op)
         )
 
     def _request_cold_chunk(self) -> List[Msg]:
@@ -2460,6 +3098,7 @@ class VsrReplica(Replica):
         # Progress resets the sync resend timer, or the tick would wipe an
         # in-flight multi-chunk transfer every SYNC_RESEND ticks.
         self._last_sync_req = self._ticks
+        self._sync_progress = self._ticks
         if len(cf["buf"]) < int(h["total"]):
             return self._request_cold_chunk()
         if not self.machine.cold.install_file(
@@ -2517,6 +3156,23 @@ class VsrReplica(Replica):
                 self._last_sync_req = self._ticks
                 return self._request_cold_chunk()
         self._cold_fetch = None
+        self.sync_stats["mode"] = "full"
+        self._sync_obs("sync.mode.full")
+        return self._install_sync_state(
+            ledger, meta, op, target["file_checksum"],
+            target.get("commit_max", op),
+        )
+
+    def _install_sync_state(
+        self, ledger, meta: dict, op: int, file_checksum: int,
+        commit_max: int,
+    ) -> List[Msg]:
+        """The shared install tail of BOTH sync transports (full blob and
+        incremental reconstruction): swap machine state, adopt sessions,
+        reset the log around the snapshot, seal the superblock, rejoin.
+        May raise loudly (DeviceStateUnrecoverable) when the snapshot is
+        unservable in this machine mode — e.g. a cold-tier manifest at a
+        sharded rejoiner — rather than wedging silently."""
         # A background checkpoint still in flight refers to the pre-sync
         # ledger; land it BEFORE the snapshot replaces machine/forest state
         # (its anchor then loses the _superblock_install merge below).
@@ -2535,7 +3191,7 @@ class VsrReplica(Replica):
         }
         self.op_checkpoint = op
         self.commit_min = op
-        self.commit_max = max(self.commit_max, target.get("commit_max", op))
+        self.commit_max = max(self.commit_max, commit_max)
         self.op = op
         self.headers = {}
         self.stash.clear()
@@ -2547,7 +3203,7 @@ class VsrReplica(Replica):
         # old adoption watermark referred to a WAL the sync replaced.
         self._log_adopted_op = op
         manifest_checksum = self.forest.adopt_base(
-            ledger, meta, op, target["file_checksum"]
+            ledger, meta, op, file_checksum
         )
         state = SuperBlockState(
             cluster=self.cluster,
@@ -2560,7 +3216,7 @@ class VsrReplica(Replica):
             commit_max=self.commit_max,
             log_adopted_op=self._log_adopted_op,
             op_checkpoint=op,
-            checkpoint_file_checksum=target["file_checksum"],
+            checkpoint_file_checksum=file_checksum,
             ledger_digest=self.machine.digest(),
             prepare_timestamp=self.machine.prepare_timestamp,
             commit_timestamp=self.machine.commit_timestamp,
@@ -2571,6 +3227,7 @@ class VsrReplica(Replica):
         self.forest.gc()
         self.sync_target = None
         self.sync_buffer = bytearray()
+        self._sync_local = None
         self._sync_peer = None
         # Any view finish deferred before the sync refers to pre-snapshot
         # state; resuming it would regress the view.  Rejoin fresh.
@@ -2697,6 +3354,7 @@ class VsrReplica(Replica):
             self.status = SYNCING
             if self._ticks - self._last_sync_req >= SYNC_RESEND:
                 self._last_sync_req = self._ticks
+                mode = self.sync_target.get("mode", "full")
                 if self._cold_fetch is not None:
                     cf = self._cold_fetch
                     cf["attempts"] += 1
@@ -2705,7 +3363,10 @@ class VsrReplica(Replica):
                         # (GC'd past this checkpoint): restart the sync at
                         # whatever is latest instead of waiting forever.
                         self._cold_fetch = None
-                        self.sync_target = {"checkpoint_op": 0, "total": None}
+                        self.sync_target = {
+                            "checkpoint_op": 0, "total": None,
+                            "mode": "full",
+                        }
                         self.sync_buffer = bytearray()
                         if self._sync_peer is not None:
                             self._sync_peer = self._next_peer(self._sync_peer)
@@ -2715,10 +3376,16 @@ class VsrReplica(Replica):
                             self._sync_peer = self._next_peer(self._sync_peer)
                         cf["buf"] = bytearray()
                         out.extend(self._request_cold_chunk())
-                else:
+                    return out
+                # Sync-PROGRESS stall (no payload accepted for a full
+                # resend interval — distinct from the resend clock, which
+                # checkpoint-refreshes legitimately restart): the current
+                # responder is dead or pruned past our target — rotate.
+                if self._ticks - self._sync_progress >= SYNC_RESEND:
                     if self._sync_peer is not None:
-                        # Explicit-peer sync (block-repair fallback): a
-                        # silent responder means we guessed wrong — rotate.
+                        # Explicit-peer sync (block-repair fallback, or an
+                        # earlier rotation): a silent responder means we
+                        # guessed wrong — rotate.
                         self._sync_peer = self._next_peer(self._sync_peer)
                     else:
                         # Targeted sync whose default responder (the
@@ -2738,6 +3405,38 @@ class VsrReplica(Replica):
                         self._sync_peer = self._next_peer(
                             self.primary_index()
                         )
+                    # Stalled long enough that the rotation clock must
+                    # restart with the new responder.
+                    self._sync_progress = self._ticks
+                if mode == "roots":
+                    t = self.sync_target
+                    t["roots_attempts"] = t.get("roots_attempts", 0) + 1
+                    if t["roots_attempts"] > SYNC_ROOTS_ATTEMPTS * max(
+                        1, self.replica_count - 1
+                    ):
+                        # Nobody speaks sync_roots (merkle-off peers,
+                        # version skew): the proven full transfer.
+                        out.extend(self._sync_fallback("unsupported"))
+                    else:
+                        out.extend(self._request_sync_roots())
+                elif mode == "descend":
+                    t = self.sync_target
+                    t["descend_attempts"] = t.get("descend_attempts", 0) + 1
+                    if t["descend_attempts"] > SYNC_ROOTS_ATTEMPTS * max(
+                        1, self.replica_count - 1
+                    ):
+                        # The roots responder vanished mid-descent and no
+                        # peer serves subtrees (e.g. the only other
+                        # merkle-on replica died): the full transfer is
+                        # still served by everyone — take it instead of
+                        # rotating forever.
+                        out.extend(self._sync_fallback("unresponsive"))
+                    elif self._sync_local is None:
+                        out.extend(self._sync_fallback("lost_state"))
+                    else:
+                        self._sync_local["outstanding"] = None
+                        out.extend(self._sync_request_next())
+                else:
                     out.extend(self._request_sync_chunk())
             return out
 
@@ -2995,6 +3694,7 @@ class VsrReplica(Replica):
         "_primary_gap_ewma", "_probe_sent_at", "_pong_standdowns",
         "_floor_stall", "_abdicate_commit_mark", "_abdicate_ticks",
         "_vc_started", "_vc_escalations", "_last_sync_req",
+        "_sync_progress",
         "_heartbeat_jitter", "_recovering_since", "_last_tick_mono",
     )
     _MC_CONTAINERS = (
@@ -3007,6 +3707,12 @@ class VsrReplica(Replica):
     )
     # Lazily-created attributes (e.g. _repair_rotation) must restore to
     # ABSENT, not None — their getattr defaults are load-bearing.
+    # Deliberately NOT in the capsule: _sync_local/_sync_pack_cache (bulk
+    # numpy descent state, reconstructible — a restored-elsewhere replica
+    # mid-descent degrades to the full transfer via the lost_state
+    # fallback) and sync_stats (pure accounting, read by no protocol
+    # decision).  Same-instance round trips (snapshot_interpose) keep
+    # them as live attributes either way.
     _MC_MISSING = "__mc_missing__"
 
     def snapshot(self) -> dict:

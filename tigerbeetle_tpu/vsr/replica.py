@@ -1218,6 +1218,11 @@ class Replica:
             timestamp=timestamp,
             request=int(header["request"]),
             operation=int(operation),
+            # Continuous client-side auditing (docs/commitments.md): the
+            # canonical accounts commitment root rides every reply —
+            # carved from reserved padding, 0 when commitments are off,
+            # so merkle-off serving stays bit-identical to pre-root wire.
+            root=self.machine.commitment_root(),
         )
         reply_h["replica"] = self.replica
         reply = wire.encode(reply_h, result_body)
@@ -1680,6 +1685,11 @@ class Replica:
         )
         self._sb_state = state
         self.op_checkpoint = state.op_checkpoint
+        # The state-sync responder pack (canonical arrays + trees for the
+        # PREVIOUS checkpoint, vsr/consensus.py) is dead weight the moment
+        # the checkpoint moves: release it rather than holding a full
+        # state copy until the next sync request happens to replace it.
+        self._sync_pack_cache = None
         if _obs.enabled:
             _obs.counter("replica.checkpoints").inc()
             _obs.gauge("replica.op_checkpoint").set(self.op_checkpoint)

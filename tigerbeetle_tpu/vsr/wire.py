@@ -70,6 +70,18 @@ class Command(enum.IntEnum):
     # client burn its whole timeout.  Retryable by contract: the request
     # was never journaled, so a resend is not a duplicate.
     busy = 24
+    # Merkle-anchored incremental state sync (docs/state_sync.md): a
+    # catching-up replica fetches the responder's per-pad commitment roots
+    # + the top frontier of each tree (sync_roots), batch-descends only
+    # DIVERGING interior nodes and fetches only diverging leaf rows
+    # (sync_subtree), so a small-divergence rejoin ships O(diff.log cap)
+    # bytes instead of the full checkpoint blob.  Peers that do not speak
+    # these commands (version skew, merkle off) simply never answer and
+    # the requester degrades to the request_sync_checkpoint path above.
+    request_sync_roots = 25
+    sync_roots = 26
+    request_sync_subtree = 27
+    sync_subtree = 28
 
 
 VSR_OPERATIONS_RESERVED = 128
@@ -175,7 +187,14 @@ REPLY_DTYPE = _dtype([
     ("timestamp", "<u8"),
     ("request", "<u4"),
     ("operation", "u1"),
-    ("reserved", "V19"),
+    # Canonical accounts-pad commitment root at (or, under grouped/
+    # pipelined commit, just after) this reply's commit point — carved
+    # from the previously-reserved (always-zero) tail, so legacy frames
+    # decode as 0 and 0 still means "no commitment armed" (merkle off).
+    # Clients track it for continuous ledger auditing and cross-check
+    # get_proof anchors against it (docs/commitments.md, client.py).
+    ("root", "<u8"),
+    ("reserved", "V11"),
 ])
 
 COMMIT_DTYPE = _dtype([
@@ -363,6 +382,59 @@ SYNC_CHECKPOINT_DTYPE = _dtype([
     ("reserved", "V80"),
 ])
 
+# Merkle-anchored incremental state sync (docs/state_sync.md).  The
+# requester first fetches the responder's checkpoint commitment summary
+# (request_sync_roots -> sync_roots: per-pad roots, capacities, scalars,
+# the top frontier of each tree, schema, meta — body is the statesync
+# pack codec), then batch-descends diverging nodes and fetches diverging
+# leaf rows (request_sync_subtree -> sync_subtree).
+REQUEST_SYNC_ROOTS_DTYPE = _dtype([
+    ("checkpoint_op", "<u8"),    # 0 = whatever is latest
+    ("reserved", "V120"),
+])
+
+SYNC_ROOTS_DTYPE = _dtype([
+    ("checkpoint_op", "<u8"),
+    ("commit_max", "<u8"),
+    # Order-independent accounts digest of the checkpoint state (the
+    # convergence-oracle fold) — a cheap cross-check alongside the roots.
+    ("ledger_digest", "<u8"),
+    # AEGIS checksum (truncated to u64 lanes below) over EVERY canonical
+    # array byte of the checkpoint state: the requester's reconstructed
+    # state must hash to exactly this before it may install — the
+    # byte-identity guarantee that subsumes per-column coverage gaps.
+    ("state_checksum_lo", "<u8"), ("state_checksum_hi", "<u8"),
+    ("reserved", "V88"),
+])
+
+# Subtree request kinds (who picks what the body means).
+SYNC_DESCEND = 0   # body: u64 node list -> reply u64[2n] children pairs
+SYNC_ROWS = 1      # body: u64 leaf-slot list -> reply packed row bytes
+SYNC_HISTORY = 2   # header start/count -> reply packed history row range
+
+REQUEST_SYNC_SUBTREE_DTYPE = _dtype([
+    ("checkpoint_op", "<u8"),
+    ("start", "<u8"),            # SYNC_HISTORY: first row requested
+    ("count", "<u4"),            # nodes/slots in body, or history rows
+    ("pad", "u1"),               # 0 accounts / 1 transfers / 2 posted
+    ("kind", "u1"),              # SYNC_*
+    ("reserved", "V106"),
+])
+
+SYNC_SUBTREE_DTYPE = _dtype([
+    ("checkpoint_op", "<u8"),
+    ("start", "<u8"),
+    ("total", "<u8"),            # SYNC_HISTORY: responder's row count
+    # Checksum (low u64) of the REQUEST body this answers: binds a reply
+    # to its exact node/slot list so a delayed duplicate of an earlier
+    # same-shaped request cannot mis-install.
+    ("list_checksum", "<u8"),
+    ("count", "<u4"),
+    ("pad", "u1"),
+    ("kind", "u1"),
+    ("reserved", "V90"),
+])
+
 COMMAND_DTYPES = {
     Command.request: REQUEST_DTYPE,
     Command.prepare: PREPARE_DTYPE,
@@ -388,6 +460,10 @@ COMMAND_DTYPES = {
     Command.request_sync_checkpoint: REQUEST_SYNC_CHECKPOINT_DTYPE,
     Command.sync_checkpoint: SYNC_CHECKPOINT_DTYPE,
     Command.busy: BUSY_DTYPE,
+    Command.request_sync_roots: REQUEST_SYNC_ROOTS_DTYPE,
+    Command.sync_roots: SYNC_ROOTS_DTYPE,
+    Command.request_sync_subtree: REQUEST_SYNC_SUBTREE_DTYPE,
+    Command.sync_subtree: SYNC_SUBTREE_DTYPE,
 }
 
 
@@ -555,4 +631,6 @@ SOURCE_AUTHENTICATED_COMMANDS = frozenset({
     Command.request_prepare, Command.nack_prepare, Command.headers,
     Command.request_reply, Command.request_blocks, Command.block,
     Command.request_sync_checkpoint, Command.sync_checkpoint,
+    Command.request_sync_roots, Command.sync_roots,
+    Command.request_sync_subtree, Command.sync_subtree,
 })

@@ -23,6 +23,10 @@ ones green):
                traced+metered serving run, mini-bench with TB_TRACE +
                --metrics-json; asserts the artifacts parse and carry the
                expected span/series names
+  sync         state-sync smoke (tools/sync_smoke.py): small-divergence
+               incremental rejoin byte win + byte identity vs the full
+               transfer at TB_SHARDS {0,2}, corrupt-chunk detect+rotate,
+               sync.* metrics (SYNC_SMOKE.json)
   mc           tbmc model-checker smoke (tools/mc_smoke.py): exhaustive-
                clean at the pinned scope, all three protocol mutations
                caught, counterexample replay identity, mc.* metrics
@@ -96,6 +100,7 @@ TIERS = {
             "tests/test_cold_consensus.py", "tests/test_storage_direct.py",
             "tests/test_scrub.py", "tests/test_overload.py",
             "tests/test_byzantine.py", "tests/test_mc.py",
+            "tests/test_sync.py",
         ],
         extra=["-m", "not slow"],
     ),
@@ -183,6 +188,16 @@ TIERS = {
         # METRICS.json.  Artifact: MC_SMOKE.json at the repo root.
         cmd=["tools/mc_smoke.py"],
     ),
+    "sync": dict(
+        # Merkle-anchored incremental state sync smoke (docs/state_sync.md):
+        # a <= 1%-divergence rejoin must ship <= 10% of the full-checkpoint
+        # byte count with byte-identical final state, the same pair must
+        # hold under TB_SHARDS=2, a lying responder's corrupt subtree
+        # chunk must be detected by root verification and recovered via
+        # peer rotation, and the sync.* counters must land in
+        # METRICS.json.  Artifact: SYNC_SMOKE.json at the repo root.
+        cmd=["tools/sync_smoke.py"],
+    ),
     "byzantine": dict(
         # Byzantine fault domain smoke (docs/fault_domains.md): pinned
         # seed with one equivocating/corrupting/lying replica of six
@@ -245,6 +260,13 @@ TIERS = {
             # Byzantine fault kind: the pinned on/off proof pair (slow:
             # two full 6-replica runs under the open-loop workload).
             "tests/test_byzantine.py::TestVoprByzantine",
+            # State-sync catch-up: the pinned incremental/forced-fallback/
+            # lying-responder/verify-off quartet (slow: four full catch-up
+            # sim runs) plus the sharded cold-manifest refusal (slow:
+            # sharded machine construction).
+            "tests/test_sync.py::TestVoprCatchup",
+            "tests/test_sync.py::"
+            "test_cold_manifest_refused_loudly_at_sharded_rejoiner",
             # Merkle commitments: the shards x pipeline-depth oracle
             # matrix (slow: sharded compiles) and the pinned VOPR seed
             # whose SDC flip must be detected by root mismatch with the
@@ -282,7 +304,7 @@ TIERS = {
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
     "scrub", "merkle", "overload", "waves", "sharded", "async",
-    "sanitize", "byzantine", "mc", "integration",
+    "sanitize", "sync", "byzantine", "mc", "integration",
 ]
 
 

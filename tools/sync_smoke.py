@@ -1,0 +1,170 @@
+"""CI sync smoke: prove Merkle-anchored incremental state sync end to end.
+
+In-process (CPU-pinned), four proofs mirroring the acceptance bar in
+docs/state_sync.md, all driven through the pinned VOPR catch-up scenario
+(sim/vopr.run_catchup_seed: crash one backup mid-open-loop-flood, advance
+two checkpoints, heal):
+
+1. SMALL-DIVERGENCE BYTE WIN — at <= 1% of transfer rows changed while
+   the rejoiner was down (a widened ledger config), the incremental
+   rejoin ships <= 10% of the byte count the full-checkpoint transfer
+   ships for the same pinned seed, and BOTH rejoins land canonical
+   arrays BYTE-identical to their never-crashed peers'
+   (statesync.arrays_checksum — stronger than the digest oracle, which
+   folds accounts only).  Identity across the two transports is pinned
+   in-protocol by the install gate: incremental state must hash to the
+   responder's whole-state checksum or the full path runs instead.
+2. SHARDED IDENTITY — the same incremental-vs-forced-full pair under
+   TB_SHARDS=2: rejoiner-vs-peer byte identity at every
+   (shards x merkle) point, so the transport is shard-config
+   independent.
+3. CORRUPT-CHUNK DETECT + ROTATE — a lying responder serving corrupted
+   subtree rows under valid checksums is caught by root verification
+   (chunk_retries >= 1), rotated away from, and the rejoin still
+   completes green on the incremental path.
+4. COUNTERS — the sync.* series (mode, bytes, subtrees, retries,
+   fallbacks) land in METRICS.json.
+
+Artifact: SYNC_SMOKE.json at the repo root; the ``sync`` tier in
+tools/ci.py records pass/fail in CI_LAST.json.
+
+Usage: python tools/sync_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 42
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["TB_SHARDS"] = "0"
+    from tigerbeetle_tpu import jaxenv
+
+    jaxenv.enable_compile_cache()
+    jaxenv.force_cpu(8)
+
+    from tigerbeetle_tpu.config import LedgerConfig
+    from tigerbeetle_tpu.obs.metrics import registry
+    from tigerbeetle_tpu.sim.vopr import run_catchup_seed
+
+    summary: dict = {}
+
+    # 1. SMALL-DIVERGENCE BYTE WIN + BYTE IDENTITY -------------------------
+    # Widened tables so the flood's ~200 changed rows are <= 1% of the
+    # transfers pad — the acceptance cell.
+    wide = LedgerConfig(
+        accounts_capacity_log2=12, transfers_capacity_log2=15,
+        posted_capacity_log2=12, history_capacity_log2=14,
+        max_probe=1 << 10, bloom_bits_log2=14,
+    )
+    registry.enable()
+    try:
+        inc = run_catchup_seed(SEED, ledger_config=wide)
+        snap = registry.snapshot()
+        metrics_path = os.path.join(REPO, "METRICS.json")
+        with open(metrics_path, "w") as f:
+            json.dump(snap, f, indent=1)
+    finally:
+        registry.reset()
+        registry.disable()
+
+    def assert_peer_identity(res, what):
+        assert res.exit_code == 0, f"{what} cell failed: {res.reason}"
+        assert res.state_checksum is not None
+        assert res.state_checksum == res.peer_state_checksum, (
+            f"{what}: rejoiner's final canonical arrays differ from its "
+            "never-crashed peer's — the rejoin was not byte-identical"
+        )
+
+    assert_peer_identity(inc, "incremental")
+    assert inc.sync_mode == "incremental", inc.sync_stats
+    assert inc.sync_stats["fallbacks"] == 0, inc.sync_stats
+
+    full = run_catchup_seed(SEED, ledger_config=wide, force_full=True)
+    assert_peer_identity(full, "forced-full")
+    assert full.sync_mode == "full", full.sync_stats
+
+    # rows_installed counts diverging rows across ALL pads; the transfers
+    # pad dominates both the changed rows and the capacity, so the bound
+    # is conservative: total changed rows over the transfers capacity
+    # (derived from the config above, not a duplicated literal).
+    divergence = inc.sync_stats["rows_installed"] / wide.transfers_capacity
+    assert divergence <= 0.01, (
+        f"scenario drifted: {divergence:.2%} rows changed vs the "
+        "transfers capacity — not the small-divergence cell the "
+        "acceptance bar names"
+    )
+    ratio = inc.sync_stats["bytes_incremental"] / max(
+        1, full.sync_stats["bytes_full"]
+    )
+    assert ratio <= 0.10, (
+        f"incremental rejoin shipped {ratio:.1%} of the full transfer "
+        f"({inc.sync_stats['bytes_incremental']} vs "
+        f"{full.sync_stats['bytes_full']} bytes)"
+    )
+    summary["small_divergence"] = {
+        "rows_changed": inc.sync_stats["rows_installed"],
+        "divergence_fraction": divergence,
+        "bytes_incremental": inc.sync_stats["bytes_incremental"],
+        "bytes_full": full.sync_stats["bytes_full"],
+        "ratio": ratio,
+        "rejoiner_peer_identical": True,
+        "ops_advanced": inc.ops_advanced,
+    }
+
+    # 2. SHARDED IDENTITY (TB_SHARDS=2 x merkle on) ------------------------
+    os.environ["TB_SHARDS"] = "2"
+    try:
+        inc2 = run_catchup_seed(SEED)
+        full2 = run_catchup_seed(SEED, force_full=True)
+    finally:
+        os.environ["TB_SHARDS"] = "0"
+    assert_peer_identity(inc2, "sharded incremental")
+    assert_peer_identity(full2, "sharded forced-full")
+    assert inc2.sync_mode == "incremental", inc2.sync_stats
+    summary["sharded"] = {
+        "bytes_incremental": inc2.sync_stats["bytes_incremental"],
+        "bytes_full": full2.sync_stats["bytes_full"],
+        "rejoiner_peer_identical": True,
+    }
+
+    # 3. CORRUPT-CHUNK DETECT + ROTATE -------------------------------------
+    liar = run_catchup_seed(SEED, lying_responder=True)
+    assert liar.exit_code == 0, f"lying-responder cell failed: {liar.reason}"
+    assert liar.sync_stats["chunk_retries"] >= 1, (
+        "the corrupted subtree chunk was never rejected "
+        f"({liar.sync_stats})"
+    )
+    assert liar.sync_mode == "incremental", liar.sync_stats
+    summary["lying_responder"] = {
+        "chunk_retries": liar.sync_stats["chunk_retries"],
+        "recovered_incremental": True,
+    }
+
+    # 4. COUNTERS ----------------------------------------------------------
+    with open(metrics_path) as f:
+        series = json.load(f)["counters"]
+    for name in ("sync.bytes_incremental", "sync.subtrees_shipped",
+                 "sync.rows_installed", "sync.mode.incremental"):
+        assert series.get(name, 0) >= 1, f"{name} missing from METRICS.json"
+    summary["counters"] = {
+        k: v for k, v in series.items() if k.startswith("sync.")
+    }
+
+    out = os.path.join(REPO, "SYNC_SMOKE.json")
+    with open(out, "w") as f:
+        json.dump({"green": True, **summary}, f, indent=1)
+    print(json.dumps({"green": True, **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
