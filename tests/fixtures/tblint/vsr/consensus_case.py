@@ -1,0 +1,37 @@
+"""tblint fixture: ingress-auth violations in the vsr handler idiom."""
+
+
+class GoodReplica:
+    """Verify-first: the contract. No findings."""
+
+    def on_commit(self, h, body):
+        if not self._ingress_auth(h):
+            return []
+        return [int(h["view"])]
+
+    def on_reply_repair(self, h, body):
+        # Not a SOURCE_AUTHENTICATED command name: out of scope.
+        return [int(h["view"])]
+
+
+class MissingGate:
+    def on_prepare_ok(self, h, body):  # finding: no _ingress_auth at all
+        return [int(h["replica"])]
+
+
+class LateGate:
+    def on_headers(self, h, body):
+        view = int(h["view"])  # finding: consumed before the gate
+        if not self._ingress_auth(h):
+            return []
+        return [view]
+
+
+class SuppressedGate:
+    # A deliberate pre-gate read, justified: pure logging of the claimed
+    # origin, no state steered by it.
+    def on_ping(self, h, body):
+        self._debug(origin=int(h["replica"]))  # tblint: ignore[ingress-auth]
+        if not self._ingress_auth(h):
+            return []
+        return []

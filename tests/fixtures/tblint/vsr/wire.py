@@ -1,0 +1,16 @@
+"""tblint fixture: SOURCE_AUTHENTICATED_COMMANDS drifted from the rule.
+
+The set below names a command (``evolve``) the ingress-auth rule's
+mirrored list does not know, so the finalize cross-check must flag it.
+"""
+
+
+class Command:
+    ping = 1
+    evolve = 99
+
+
+SOURCE_AUTHENTICATED_COMMANDS = frozenset({
+    Command.ping,
+    Command.evolve,
+})

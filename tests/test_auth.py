@@ -1,0 +1,422 @@
+"""Wire authentication (docs/fault_domains.md "Byzantine primary"):
+
+- vsr/auth.py Keychain units: stamp/verify round-trip, tamper and
+  wrong-key rejection, zero-MAC sentinel, off-path wire identity.
+- VsrReplica._ingress_auth policy: strict missing-MAC rejection,
+  mixed-version accept-and-count, MAC-failure drop-and-count.
+- SimCluster end-to-end: strict cluster converges with verified frames;
+  a mixed-version (auth-off peer) cluster degrades WITHOUT wedging.
+- The PR 6 gap regression: a single unauthenticated headers frame must
+  not PROPOSE repair targets (extend the head / pin `missing`) until a
+  source-authenticated anchor certifies it.
+- tbmc Byzantine-primary scope: a small scope exhausts clean with auth
+  ON, and each seeded defense knockout (mac_skip, key_confusion,
+  cert_downgrade, equiv_dedup) yields a machine-checked counterexample
+  that replays bit-identically — and does NOT reproduce with the
+  defense restored.
+- The pinned VOPR primary-seat proof (slow): green with auth on,
+  failing the safety oracles with verification off.
+"""
+
+import dataclasses
+import os
+import tempfile
+
+import pytest
+
+from tigerbeetle_tpu.config import ClusterConfig, LedgerConfig
+from tigerbeetle_tpu.obs.metrics import registry
+from tigerbeetle_tpu.vsr import wire
+from tigerbeetle_tpu.vsr.auth import MAC_BYTES, Keychain, derive_secret
+from tigerbeetle_tpu.vsr.checksum import checksum as _checksum
+from tigerbeetle_tpu.vsr.consensus import NORMAL, VsrReplica
+
+CLUSTER = 0xAD
+CFG = ClusterConfig(message_size_max=8192, journal_slot_count=64)
+LEDGER = LedgerConfig(
+    accounts_capacity_log2=10, transfers_capacity_log2=11,
+    posted_capacity_log2=10,
+)
+
+
+def commit_frame(keychain=None, origin=0, view=0, commit=0):
+    """An encoded commit heartbeat (a SOURCE_AUTHENTICATED command),
+    optionally MAC-stamped under the claimed origin's key."""
+    h = wire.new_header(
+        wire.Command.commit, cluster=CLUSTER, view=view, commit=commit,
+    )
+    h["replica"] = origin
+    frame = wire.encode(h, b"")
+    if keychain is not None:
+        frame = keychain.stamp(frame)
+    return frame
+
+
+def reforge_checksum(frame: bytes) -> bytes:
+    """Recompute the header checksum of a (tampered) frame WITHOUT any
+    key — what an adversary who can compute AEGIS but holds no MAC key
+    can always do."""
+    h = wire.decode_unverified(frame)[0].copy()
+    c = _checksum(wire.checksum_input(h.tobytes()))
+    h["checksum_lo"] = c & 0xFFFF_FFFF_FFFF_FFFF
+    h["checksum_hi"] = c >> 64
+    return h.tobytes() + frame[wire.HEADER_SIZE:]
+
+
+# ---------------------------------------------------------------------------
+# Keychain units
+# ---------------------------------------------------------------------------
+
+
+class TestKeychain:
+    def test_stamp_roundtrip(self):
+        kc = Keychain(CLUSTER, seed=3)
+        frame = commit_frame(kc, origin=2)
+        h = wire.decode_header(frame)[0]
+        assert wire.header_mac(h) != 0
+        assert kc.verify(h)
+
+    def test_zero_mac_never_verifies(self):
+        kc = Keychain(CLUSTER, seed=3)
+        h = wire.decode_header(commit_frame(None, origin=2))[0]
+        assert wire.header_mac(h) == 0
+        assert not kc.verify(h)
+
+    def test_tampered_field_fails_even_rechecksummed(self):
+        kc = Keychain(CLUSTER, seed=3)
+        frame = commit_frame(kc, origin=2, commit=5)
+        h = wire.decode_unverified(frame)[0].copy()
+        h["commit"] = 6  # the lie
+        tampered = reforge_checksum(h.tobytes())
+        th = wire.decode_header(tampered)[0]  # checksum now passes...
+        assert not kc.verify(th)  # ...but the MAC does not
+
+    def test_wrong_claimed_origin_fails(self):
+        kc = Keychain(CLUSTER, seed=3)
+        frame = commit_frame(kc, origin=2)
+        h = wire.decode_unverified(frame)[0].copy()
+        h["replica"] = 1  # replay origin-2's MAC under an origin-1 claim
+        th = wire.decode_header(reforge_checksum(h.tobytes()))[0]
+        assert not kc.verify(th)
+
+    def test_foreign_secret_fails(self):
+        frame = commit_frame(Keychain(CLUSTER, seed=3), origin=2)
+        h = wire.decode_header(frame)[0]
+        assert not Keychain(CLUSTER, seed=4).verify(h)
+
+    def test_keys_deterministic_and_distinct(self):
+        a, b = Keychain(CLUSTER, seed=3), Keychain(CLUSTER, seed=3)
+        assert a.key(0) == b.key(0) and a.key(7) == b.key(7)
+        assert len({a.key(i) for i in range(8)}) == 8
+        assert derive_secret(CLUSTER, 1) != derive_secret(CLUSTER, 2)
+        assert a.mac(0, commit_frame()) != 0
+
+    def test_stamp_touches_only_mac_bytes(self):
+        """Off-path wire identity: stamping writes ONLY the reserved MAC
+        carve, so auth-off frames stay bit-identical to the legacy wire
+        and the header checksum needs no recompute."""
+        plain = commit_frame(None, origin=2)
+        stamped = commit_frame(Keychain(CLUSTER, seed=3), origin=2)
+        assert plain[:wire.MAC_OFFSET] == stamped[:wire.MAC_OFFSET]
+        assert plain[wire.MAC_END:] == stamped[wire.MAC_END:]
+        assert plain[wire.MAC_OFFSET:wire.MAC_END] == b"\x00" * MAC_BYTES
+        assert stamped[wire.MAC_OFFSET:wire.MAC_END] != b"\x00" * MAC_BYTES
+        # Both decode under full verification: the checksum domain
+        # excludes the MAC bytes.
+        wire.decode_header(plain)
+        wire.decode_header(stamped)
+
+
+# ---------------------------------------------------------------------------
+# VsrReplica ingress policy
+# ---------------------------------------------------------------------------
+
+
+def make_replica(tmp_path, i, n=3):
+    path = os.path.join(str(tmp_path), f"r{i}.data")
+    VsrReplica.format(
+        path, cluster=CLUSTER, replica=i, replica_count=n,
+        cluster_config=CFG,
+    )
+    r = VsrReplica(
+        path, cluster_config=CFG, ledger_config=LEDGER, batch_lanes=64,
+        seed=7 + i,
+    )
+    r.open()
+    r.status = NORMAL
+    return r
+
+
+class TestIngressPolicy:
+    def _armed(self, tmp_path, strict=True):
+        r = make_replica(tmp_path, 1)
+        r.auth = Keychain(CLUSTER, seed=9)
+        r.auth_strict = strict
+        return r
+
+    def test_strict_rejects_missing_mac_from_replica(self, tmp_path):
+        r = self._armed(tmp_path)
+        fh = wire.decode_header(commit_frame(None, origin=0))[0]
+        with registry.enabled_scope():
+            assert r.on_commit(fh, b"") == []
+            c = registry.snapshot()["counters"]
+        assert c.get("auth.rejected.missing") == 1
+        assert c.get("byzantine.rejected.auth_missing") == 1
+
+    def test_strict_rejects_bad_mac(self, tmp_path):
+        r = self._armed(tmp_path)
+        frame = commit_frame(Keychain(CLUSTER, seed=9), origin=0, commit=1)
+        h = wire.decode_unverified(frame)[0].copy()
+        h["commit"] = 2
+        fh = wire.decode_header(reforge_checksum(h.tobytes()))[0]
+        with registry.enabled_scope():
+            assert r.on_commit(fh, b"") == []
+            c = registry.snapshot()["counters"]
+        assert c.get("auth.rejected.mac") == 1
+
+    def test_strict_verifies_stamped_frame(self, tmp_path):
+        r = self._armed(tmp_path)
+        frame = commit_frame(Keychain(CLUSTER, seed=9), origin=0)
+        fh = wire.decode_header(frame)[0]
+        with registry.enabled_scope():
+            r.on_commit(fh, b"")
+            c = registry.snapshot()["counters"]
+        assert c.get("auth.verified") == 1
+        assert "auth.rejected.missing" not in c
+
+    def test_mixed_version_accepts_and_counts(self, tmp_path):
+        """strict=False (rolling upgrade): an auth-off peer's zero-MAC
+        frame is accepted and counted, never dropped."""
+        r = self._armed(tmp_path, strict=False)
+        fh = wire.decode_header(commit_frame(None, origin=0))[0]
+        with registry.enabled_scope():
+            r.on_commit(fh, b"")
+            c = registry.snapshot()["counters"]
+        assert c.get("auth.accepted.unauthenticated") == 1
+        assert "auth.rejected.missing" not in c
+
+    def test_auth_off_is_legacy_permissive(self, tmp_path):
+        r = make_replica(tmp_path, 1)
+        assert r.auth is None
+        fh = wire.decode_header(commit_frame(None, origin=0))[0]
+        with registry.enabled_scope():
+            r.on_commit(fh, b"")
+            c = registry.snapshot()["counters"]
+        assert not any(k.startswith("auth.") for k in c)
+
+
+# ---------------------------------------------------------------------------
+# PR 6 gap regression: headers frames must not PROPOSE repair targets
+# ---------------------------------------------------------------------------
+
+
+class TestUncertifiedExtension:
+    def test_headers_extension_waits_for_anchor(self, tmp_path):
+        """A single (unauthenticated) headers response proposing a chained
+        head extension is REFUSED until a source-authenticated anchor
+        certifies the checksum — then the same frame is adopted.  Before
+        the fix the first frame pinned `missing[op]` to an arbitrary
+        checksum, a repair target no honest peer can serve."""
+        r = make_replica(tmp_path, 1)
+        ph = wire.new_header(
+            wire.Command.prepare, cluster=CLUSTER, view=0, op=r.op + 1,
+            parent=r.parent_checksum,
+        )
+        ph["replica"] = 0
+        ext = wire.decode_header(wire.encode(ph, b""))[0]
+        hh = wire.new_header(wire.Command.headers, cluster=CLUSTER, view=0)
+        hh["replica"] = 2
+        fh, _, fbody = wire.decode(wire.encode(hh, wire.pack_headers([ext])))
+
+        op0, parent0 = r.op, r.parent_checksum
+        with registry.enabled_scope():
+            r.on_headers(fh, fbody)
+            c = registry.snapshot()["counters"]
+        assert (r.op, r.parent_checksum) == (op0, parent0)
+        assert not r.missing
+        assert c.get("byzantine.rejected.uncertified_extension") == 1
+
+        # The commit-heartbeat anchor arrives: the SAME frame now extends.
+        r._anchors[op0 + 1] = wire.header_checksum(ext)
+        r.on_headers(fh, fbody)
+        assert r.op == op0 + 1
+        assert r.missing.get(op0 + 1) == wire.header_checksum(ext)
+
+
+# ---------------------------------------------------------------------------
+# SimCluster end to end
+# ---------------------------------------------------------------------------
+
+
+def run_cluster(tmp, auth, seed=11, clients=1, requests=2, max_ticks=60_000):
+    from tigerbeetle_tpu.config import TEST_MIN
+    from tigerbeetle_tpu.sim.cluster import SimCluster
+    from tigerbeetle_tpu.sim.network import PacketSimulator
+
+    cluster = SimCluster(
+        tmp, n_replicas=3, n_clients=clients, seed=seed,
+        requests_per_client=requests, config=TEST_MIN,
+        net=PacketSimulator(seed=seed + 1, delay_mean=1, delay_max=6),
+        auth=auth,
+    )
+    ok = cluster.run_until(
+        lambda: cluster.clients_done() and cluster.converged(),
+        max_ticks=max_ticks,
+    )
+    return cluster, ok
+
+
+class TestClusterAuth:
+    def test_strict_cluster_converges_verified(self, tmp_path):
+        with registry.enabled_scope():
+            _, ok = run_cluster(
+                str(tmp_path), {"strict": True, "seed": 11},
+            )
+            c = registry.snapshot()["counters"]
+        assert ok
+        assert c.get("auth.verified", 0) > 0
+        assert "auth.rejected.mac" not in c
+        assert "auth.rejected.missing" not in c
+
+    def test_mixed_version_peer_degrades_without_wedging(self, tmp_path):
+        """Rolling upgrade: one replica still speaks the zero-MAC legacy
+        wire.  In mixed-version mode (strict=False) the cluster counts
+        its frames and STILL converges — nobody wedges."""
+        with registry.enabled_scope():
+            _, ok = run_cluster(
+                str(tmp_path),
+                {"strict": False, "seed": 11, "off_replicas": (2,)},
+            )
+            c = registry.snapshot()["counters"]
+        assert ok
+        assert c.get("auth.accepted.unauthenticated", 0) > 0
+        assert c.get("auth.verified", 0) > 0
+
+    def test_strict_drops_unauthenticated_peer_frames(self, tmp_path):
+        """Under strict auth an auth-off replica's frames are refused
+        (certificates then need every seat: full-auth deployments only
+        — the documented flag-day contract, docs/fault_domains.md)."""
+        with registry.enabled_scope():
+            _, _ok = run_cluster(
+                str(tmp_path),
+                {"strict": True, "seed": 11, "off_replicas": (2,)},
+                max_ticks=2_000,
+            )
+            c = registry.snapshot()["counters"]
+        assert c.get("auth.rejected.missing", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# tbmc Byzantine-primary scope + seeded defense knockouts
+# ---------------------------------------------------------------------------
+
+# Guided hunt prefixes (docs/tbmc.md): links are per-(src,dst) FIFO, so
+# the adversary's forged frames queue BEHIND the honest prepare X and its
+# attest ok(X) on the r0->r1 link — both must be dropped before the
+# forged equivocating prepare, forged votes, and forged anchor land.
+PREFIX_FULL = (
+    ("client", 1009, 0),
+    ("deliver", "client", 1009, "replica", 0),
+    ("drop", "replica", 0, "replica", 1),    # honest prepare X
+    ("drop", "replica", 0, "replica", 1),    # primary attest ok(X)
+    ("byzp", "equiv_prepare", 1),
+    ("deliver", "replica", 0, "replica", 1),
+    ("byzp", "forge_ok", 0, 1),   # own-identity false vote (legal MAC)
+    ("byzp", "forge_ok", 2, 1),   # foreign vote: needs the knockout
+    ("byzp", "anchor_commit", 1),
+)
+PREFIX_SMALL = PREFIX_FULL[:6] + (("byzp", "anchor_commit", 1),)
+
+#: mutation -> (byzp_budget, drop_budget, prefix)
+MUTATION_HUNTS = {
+    "mac_skip": (4, 2, PREFIX_FULL),
+    "key_confusion": (4, 2, PREFIX_FULL),
+    "cert_downgrade": (2, 2, PREFIX_SMALL),
+    "equiv_dedup": (4, 0, ()),
+}
+
+
+def byzp_scope(byzp=2, drops=0, depth=14, max_states=100_000):
+    from tigerbeetle_tpu.sim.mc import McScope
+
+    return McScope(
+        n_replicas=3, n_clients=1, ops_per_client=1,
+        crash_budget=0, timeout_budget=0, drop_budget=drops,
+        auth=True, byzp_budget=byzp,
+        depth_max=depth, max_states=max_states, seed=0,
+    )
+
+
+class TestTbmcByzantinePrimary:
+    def test_small_scope_exhausts_clean(self):
+        """One Byzantine-primary action, every interleaving: no safety
+        violation with the full defense stack armed.  (The acceptance
+        scope — byzp_budget=2, depth 14, ~93k states — runs in the auth
+        smoke, tools/auth_smoke.py.)"""
+        from tigerbeetle_tpu.sim.mc import check
+
+        rep = check(byzp_scope(byzp=1), ())
+        assert rep.exhaustive and rep.violation is None, rep.violation
+
+    @pytest.mark.parametrize("mutation", sorted(MUTATION_HUNTS))
+    def test_knockout_yields_replayable_counterexample(
+        self, mutation, tmp_path
+    ):
+        """Each seeded defense knockout admits a safety violation whose
+        schedule (a) replays bit-identically through the VOPR replayer
+        and (b) does NOT reproduce once the defense is restored — the
+        mutation-harness proof that every layer is load-bearing."""
+        import json
+
+        from tigerbeetle_tpu.sim.mc import check, replay_schedule
+
+        byzp, drops, prefix = MUTATION_HUNTS[mutation]
+        rep = check(
+            byzp_scope(byzp=byzp, drops=drops, depth=20, max_states=50_000),
+            (mutation,), prefix=prefix,
+        )
+        assert rep.violation is not None, (mutation, rep.states)
+        assert rep.violation["kind"] == "quorum_journal"
+        ce = rep.counterexample()
+        path = str(tmp_path / f"ce_{mutation}.json")
+        with open(path, "w") as f:
+            json.dump(ce, f)
+        replay = replay_schedule(path)
+        assert replay["reproduced"] and replay["identical"], replay
+        defended = replay_schedule(dict(ce, mutations=[]))
+        assert not defended["reproduced"], (
+            f"{mutation}: defense restored, yet the violation reproduced"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the pinned VOPR primary-seat proof (slow: full 6-replica run, on + off)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestVoprPrimarySeat:
+    def test_pinned_seed_auth_on_passes(self):
+        from tigerbeetle_tpu.sim.vopr import EXIT_PASSED, run_byzantine_seed
+
+        r = run_byzantine_seed(7, ticks=2_600, primary_seat=True, auth=True)
+        assert r.exit_code == EXIT_PASSED, r.reason
+        assert r.primary_seat and r.auth
+        assert r.attacks.get("equiv_sv", 0) > 0
+        assert r.attacks.get("fork_serve", 0) > 0
+        assert r.attacks.get("lie_reply", 0) > 0
+        # Every lying reply died at the client's decode/MAC gate.
+        assert r.rejected.get("body_checksum", 0) > 0
+
+    def test_pinned_seed_no_verify_fails_safety(self):
+        from tigerbeetle_tpu.sim.vopr import (
+            EXIT_CORRECTNESS, run_byzantine_seed,
+        )
+
+        r = run_byzantine_seed(
+            7, ticks=2_600, primary_seat=True, verify=False,
+        )
+        assert r.exit_code == EXIT_CORRECTNESS, (
+            f"verification off must fail the safety oracle: {r.reason}"
+        )
+        assert "lying reply" in (r.reason or "")

@@ -332,6 +332,7 @@ class TestOverflowCodesVectorized:
 
 
 class TestLinkedChainsWithLimits:
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_failed_chain_with_limit_member_exact(self):
         """A failed linked chain containing a limit-account member must
         match sequential semantics (routes to the scan path for exactness)."""

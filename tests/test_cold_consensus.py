@@ -57,6 +57,7 @@ def test_tiered_cluster_converges_with_evictions(tmp_path):
     assert cluster.auditor.audited > 30
 
 
+@pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
 def test_tiered_cluster_crash_restart(tmp_path):
     """A replica restarting mid-history reloads its cold manifest + bloom
     from the checkpoint and keeps committing exactly (auditor-checked)."""

@@ -207,6 +207,7 @@ class TestEvictionExactness:
         assert got == want
         assert got == [(0, int(types.CreateTransferResult.exists))]
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_restart_query_includes_cold(self, tmp_path):
         """After a restart the rebuilt index must cover the cold tier too:
         get_account_transfers would otherwise silently drop every evicted

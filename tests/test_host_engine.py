@@ -218,6 +218,7 @@ class TestCrossExecutorParity:
     produce BIT-IDENTICAL ledgers (shared probe discipline => same slots)."""
 
     @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_digest_parity(self, seed):
         rng = np.random.default_rng(300 + seed)
         dev = TpuStateMachine(CFG, batch_lanes=256)
@@ -337,6 +338,7 @@ class TestGrowthAndQueries:
         assert host._host_led.transfers.capacity > 1 << 7, "growth happened"
         assert host.balances_snapshot() == ref.balances_snapshot()
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_get_account_transfers_after_engine_commits(self):
         host = TpuStateMachine(CFG, batch_lanes=256, host_engine=True)
         dev = TpuStateMachine(CFG, batch_lanes=256)

@@ -460,6 +460,10 @@ def test_scope_json_round_trip():
 
 
 def test_mutations_are_frozen_set_of_known_names(tmp_path):
-    assert set(MUTATIONS) == {"not_primary", "anchor_certify", "vc_quorum"}
+    assert set(MUTATIONS) == {
+        "not_primary", "anchor_certify", "vc_quorum",
+        # PR 16 auth-layer knockouts (docs/tbmc.md mutation table):
+        "mac_skip", "key_confusion", "cert_downgrade", "equiv_dedup",
+    }
     with pytest.raises(AssertionError):
         McCluster(McScope(), str(tmp_path), ("no_such_mutation",))

@@ -120,6 +120,7 @@ def drive_mixes(m):
 
 
 class TestMerkleOps:
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_build_matches_numpy_oracle(self):
         m = make_machine(merkle=False, interval=0)
         drive_mixes(m)

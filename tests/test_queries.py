@@ -236,6 +236,7 @@ class TestGetAccountHistory:
         # Only the post-rollback transfer survives in history.
         assert len(dev.get_account_history(filt(1))) == 1
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_history_log_grows_past_capacity(self):
         cfg = LedgerConfig(
             accounts_capacity_log2=10,

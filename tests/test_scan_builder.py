@@ -163,6 +163,7 @@ def check(m, t_rows, expr, ts_min=0, ts_max=0, limit=8190, reversed_=False):
 
 
 class TestPrefixScans:
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_every_transfer_field(self, populated):
         m, t_rows, _ = populated
         for field, get in TRANSFER_FIELD_GET.items():
@@ -232,6 +233,7 @@ class TestCompositions:
             sb.scan_prefix("ledger", 2), sb.scan_prefix("code", 30)
         ))
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_nested_depth_two(self, populated):
         m, t_rows, _ = populated
         expr = sb.merge_union(
@@ -367,6 +369,7 @@ class TestMaintenance:
             sb.scan_prefix("code", 10), sb.scan_prefix("code", 20)
         ))
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_lazy_index_mode(self):
         """lazy_index defers maintenance (bulk-ingest serving mode): commits
         mark derived indexes stale instead of appending; the next query

@@ -92,6 +92,7 @@ class TestSequentialAccounts:
 
 
 class TestSequentialTransfers:
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_plain_matches_fast_semantics(self):
         dev, ref = make_pair()
         seed(dev, ref)
@@ -248,6 +249,7 @@ class TestSequentialTransfers:
         run_transfers(dev, ref, types.transfers_array(rows))
         check_parity(dev, ref)
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_random_differential_all_features(self):
         dev, ref = make_pair(force_sequential=False)
         rng = np.random.default_rng(99)

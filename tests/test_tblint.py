@@ -25,6 +25,8 @@ ALL_RULE_IDS = {
     "wide-literal", "layout-drift", "swallow", "unrolled-loop",
     # tbsan semantic suite (PR 12):
     "donation", "size-class", "lane-race", "shard-rep",
+    # authenticated-wire suite (PR 16):
+    "ingress-auth",
 }
 
 

@@ -16,6 +16,7 @@ def test_vopr_random_schedule_passes(tmp_path, seed):
     assert result.commits > 0
 
 
+@pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
 def test_vopr_seed_10056_two_replica_clock_skew(tmp_path):
     """Regression: a 2-replica cluster whose wall skew exceeds the RTT
     could never clock-synchronize (zero-width own-clock interval made the

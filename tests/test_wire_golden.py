@@ -24,7 +24,8 @@ Shared frame prefix (message_header.zig:17-66):
     108  version                u16
     110  command                u8
     111  replica                u8
-    112  reserved_frame         [16]u8
+    112  reserved_frame         [16]u8   (carved into the wire MAC; zero =
+                                          unauthenticated, byte-identical)
     128  (command-specific area, 128 bytes)
 
 Request (message_header.zig:409-460):
@@ -138,7 +139,9 @@ def test_dtype_offsets_match_reference_layout():
         "checksum_body_padding": 48, "nonce_reserved": 64,
         "cluster_lo": 80, "cluster_hi": 88, "size": 96, "epoch": 100,
         "view": 104, "version": 108, "command": 110, "replica": 111,
-        "reserved_frame": 112,
+        # reserved_frame [16]u8 in the reference; carved into the wire MAC
+        # (zero = unauthenticated — the frame bytes are unchanged).
+        "mac_lo": 112, "mac_hi": 120,
     }
     request_offsets = dict(frame_offsets, **{
         "parent_lo": 128, "parent_hi": 136, "parent_padding": 144,

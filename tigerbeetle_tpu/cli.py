@@ -237,6 +237,18 @@ def main(argv=None) -> int:
                              "consensus ingress verification OFF — the "
                              "negative control that demonstrably fails "
                              "the safety oracle")
+    p_vopr.add_argument("--primary-seat", action="store_true",
+                        help="with --byzantine: seat 0 (the view-0 "
+                             "PRIMARY) is the liar — equivocating "
+                             "prepares and start_views plus fork-serving "
+                             "headers; combine with --auth for the "
+                             "defended run, --no-verify for the negative "
+                             "control (docs/fault_domains.md)")
+    p_vopr.add_argument("--auth", action="store_true",
+                        help="with --byzantine: arm strict per-replica "
+                             "wire MACs (vsr/auth.py) — authenticated "
+                             "certificates are what contain the "
+                             "primary-seat liar")
     p_vopr.add_argument("--catchup", action="store_true",
                         help="run the CATCH-UP scenario: crash one backup "
                              "mid-open-loop-flood in a merkle-armed "
@@ -405,6 +417,10 @@ def _cmd_vopr(args) -> int:
         print("error: --no-verify applies only with --byzantine or "
               "--catchup", file=sys.stderr)
         return 2
+    if (args.primary_seat or args.auth) and not args.byzantine:
+        print("error: --primary-seat/--auth apply only with --byzantine",
+              file=sys.stderr)
+        return 2
     if (args.force_full or args.lying_responder) and not args.catchup:
         print("error: --force-full/--lying-responder apply only with "
               "--catchup", file=sys.stderr)
@@ -471,12 +487,17 @@ def _cmd_vopr(args) -> int:
                 seed,
                 verify=not args.no_verify,
                 ticks=args.ticks if args.ticks is not None else 2_600,
+                primary_seat=args.primary_seat,
+                auth=args.auth,
             )
             print(
                 f"seed={result.seed} exit={result.exit_code} "
                 f"byz_replica={result.byz_replica} "
-                f"verify={result.verify} attacks={result.attacks} "
+                f"verify={result.verify} "
+                f"primary_seat={result.primary_seat} auth={result.auth} "
+                f"attacks={result.attacks} "
                 f"rejected={result.rejected} "
+                f"auth_counters={result.auth_counters} "
                 f"detected={result.equivocations_detected}: {result.reason}"
             )
             worst = max(worst, result.exit_code)
@@ -718,7 +739,28 @@ def _cmd_start(args) -> int:
         )
         if args.pipeline_depth is not None:
             replica.pipeline_depth = args.pipeline_depth
+        auth_secret = os.environ.get("TB_AUTH_SECRET", "")
+        if auth_secret:
+            # Wire authentication (vsr/auth.py): every replica of the
+            # cluster must export the SAME secret (hex, >= 16 bytes).
+            # TB_AUTH_STRICT=0 downgrades to accept-and-count for rolling
+            # deployment alongside auth-off peers (docs/fault_domains.md).
+            from .vsr.auth import Keychain
+
+            try:
+                secret = bytes.fromhex(auth_secret)
+            except ValueError:
+                secret = b""
+            if len(secret) < 16:
+                print("error: TB_AUTH_SECRET must be >= 16 bytes of hex",
+                      file=sys.stderr)
+                return 1
         replica.open()
+        if auth_secret:
+            replica.auth = Keychain(replica.cluster, secret=secret)
+            replica.auth_strict = (
+                os.environ.get("TB_AUTH_STRICT", "1") != "0"
+            )
         replica.machine.warmup()  # compile before announcing readiness
         host = addresses[replica.replica][0]
 

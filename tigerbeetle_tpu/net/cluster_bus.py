@@ -436,7 +436,19 @@ class ClusterServer:
             )
 
     async def _route(self, envelopes) -> None:
+        keychain = getattr(self.replica, "auth", None)
         for (kind, ident), message in envelopes:
+            if (
+                keychain is not None
+                and len(message) >= wire.HEADER_SIZE
+                and message[111] == self.index
+                and message[110] in wire.SOURCE_AUTHENTICATED_BYTES
+            ):
+                # MAC-stamp our OWN source-authenticated frames at egress
+                # (vsr/auth.py; the sim transport does the same in
+                # SimCluster._route).  Relayed frames — prepares, re-served
+                # replies — keep their creator's stamp (or zero, legacy).
+                message = keychain.stamp(message)
             if kind == "replica":
                 w = self.peer_writers.get(ident)
             else:

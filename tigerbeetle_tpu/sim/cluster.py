@@ -69,6 +69,13 @@ class ByzantineActor:
     """
 
     KINDS = ("equivocate", "corrupt", "replay", "lie_reply")
+    #: Primary-seat-only frame classes (vopr --byzantine --primary-seat):
+    #: equivocating same-view start_views and unsolicited fork-serving
+    #: headers responses, forged from the seat's own prepare stream.
+    #: Deliberately NOT in the default set — arming them changes the rng
+    #: draw sequence, and pinned backup-seat seeds must keep replaying
+    #: bit-identically.
+    PRIMARY_KINDS = ("equiv_sv", "fork_serve")
 
     def __init__(
         self,
@@ -85,7 +92,7 @@ class ByzantineActor:
         self.cluster_id = cluster_id
         self.rng = random.Random(seed)
         self.kinds = set(kinds) if kinds else set(self.KINDS)
-        unknown = self.kinds - set(self.KINDS)
+        unknown = self.kinds - set(self.KINDS) - set(self.PRIMARY_KINDS)
         assert not unknown, f"unknown byzantine kinds: {sorted(unknown)}"
         self.rate = rate
         self.window = window
@@ -94,7 +101,13 @@ class ByzantineActor:
         # identically either way — same seed, same draws, same frames.
         self.verify = True
         self.active = True
-        self.attacks: Dict[str, int] = {k: 0 for k in self.KINDS}
+        self.attacks: Dict[str, int] = {
+            k: 0 for k in self.KINDS + self.PRIMARY_KINDS
+        }
+        # Fork material for the primary-seat kinds: the last prepare the
+        # wrapped seat originated (captured at egress — a primary never
+        # RECEIVES prepares, so observe_ingress cannot supply it).
+        self._fork_material = None
         # Bounded observation state (learned from the wrapped replica's own
         # ingress): client-request facts for forging replies, captured raw
         # frames for replays.
@@ -153,7 +166,7 @@ class ByzantineActor:
             stale = _checksum(body + b"\x00")
             h["checksum_body_lo"] = stale & 0xFFFF_FFFF_FFFF_FFFF
             h["checksum_body_hi"] = stale >> 64
-        c = _checksum(h.tobytes()[16:])
+        c = _checksum(wire.checksum_input(h.tobytes()))
         h["checksum_lo"] = c & 0xFFFF_FFFF_FFFF_FFFF
         h["checksum_hi"] = c >> 64
         return h.tobytes() + body
@@ -187,9 +200,33 @@ class ByzantineActor:
         if not self._on(now):
             return envelopes
         out = []
+        primary_armed = bool(self.kinds & set(self.PRIMARY_KINDS))
         for dst, message in envelopes:
             command = message[110] if len(message) > 110 else 0
             is_prepare = command == int(wire.Command.prepare)
+            if (
+                primary_armed and is_prepare
+                and len(message) > wire.HEADER_SIZE
+            ):
+                ph, _, pbody = wire.decode(message)
+                if wire.u128(ph, "client") and pbody:
+                    self._fork_material = (ph, pbody)
+                    # The primary never RECEIVES prepares, so the lying-
+                    # reply material observe_ingress gathers for a backup
+                    # seat is learned from the seat's own egress instead.
+                    self._requests.append({
+                        "client": wire.u128(ph, "client"),
+                        "request": int(ph["request"]),
+                        "op": int(ph["op"]),
+                        "commit": int(ph["commit"]),
+                        "view": int(ph["view"]),
+                        "timestamp": int(ph["timestamp"]),
+                        "operation": int(ph["operation"]),
+                        "request_checksum": wire.u128(
+                            ph, "request_checksum"
+                        ),
+                    })
+                    del self._requests[:-32]
             draw = self.rng.random()
             if (
                 is_prepare and "equivocate" in self.kinds
@@ -246,7 +283,51 @@ class ByzantineActor:
             req = self._requests[self.rng.randrange(len(self._requests))]
             self.attacks["lie_reply"] += 1
             out.append((("client", req["client"]), self._forge_reply(req)))
+        for kind in self.PRIMARY_KINDS:
+            if (
+                kind in self.kinds and self._fork_material is not None
+                and self.rng.random() < self.rate / 2
+            ):
+                victim = self.rng.randrange(self.n)
+                if victim != self.replica:
+                    self.attacks[kind] += 1
+                    out.append((("replica", victim), self._fork_frame(kind)))
         return out
+
+    def _fork_frame(self, kind: str) -> bytes:
+        """A primary-seat forgery built from the seat's own last prepare:
+        the body's first byte flipped, checksums recomputed — fully
+        wire-valid, and sent under the seat's OWN origin (the transport
+        MAC-stamps it legally; containment must come from the consensus
+        layer's anchor certification, not from the MAC)."""
+        ph, pbody = self._fork_material
+        evil = wire.encode(
+            ph.copy(), bytes([pbody[0] ^ 1]) + pbody[1:]
+        )
+        evil_h = wire.decode_header(evil)[0]
+        if kind == "equiv_sv":
+            # Equivocating start_view for the seat's CURRENT view (the
+            # only view whose SVs pass the primary-origin check), naming
+            # the fork as the canonical head.
+            h = wire.new_header(
+                wire.Command.start_view,
+                cluster=self.cluster_id,
+                view=int(ph["view"]),
+                op=int(ph["op"]),
+                commit=int(ph["commit"]),
+            )
+        else:  # fork_serve
+            # Unsolicited fork-serving headers "response" (the PR 6 gap's
+            # probe): proposes the fork as a repair target — under the
+            # ingress discipline, repair-target certification must come
+            # from anchors, never from a single headers frame.
+            h = wire.new_header(
+                wire.Command.headers,
+                cluster=self.cluster_id,
+                view=int(ph["view"]),
+            )
+        h["replica"] = self.replica
+        return wire.encode(h, wire.pack_headers([evil_h]))
 
 
 class SimClient:
@@ -538,6 +619,7 @@ class SimCluster:
         merkle: bool = False,
         overload: Optional[dict] = None,
         byzantine: Optional[dict] = None,
+        auth: Optional[dict] = None,
         machine_factory=None,
     ) -> None:
         self.workdir = workdir
@@ -619,6 +701,28 @@ class SimCluster:
         # replicas skip their ingress checks, modeling a build whose
         # verification is broken so the same pinned attack schedule must
         # demonstrably fail the safety oracles.
+        # Wire authentication (vsr/auth.py, docs/fault_domains.md "Byzantine
+        # primary").  None (default): zero-MAC legacy wire, bit-identical to
+        # every pinned seed.  A dict arms a deterministic cluster keychain
+        # on every replica and MAC-stamps SOURCE_AUTHENTICATED egress in
+        # _route.  Keys: ``strict`` (default True — unauthenticated replica
+        # frames rejected, certified commits require ack certificates;
+        # False = mixed-version accept-and-count), ``seed`` (keychain
+        # derivation, default the cluster seed), ``off_replicas`` (iterable
+        # of indexes left auth-OFF: the mixed-version degradation tests).
+        self.auth_config: Optional[dict] = None
+        self.auth_keychain = None
+        self._auth_off: frozenset = frozenset()
+        if auth is not None:
+            from ..vsr.auth import Keychain
+
+            a = dict(auth)
+            a.setdefault("strict", True)
+            self.auth_keychain = Keychain(
+                cluster_id, seed=int(a.get("seed", seed))
+            )
+            self._auth_off = frozenset(a.get("off_replicas", ()))
+            self.auth_config = a
         self.byzantine = None
         self._byz: Optional[ByzantineActor] = None
         # Ingress drop-and-count accounting (reason -> frames), always-on
@@ -739,6 +843,20 @@ class SimCluster:
             client.reply_observer = self._observe_client_reply
 
     def _observe_client_reply(self, client_id, h, operation, body) -> None:
+        if (
+            self.auth_keychain is not None
+            and self.auth_config["strict"]
+            and not (self._byz is not None and not self._byz.verify)
+            and int(h["replica"]) not in self._auth_off
+        ):
+            # Auditor cross-check (belt to the dispatch gate's braces):
+            # under strict auth, every reply a client ACCEPTS must verify
+            # under its claimed origin's key.
+            assert self.auth_keychain.verify(h), (
+                f"client {client_id} accepted a reply for op "
+                f"{int(h['op'])} that fails MAC verification under "
+                f"claimed origin {int(h['replica'])}"
+            )
         self.auditor.observe_reply(
             int(h["op"]), operation.name, body,
             client=client_id, request=int(h["request"]),
@@ -782,6 +900,9 @@ class SimCluster:
             # Negative control: the consensus-level byzantine checks are
             # forced off along with the transport's (see step()).
             replica.ingress_verify = False
+        if self.auth_keychain is not None and i not in self._auth_off:
+            replica.auth = self.auth_keychain
+            replica.auth_strict = bool(self.auth_config["strict"])
         if self.overload is not None:
             # One knob across the domain: the primary's shed points signal
             # busy exactly when the governor does.
@@ -901,6 +1022,16 @@ class SimCluster:
         skind, sid = src
         if skind == "replica":
             if command in wire.SOURCE_AUTHENTICATED_COMMANDS:
+                if (
+                    self.auth_keychain is not None
+                    and self.auth_config["strict"]
+                ):
+                    # Strict auth: the MAC is the load-bearing identity
+                    # check, so the transport pin is lifted — this is the
+                    # adversarial-network model the tbmc byzantine-primary
+                    # scope exhausts (a forged-identity frame must FAIL at
+                    # _ingress_auth, not lean on transport pinning).
+                    return True
                 return int(h["replica"]) == sid
             return True
         if command in (wire.Command.request, wire.Command.ping_client):
@@ -962,6 +1093,21 @@ class SimCluster:
             except ValueError as err:
                 self._ingress_reject(getattr(err, "reason", "decode"))
                 return
+            if (
+                command == wire.Command.reply
+                and not unverified
+                and self.auth_keychain is not None
+                and self.auth_config["strict"]
+                and int(h["replica"]) not in self._auth_off
+            ):
+                # Replies are MAC'd at CREATION under the committing
+                # replica's key (vsr/replica._commit_prepare) and survive
+                # verbatim re-serving, so under strict auth a client-bound
+                # reply that fails its claimed origin's key is a forgery
+                # (e.g. the byzantine actor's lie_reply): drop-and-count.
+                if not self.auth_keychain.verify(h):
+                    self._ingress_reject("unauthenticated_reply")
+                    return
             client.on_message(h, command, body, self.t)
 
     def tick_replica(self, i: int) -> None:
@@ -1109,11 +1255,32 @@ class SimCluster:
             ),
         }
 
+    def _auth_stamp(self, sid: int, message: bytes) -> bytes:
+        """MAC-stamp a SOURCE_AUTHENTICATED egress frame whose header
+        claims the sending replica itself as origin.  Stamping sits AFTER
+        the byzantine transform (see _route): the byz actor's own-identity
+        forgeries legally carry valid MACs (it holds its own key), while
+        forged-identity frames stay unstamped — the MAC layer, not the
+        transport pin, must catch them."""
+        if sid in self._auth_off or len(message) < wire.HEADER_SIZE:
+            return message
+        if (
+            message[110] not in wire.SOURCE_AUTHENTICATED_BYTES
+            or message[111] != sid
+        ):
+            return message
+        return self.auth_keychain.stamp(message)
+
     def _route(self, src, envelopes) -> None:
         if self._byz is not None and src == ("replica", self._byz.replica):
             # The Byzantine wrapper owns this replica's egress: frames may
             # pass, corrupt, or fan out as conflicting forgeries.
             envelopes = self._byz.transform(envelopes, self.t)
+        if self.auth_keychain is not None and src[0] == "replica":
+            sid = src[1]
+            envelopes = [
+                (dst, self._auth_stamp(sid, m)) for dst, m in envelopes
+            ]
         for dst, message in envelopes:
             self.net.send(src, dst, message, self.t)
 

@@ -95,7 +95,17 @@ MC_CONFIG = ClusterConfig(
     clients_max=4,
 )
 
-MUTATIONS = ("not_primary", "anchor_certify", "vc_quorum")
+MUTATIONS = (
+    "not_primary", "anchor_certify", "vc_quorum",
+    # Auth-layer knockouts (vsr/auth.py + consensus._ingress_auth /
+    # _note_ack / _ack_certified — the byzantine-primary scope's proof
+    # subjects, tools/auth_smoke.py):
+    "mac_skip",       # _ingress_auth accepts every frame unverified
+    "key_confusion",  # MAC accepted if it verifies under ANY node's key
+    "cert_downgrade", # backup execution skips the ack-certificate gate
+    "equiv_dedup",    # conflicting prepares adopted + re-acked; one-vote-
+                      # per-op certificate dedup removed
+)
 
 Event = Tuple  # flat tuples of str/int — JSON round-trippable
 
@@ -121,6 +131,22 @@ class McScope:
     drop_budget: int = 0
     partition_budget: int = 0
     timeout_budget: int = 4
+    # Wire-auth scope (vsr/auth.py): every replica armed with the
+    # deterministic cluster keychain in STRICT mode — source-authenticated
+    # frames must carry a valid origin MAC, and backups execute only
+    # certificate-covered ops.
+    auth: bool = False
+    # Byzantine-PRIMARY adversary (docs/tbmc.md): ``byzp_budget`` forged-
+    # frame events from the replica holding seat ``byzp_replica`` (seat 0
+    # = the bootstrap primary).  The adversary's internal state stays
+    # honest; each event injects one frame CONSTRUCTIBLE from its own key
+    # material and journal — equivocating prepares, own-or-claimed forged
+    # votes, fork-anchoring commits, fork-serving headers/SVs, forged
+    # sync replies.  It never holds another node's key: frames claiming a
+    # peer identity carry the adversary's own-key MAC (the key_confusion
+    # bait) and must die at _ingress_auth when defenses are on.
+    byzp_budget: int = 0
+    byzp_replica: int = 0
     # Slow-timer scope assumption: timers fire only at QUIESCENT states
     # (no deliverable frame anywhere) — a consensus tick (~10 ms) is
     # orders of magnitude slower than a link delivery, so racing a timer
@@ -468,6 +494,9 @@ class McCluster:
             audit=False,
             machine_factory=DigestMachine,
             mc_mutations=frozenset(mutations),
+            auth=(
+                {"strict": True, "seed": scope.seed} if scope.auth else None
+            ),
         )
         self.clients: Dict[int, McClient] = {}
         for j in range(scope.n_clients):
@@ -487,6 +516,7 @@ class McCluster:
         self.budgets = {
             "crash": scope.crash_budget,
             "byz": scope.byz_budget,
+            "byzp": scope.byzp_budget,
             "drop": scope.drop_budget,
             "partition": scope.partition_budget,
             "timeout": scope.timeout_budget,
@@ -637,6 +667,17 @@ class McCluster:
                     for v in range(cl.n):
                         if v != i and cl.alive[v]:
                             ev.append(("byz", i, v))
+        if self.budgets["byzp"] > 0 and self._byzp_fork() is not None:
+            b = self.scope.byzp_replica
+            for v in range(cl.n):
+                if v == b or not cl.alive[v]:
+                    continue
+                for sub in ("equiv_prepare", "anchor_commit",
+                            "fork_headers", "fork_sv", "forge_sync"):
+                    ev.append(("byzp", sub, v))
+                for claim in range(cl.n):
+                    if claim != v:
+                        ev.append(("byzp", "forge_ok", claim, v))
         if self.budgets["partition"] > 0 and self.partition is None:
             for i in range(cl.n):
                 ev.append(("partition", i))
@@ -651,8 +692,8 @@ class McCluster:
     # fault-induced counterexamples surface early instead of after the
     # full fault-free tree.
     _KIND_ORDER = {
-        "byz": 0, "drop": 1, "partition": 2, "heal": 3, "crash": 4,
-        "restart": 5, "timeout": 6, "client": 7, "deliver": 8,
+        "byzp": 0, "byz": 1, "drop": 2, "partition": 3, "heal": 4,
+        "crash": 5, "restart": 6, "timeout": 7, "client": 8, "deliver": 9,
     }
 
     @classmethod
@@ -701,6 +742,9 @@ class McCluster:
         elif kind == "byz":
             self.budgets["byz"] -= 1
             self._apply_byz(event[1], event[2])
+        elif kind == "byzp":
+            self.budgets["byzp"] -= 1
+            self._apply_byzp(event)
         elif kind == "partition":
             self.budgets["partition"] -= 1
             self.partition = event[1]
@@ -779,6 +823,133 @@ class McCluster:
                       self.cluster.t)
         self.net.send(("replica", i), ("replica", victim),
                       wire.encode(forged), self.cluster.t)
+
+    # -- Byzantine-PRIMARY action set (scope.byzp_budget) ----------------------
+
+    def _byzp_fork(self) -> Optional[Tuple[int, bytes]]:
+        """The adversary's deterministic fork: its highest journaled
+        client-carrying prepare, body's first byte flipped, checksums
+        recomputed — fully wire-valid, and a prepare legitimately carries
+        the preparing primary's origin (the seat the adversary holds).
+        Pure function of the adversary's own capsule state, so the
+        canonical hash needs no extra forged-material tracking."""
+        b = self.scope.byzp_replica
+        cl = self.cluster
+        if not cl.alive[b]:
+            return None
+        r = cl.replicas[b]
+        for op in sorted(r.headers, reverse=True):
+            if not wire.u128(r.headers[op], "client"):
+                continue
+            read = Journal(cl.storages[b]).read_prepare(op)
+            if read is None:
+                continue
+            hh, body = read
+            if not body:
+                continue
+            evil = wire.encode(hh.copy(), bytes([body[0] ^ 1]) + body[1:])
+            return op, evil
+        return None
+
+    def _apply_byzp(self, event: Event) -> None:
+        """Inject ONE Byzantine-primary forged frame.  Every frame is
+        constructible from the adversary's own key + journal (vsr/auth.py
+        threat model): own-identity frames carry LEGAL MACs; frames
+        claiming a peer identity (forge_ok with claim != adversary) carry
+        the adversary's own-key MAC — accepted only under the
+        ``mac_skip``/``key_confusion`` knockouts, never with defenses on."""
+        sub, victim = event[1], event[-1]
+        b = self.scope.byzp_replica
+        cl = self.cluster
+        r = cl.replicas[b]
+        keychain = cl.auth_keychain
+        op, evil = self._byzp_fork()
+        evil_h, _ = wire.decode_header(evil)
+        fork_checksum = wire.header_checksum(evil_h)
+
+        def stamped(h, body=b""):
+            frame = wire.encode(h, body)
+            if keychain is None:
+                return frame
+            # Own key ALWAYS — the adversary holds no other; for claimed
+            # peer identities this is exactly the key_confusion bait.
+            return wire.stamp_mac(
+                frame, keychain.mac(b, frame[: wire.HEADER_SIZE])
+            )
+
+        if sub == "equiv_prepare":
+            # Conflicting prepare for an op the honest broadcast already
+            # carries — prepares are relayed (never MAC'd), so this is
+            # wire-legal as-is.
+            frame = evil
+        elif sub == "forge_ok":
+            claim = event[2]
+            ok = wire.new_header(
+                wire.Command.prepare_ok,
+                cluster=cl.cluster_id,
+                view=r.view,
+                parent=wire.u128(evil_h, "parent"),
+                prepare_checksum=fork_checksum,
+                client=wire.u128(evil_h, "client"),
+                op=op,
+                commit=r.commit_min,
+                timestamp=int(evil_h["timestamp"]),
+                request=int(evil_h["request"]),
+                operation=int(evil_h["operation"]),
+            )
+            ok["replica"] = claim
+            frame = stamped(ok)
+        elif sub == "anchor_commit":
+            # Fork-anchoring commit heartbeat under the adversary's OWN
+            # identity — legal while it holds the primary seat of its
+            # view; the cert_downgrade knockout's bait.
+            forged = wire.new_header(
+                wire.Command.commit,
+                cluster=cl.cluster_id,
+                view=r.view,
+                commit=op,
+                commit_checksum=fork_checksum,
+                checkpoint_op=0,
+                timestamp_monotonic=0,
+            )
+            forged["replica"] = b
+            frame = stamped(forged)
+        elif sub == "fork_headers":
+            # Fork-serving repair response (the PR 6 gap's probe): a
+            # single authenticated headers frame proposing the fork as a
+            # repair target — certification must come from anchors, never
+            # from the response alone.
+            hdr = wire.new_header(wire.Command.headers,
+                                  cluster=cl.cluster_id, view=r.view)
+            hdr["replica"] = b
+            frame = stamped(hdr, wire.pack_headers([evil_h]))
+        elif sub == "fork_sv":
+            # Equivocating start_view for the adversary's OWN view (the
+            # only view whose SVs pass the primary-origin check), serving
+            # the fork as the canonical head.
+            sv = wire.new_header(
+                wire.Command.start_view,
+                cluster=cl.cluster_id,
+                view=r.view,
+                op=op,
+                commit=r.commit_min,
+                checkpoint_op=r.op_checkpoint,
+            )
+            sv["replica"] = b
+            frame = stamped(sv, wire.pack_headers([evil_h]))
+        elif sub == "forge_sync":
+            # Forged sync summary under own identity: empty body — the
+            # victim's structural gates must reject it without wedging.
+            roots = wire.new_header(
+                wire.Command.sync_roots,
+                cluster=cl.cluster_id, view=r.view, checkpoint_op=op,
+            )
+            roots["replica"] = b
+            frame = stamped(roots)
+        else:
+            raise ValueError(f"unknown byzp subkind {sub!r}")
+        self.net.send(("replica", b), ("replica", victim), frame,
+                      self.cluster.t)
 
     # -- invariants -----------------------------------------------------------
 
@@ -1010,7 +1181,7 @@ class McCluster:
     def canon_parts(self) -> List[bytes]:
         return [self.canon_blob(i) for i in range(self.cluster.total)]
 
-    _BUDGET_ORDER = ("byz", "crash", "drop", "partition", "timeout")
+    _BUDGET_ORDER = ("byz", "byzp", "crash", "drop", "partition", "timeout")
 
     def budget_vector(self) -> Tuple[int, ...]:
         """Remaining budgets, fixed order.  Kept OUT of canonical_key:
@@ -1060,7 +1231,7 @@ class McCluster:
         return ("net",)
 
     _BUDGET_OF = {"drop": "drop", "timeout": "timeout", "crash": "crash",
-                  "byz": "byz", "partition": "partition"}
+                  "byz": "byz", "byzp": "byzp", "partition": "partition"}
 
     @staticmethod
     def _link_src(event):
@@ -1092,6 +1263,11 @@ class McCluster:
         pop-that-link pair (coalescing, see _emitter).  Partition toggles
         conflict with everything (they flip global deliverability)."""
         if a[0] in ("partition", "heal") or b[0] in ("partition", "heal"):
+            return False
+        if a[0] == "byzp" or b[0] == "byzp":
+            # The forged frame is DERIVED from the adversary's live state
+            # (journal head) and lands on a link any deliver can pop —
+            # conservatively dependent with everything.
             return False
         if cls._agent(a) == cls._agent(b):
             return False

@@ -400,6 +400,9 @@ class ByzantineResult(VoprResult):
     rejected: Optional[dict] = None      # reason -> ingress frames dropped
     equivocations_detected: int = 0
     openloop_requests: int = 0
+    primary_seat: bool = False           # the byzantine replica IS seat 0
+    auth: bool = False                   # strict per-replica MACs armed
+    auth_counters: Optional[dict] = None  # auth.* observability rows
 
 
 def run_byzantine_seed(
@@ -410,6 +413,8 @@ def run_byzantine_seed(
     settle_ticks: int = 60_000,
     rate: float = 0.2,
     kinds=None,
+    primary_seat: bool = False,
+    auth: bool = False,
 ) -> ByzantineResult:
     """The BYZANTINE fault kind (docs/fault_domains.md, fifth domain): one
     replica of SIX lies — it equivocates conflicting prepares, corrupts
@@ -440,11 +445,22 @@ def run_byzantine_seed(
 
     byz_rng = _random.Random(seed ^ 0xB12A5)
     n_replicas = 6
-    # Never the initial primary: with no crash schedule the run stays in
-    # view 0, so the Byzantine replica is a backup inside the replication
-    # ring for the whole attack window (a Byzantine PRIMARY's full forgery
-    # power is documented as undefended — docs/fault_domains.md).
-    byz_replica = byz_rng.randrange(1, n_replicas)
+    if primary_seat:
+        # The PRIMARY-SEAT variant (docs/fault_domains.md, defended since
+        # the MAC'd wire landed): with no crash schedule the run stays in
+        # view 0, so seat 0 holds the primary's full forgery power —
+        # equivocating prepares/start_views and fork-serving headers —
+        # for the whole attack window.  Containment is the authenticated
+        # certificate layer (``auth=True``), not transport pinning; the
+        # ``verify=False`` negative control must fail the safety oracle.
+        byz_replica = 0
+        byz_rng.randrange(1, n_replicas)  # keep the stream aligned
+        if kinds is None:
+            kinds = ("equivocate", "equiv_sv", "fork_serve", "lie_reply")
+    else:
+        # Never the initial primary: the Byzantine replica is a backup
+        # inside the replication ring for the whole attack window.
+        byz_replica = byz_rng.randrange(1, n_replicas)
     attack_window = (200, max(400, ticks - 600))
     gen = OpenLoopGen(
         seed ^ 0x09E7,
@@ -472,6 +488,7 @@ def run_byzantine_seed(
                 "kinds": kinds,
                 "window": attack_window,
             },
+            auth=({"strict": True, "seed": seed} if auth else None),
         )
         gen.attach(cluster)
 
@@ -487,8 +504,17 @@ def run_byzantine_seed(
             )
             res.byz_replica = byz_replica
             res.verify = verify
+            res.primary_seat = primary_seat
+            res.auth = auth
             res.attacks = dict(actor.attacks)
             res.rejected = dict(cluster.rejected_frames)
+            if _obs.enabled:
+                res.auth_counters = {
+                    name: value
+                    for name, value in
+                    _obs.snapshot()["counters"].items()
+                    if name.startswith("auth.")
+                } or None
             res.equivocations_detected = sum(
                 r.byzantine_detections
                 for r in cluster.replicas if r is not None

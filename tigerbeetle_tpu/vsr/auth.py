@@ -1,0 +1,98 @@
+"""Wire authentication: per-replica keys + header MACs (docs/fault_domains.md).
+
+Checksums (vsr/vsr.zig checksum discipline) are error detection, not
+authentication: any party that can compute AEGIS-128L can forge a frame
+that verifies, so PR 6's ingress discipline only defends against Byzantine
+*backups* whose transport identity pins them.  This module adds the missing cryptographic layer:
+
+- every replica (and the sim/test harness) derives a per-origin key from a
+  shared cluster secret — ``key(i) = BLAKE2b(secret || "replica" || i)`` —
+  seeded deterministically so VOPR/tbmc schedules replay bit-identically;
+- a frame's MAC is keyed BLAKE2b-128 over header bytes [16..256) with the
+  MAC field itself zeroed (wire.MAC_OFFSET..MAC_END), computed under the
+  key of the replica the header CLAIMS as its origin (``h["replica"]``) —
+  so holding your own key lets you speak only as yourself;
+- the MAC rides in the header bytes carved from ``reserved_frame``
+  (wire.py): zero = unauthenticated, and the header checksum excludes the
+  MAC bytes, so transports stamp egress frames in place.
+
+The threat model is a Byzantine REPLICA (including the primary seat): the
+cluster secret is deployment configuration shared by the operator with
+every replica and client, exactly like the cluster id.  The model-checker
+adversary (sim/mc.py "byzp" actions) holds only its OWN key — that
+restriction is enforced by the action set, not by this module.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Optional
+
+from . import wire
+
+#: MAC width in bytes (the full reserved_frame carve).
+MAC_BYTES = wire.MAC_END - wire.MAC_OFFSET
+
+
+def derive_secret(cluster: int, seed: int = 0) -> bytes:
+    """Deterministic cluster secret for sim/replay (production deployments
+    would provision a random one out of band)."""
+    tag = b"tb-auth-secret|%d|%d" % (cluster, seed)
+    return hashlib.blake2b(tag, digest_size=32).digest()
+
+
+class Keychain:
+    """Per-origin MAC keys derived from one cluster secret.
+
+    Keys are derived lazily and cached; any origin index (replicas and
+    standbys alike) resolves to a stable key, so membership changes never
+    re-key existing origins.
+    """
+
+    __slots__ = ("cluster", "secret", "_keys")
+
+    def __init__(self, cluster: int, secret: Optional[bytes] = None,
+                 seed: int = 0) -> None:
+        self.cluster = int(cluster)
+        self.secret = (
+            secret if secret is not None else derive_secret(cluster, seed)
+        )
+        self._keys: Dict[int, bytes] = {}
+
+    def key(self, origin: int) -> bytes:
+        k = self._keys.get(origin)
+        if k is None:
+            k = hashlib.blake2b(
+                self.secret + b"|replica|%d" % origin, digest_size=32
+            ).digest()
+            self._keys[origin] = k
+        return k
+
+    # -- MAC over the 256-byte header -----------------------------------------
+
+    def mac(self, origin: int, header_bytes: bytes) -> int:
+        """MAC of a header under ``origin``'s key: keyed BLAKE2b-128 over
+        the checksum domain (bytes [16..256) with the MAC field zeroed).
+        Never returns 0 — zero is the "unauthenticated" sentinel."""
+        digest = hashlib.blake2b(
+            wire.checksum_input(header_bytes),
+            key=self.key(origin), digest_size=MAC_BYTES,
+        ).digest()
+        value = int.from_bytes(digest, "little")
+        return value or 1
+
+    def stamp(self, frame: bytes) -> bytes:
+        """Stamp an encoded frame's MAC in place, under the key of the
+        origin the header claims (byte 111) — egress transports call this
+        only for frames they originated themselves."""
+        origin = frame[111]
+        return wire.stamp_mac(frame, self.mac(origin, frame))
+
+    def verify(self, h) -> bool:
+        """True iff the decoded header's MAC verifies under the CLAIMED
+        origin's key.  A zero MAC never verifies (callers decide whether
+        an unauthenticated frame is acceptable — mixed-version policy)."""
+        claimed = wire.header_mac(h)
+        if not claimed:
+            return False
+        return self.mac(int(h["replica"]), h.tobytes()) == claimed

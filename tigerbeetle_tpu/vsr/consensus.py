@@ -190,6 +190,24 @@ class VsrReplica(Replica):
         # forged prepare inert: it can enter the journal, but it can never
         # EXECUTE, because no honest primary will ever anchor its checksum.
         self._anchors: Dict[int, int] = {}
+        # Wire authentication (vsr/auth.py; docs/fault_domains.md "Byzantine
+        # primary").  ``auth`` is a Keychain or None — OFF by default: every
+        # frame then carries a zero MAC and the wire is bit-identical to the
+        # pre-auth protocol, so pinned seeds and goldens are untouched.
+        # Armed, every SOURCE_AUTHENTICATED ingress frame passes
+        # _ingress_auth (MAC failures drop-and-count as auth.rejected.*);
+        # ``auth_strict`` additionally rejects UNauthenticated replica
+        # frames and upgrades certified commits from checksum anchors to
+        # authenticated ack CERTIFICATES: prepare_ok is broadcast, and a
+        # backup executes an op only once _cert_quorum() distinct
+        # MAC-verified acks name its exact journaled checksum — the quorum
+        # size guarantees two certificates for the same op intersect in an
+        # honest replica, so a lying PRIMARY cannot fork execution.
+        self.auth = None
+        self.auth_strict = False
+        # Ack certificates: op -> {checksum -> acking replica set},
+        # accumulated only under auth_strict (bounded by _ACK_CERTS_MAX).
+        self._ack_certs: Dict[int, Dict[int, Set[int]]] = {}
 
         # Journaled prepare headers by op for the live window (chain checks,
         # repair responses, DVC/SV bodies).  Pruned at checkpoint.
@@ -554,6 +572,95 @@ class VsrReplica(Replica):
             self._debug("ingress_reject", reason=reason, **kw)
         return []
 
+    def _ingress_auth(self, h: np.ndarray) -> bool:
+        """MAC gate for SOURCE_AUTHENTICATED ingress (vsr/auth.py): the
+        FIRST call in every handler of a source-authenticated command,
+        before any header field is consumed — tblint's ingress-auth rule
+        enforces that ordering syntactically.  Auth off: always passes
+        (the zero-MAC legacy wire).  Keychain armed: a bad MAC drops-and-
+        counts (auth.rejected.mac); a MISSING MAC is accepted-and-counted
+        in mixed-version mode (an auth-off peer must not wedge a rolling
+        upgrade) but rejected under auth_strict when the frame claims a
+        cluster-replica origin."""
+        if self.auth is None:
+            return True
+        if "mac_skip" in self.mc_mutations:
+            return True  # seeded defense knockout (docs/tbmc.md)
+        mac = wire.header_mac(h)
+        if not mac:
+            if self.auth_strict and int(h["replica"]) < self.replica_count:
+                if _obs.enabled:
+                    _obs.counter("auth.rejected.missing").inc()
+                self._reject_frame(
+                    "auth_missing", claimed=int(h["replica"])
+                )
+                return False
+            if _obs.enabled:
+                _obs.counter("auth.accepted.unauthenticated").inc()
+            return True
+        if "key_confusion" in self.mc_mutations:
+            # Seeded knockout: verification forgets WHOSE key must match,
+            # so a frame MAC'd under ANY cluster key passes — an adversary
+            # can then speak as any peer using only its own key.
+            hb = h.tobytes()
+            ok = any(
+                self.auth.mac(origin, hb) == mac
+                for origin in range(self.node_count)
+            )
+        else:
+            ok = self.auth.verify(h)
+        if not ok:
+            if _obs.enabled:
+                _obs.counter("auth.rejected.mac").inc()
+            self._reject_frame("auth_mac", claimed=int(h["replica"]))
+            return False
+        if _obs.enabled:
+            _obs.counter("auth.verified").inc()
+        return True
+
+    # -- authenticated ack certificates (auth_strict) -------------------------
+
+    _ACK_CERTS_MAX = 64
+
+    def _cert_quorum(self) -> int:
+        """Certificate size: > (n + f) / 2 with f = 1, so two certificates
+        for the same op share an honest member — the honest single-voice
+        rule (one ack per op per honest replica) then forbids certificates
+        for two DIFFERENT checksums at one op."""
+        return (self.replica_count + 3) // 2
+
+    def _note_ack(self, op: int, checksum: int, replica: int) -> None:
+        """Record a MAC-verified prepare_ok toward op's certificate.  An
+        already-voted replica naming a SECOND checksum is equivocating:
+        keep its first vote and count the evidence (the dedup the
+        ``equiv_dedup`` mutation removes)."""
+        certs = self._ack_certs.setdefault(op, {})
+        if "equiv_dedup" not in self.mc_mutations:
+            for have, voters in certs.items():
+                if have != checksum and replica in voters:
+                    self.byzantine_detections += 1
+                    if _obs.enabled:
+                        _obs.counter("auth.equivocating_acks").inc()
+                    return
+        certs.setdefault(checksum, set()).add(replica)
+        if len(self._ack_certs) > self._ACK_CERTS_MAX:
+            for stale in sorted(self._ack_certs)[
+                : len(self._ack_certs) - self._ACK_CERTS_MAX
+            ]:
+                del self._ack_certs[stale]
+
+    def _ack_certified(self, op: int) -> bool:
+        """True iff op's JOURNALED content holds a full ack certificate.
+        Only consulted under auth_strict (certificates upgrade the anchor
+        check, they do not replace it for the legacy wire); the
+        ``cert_downgrade`` mutation is the seeded knockout that falls back
+        to anchors alone."""
+        h = self.headers.get(op)
+        if h is None:
+            return False
+        voters = self._ack_certs.get(op, {}).get(wire.header_checksum(h))
+        return voters is not None and len(voters) >= self._cert_quorum()
+
     # Commands that only the primary of their stamped view ever originates.
     # Prepares keep the preparing primary's header through ring forwarding
     # and repair fills, so the invariant holds for EVERY honest frame of
@@ -729,6 +836,10 @@ class VsrReplica(Replica):
             ok_from={self.replica},
         )
         out: List[Msg] = []
+        if self.auth is not None and self.auth_strict:
+            # The primary's own attestation joins the certificate: backups
+            # need _cert_quorum() distinct votes, the leader's included.
+            self._append_ok(out, prepare_h)
         message = wire.encode(prepare_h, prepare_body)
         successor = self._ring_successor()
         if successor is not None:
@@ -813,6 +924,8 @@ class VsrReplica(Replica):
         return self._broadcast(wire.encode(req))
 
     def on_request_reply(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         client = wire.u128(h, "client")
         s = self.sessions.get(client)
         if s is None or not s.reply_bytes or s.session != int(h["session"]):
@@ -917,13 +1030,25 @@ class VsrReplica(Replica):
                 # parent link of the next header before adopting.
                 self.stash[op] = (h, body)
                 self._fill_gaps(out)
-            elif existing is not None and _obs.enabled:
-                # Two different prepares for the same op in the SAME view:
-                # an honest primary assigns each op once, so this is
-                # equivocation evidence (the conflicting frame is dropped
-                # either way; the commit-checksum anchor adjudicates which
-                # copy is canonical).
-                _obs.counter("byzantine.prepare_conflicts").inc()
+            elif existing is not None:
+                if "equiv_dedup" in self.mc_mutations:
+                    # Seeded knockout (docs/tbmc.md): the keep-first rule
+                    # is what makes an honest replica speak ONCE per op.
+                    # Adopting-and-acking the conflicting copy lets an
+                    # equivocating primary assemble ack certificates for
+                    # BOTH forks of the same op.
+                    self.journal.write_prepare(wire.encode(h, body))
+                    self.headers[op] = h
+                    if op == self.op:
+                        self.parent_checksum = checksum
+                    self._append_ok(out, h)
+                elif _obs.enabled:
+                    # Two different prepares for the same op in the SAME
+                    # view: an honest primary assigns each op once, so this
+                    # is equivocation evidence (the conflicting frame is
+                    # dropped either way; the commit-checksum anchor
+                    # adjudicates which copy is canonical).
+                    _obs.counter("byzantine.prepare_conflicts").inc()
             return out
 
         if op == self.op + 1 and wire.u128(h, "parent") == self.parent_checksum:
@@ -966,7 +1091,24 @@ class VsrReplica(Replica):
         """Queue a prepare_ok — unless we are a standby (standbys receive
         and replicate prepares but NEVER ack: they must not count toward
         commit quorums, replica.zig:4877)."""
-        if not self.is_standby:
+        if self.is_standby:
+            return
+        if self.auth is not None and self.auth_strict:
+            # Authenticated ack certificates: the ack goes to EVERY replica
+            # (not just the primary) so backups can assemble a
+            # _cert_quorum() certificate before executing; our own vote is
+            # recorded locally (no loopback delivery).
+            _, frame = self._send_prepare_ok(prepare_h)
+            out.extend(
+                (("replica", r), frame)
+                for r in range(self.replica_count)
+                if r != self.replica
+            )
+            self._note_ack(
+                int(prepare_h["op"]),
+                wire.header_checksum(prepare_h), self.replica,
+            )
+        else:
             out.append(self._send_prepare_ok(prepare_h))
 
     def _send_prepare_ok(self, prepare_h: np.ndarray) -> Msg:
@@ -1034,8 +1176,22 @@ class VsrReplica(Replica):
         return gaps[-limit:]
 
     def on_prepare_ok(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         if int(h["replica"]) >= self.replica_count:
             return []  # a standby's ack must never count (defense in depth)
+        if self.auth is not None and self.auth_strict:
+            # Certificate assembly: every replica collects MAC-verified
+            # acks (the strict-mode broadcast), then a backup retries the
+            # commit gate — it may have been waiting on exactly this vote.
+            self._note_ack(
+                int(h["op"]), wire.u128(h, "prepare_checksum"),
+                int(h["replica"]),
+            )
+            if not self.is_primary:
+                out: List[Msg] = []
+                self._commit_journal(out)
+                return out
         if self.status != NORMAL or not self.is_primary:
             return []
         if int(h["view"]) != self.view:
@@ -1069,6 +1225,8 @@ class VsrReplica(Replica):
 
     def on_commit(self, h: np.ndarray, body: bytes) -> List[Msg]:
         """Commit-number heartbeat from the primary (replica.zig :1591)."""
+        if not self._ingress_auth(h):
+            return []
         view = int(h["view"])
         if view < self.view:
             return []
@@ -1108,7 +1266,9 @@ class VsrReplica(Replica):
             and "anchor_certify" not in self.mc_mutations
         ):
             mine = self.headers.get(commit_op)
-            if mine is not None and wire.header_checksum(mine) != want:
+            if mine is not None and wire.header_checksum(mine) != want and (
+                self._anchor_trusted(commit_op, want)
+            ):
                 self.byzantine_detections += 1
                 if _obs.enabled:
                     _obs.counter("byzantine.equivocation_detected").inc()
@@ -1120,7 +1280,8 @@ class VsrReplica(Replica):
                 self.commit_max = max(self.commit_max, commit_op)
                 out.extend(self._request_missing())
                 return out
-            if mine is None and self.missing.get(commit_op, want) != want:
+            if mine is None and self.missing.get(commit_op, want) != want \
+                    and self._anchor_trusted(commit_op, want):
                 # A forged frame polluted the repair target for this op;
                 # the source-authenticated anchor corrects it (honest runs
                 # already record the canonical checksum — this is a no-op
@@ -1141,6 +1302,33 @@ class VsrReplica(Replica):
             for o in [o for o in self._anchors if o < self.commit_min]:
                 del self._anchors[o]
 
+    def _anchor_trusted(self, op: int, checksum: int) -> bool:
+        """May this anchor EVICT journaled content / pin repair targets?
+
+        Legacy (auth off): yes — anchors are source-authenticated by the
+        transport, and the byzantine fault domain models only Byzantine
+        BACKUPS, so a commit heartbeat's anchor is honest by assumption.
+
+        Under strict wire auth the primary SEAT itself is in the threat
+        model: its forged heartbeat carries a perfectly valid own-key MAC,
+        and a bare anchor must not be able to evict an honest journaled
+        prepare (whose ack may already have let the cluster commit it —
+        the quorum_journal violation the tbmc byzantine-primary scope
+        found).  Destructive anchor actions therefore additionally require
+        a REPLICATION QUORUM of MAC-verified acks for the anchored
+        checksum: every honest anchor has one (the preparing primary's
+        attestation plus the backups that acked — all broadcast under
+        strict mode), while a Byzantine primary can muster only its own
+        vote for a fork it invented."""
+        if self.auth is None or not self.auth_strict:
+            return True
+        voters = self._ack_certs.get(op, {}).get(checksum)
+        if voters is not None and len(voters) >= self.quorum_replication:
+            return True
+        if _obs.enabled:
+            _obs.counter("auth.rejected.unsupported_anchor").inc()
+        return False
+
     def _content_certified(self, op: int) -> bool:
         """True iff the journaled content at ``op`` parent-chains up to a
         source-authenticated anchor (see _anchors).  Walking DOWN from the
@@ -1157,6 +1345,11 @@ class VsrReplica(Replica):
             if h is None:
                 continue
             if wire.header_checksum(h) != self._anchors[a]:
+                if not self._anchor_trusted(a, self._anchors[a]):
+                    # Vote-unsupported anchor conflicting with our journal:
+                    # the anchor itself is the suspect (Byzantine primary
+                    # seat) — never certify through it, never evict for it.
+                    continue
                 self.byzantine_detections += 1
                 if _obs.enabled:
                     _obs.counter("byzantine.equivocation_detected").inc()
@@ -1171,6 +1364,8 @@ class VsrReplica(Replica):
                     return False  # header gap: repair must fill first
                 parent = wire.u128(hk, "parent")
                 if wire.header_checksum(below) != parent:
+                    if not self._anchor_trusted(k - 1, parent):
+                        return False
                     self.byzantine_detections += 1
                     if _obs.enabled:
                         _obs.counter(
@@ -1282,6 +1477,20 @@ class VsrReplica(Replica):
                 # anchor.  Waiting costs at most one commit-heartbeat
                 # interval in honest runs; executing early is how a forged
                 # relayed prepare becomes committed state.
+                break
+            if (
+                self.auth is not None and self.auth_strict
+                and "cert_downgrade" not in self.mc_mutations
+                and self.replica_count > 1 and not self.is_primary
+                and not self._ack_certified(op)
+            ):
+                # AUTHENTICATED CERTIFICATES (auth_strict): anchors alone
+                # are not proof against a lying PRIMARY — its own-key
+                # heartbeat MAC verifies, so it can anchor forked content.
+                # Execution additionally requires _cert_quorum() distinct
+                # MAC-verified acks naming this exact checksum; quorum
+                # intersection plus the honest one-vote-per-op rule makes
+                # a second certificate for different content impossible.
                 break
             read = self.journal.read_prepare(op)
             if read is None or wire.header_checksum(read[0]) != (
@@ -1415,6 +1624,8 @@ class VsrReplica(Replica):
         return out
 
     def on_start_view_change(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         view = int(h["view"])
         if view < self.view or self.replica_count == 1:
             return []
@@ -1495,6 +1706,8 @@ class VsrReplica(Replica):
         return [self.headers[o] for o in ops]
 
     def on_do_view_change(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         view = int(h["view"])
         if view < self.view:
             return []
@@ -1801,6 +2014,8 @@ class VsrReplica(Replica):
     def on_start_view(self, h: np.ndarray, body: bytes) -> List[Msg]:
         """Backup installs the new view's canonical log
         (replica.zig on_start_view :1702+)."""
+        if not self._ingress_auth(h):
+            return []
         # A nonce-carrying SV is a response to a request_start_view: accept
         # it only if it answers OUR outstanding request (unsolicited
         # broadcasts carry nonce 0 and pass).
@@ -1905,6 +2120,8 @@ class VsrReplica(Replica):
         return [(("replica", view % self.replica_count), wire.encode(req))]
 
     def on_request_start_view(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         if self.status != NORMAL or not self.is_primary:
             return []
         if int(h["view"]) > self.view:
@@ -1936,6 +2153,8 @@ class VsrReplica(Replica):
         return out
 
     def on_request_prepare(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         op = int(h["op"]) if "op" in h.dtype.names else int(h["prepare_op"])
         checksum = wire.u128(h, "prepare_checksum")
         read = self.journal.read_prepare(op)
@@ -1972,6 +2191,8 @@ class VsrReplica(Replica):
         was never quorum-journaled — so it never committed — and the
         canonical suffix truncates at it instead of wedging the view
         change forever (vsr.zig nack protocol; VOPR seed 10133)."""
+        if not self._ingress_auth(h):
+            return []
         op = int(h["prepare_op"])
         checksum = wire.u128(h, "prepare_checksum")
         if int(h["view"]) != self.view:
@@ -2027,6 +2248,8 @@ class VsrReplica(Replica):
         return []
 
     def on_request_headers(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         op_min, op_max = int(h["op_min"]), int(h["op_max"])
         selected = [
             self.headers[o]
@@ -2045,6 +2268,8 @@ class VsrReplica(Replica):
 
     def on_headers(self, h: np.ndarray, body: bytes) -> List[Msg]:
         """Merge repair headers: adopt chained extensions of our log."""
+        if not self._ingress_auth(h):
+            return []
         try:
             headers = wire.unpack_headers(body)
         except ValueError:
@@ -2086,6 +2311,12 @@ class VsrReplica(Replica):
                 if a in certified:
                     continue
                 if self._anchors.get(a) != wire.header_checksum(by_op[a]):
+                    continue
+                if not self._anchor_trusted(a, self._anchors[a]):
+                    # Byzantine-primary defense: an anchor without a
+                    # replication quorum of MAC-verified votes certifies
+                    # nothing — it may be the adversary's own forged
+                    # heartbeat vouching for its own forged headers.
                     continue
                 k = a
                 while k in by_op:
@@ -2129,6 +2360,20 @@ class VsrReplica(Replica):
             if op == self.op + 1 and wire.u128(ch, "parent") == (
                 self.parent_checksum
             ):
+                if self.ingress_verify and op not in certified:
+                    # PR 6 gap, closed: a single unauthenticated headers
+                    # frame could still PROPOSE repair targets — extending
+                    # our head and pinning `missing[op]` to a checksum no
+                    # honest peer can serve.  Repair-target selection now
+                    # routes exclusively through the anchor-certified set;
+                    # an uncertified extension waits for the next commit
+                    # heartbeat to anchor it (one heartbeat of latency in
+                    # honest runs, never a wedge).
+                    if _obs.enabled:
+                        _obs.counter(
+                            "byzantine.rejected.uncertified_extension"
+                        ).inc()
+                    continue
                 self.headers[op] = ch
                 self.missing[op] = wire.header_checksum(ch)
                 self.op = op
@@ -2159,7 +2404,8 @@ class VsrReplica(Replica):
         if self.ingress_verify and op - 1 > self.commit_min:
             below = self.headers.get(op - 1)
             parent = wire.u128(h, "parent")
-            if below is not None and wire.header_checksum(below) != parent:
+            if below is not None and wire.header_checksum(below) != parent \
+                    and self._anchor_trusted(op - 1, parent):
                 self.byzantine_detections += 1
                 if _obs.enabled:
                     _obs.counter("byzantine.equivocation_detected").inc()
@@ -2238,6 +2484,8 @@ class VsrReplica(Replica):
         return self._request_block()
 
     def on_request_blocks(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         kind = _BLOCK_KIND_NAME.get(int(h["block_kind"]))
         if kind is None:
             return []
@@ -2270,6 +2518,8 @@ class VsrReplica(Replica):
         return [(("replica", int(h["replica"])), wire.encode(resp, chunk))]
 
     def on_block(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         br = self._block_repair
         if br is None and self._cold_fetch is not None:
             return self._on_cold_block(h, body)
@@ -2468,6 +2718,8 @@ class VsrReplica(Replica):
         return [(("replica", target), wire.encode(req))]
 
     def on_request_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         checkpoint_op = int(h["checkpoint_op"])
         offset = int(h["offset"])
         # checkpoint_op 0 = "whatever is latest" (block-repair fallback:
@@ -2503,6 +2755,8 @@ class VsrReplica(Replica):
         return [(("replica", int(h["replica"])), wire.encode(resp, chunk))]
 
     def on_sync_checkpoint(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         if self.sync_target is None:
             return []
         if self.sync_target.get("mode", "full") != "full":
@@ -2590,6 +2844,8 @@ class VsrReplica(Replica):
         return [(("replica", self._sync_responder()), wire.encode(req))]
 
     def on_request_sync_roots(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         if self.op_checkpoint == 0 or not getattr(
             self.machine, "merkle_enabled", False
         ):
@@ -2619,6 +2875,8 @@ class VsrReplica(Replica):
                  wire.encode(resp, pack.roots_body))]
 
     def on_sync_roots(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         from . import checkpoint as ckpt_mod
         from . import statesync
 
@@ -2810,6 +3068,8 @@ class VsrReplica(Replica):
         return self._sync_finalize()
 
     def on_request_sync_subtree(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         from . import statesync
         from .checksum import checksum as _checksum
 
@@ -2874,6 +3134,8 @@ class VsrReplica(Replica):
         return [(requester, wire.encode(resp, payload))]
 
     def on_sync_subtree(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         from . import statesync
 
         target = self.sync_target
@@ -3239,6 +3501,8 @@ class VsrReplica(Replica):
     # -- clock ----------------------------------------------------------------
 
     def on_ping(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         pong = self._hdr(
             wire.Command.pong,
             ping_timestamp_monotonic=int(h["ping_timestamp_monotonic"]),
@@ -3259,6 +3523,8 @@ class VsrReplica(Replica):
         return out
 
     def on_pong(self, h: np.ndarray, body: bytes) -> List[Msg]:
+        if not self._ingress_auth(h):
+            return []
         ping_mono = int(h["ping_timestamp_monotonic"])
         if int(h["replica"]) < self.replica_count:
             # Standby clocks never affect cluster time (replica.zig:1274).
@@ -3682,7 +3948,8 @@ class VsrReplica(Replica):
         "_log_adopted_op", "byzantine_detections", "_dvc_sent_for",
         "_new_view_pending", "_pending_finish", "_sync_peer", "_rsv_nonce",
         "_repair_rotation", "commit_budget", "commit_budget_stopped",
-        "overload_control", "ingress_verify", "blocks_repaired",
+        "overload_control", "ingress_verify", "auth_strict",
+        "blocks_repaired",
     )
     # Pure-time counters and retry-arm state: behavior-relevant only
     # through WHICH timers are due — which the model checker replaces with
@@ -3698,9 +3965,9 @@ class VsrReplica(Replica):
         "_heartbeat_jitter", "_recovering_since", "_last_tick_mono",
     )
     _MC_CONTAINERS = (
-        "headers", "stash", "missing", "_nacks", "_anchors", "pipeline",
-        "svc_from", "dvc_from", "sessions", "sync_target", "_block_repair",
-        "_cold_fetch", "_sb_state",
+        "headers", "stash", "missing", "_nacks", "_anchors", "_ack_certs",
+        "pipeline", "svc_from", "dvc_from", "sessions", "sync_target",
+        "_block_repair", "_cold_fetch", "_sb_state",
     )
     _MC_TIMEOUTS = (
         "_prepare_timeout", "_vc_timeout", "_rsv_timeout", "_repair_timeout",

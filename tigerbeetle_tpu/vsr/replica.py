@@ -170,6 +170,11 @@ class Replica:
         self.replica = 0
         self.replica_count = 1
         self.standby_count = 0
+        # Wire authentication (vsr/auth.Keychain); None = zero-MAC legacy
+        # wire.  The consensus layer (VsrReplica) adds the strict-mode
+        # policy knobs; the base replica only needs the keychain to stamp
+        # the replies it creates (_commit_prepare).
+        self.auth = None
         # Optional commit observer (testing/auditor.py): called with every
         # committed op's (op, operation, timestamp, body, results, replay)
         # — the simulator's op-ordered reply auditor hooks in here.
@@ -1226,6 +1231,11 @@ class Replica:
         )
         reply_h["replica"] = self.replica
         reply = wire.encode(reply_h, result_body)
+        if self.auth is not None:
+            # Stamp at creation, not egress: the MAC is keyed by the reply's
+            # ORIGIN, so a stored reply re-served verbatim by any peer
+            # (request_reply repair) still verifies under the creator's key.
+            reply = self.auth.stamp(reply)
 
         session = self.sessions.get(client)
         if session is not None:

@@ -122,8 +122,18 @@ _FRAME = [
     ("version", "<u2"),
     ("command", "u1"),
     ("replica", "u1"),
-    ("reserved_frame", "V16"),
+    # Carved from the reference's reserved_frame [16]u8: a keyed-BLAKE2b
+    # MAC over header bytes [16..256) with this field zeroed (vsr/auth.py).
+    # Zero = unauthenticated (the legacy wire, bit-identical).
+    ("mac_lo", "<u8"), ("mac_hi", "<u8"),
 ]
+
+# The MAC's absolute byte range in the 256-byte header.  The header
+# checksum EXCLUDES it (zeroed in the checksum input below), so transports
+# stamp/verify the MAC in place without re-checksumming — and an all-zero
+# MAC leaves every frame byte-identical to the pre-auth wire.
+MAC_OFFSET = 112
+MAC_END = 128
 
 
 def _dtype(tail) -> np.dtype:
@@ -512,6 +522,15 @@ def u128(h: np.ndarray, name: str) -> int:
     return (int(h[name + "_hi"]) << 64) | int(h[name + "_lo"])
 
 
+def checksum_input(header_bytes) -> bytes:
+    """Header-checksum domain: bytes [16..256) with the MAC field zeroed,
+    so the checksum is invariant under MAC stamping/stripping (a zero-MAC
+    frame's domain equals the legacy bytes [16..256) verbatim)."""
+    b = bytearray(header_bytes[:HEADER_SIZE])
+    b[MAC_OFFSET:MAC_END] = bytes(MAC_END - MAC_OFFSET)
+    return bytes(b[16:])
+
+
 def set_checksums(h: np.ndarray, body: bytes = b"") -> np.ndarray:
     """set_checksum_body then set_checksum (message_header.zig:118-127)."""
     h = h.copy()
@@ -519,7 +538,7 @@ def set_checksums(h: np.ndarray, body: bytes = b"") -> np.ndarray:
     cb = checksum(body)
     h["checksum_body_lo"] = cb & 0xFFFF_FFFF_FFFF_FFFF
     h["checksum_body_hi"] = cb >> 64
-    c = checksum(h.tobytes()[16:])
+    c = checksum(checksum_input(h.tobytes()))
     h["checksum_lo"] = c & 0xFFFF_FFFF_FFFF_FFFF
     h["checksum_hi"] = c >> 64
     return h
@@ -527,6 +546,21 @@ def set_checksums(h: np.ndarray, body: bytes = b"") -> np.ndarray:
 
 def header_checksum(h: np.ndarray) -> int:
     return u128(h, "checksum")
+
+
+def header_mac(h: np.ndarray) -> int:
+    """The frame's MAC field (0 = unauthenticated)."""
+    return u128(h, "mac")
+
+
+def stamp_mac(frame: bytes, mac: int) -> bytes:
+    """Rewrite the MAC bytes of an encoded frame in place.  The header
+    checksum excludes them, so the stamped frame still decodes."""
+    return (
+        frame[:MAC_OFFSET]
+        + mac.to_bytes(MAC_END - MAC_OFFSET, "little")
+        + frame[MAC_END:]
+    )
 
 
 def encode(h: np.ndarray, body: bytes = b"") -> bytes:
@@ -542,7 +576,7 @@ def decode_header(buf: bytes) -> Tuple[np.ndarray, Command]:
     if len(buf) < HEADER_SIZE:
         raise WireError("short_header", f"short header: {len(buf)} bytes")
     prefix = np.frombuffer(buf[:HEADER_SIZE], dtype=PREFIX_DTYPE)[0]
-    expected = checksum(buf[16:HEADER_SIZE])
+    expected = checksum(checksum_input(buf))
     if u128(prefix, "checksum") != expected:
         raise WireError("header_checksum", "header checksum mismatch")
     try:
@@ -634,3 +668,10 @@ SOURCE_AUTHENTICATED_COMMANDS = frozenset({
     Command.request_sync_roots, Command.sync_roots,
     Command.request_sync_subtree, Command.sync_subtree,
 })
+
+#: Raw command-byte view of the set above: egress transports peek at
+#: frame byte 110 to decide whether to MAC-stamp, without decoding (and
+#: without Command() raising on an undecodable byte).
+SOURCE_AUTHENTICATED_BYTES = frozenset(
+    int(c) for c in SOURCE_AUTHENTICATED_COMMANDS
+)

@@ -1,0 +1,232 @@
+"""CI auth smoke: prove the authenticated wire end to end, cheaply
+(docs/fault_domains.md "Byzantine primary"; vsr/auth.py; docs/tbmc.md).
+
+In-process (deterministic sim time), four proofs with asserted artifacts:
+
+1. Off-path wire identity — with auth off every frame carries a zero MAC
+   and is BIT-IDENTICAL to the legacy wire (checked against the
+   hand-built golden frames from tests/test_wire_golden.py, which encode
+   the reference layout independently of wire.py), and stamping writes
+   ONLY the reserved MAC carve: stripping the MAC restores the exact
+   legacy bytes and both forms pass full header verification.
+2. Byzantine-primary scope, exhaustively clean — the tbmc adversary
+   (holding ONLY its own key: equivocating prepares, forged own-identity
+   votes, forged anchors, forked SVs/headers/sync) at the acceptance
+   scope (3 replicas, 1 op, byzp_budget=2, depth 14) explores every
+   interleaving with auth ON and finds no safety violation.
+3. Mutation-counterexample proof — each seeded defense knockout
+   (mac_skip, key_confusion, cert_downgrade, equiv_dedup) admits a
+   machine-checked counterexample under a guided prefix; every schedule
+   replays bit-identically (one through the real
+   ``vopr --replay-schedule`` CLI), and NONE reproduces with the defense
+   restored: every layer is load-bearing.
+4. ``auth.*`` metrics — a strict-auth cluster run lands auth.verified in
+   the registry snapshot (dumped to METRICS.json like the other tiers),
+   with zero rejections on an all-honest wire.
+
+Artifact: AUTH_SMOKE.json at the repo root; the ``auth`` tier in
+tools/ci.py records pass/fail in CI_LAST.json.
+
+Usage: python tools/auth_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Guided hunt prefixes (docs/tbmc.md; mirrored in tests/test_auth.py):
+# per-link FIFO queues the forged frames BEHIND the honest prepare X and
+# its attest ok(X) on the r0->r1 link, so both are dropped first.
+PREFIX_FULL = (
+    ("client", 1009, 0),
+    ("deliver", "client", 1009, "replica", 0),
+    ("drop", "replica", 0, "replica", 1),
+    ("drop", "replica", 0, "replica", 1),
+    ("byzp", "equiv_prepare", 1),
+    ("deliver", "replica", 0, "replica", 1),
+    ("byzp", "forge_ok", 0, 1),
+    ("byzp", "forge_ok", 2, 1),
+    ("byzp", "anchor_commit", 1),
+)
+PREFIX_SMALL = PREFIX_FULL[:6] + (("byzp", "anchor_commit", 1),)
+MUTATION_HUNTS = {
+    "mac_skip": (4, 2, PREFIX_FULL),
+    "key_confusion": (4, 2, PREFIX_FULL),
+    "cert_downgrade": (2, 2, PREFIX_SMALL),
+    "equiv_dedup": (4, 0, ()),
+}
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tigerbeetle_tpu.obs.metrics import registry
+    from tigerbeetle_tpu.sim.mc import McScope, check, replay_schedule
+    from tigerbeetle_tpu.vsr import wire
+    from tigerbeetle_tpu.vsr.auth import MAC_BYTES, Keychain
+    from tests.test_wire_golden import (
+        golden_prepare, golden_reply, golden_request,
+    )
+
+    summary: dict = {}
+
+    # -- 1. off-path wire identity vs the hand-built goldens ----------------
+    zero = b"\x00" * MAC_BYTES
+    for name, frame in (
+        ("request", golden_request()),
+        ("prepare", golden_prepare()),
+        ("reply", golden_reply()),
+    ):
+        assert frame[wire.MAC_OFFSET:wire.MAC_END] == zero, (
+            f"golden {name} frame carries a nonzero MAC carve"
+        )
+    kc = Keychain(1, seed=0)
+    commands_checked = 0
+    for command in sorted(wire.SOURCE_AUTHENTICATED_COMMANDS):
+        h = wire.new_header(wire.Command(command), cluster=1, view=1)
+        h["replica"] = 2
+        plain = wire.encode(h, b"")
+        assert plain[wire.MAC_OFFSET:wire.MAC_END] == zero
+        stamped = kc.stamp(plain)
+        assert stamped != plain, "stamp was a no-op"
+        # The carve is the ONLY difference; stripping it restores the
+        # legacy bytes, and both pass full header verification (the
+        # checksum domain excludes the MAC).
+        stripped = (
+            stamped[:wire.MAC_OFFSET] + zero + stamped[wire.MAC_END:]
+        )
+        assert stripped == plain, (
+            f"{wire.Command(command).name}: stamping leaked outside "
+            "the MAC carve"
+        )
+        wire.decode_header(plain)
+        sh = wire.decode_header(stamped)[0]
+        assert kc.verify(sh)
+        commands_checked += 1
+    summary["wire_identity"] = {
+        "goldens_zero_mac": ["request", "prepare", "reply"],
+        "source_authenticated_commands": commands_checked,
+    }
+
+    # -- 2. byzantine-primary scope exhausts clean with auth ON -------------
+    def scope(byzp, drops=0, depth=14, max_states=400_000):
+        return McScope(
+            n_replicas=3, n_clients=1, ops_per_client=1,
+            crash_budget=0, timeout_budget=0, drop_budget=drops,
+            auth=True, byzp_budget=byzp,
+            depth_max=depth, max_states=max_states, seed=0,
+        )
+
+    clean = check(scope(byzp=2), ())
+    assert clean.exhaustive, (
+        f"byz-primary scope hit the state cap at {clean.states} states"
+    )
+    assert clean.violation is None, (
+        f"defended byz-primary scope found a violation: {clean.violation}"
+    )
+    summary["byzp_scope"] = {
+        "states": clean.states,
+        "exhaustive": True,
+        "elapsed_s": round(clean.elapsed_s, 1),
+    }
+
+    # -- 3. every defense knockout yields a replayable counterexample -------
+    knockouts = {}
+    cli_ce_path = None
+    with tempfile.TemporaryDirectory(prefix="tb_auth_smoke_") as tmp:
+        for mutation, (byzp, drops, prefix) in MUTATION_HUNTS.items():
+            rep = check(
+                scope(byzp=byzp, drops=drops, depth=20, max_states=50_000),
+                (mutation,), prefix=prefix,
+            )
+            assert rep.violation is not None, (
+                f"{mutation}: knockout admitted NO counterexample "
+                f"({rep.states} states)"
+            )
+            ce = rep.counterexample()
+            path = os.path.join(tmp, f"ce_{mutation}.json")
+            with open(path, "w") as f:
+                json.dump(ce, f)
+            replay = replay_schedule(path)
+            assert replay["reproduced"] and replay["identical"], (
+                f"{mutation}: counterexample replay diverged: {replay}"
+            )
+            defended = replay_schedule(dict(ce, mutations=[]))
+            assert not defended["reproduced"], (
+                f"{mutation}: defense restored, violation still reproduced"
+            )
+            knockouts[mutation] = {
+                "states": rep.states,
+                "schedule_len": len(ce["schedule"]),
+                "violation": rep.violation["kind"],
+                "replay_identical": True,
+                "defense_replay_reproduced": False,
+            }
+            if cli_ce_path is None:
+                cli_ce_path = path
+
+        # One schedule through the REAL replayer CLI — the cross-check
+        # that the counterexample format is the VOPR's, not a private one.
+        proc = subprocess.run(
+            [sys.executable, "-m", "tigerbeetle_tpu", "vopr",
+             "--replay-schedule", cli_ce_path],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        cli = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert cli["reproduced"] and cli["identical"], cli
+        assert cli["state_key"] == cli["expected_state_key"], cli
+    summary["knockouts"] = knockouts
+    summary["cli_replay"] = {"reproduced": True, "identical": True}
+
+    # -- 4. auth.* series in METRICS.json -----------------------------------
+    import shutil
+
+    from tigerbeetle_tpu.config import TEST_MIN
+    from tigerbeetle_tpu.sim.cluster import SimCluster
+    from tigerbeetle_tpu.sim.network import PacketSimulator
+
+    registry.enable()
+    tmp = tempfile.mkdtemp(prefix="tb_auth_smoke_cluster_")
+    try:
+        cluster = SimCluster(
+            tmp, n_replicas=3, n_clients=1, seed=11,
+            requests_per_client=2, config=TEST_MIN,
+            net=PacketSimulator(seed=12, delay_mean=1, delay_max=6),
+            auth={"strict": True, "seed": 11},
+        )
+        ok = cluster.run_until(
+            lambda: cluster.clients_done() and cluster.converged(),
+            max_ticks=60_000,
+        )
+        assert ok, "strict-auth cluster failed to converge"
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    metrics_path = os.path.join(REPO, "METRICS.json")
+    snap = registry.dump(metrics_path)
+    counters = snap["counters"]
+    assert counters.get("auth.verified", 0) > 0, (
+        f"auth.verified never incremented: {sorted(counters)[:20]}"
+    )
+    assert not any(
+        k.startswith("auth.rejected.") for k in counters
+    ), f"honest strict run rejected frames: {counters}"
+    summary["series"] = sorted(
+        k for k in counters if k.startswith("auth.")
+    )
+
+    out_path = os.path.join(REPO, "AUTH_SMOKE.json")
+    with open(out_path, "w") as f:
+        json.dump({"green": True, **summary}, f, indent=1)
+    print(json.dumps({"green": True, **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

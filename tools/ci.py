@@ -30,6 +30,11 @@ ones green):
   mc           tbmc model-checker smoke (tools/mc_smoke.py): exhaustive-
                clean at the pinned scope, all three protocol mutations
                caught, counterexample replay identity, mc.* metrics
+  auth         authenticated-wire smoke (tools/auth_smoke.py): off-path
+               wire identity vs the goldens, the tbmc Byzantine-primary
+               scope exhaustively clean with auth ON, four defense
+               knockouts each counterexampled + replayed bit-identically,
+               auth.* metrics (AUTH_SMOKE.json)
   integration  subprocess/black-box: TCP servers, cluster e2e, native
                clients, demos, longhaul (includes @slow)
 
@@ -100,7 +105,7 @@ TIERS = {
             "tests/test_cold_consensus.py", "tests/test_storage_direct.py",
             "tests/test_scrub.py", "tests/test_overload.py",
             "tests/test_byzantine.py", "tests/test_mc.py",
-            "tests/test_sync.py",
+            "tests/test_sync.py", "tests/test_auth.py",
         ],
         extra=["-m", "not slow"],
     ),
@@ -207,6 +212,19 @@ TIERS = {
         # METRICS.json.  Artifact: BYZANTINE_SMOKE.json at the repo root.
         cmd=["tools/byzantine_smoke.py"],
     ),
+    "auth": dict(
+        # Authenticated-wire smoke (docs/fault_domains.md "Byzantine
+        # primary"): off-path wire identity vs the hand-built goldens
+        # (zero-MAC legacy bytes, stamping confined to the MAC carve),
+        # the tbmc Byzantine-primary scope exhaustively clean with auth
+        # ON, every seeded defense knockout (mac_skip, key_confusion,
+        # cert_downgrade, equiv_dedup) yielding a counterexample that
+        # replays bit-identically (one through the real
+        # `vopr --replay-schedule`) and dies with the defense restored,
+        # and the auth.* series asserted in METRICS.json.
+        # Artifact: AUTH_SMOKE.json at the repo root.
+        cmd=["tools/auth_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -260,6 +278,12 @@ TIERS = {
             # Byzantine fault kind: the pinned on/off proof pair (slow:
             # two full 6-replica runs under the open-loop workload).
             "tests/test_byzantine.py::TestVoprByzantine",
+            # Byzantine PRIMARY seat (authenticated wire): the pinned
+            # on/off proof pair — auth on contains the equivocating/
+            # fork-serving/lying primary, verification off demonstrably
+            # fails the reply-coherence safety oracle (slow: two full
+            # 6-replica runs).
+            "tests/test_auth.py::TestVoprPrimarySeat",
             # State-sync catch-up: the pinned incremental/forced-fallback/
             # lying-responder/verify-off quartet (slow: four full catch-up
             # sim runs) plus the sharded cold-manifest refusal (slow:
@@ -297,6 +321,35 @@ TIERS = {
             "test_tiered_cluster_converges_with_evictions",
             "tests/test_scan_builder.py::TestPrefixScans::"
             "test_limit_and_window_growth",
+            # Tier-1 budget audit (PR 16): next tranche of slowest tier-1
+            # tests moved to @slow (the suite outgrew the 870s budget);
+            # they run whole here so the full matrix still covers them.
+            "tests/test_cold_tier.py::TestEvictionExactness::"
+            "test_restart_query_includes_cold",
+            "tests/test_scan_path.py::TestSequentialTransfers::"
+            "test_plain_matches_fast_semantics",
+            "tests/test_scan_path.py::TestSequentialTransfers::"
+            "test_random_differential_all_features",
+            "tests/test_scan_builder.py::TestMaintenance::"
+            "test_lazy_index_mode",
+            "tests/test_scan_builder.py::TestPrefixScans::"
+            "test_every_transfer_field",
+            "tests/test_scan_builder.py::TestCompositions::"
+            "test_nested_depth_two",
+            "tests/test_host_engine.py::TestCrossExecutorParity::"
+            "test_digest_parity",
+            "tests/test_host_engine.py::TestGrowthAndQueries::"
+            "test_get_account_transfers_after_engine_commits",
+            "tests/test_cold_consensus.py::"
+            "test_tiered_cluster_crash_restart",
+            "tests/test_vopr.py::"
+            "test_vopr_seed_10056_two_replica_clock_skew",
+            "tests/test_queries.py::TestGetAccountHistory::"
+            "test_history_log_grows_past_capacity",
+            "tests/test_merkle.py::TestMerkleOps::"
+            "test_build_matches_numpy_oracle",
+            "tests/test_balancing_vector.py::TestLinkedChainsWithLimits::"
+            "test_failed_chain_with_limit_member_exact",
         ],
         extra=[],
     ),
@@ -304,7 +357,7 @@ TIERS = {
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
     "scrub", "merkle", "overload", "waves", "sharded", "async",
-    "sanitize", "sync", "byzantine", "mc", "integration",
+    "sanitize", "sync", "byzantine", "mc", "auth", "integration",
 ]
 
 

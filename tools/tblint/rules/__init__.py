@@ -5,6 +5,7 @@ from . import (  # noqa: F401
     donation,
     excepts,
     hostsync,
+    ingress_auth,
     lanerace,
     layout,
     loops,

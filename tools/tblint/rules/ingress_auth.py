@@ -1,0 +1,187 @@
+"""ingress-auth: SOURCE_AUTHENTICATED handlers must MAC-verify first.
+
+Every VSR command whose authority derives from its *origin replica* —
+acks, commit heartbeats, view-change votes, repair/sync responses — is in
+``wire.SOURCE_AUTHENTICATED_COMMANDS`` and carries a keyed-BLAKE2b MAC in
+the reserved header bytes (vsr/auth.py).  The ingress contract is strict:
+an ``on_<command>`` handler for one of those commands must call
+``self._ingress_auth(<header>)`` *before reading anything else out of the
+header or body*.  A handler that consults ``h["view"]`` (or hands the
+frame to a helper) first has already let an unauthenticated field steer
+replica state — exactly the class of bug the Byzantine-primary tbmc scope
+exists to catch, and the one thing a forged frame needs to be useful.
+
+Two findings:
+
+- a source-authenticated ``on_<command>`` handler with NO
+  ``self._ingress_auth(...)`` call at all;
+- one whose header/body parameters are consumed on a line before the
+  verify call (decorators and the ``def`` line itself are exempt).
+
+The command list is duplicated here (a lint tool must not import the
+package it lints — fixture trees mirror the layout with deliberately
+broken files).  ``finalize`` cross-checks the duplicate against the
+``SOURCE_AUTHENTICATED_COMMANDS = frozenset({...})`` literal of any
+scanned ``wire.py``, so drift between the wire contract and this rule is
+itself a finding rather than a silent coverage gap.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..core import FileContext, Finding, ProjectState, Rule, register
+
+#: Mirror of wire.SOURCE_AUTHENTICATED_COMMANDS (see module docstring).
+SOURCE_AUTHENTICATED = frozenset({
+    "ping", "pong",
+    "prepare_ok", "commit",
+    "start_view_change", "do_view_change", "start_view",
+    "request_start_view", "request_headers",
+    "request_prepare", "nack_prepare", "headers",
+    "request_reply", "request_blocks", "block",
+    "request_sync_checkpoint", "sync_checkpoint",
+    "request_sync_roots", "sync_roots",
+    "request_sync_subtree", "sync_subtree",
+})
+
+VERIFY_METHOD = "_ingress_auth"
+
+
+def _is_verify_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == VERIFY_METHOD
+    )
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    """Positional parameter names after ``self`` (the frame: header, body)."""
+    args = [a.arg for a in fn.args.args]
+    if args and args[0] in ("self", "cls"):
+        args = args[1:]
+    return args
+
+
+class _PreVerifyUse(ast.NodeVisitor):
+    """First use of a frame parameter strictly before the verify call."""
+
+    def __init__(self, params: Set[str]) -> None:
+        self.params = params
+        self.verify: Optional[ast.Call] = None
+        self.first_use: Optional[ast.Name] = None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.verify is None and _is_verify_call(node):
+            self.verify = node
+            return  # uses inside the verify call itself are the contract
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if (self.verify is None and self.first_use is None
+                and node.id in self.params):
+            self.first_use = node
+
+
+@register
+class IngressAuthRule(Rule):
+    id = "ingress-auth"
+    summary = ("source-authenticated handler consumes the frame before "
+               "(or without) the MAC-verify call")
+    rationale = (
+        "A forged frame is only useful if some field of it is read before "
+        "authentication; every SOURCE_AUTHENTICATED on_<command> handler "
+        "must gate on self._ingress_auth(h) first."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_py and "vsr" in ctx.parts
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for fn in node.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                if not fn.name.startswith("on_"):
+                    continue
+                if fn.name[3:] not in SOURCE_AUTHENTICATED:
+                    continue
+                self._check_handler(ctx, fn, out)
+        return out
+
+    def _check_handler(self, ctx: FileContext, fn: ast.FunctionDef,
+                       out: List[Finding]) -> None:
+        visitor = _PreVerifyUse(set(_param_names(fn)))
+        for stmt in fn.body:
+            visitor.visit(stmt)
+        if visitor.verify is None:
+            out.append(Finding(
+                self.id, ctx.display_path, fn.lineno, fn.col_offset,
+                f"{fn.name} handles a SOURCE_AUTHENTICATED command but "
+                f"never calls self.{VERIFY_METHOD}(...): a forged frame "
+                "reaches the handler body unchecked",
+            ))
+            return
+        use = visitor.first_use
+        if use is not None:
+            out.append(Finding(
+                self.id, ctx.display_path, use.lineno, use.col_offset,
+                f"{fn.name} reads `{use.id}` before the "
+                f"self.{VERIFY_METHOD}(...) gate (line "
+                f"{visitor.verify.lineno}); verify the MAC first",
+            ))
+
+    # -- drift cross-check against the scanned wire.py ----------------------
+
+    def finalize(self, state: ProjectState) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for ctx in state.contexts:
+            if ctx.basename != "wire.py" or "vsr" not in ctx.parts:
+                continue
+            if ctx.tree is None:
+                continue
+            declared = self._declared_commands(ctx.tree)
+            if declared is None:
+                continue
+            drift = declared ^ SOURCE_AUTHENTICATED
+            if drift:
+                out.append(Finding(
+                    self.id, ctx.display_path, self._decl_line(ctx.tree), 0,
+                    "wire.SOURCE_AUTHENTICATED_COMMANDS drifted from the "
+                    "ingress-auth rule's command list "
+                    f"({', '.join(sorted(drift))}); update "
+                    "tools/tblint/rules/ingress_auth.py so handler "
+                    "coverage tracks the wire contract",
+                ))
+        return out
+
+    def _declared_commands(self, tree: ast.AST) -> Optional[Set[str]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name)
+                       and t.id == "SOURCE_AUTHENTICATED_COMMANDS"
+                       for t in node.targets):
+                continue
+            names: Set[str] = set()
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Attribute) and isinstance(
+                        sub.value, ast.Name) and sub.value.id == "Command":
+                    names.add(sub.attr)
+            return names or None
+        return None
+
+    def _decl_line(self, tree: ast.AST) -> int:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name)
+                and t.id == "SOURCE_AUTHENTICATED_COMMANDS"
+                for t in node.targets
+            ):
+                return node.lineno
+        return 1

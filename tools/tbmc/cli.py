@@ -52,6 +52,17 @@ def main(argv=None) -> int:
     p.add_argument("--drops", type=int, default=0, help="drop budget")
     p.add_argument("--partitions", type=int, default=0,
                    help="partition-toggle budget")
+    p.add_argument("--auth", action="store_true",
+                   help="arm strict source authentication (per-replica "
+                        "MAC keys, certified commits become authenticated "
+                        "certificates; docs/tbmc.md)")
+    p.add_argument("--byzp", type=int, default=0,
+                   help="Byzantine-PRIMARY action budget: the adversary "
+                        "seat forges equivocating/forked frames it can "
+                        "construct from its own key + observed traffic")
+    p.add_argument("--byzp-replica", type=int, default=0,
+                   help="which seat is the Byzantine primary (default 0, "
+                        "the bootstrap primary)")
     p.add_argument("--timeouts", type=int, default=0,
                    help="explicit timer-fire budget (0 = no timer events: "
                         "the default matches the smoke's acceptance "
@@ -76,6 +87,11 @@ def main(argv=None) -> int:
                         "(drops the slow-timer scope assumption; widens "
                         "the scope — mutation hunts use it to reach "
                         "timer-vs-frame races, docs/tbmc.md)")
+    p.add_argument("--prefix", default=None, metavar="FILE",
+                   help="JSON file with a pinned event-schedule prefix "
+                        "(a list of event lists); exploration is then "
+                        "exhaustive FROM the state it reaches — guided "
+                        "hunts for deep scenarios (docs/tbmc.md)")
     p.add_argument("--out", default=None, metavar="FILE",
                    help="write the counterexample schedule JSON here")
     p.add_argument("--allow-capped", action="store_true",
@@ -92,6 +108,9 @@ def main(argv=None) -> int:
         drop_budget=args.drops,
         partition_budget=args.partitions,
         timeout_budget=args.timeouts,
+        auth=args.auth,
+        byzp_budget=args.byzp,
+        byzp_replica=args.byzp_replica,
         timeout_quiescent_only=not args.racy_timers,
         timeout_kinds=(
             tuple(args.timeout_kinds.split(","))
@@ -104,7 +123,11 @@ def main(argv=None) -> int:
         seed=args.seed,
     )
     mutations = tuple(args.mutation or ())
-    report = ModelChecker(scope, mutations).run()
+    prefix = ()
+    if args.prefix:
+        with open(args.prefix) as f:
+            prefix = tuple(tuple(e) for e in json.load(f))
+    report = ModelChecker(scope, mutations, prefix).run()
     summary = {
         "scope": scope.to_json(),
         "mutations": list(mutations),
