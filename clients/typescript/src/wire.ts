@@ -12,6 +12,10 @@ export const MESSAGE_SIZE_MAX = 1 << 20;
 // Shared frame prefix (message_header.zig:17-66).
 export const OFF_CHECKSUM = 0;
 export const OFF_CHECKSUM_BODY = 32;
+// Causal trace id, a u64 carved from the reference's nonce_reserved u128
+// (docs/tracing.md).  Zero = untraced — the legacy wire, byte-identical.
+// It rides inside the header-checksum domain: stamp it before encoding.
+export const OFF_TRACE = 64;
 export const OFF_CLUSTER = 80;
 export const OFF_SIZE = 96;
 export const OFF_EPOCH = 100;
@@ -96,6 +100,8 @@ export interface RequestFields {
   session: bigint;
   request: number;
   operation: number;
+  /** Causal trace id (0n / omitted = untraced; see OFF_TRACE). */
+  trace?: bigint;
 }
 
 /** Build a complete request message (header + body) with both checksums. */
@@ -107,6 +113,7 @@ export function encodeRequest(f: RequestFields, body: Uint8Array): Uint8Array {
   view.setUint8(OFF_COMMAND, Command.request);
   putU128(view, OFF_REQ_PARENT, f.parent);
   putU128(view, OFF_REQ_CLIENT, f.client);
+  if (f.trace) view.setBigUint64(OFF_TRACE, f.trace, true);
   view.setBigUint64(OFF_REQ_SESSION, f.session, true);
   view.setUint32(OFF_REQ_REQUEST, f.request, true);
   view.setUint8(OFF_REQ_OPERATION, f.operation);
@@ -120,6 +127,11 @@ export function encodeRequest(f: RequestFields, body: Uint8Array): Uint8Array {
 /** The header checksum of an encoded message (its wire identity). */
 export function headerChecksum(message: Uint8Array): bigint {
   return getU128(new DataView(message.buffer, message.byteOffset), OFF_CHECKSUM);
+}
+
+/** The frame's causal trace id (0n = untraced — the legacy wire). */
+export function headerTrace(h: DecodedHeader): bigint {
+  return h.view.getBigUint64(OFF_TRACE, true);
 }
 
 export interface DecodedHeader {

@@ -157,6 +157,7 @@ class TestMerkleOps:
 
 
 class TestRootOracle:
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_root_vs_oracle_mixed_stream(self):
         """Maintained roots after plain/zipf/two-phase/linked mixes equal
         the from-scratch numpy oracle, and the results/digest are

@@ -204,6 +204,7 @@ class TestGetAccountHistory:
             check_history_query(dev, ref, f)
         assert len(dev.get_account_history(filt(3))) == 0
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_two_phase_no_history_on_post(self):
         # post/void inserts no history row (state_machine.zig:1391-1498 has
         # no account_history insert); only the pending creation records one.

@@ -109,6 +109,7 @@ class TestSequentialTransfers:
         run_transfers(dev, ref, types.transfers_array(rows))
         check_parity(dev, ref)
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_balance_limits(self):
         dev, ref = make_pair()
         seed(dev, ref, flags={1: int(AF.DEBITS_MUST_NOT_EXCEED_CREDITS),

@@ -257,6 +257,7 @@ def test_sharded_full_kernel_two_phase_parity(mesh):
     assert not np.asarray(ledger.posted.probe_overflow).any()
 
 
+@pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
 def test_sharded_full_kernel_routes_history(mesh):
     """History-flagged accounts route (kflags FLAG_SEQ) with nothing
     applied: the mesh ledger has no history log."""

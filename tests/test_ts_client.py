@@ -36,16 +36,18 @@ def _tag_hex(data: bytes) -> str:
 
 
 def _request_frame(name, *, cluster, client, parent, session, request,
-                   operation, body):
+                   operation, body, trace=0):
     h = wire.new_header(
         wire.Command.request, cluster=cluster, client=client, parent=parent,
         session=session, request=request, operation=operation,
         size=wire.HEADER_SIZE + len(body),
     )
+    if trace:
+        h["trace"] = trace
     return {
         "name": name, "cluster": str(cluster), "client": str(client),
         "parent": str(parent), "session": str(session), "request": request,
-        "operation": operation, "body_hex": body.hex(),
+        "operation": operation, "trace": str(trace), "body_hex": body.hex(),
         "frame_hex": wire.encode(h, body).hex(),
     }
 
@@ -83,6 +85,16 @@ def build_golden() -> dict:
         parent=register_checksum, session=3, request=1,
         operation=int(wire.Operation.create_transfers),
         body=bytes(transfer_row.tobytes()),
+    )
+    # Same request with a nonzero causal trace id (docs/tracing.md): proves
+    # the TS codec stamps bytes [64:72] inside the header-checksum domain
+    # exactly as the Python side does.
+    traced = _request_frame(
+        "create_transfers_traced", cluster=0xA1, client=0xC11E17,
+        parent=register_checksum, session=3, request=1,
+        operation=int(wire.Operation.create_transfers),
+        body=bytes(transfer_row.tobytes()),
+        trace=0xDECAF_C0FFEE_0042,
     )
 
     # A reply frame as the server would build it.
@@ -141,7 +153,7 @@ def build_golden() -> dict:
 
     return {
         "aegis": aegis,
-        "request_frames": [register, create],
+        "request_frames": [register, create, traced],
         "reply_frames": [reply],
         "busy_frames": [busy],
         "eviction_frames": [eviction],
@@ -214,6 +226,7 @@ def test_ts_wire_offsets_match_python():
         "HEADER_SIZE": wire.HEADER_SIZE,
         "OFF_CHECKSUM": req["checksum_lo"],
         "OFF_CHECKSUM_BODY": req["checksum_body_lo"],
+        "OFF_TRACE": req["trace"],
         "OFF_CLUSTER": req["cluster_lo"],
         "OFF_SIZE": req["size"],
         "OFF_EPOCH": req["epoch"],

@@ -1,0 +1,252 @@
+"""Causal tracing + attribution + flight recorder (obs/txtrace.py).
+
+Covers the three coupled pieces of the tracing layer (docs/tracing.md):
+flow sampling/emission (trace ids riding the wire's carved header bytes,
+hops across replica pid rows), the commit-stage attribution ledger
+(stage sums must reconcile against measured wall time on the serial
+path), and the bounded blackbox ring (overwrite semantics, postmortem
+dumps, VOPR failing seeds carrying per-replica history).
+"""
+
+import json
+import time
+
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LedgerConfig
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.obs.txtrace import (
+    REPLICA_PID_BASE,
+    STAGES,
+    Blackbox,
+    dump_blackboxes,
+    parse_sample,
+    txtrace,
+)
+from tigerbeetle_tpu.utils.tracer import tracer
+
+
+@pytest.fixture
+def json_tracer():
+    """Enable the host tracer for a test, always restore + drain after
+    (tracer and txtrace are process-global singletons)."""
+    prev = tracer.backend
+    tracer.enable("json")
+    tracer.drain()
+    try:
+        yield tracer
+    finally:
+        tracer.backend = prev
+        tracer.drain()
+
+
+# -- sampling ----------------------------------------------------------------
+
+
+def test_parse_sample_grammar():
+    assert parse_sample("") == 0
+    assert parse_sample("0") == 0
+    assert parse_sample("1/64") == 64
+    assert parse_sample("64") == 64
+    assert parse_sample(" 1/8 ") == 8
+    # Malformed values read as off, never raise (server import path).
+    assert parse_sample("banana") == 0
+    assert parse_sample("2/64") == 0
+    assert parse_sample("1/") == 0
+
+
+def test_maybe_trace_counter_sampling():
+    with txtrace.sampling_scope(every=3):
+        ids = [txtrace.maybe_trace(key=7) for _ in range(9)]
+    # Every third request is traced, the rest ride the legacy wire.
+    assert sum(1 for t in ids if t) == 3
+    assert all(t == 0 for i, t in enumerate(ids) if (i + 1) % 3)
+    traced = [t for t in ids if t]
+    assert len(set(traced)) == len(traced)  # fresh id per sample
+    assert all(0 < t < 1 << 64 for t in traced)
+
+
+def test_sampling_off_is_zero_and_scope_restores():
+    prev = txtrace.sample_every
+    with txtrace.sampling_scope(every=0):
+        assert txtrace.maybe_trace() == 0
+        assert not txtrace.sampling
+    assert txtrace.sample_every == prev
+
+
+# -- flow emission -----------------------------------------------------------
+
+
+def test_hop_noop_untraced_or_tracer_off(json_tracer):
+    txtrace.hop(0, "client.request", phase="start")  # untraced frame
+    assert json_tracer.drain() == []
+    json_tracer.backend = "none"
+    txtrace.hop(12345, "client.request", phase="start")  # tracer off
+    json_tracer.enable("json")
+    assert json_tracer.drain() == []
+
+
+def test_hop_emits_slice_plus_flow_on_replica_pid(json_tracer):
+    trace = 0xDECAF
+    txtrace.hop(trace, "client.request", phase="start", request=3)
+    txtrace.hop(trace, "replica.prepare", phase="step", replica=1, op=9)
+    txtrace.hop(trace, "client.reply", phase="end")
+    events = json_tracer.drain()
+    slices = [e for e in events if e.get("cat") == "txtrace"]
+    flows = [e for e in events if e.get("cat") == "txflow"]
+    assert [e["name"] for e in slices] == [
+        "client.request", "replica.prepare", "client.reply",
+    ]
+    # Every slice is bound to the chain by the trace id in its args.
+    assert all(int(e["args"]["trace"], 16) == trace for e in slices)
+    # The flow arrows: one s, one t, one f (terminated), same id.
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert all(e["id"] == trace for e in flows)
+    assert flows[-1]["bp"] == "e"
+    # Replica hops land on the synthetic per-replica process row.
+    assert slices[1]["pid"] == REPLICA_PID_BASE + 1
+    assert slices[0]["pid"] != slices[1]["pid"]
+
+
+def test_span_records_real_duration(json_tracer):
+    with txtrace.span(77, "replica.execute", replica=0):
+        time.sleep(0.002)
+    events = json_tracer.drain()
+    sl = [e for e in events if e.get("cat") == "txtrace"]
+    assert len(sl) == 1 and sl[0]["dur"] >= 1_000  # >= 1 ms in us
+
+
+# -- attribution -------------------------------------------------------------
+
+
+def test_stage_ledger_reconciles_against_wall():
+    with txtrace.attribution_scope():
+        t0 = time.perf_counter_ns()
+        for _ in range(3):
+            with txtrace.stage("wal_fsync"):
+                time.sleep(0.004)
+        with txtrace.stage("device_execute"):
+            time.sleep(0.006)
+        wall_us = (time.perf_counter_ns() - t0) / 1e3
+        totals = txtrace.stage_totals()
+    assert totals["wal_fsync"]["count"] == 3
+    assert totals["device_execute"]["count"] == 1
+    attributed = sum(v["us"] for v in totals.values())
+    # The serial path: stage sums reconcile against measured wall time.
+    assert attributed == pytest.approx(wall_us, rel=0.10)
+    assert set(totals) <= set(STAGES)
+
+
+def test_stage_free_when_inactive():
+    assert not txtrace.active
+    with txtrace.stage("device_execute"):
+        pass
+    txtrace.stage_observe("readback", 123.0)  # guard is the CALLER's job
+    with txtrace.attribution_scope() as t:  # reset=True clears any residue
+        assert t.stage_totals() == {}
+
+
+def test_machine_commit_bills_device_execute():
+    cfg = LedgerConfig(
+        accounts_capacity_log2=8, transfers_capacity_log2=10,
+        posted_capacity_log2=8,
+    )
+    m = TpuStateMachine(cfg, batch_lanes=16)
+    accounts = types.accounts_array(
+        [types.account(id=i + 1, ledger=1, code=10) for i in range(4)]
+    )
+    assert m.create_accounts(accounts, wall_clock_ns=1000) == []
+    batch = types.transfers_array([
+        types.transfer(id=100 + i, debit_account_id=1 + i % 4,
+                       credit_account_id=1 + (i + 1) % 4, amount=5,
+                       ledger=1, code=10)
+        for i in range(8)
+    ])
+    m.commit_batch("create_transfers", batch, timestamp=2_000)  # warm up
+    with txtrace.attribution_scope():
+        t0 = time.perf_counter_ns()
+        batch2 = types.transfers_array([
+            types.transfer(id=200 + i, debit_account_id=1 + i % 4,
+                           credit_account_id=1 + (i + 1) % 4, amount=5,
+                           ledger=1, code=10)
+            for i in range(8)
+        ])
+        m.commit_batch("create_transfers", batch2, timestamp=3_000)
+        wall_us = (time.perf_counter_ns() - t0) / 1e3
+        totals = txtrace.stage_totals()
+    # The whole blocking commit routes through ONE device_execute stage
+    # block (XLA-CPU executes the jitted call synchronously inside it).
+    assert totals["device_execute"]["count"] == 1
+    assert 0 < totals["device_execute"]["us"] <= wall_us * 1.05
+
+
+# -- blackbox ----------------------------------------------------------------
+
+
+def test_blackbox_ring_overwrites_oldest():
+    box = Blackbox("r0", cap=8)
+    for i in range(20):
+        box.record("prepare", op=i)
+    assert box.seq == 20
+    snap = box.snapshot()
+    assert len(snap) == 8
+    assert [e["seq"] for e in snap] == list(range(12, 20))
+    assert [e["op"] for e in snap] == list(range(12, 20))
+    text = box.dump_text()
+    assert "20 events recorded, 8 retained (cap 8), 12 lost" in text
+    # One JSON line per retained event after the header.
+    lines = text.strip().split("\n")
+    assert len(lines) == 9
+    assert json.loads(lines[1])["seq"] == 12
+
+
+def test_dump_blackboxes_writes_files(tmp_path):
+    boxes = [Blackbox("r0", cap=4), None, Blackbox("r2", cap=4)]
+    boxes[0].record("commit", op=1)
+    boxes[2].record("view_change", view=2)
+    paths = dump_blackboxes(boxes, str(tmp_path))
+    assert [p.rsplit("/", 1)[1] for p in paths] == [
+        "blackbox_r0.txt", "blackbox_r2.txt",
+    ]
+    body = (tmp_path / "blackbox_r2.txt").read_text()
+    assert "view_change" in body and "# blackbox r2:" in body
+    # Best-effort: unwritable directory yields no paths, never raises.
+    assert dump_blackboxes(boxes, str(tmp_path / "missing" / "nested")) == []
+
+
+# -- VOPR integration --------------------------------------------------------
+
+
+def test_vopr_pinned_seed_green_with_tracing_on(tmp_path, json_tracer):
+    """Tracing every request must not shift a pinned schedule: seed 1's
+    3k-tick run (pinned green in test_vopr.py) stays green with the
+    tracer recording and sampling at 1/1, and the run emits flow
+    events across replica pid rows."""
+    from tigerbeetle_tpu.sim.vopr import EXIT_PASSED, run_seed
+
+    with txtrace.sampling_scope(every=1):
+        result = run_seed(1, workdir=str(tmp_path), ticks=3_000)
+    assert result.exit_code == EXIT_PASSED, result
+    assert result.commits > 0
+    events = json_tracer.drain()
+    flows = [e for e in events if e.get("cat") == "txflow"]
+    assert flows, "traced run emitted no flow events"
+    replica_pids = {
+        e["pid"] for e in events
+        if e.get("cat") == "txtrace" and e["pid"] >= REPLICA_PID_BASE
+    }
+    assert len(replica_pids) >= 2  # chain crosses replica rows
+
+
+def test_vopr_failing_seed_carries_blackboxes(tmp_path):
+    """A failing seed attaches every seat's flight-recorder dump (and
+    the CLI writes them next to the viz grid).  Forced cheaply: too few
+    ticks to converge -> liveness failure."""
+    from tigerbeetle_tpu.sim.vopr import EXIT_PASSED, run_seed
+
+    result = run_seed(3, workdir=str(tmp_path), ticks=40, settle_ticks=1)
+    assert result.exit_code != EXIT_PASSED
+    assert result.blackboxes, "failing seed carried no blackbox dumps"
+    for name, text in result.blackboxes.items():
+        assert text.startswith(f"# blackbox {name}:")

@@ -172,6 +172,7 @@ class TestWavesDifferential:
                            flags=types.TransferFlags.VOID_PENDING_TRANSFER),
         ]))
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_forced_conflict_collapses_to_chain_path(self):
         """Balancing x linked chains: the kernel must still route FLAG_SEQ
         (the sequential chain path) with waves on — and match the model."""

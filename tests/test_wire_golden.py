@@ -16,7 +16,10 @@ Shared frame prefix (message_header.zig:17-66):
      16  checksum_padding       u128
      32  checksum_body          u128
      48  checksum_body_padding  u128
-     64  nonce_reserved         u128
+     64  trace                  u64      (carved from nonce_reserved u128;
+                                          causal trace id, zero = untraced —
+                                          the legacy wire, byte-identical)
+     72  nonce_reserved         u64      (remaining reserved half)
      80  cluster                u128
      96  size                   u32
     100  epoch                  u32
@@ -54,6 +57,7 @@ body checksum is covered.
 import struct
 
 import numpy as np
+import pytest
 
 from tigerbeetle_tpu.vsr import wire
 from tigerbeetle_tpu.vsr.checksum import checksum
@@ -136,7 +140,10 @@ def test_dtype_offsets_match_reference_layout():
     frame_offsets = {
         "checksum_lo": 0, "checksum_hi": 8, "checksum_padding": 16,
         "checksum_body_lo": 32, "checksum_body_hi": 40,
-        "checksum_body_padding": 48, "nonce_reserved": 64,
+        # trace u64 carved from the reference's nonce_reserved u128 (zero =
+        # untraced — the frame bytes are unchanged); rides inside the
+        # header-checksum domain, unlike the MAC.
+        "checksum_body_padding": 48, "trace": 64, "nonce_reserved": 72,
         "cluster_lo": 80, "cluster_hi": 88, "size": 96, "epoch": 100,
         "view": 104, "version": 108, "command": 110, "replica": 111,
         # reserved_frame [16]u8 in the reference; carved into the wire MAC
@@ -218,6 +225,49 @@ def test_golden_reply_frame():
         size=HDR + len(body),
     )
     assert made == golden
+
+
+def test_golden_traced_request_frame():
+    """A nonzero trace id occupies bytes [64:72] and is covered by the
+    header checksum: the hand-built frame (trace packed at the absolute
+    offset, checksummed by _finish) must equal the codec's output."""
+    body = b"\xAB" * 128
+    trace = 0xDECAF_C0FFEE_0042
+    buf = bytearray(HDR)
+    _frame_prefix(buf, cluster=0xBE, size=HDR + len(body), view=7,
+                  command=int(wire.Command.request), replica=0)
+    struct.pack_into("<Q", buf, 64, trace)                # trace
+    _put_u128(buf, 160, 0xC11E17)                         # client
+    struct.pack_into("<Q", buf, 176, 42)                  # session
+    struct.pack_into("<I", buf, 192, 9)                   # request
+    struct.pack_into("B", buf, 196,
+                     int(wire.Operation.create_transfers))
+    golden = _finish(buf, body)
+
+    h = wire.new_header(
+        wire.Command.request, cluster=0xBE, view=7, client=0xC11E17,
+        session=42, request=9,
+        operation=int(wire.Operation.create_transfers),
+        size=HDR + len(body),
+    )
+    h["trace"] = trace
+    made = wire.encode(h, body)
+    assert made == golden
+
+    got, cmd, _ = wire.decode(golden)
+    assert cmd == wire.Command.request
+    assert wire.header_trace(got) == trace
+
+    # Zero-carve identity: the same frame with trace 0 is byte-identical to
+    # the pre-carve golden (which never wrote bytes [64:80]) — corrupting
+    # the trace bytes must also break the header checksum.
+    h["trace"] = 0
+    untraced = wire.encode(h, body)
+    assert untraced[64:80] == b"\x00" * 16
+    assert untraced != golden
+    tampered = golden[:64] + b"\x00" * 8 + golden[72:]
+    with pytest.raises(wire.WireError):
+        wire.decode(tampered)
 
 
 def test_golden_decode_fields():

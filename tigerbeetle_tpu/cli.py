@@ -341,6 +341,19 @@ def _cmd_vopr(args) -> int:
         from .sim.mc import replay_schedule
 
         result = replay_schedule(args.replay_schedule)
+        boxes = result.pop("blackboxes", None) or {}
+        box_paths = []
+        for name, text in sorted(boxes.items()):
+            box_path = f"blackbox_replay_{name}.txt"
+            try:
+                with open(box_path, "w") as f:
+                    f.write(text)
+            except OSError:
+                continue
+            box_paths.append(box_path)
+        if box_paths:
+            print(f"# flight recorders: {', '.join(box_paths)}",
+                  file=sys.stderr)
         print(json.dumps(result))
         if result["error"]:
             print(f"error: replay diverged: {result['error']}",
@@ -544,6 +557,24 @@ def _cmd_vopr(args) -> int:
             tail = result.viz.splitlines()
             for line in tail[:2] + tail[max(2, len(tail) - 20):]:
                 print(f"# {line}", file=sys.stderr)
+        if result.exit_code != 0 and getattr(result, "blackboxes", None):
+            # Per-replica flight-recorder dumps ride next to the viz grid
+            # (docs/tracing.md): the protocol history leading into the
+            # failure, one postmortem file per seat.
+            box_paths = []
+            for name, text in sorted(result.blackboxes.items()):
+                box_path = f"blackbox_{result.seed}_{name}.txt"
+                try:
+                    with open(box_path, "w") as f:
+                        f.write(text)
+                except OSError as err:
+                    print(f"# could not write {box_path}: {err}",
+                          file=sys.stderr)
+                    continue
+                box_paths.append(box_path)
+            if box_paths:
+                print(f"# flight recorders: {', '.join(box_paths)}",
+                      file=sys.stderr)
         worst = max(worst, result.exit_code)
     return worst
 
@@ -614,10 +645,16 @@ def _enable_metrics(path):
             return
         print(f"metrics: wrote snapshot to {path}", file=sys.stderr)
 
-    # Servers are stopped with SIGTERM, whose default handler skips atexit —
-    # the flight recorder must still land its snapshot.  Raising SystemExit
-    # unwinds serve_forever and runs the dump; only installed when nothing
-    # else claimed the signal.
+    _install_sigterm_atexit()
+    return registry
+
+
+def _install_sigterm_atexit() -> None:
+    """Servers are stopped with SIGTERM, whose default handler skips
+    atexit — but every exit-time observability dump (metrics snapshot,
+    TB_TRACE trace, TB_BLACKBOX flight recorder) rides atexit.  Raising
+    SystemExit unwinds serve_forever and runs them; only installed when
+    nothing else claimed the signal.  Idempotent."""
     import signal
 
     def _on_sigterm(signum, frame):
@@ -630,7 +667,24 @@ def _enable_metrics(path):
         pass  # non-main thread or unsupported platform: atexit still covers
               # normal exits
 
-    return registry
+
+def _arm_blackbox(replica) -> None:
+    """Attach the flight recorder (obs/txtrace.Blackbox) when TB_BLACKBOX
+    is set — ``1`` for the default ring, a larger integer for a deeper
+    one — and dump it at process exit, covering crash-path exits
+    (unhandled server faults, KeyboardInterrupt, the SIGTERM handler's
+    atexit re-raise) as well as normal shutdown.  Device-recovery dumps
+    (replica.dump_blackbox) fire independently of this hook."""
+    spec = os.environ.get("TB_BLACKBOX", "")
+    if not spec or spec == "0":
+        return
+    from .obs.txtrace import Blackbox
+
+    cap = int(spec) if spec.isdigit() and int(spec) > 1 else 512
+    replica.blackbox = Blackbox(f"r{replica.replica}", cap=cap)
+    import atexit
+
+    atexit.register(lambda: replica.dump_blackbox("exit"))
 
 
 def _cmd_start(args) -> int:
@@ -642,6 +696,9 @@ def _cmd_start(args) -> int:
     # including warmup's jit compiles — is captured; the atexit dump covers
     # both the serve-forever exit and KeyboardInterrupt.
     _enable_metrics(args.metrics_json)
+    # TB_TRACE / TB_BLACKBOX dumps ride atexit too — a SIGTERM-stopped
+    # server must still land them even without --metrics-json.
+    _install_sigterm_atexit()
 
     if args.overload_control:
         # One knob for every layer (consensus shed points, both buses):
@@ -761,6 +818,7 @@ def _cmd_start(args) -> int:
             replica.auth_strict = (
                 os.environ.get("TB_AUTH_STRICT", "1") != "0"
             )
+        _arm_blackbox(replica)
         replica.machine.warmup()  # compile before announcing readiness
         host = addresses[replica.replica][0]
 
@@ -820,6 +878,7 @@ def _cmd_start(args) -> int:
         )
         return 1
     (host, port), = addresses
+    _arm_blackbox(replica)
     # Compile the commit kernels BEFORE announcing readiness: the first
     # create_transfers otherwise eats the full jit latency inside a client's
     # request timeout window.

@@ -25,6 +25,7 @@ import numpy as np
 
 from . import types
 from .config import ClusterConfig
+from .obs.txtrace import txtrace
 from .vsr import wire
 from .vsr.timeout import Timeout
 
@@ -342,13 +343,25 @@ class Client:
                     session=self.session,
                     operation=int(operation),
                 )
+                # Causal tracing (obs/txtrace.py): a sampled request gets a
+                # nonzero trace id carved into the header; the reply echoes
+                # it and every hop in between joins the Perfetto flow.
+                trace = txtrace.maybe_trace(
+                    self.client_id & 0xFFFF_FFFF_FFFF_FFFF
+                )
+                if trace:
+                    h["trace"] = trace
                 message = wire.encode(h, body)
                 request_checksum = wire.header_checksum(
                     wire.decode_header(message)[0]
                 )
-                _, reply_body = self._roundtrip(
+                txtrace.hop(trace, "client.request", "start",
+                            request=self.request_number)
+                reply_h, reply_body = self._roundtrip(
                     message, request_checksum, deadline
                 )
+                txtrace.hop(trace, "client.reply", "end",
+                            commit=int(reply_h["commit"]))
             except ClientEvicted as err:
                 if err.reason == wire.EVICTION_SESSION_MISMATCH:
                     # Our session number is wrong for a session the server

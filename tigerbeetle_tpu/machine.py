@@ -44,6 +44,7 @@ from . import sanitize as _san
 from . import types
 from .config import LedgerConfig
 from .obs.metrics import registry as _obs
+from .obs.txtrace import txtrace
 from .ops import merkle as merkle_ops
 from .ops import scrub as scrub_ops
 from .ops import state_machine as sm
@@ -221,6 +222,11 @@ class DeviceCommitHandle:
                 t0 = _time.perf_counter()
                 self._result = self._result.result()
                 self.join_wait_s = _time.perf_counter() - t0
+                if txtrace.active:
+                    # FIFO lane queue time — pipeline idle, not commit work.
+                    txtrace.stage_observe(
+                        "dispatch_wait", self.join_wait_s * 1e6
+                    )
                 if _obs.enabled:
                     _obs.histogram(
                         "pipeline.resolve_wait_us", "us"
@@ -230,7 +236,8 @@ class DeviceCommitHandle:
                             "pipeline.shard.resolve_wait_us", "us"
                         ).observe(self.join_wait_s * 1e6)
             codes_dev, overflow_dev = self._result
-            codes, overflow = m._d2h_codes(codes_dev, overflow_dev)
+            codes, overflow = m._d2h_codes(codes_dev, overflow_dev,
+                                           stage="readback")
         except DEVICE_FAULT_TYPES as err:
             # Dispatch-lane funnel: the dispatch (or its readback) failed —
             # quarantine the in-flight pipeline and re-dispatch every
@@ -556,7 +563,7 @@ class TpuStateMachine:
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
             self._bloom_dev = make_bloom(self._bloom_log2)
 
-    def _d2h_codes(self, codes, overflow=None):
+    def _d2h_codes(self, codes, overflow=None, stage=None):
         """The blocking device->host read of a commit's result codes: the
         ONE point every device dispatch funnels through.  Timed so the e2e
         bench can decompose wall time into device-wait vs host work (and
@@ -579,6 +586,13 @@ class TpuStateMachine:
         wait = _time.perf_counter() - t0
         self.disp_wait_s += wait
         self.disp_count += 1
+        if stage is not None and txtrace.active:
+            # Attribution ledger: only EXPLICITLY staged readbacks bill
+            # (the deferred resolve passes stage="readback").  The default
+            # funnel is already inside a device_execute stage block
+            # (commit_batch / the lane closures) — billing its wait again
+            # would double-count the barrier.
+            txtrace.stage_observe(stage, wait * 1e6)
         if _obs.enabled:
             _obs.counter("ops.dispatch").inc()
             _obs.histogram("ops.dispatch_wait_us", "us").observe(wait * 1e6)
@@ -1158,20 +1172,23 @@ class TpuStateMachine:
         if self._merkle_rebuild_if_dirty():
             return  # the rebuild already reflects this batch
         if operation == "create_accounts":
-            lo, hi = self._merkle_pad(
-                batch["id_lo"].astype(np.uint64),
-                batch["id_hi"].astype(np.uint64),
-                self._MERKLE_MIN_LANES,
-            )
-            if self._ledger_is_sharded:
-                self._merkle_forest = self._merkle_steps()["update_accounts"](
-                    self._merkle_forest, self._ledger, lo, hi
+            with txtrace.stage("merkle_refresh"):
+                lo, hi = self._merkle_pad(
+                    batch["id_lo"].astype(np.uint64),
+                    batch["id_hi"].astype(np.uint64),
+                    self._MERKLE_MIN_LANES,
                 )
-            else:
-                self._merkle_forest = merkle_ops.update_accounts(
-                    self._merkle_forest, self.ledger, lo, hi,
-                    max_probe=sm.MAX_PROBE,
-                )
+                if self._ledger_is_sharded:
+                    self._merkle_forest = (
+                        self._merkle_steps()["update_accounts"](
+                            self._merkle_forest, self._ledger, lo, hi
+                        )
+                    )
+                else:
+                    self._merkle_forest = merkle_ops.update_accounts(
+                        self._merkle_forest, self.ledger, lo, hi,
+                        max_probe=sm.MAX_PROBE,
+                    )
             self.merkle_updates += 1
             if _obs.enabled:
                 _obs.counter("merkle.updates").inc()
@@ -1188,6 +1205,10 @@ class TpuStateMachine:
             return
         if self._merkle_rebuild_if_dirty():
             return
+        with txtrace.stage("merkle_refresh"):
+            self._merkle_update_transfers_apply(batches)
+
+    def _merkle_update_transfers_apply(self, batches) -> None:
         ids_lo = np.concatenate([b["id_lo"] for b in batches])
         ids_hi = np.concatenate([b["id_hi"] for b in batches])
         dr_lo = np.concatenate([b["debit_account_id_lo"] for b in batches])
@@ -1960,9 +1981,15 @@ class TpuStateMachine:
         # Replay/backup path: keep the local prepare clock >= the primary's.
         if timestamp > self.prepare_timestamp:
             self.prepare_timestamp = timestamp
-        if operation == "create_accounts":
-            return self._commit_create_accounts(batch, timestamp)
-        return self._commit_create_transfers(batch, timestamp)
+        # Attribution stage over the WHOLE blocking commit — dispatch +
+        # compute + the readback barrier ("kernel dispatch -> completion",
+        # obs/txtrace.STAGES) — so the ledger is backend-honest: XLA-CPU
+        # executes inside the jitted call, an async backend inside the
+        # _d2h_codes wait; both land here.  Free when attribution is off.
+        with txtrace.stage("device_execute"):
+            if operation == "create_accounts":
+                return self._commit_create_accounts(batch, timestamp)
+            return self._commit_create_transfers(batch, timestamp)
 
     # -- create_accounts -----------------------------------------------------
 
@@ -2445,6 +2472,27 @@ class TpuStateMachine:
             )
         return self._lane
 
+    def _lane_dispatch(self, dispatch, deferred):
+        """Run (deferred=False) or submit (deferred=True) a commit closure,
+        timed as the ``device_execute`` attribution stage: on XLA-CPU the
+        jitted calls compute synchronously inside the closure, on an async
+        backend the closure is the enqueue and the deferred resolve's
+        ``readback`` stage carries the completion wait.  The lane thread's
+        stage observations land in the same process-global ledger."""
+        if not txtrace.active:
+            return (
+                self._dispatch_lane().submit(dispatch) if deferred
+                else dispatch()
+            )
+
+        def staged():
+            with txtrace.stage("device_execute"):
+                return dispatch()
+
+        return (
+            self._dispatch_lane().submit(staged) if deferred else staged()
+        )
+
     # Fixed scan length for the grouped dispatch: ONE jit variant (warmed at
     # startup), groups pad with zero-count batches (the kernel applies
     # nothing for count=0).  An empty step costs ~the kernel's launch-free
@@ -2602,9 +2650,7 @@ class TpuStateMachine:
 
         armed_mirror = self._scrub_mirror is not None
         armed = armed_mirror or self._merkle_forest is not None
-        result = self._dispatch_lane().submit(dispatch) if deferred else (
-            dispatch()
-        )
+        result = self._lane_dispatch(dispatch, deferred)
         handle = DeviceCommitHandle(
             self, result, counts, timestamps, stacked=True, stage=stage,
             # Batch retention feeds mirror recovery re-dispatch; the
@@ -2676,9 +2722,7 @@ class TpuStateMachine:
 
         armed_mirror = self._scrub_mirror is not None
         armed = armed_mirror or self._merkle_forest is not None
-        result = self._dispatch_lane().submit(dispatch) if deferred else (
-            dispatch()
-        )
+        result = self._lane_dispatch(dispatch, deferred)
         handle = DeviceCommitHandle(
             self, result, list(counts), list(timestamps), stacked=True,
             batches=list(batches) if armed_mirror else None,
@@ -2803,7 +2847,7 @@ class TpuStateMachine:
 
         armed_mirror = self._scrub_mirror is not None
         armed = armed_mirror or self._merkle_forest is not None
-        fut = self._dispatch_lane().submit(dispatch)
+        fut = self._lane_dispatch(dispatch, True)
         handle = DeviceCommitHandle(
             self, fut, [count], [timestamp], stacked=False,
             batches=[batch] if armed_mirror else None, deferred=True,

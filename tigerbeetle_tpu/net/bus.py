@@ -24,6 +24,7 @@ from typing import Optional
 import numpy as np
 
 from ..obs.metrics import registry as _obs
+from ..obs.txtrace import txtrace
 from ..vsr import overload, wire
 from ..vsr.replica import Replica
 
@@ -244,10 +245,17 @@ class ReplicaServer:
                 except asyncio.QueueEmpty:
                     break
             observing = self.statsd is not None or _obs.enabled
+            if txtrace.active:
+                now = time.monotonic()
+                for _h, _b, _w, t_enq in group:
+                    if t_enq:
+                        txtrace.stage_observe(
+                            "admission_wait", (now - t_enq) * 1e6
+                        )
             t0 = time.monotonic() if observing else 0.0
             try:
                 replies, fsync = self.replica.on_request_group_pipelined(
-                    [(h, body) for h, body, _w in group],
+                    [(h, body) for h, body, _w, _t in group],
                     deferred_replies=True,
                 )
             except Exception:
@@ -257,7 +265,7 @@ class ReplicaServer:
                 # failover/retry (message_bus.zig terminate discipline).
                 log.exception("group commit failed; dropping %d connections",
                               len(group))
-                for _h, _b, w in group:
+                for _h, _b, w, _t in group:
                     w.close()
                 continue
             if observing:
@@ -289,7 +297,7 @@ class ReplicaServer:
             except Exception:
                 log.exception("group fsync failed; dropping %d connections",
                               len(group))
-                for _h, _b, w in group:
+                for _h, _b, w, _t in group:
                     w.close()
                 return
         if isinstance(replies, concurrent.futures.Future):
@@ -303,14 +311,25 @@ class ReplicaServer:
                     "pipelined group failed; dropping %d connections",
                     len(group),
                 )
-                for _h, _b, w in group:
+                for _h, _b, w, _t in group:
                     w.close()
                 return
-        for (_h, _b, writer), outs in zip(group, replies):
+        t_rel = time.monotonic() if txtrace.active else 0.0
+        for (h, _b, writer, _t), outs in zip(group, replies):
             if writer.is_closing():
                 continue
             for out in outs:
                 writer.write(out)
+            if outs:
+                # The request header's trace rides the reply we just
+                # released (replica._commit_prepare copied it) — close the
+                # server half of the causal chain here.
+                txtrace.hop(int(h["trace"]), "bus.release",
+                            replica=self.replica.replica)
+        if t_rel:
+            txtrace.stage_observe(
+                "reply_release", (time.monotonic() - t_rel) * 1e6
+            )
         # Parallel bounded drains: one slow client must not serialize the
         # group, and a client that stops reading is evicted after
         # drain_timeout_ms (the bounded-send-queue discipline; a stuck
@@ -319,7 +338,7 @@ class ReplicaServer:
         timeout = self.process.drain_timeout_ms / 1000.0
         await asyncio.gather(*(
             self._drain_or_evict(writer, timeout)
-            for _h, _b, writer in group
+            for _h, _b, writer, _t in group
             if not writer.is_closing()
         ))
 
@@ -343,7 +362,7 @@ class ReplicaServer:
         every sink reads (obs/metrics).  Both best-effort, off the commit
         path's critical section."""
         events = 0
-        for h, body, _w in group:
+        for h, body, _w, _t in group:
             try:
                 op = wire.Operation(int(h["operation"]))
                 if op in (wire.Operation.create_accounts,
@@ -437,7 +456,15 @@ class ReplicaServer:
                         ))
                         await writer.drain()
                         continue
-                    await self._requests.put((h, body, writer))
+                    txtrace.hop(int(h["trace"]), "bus.ingress",
+                                replica=self.replica.replica,
+                                request=int(h["request"]))
+                    # Enqueue stamp for the admission_wait stage; 0.0 when
+                    # attribution is off (no clock read on the hot path).
+                    t_enq = (
+                        time.monotonic() if txtrace.active else 0.0
+                    )
+                    await self._requests.put((h, body, writer, t_enq))
                     continue
                 for out in self._dispatch(h, command, body):
                     writer.write(out)

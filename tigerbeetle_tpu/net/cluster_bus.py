@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..obs.metrics import registry as _obs
+from ..obs.txtrace import txtrace
 from ..vsr import overload, wire
 from ..vsr.consensus import VsrReplica
 from .bus import (
@@ -349,6 +350,11 @@ class ClusterServer:
                             _obs.histogram(
                                 "net.cluster.batch_events", "events"
                             ).observe(events)
+                if command == wire.Command.request:
+                    # A traced request crossing this replica's TCP ingress
+                    # (no-op when untraced or the tracer is off).
+                    txtrace.hop(int(h["trace"]), "cluster_bus.ingress",
+                                replica=self.index)
                 t0 = time.monotonic()
                 out = self.replica.on_message(h, command, body)
                 dt = time.monotonic() - t0

@@ -6,7 +6,11 @@ VOPR event visualization.
 - ``obs.profile``   ``jax.profiler`` device capture merged with the host
                     tracer's spans into one Chrome/Perfetto trace;
 - ``obs.vopr_viz``  the reference's one-line-per-event cluster status grid
-                    (docs/internals/testing.md) for simulator finds.
+                    (docs/internals/testing.md) for simulator finds;
+- ``obs.txtrace``   end-to-end causal tracing (sampled u64 trace ids carved
+                    into the wire header, cross-replica Perfetto flows),
+                    per-commit-batch stage attribution, and the bounded
+                    per-replica blackbox flight recorder (docs/tracing.md).
 
 Import ``metrics.registry`` for recording; everything is disabled (and near
 zero-cost) until ``TB_METRICS_PATH`` / ``--metrics-json`` / ``enable()``.

@@ -1,0 +1,337 @@
+"""End-to-end causal tracing, commit-stage attribution, black-box recorder.
+
+The third observability layer (docs/tracing.md), three coupled pieces:
+
+**TRACE** — a u64 trace id carved from the reserved header bytes
+(vsr/wire.py's shared frame prefix, offset 64; zero = untraced = the
+legacy wire, bit-identical).  Clients stamp it on a sampled fraction of
+requests (``TB_TRACE_SAMPLE=1/N``); the replica copies it request ->
+prepare -> reply, and every hop on the way — bus ingress, consensus
+prepare/ack/commit, the FIFO dispatch lane, the kernel dispatch, the
+merkle path refresh, the fsync barrier, the reply release — emits a
+cross-process *flow event* into the host tracer's Chrome buffer.  One
+request, one causal chain, across all replicas of a SimCluster or a
+real cluster_bus deployment, readable in Perfetto as connected arrows.
+
+**ATTRIBUTE** — a per-commit-batch stage ledger.  Each commit stage
+(admission_wait, wal_fsync, dispatch_wait, device_execute,
+merkle_refresh, readback, reply_release) reports its duration here;
+durations land in ``txtrace.stage.*`` registry histograms (when the
+registry is on) and accumulate into an in-process total table that
+``bench.py`` surfaces as ``payload.attribution`` — the instrument that
+names the dominant per_batch_us term (ROADMAP item 2's deferred
+commitment lane is tuned against exactly this).
+
+**BLACKBOX** — a bounded per-replica ring of protocol events (command,
+view, op, checksums, queue depths, tick) at one-append cost when
+enabled, dumped to a postmortem artifact on oracle failure,
+``DeviceStateUnrecoverable``, crash-path exits, and on demand.  VOPR
+failing seeds write per-replica dumps next to ``vopr_viz_<seed>.txt``.
+
+Cost discipline (obs/metrics.py's): everything starts OFF.  An untraced
+request pays one attribute load + branch per hop site; stage sites pay
+the same guard before any clock read; a disabled blackbox is ``None``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.tracer import tracer
+from .metrics import registry as _obs
+
+# Synthetic pid base for per-replica rows in the merged Chrome trace: a
+# SimCluster runs every replica in one process, but each replica still
+# gets its own Perfetto process row (and the flow arrows visibly cross
+# them).  Below obs/profile.DEVICE_PID_BASE (1<<20), above real pids'
+# typical range is irrelevant — rows are keyed by exact pid value.
+REPLICA_PID_BASE = 1 << 18
+
+# The commit pipeline's stage vocabulary, in pipeline order.  Attribution
+# blocks and docs/tracing.md list stages in exactly this order.
+STAGES = (
+    "admission_wait",   # request queued at the bus -> group pickup
+    "wal_fsync",        # journal append + fsync barrier
+    "dispatch_wait",    # FIFO dispatch-lane queue time
+    "device_execute",   # kernel dispatch -> completion
+    "merkle_refresh",   # touched-path leaf->root update kernels
+    "readback",         # deferred D2H resolve (codes readback)
+    "reply_release",    # reply encode + release to the wire
+)
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer: cheap, well-distributed u64 ids."""
+    x &= 0xFFFF_FFFF_FFFF_FFFF
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFF_FFFF_FFFF_FFFF
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EB & 0xFFFF_FFFF_FFFF_FFFF
+    return x ^ (x >> 31)
+
+
+def parse_sample(spec: str) -> int:
+    """``TB_TRACE_SAMPLE`` grammar -> sample period N (0 = off).
+
+    Accepts ``1/N`` (one in N), a bare integer ``N`` (same), or
+    empty/``0`` (off).  Malformed values read as off — a typo must not
+    take down a server at import time."""
+    spec = (spec or "").strip()
+    if not spec:
+        return 0
+    try:
+        if "/" in spec:
+            num, den = spec.split("/", 1)
+            if int(num) != 1:
+                return 0
+            return max(0, int(den))
+        return max(0, int(spec))
+    except ValueError:
+        return 0
+
+
+class TxTracer:
+    """Process-global trace-id sampler + flow emitter + stage ledger."""
+
+    def __init__(self) -> None:
+        self.sample_every = parse_sample(os.environ.get("TB_TRACE_SAMPLE", ""))
+        # Attribution accumulation is independent of sampling: bench arms
+        # it for every batch (no sampling) while flow tracing stays off.
+        self.attribution = False
+        self._seq = 0
+        self._lock = threading.Lock()
+        # name -> [count, total_us]; plain dict + lock (stage sites are
+        # hot-path-adjacent, but only ever taken when attribution is on).
+        self._stages: Dict[str, List[float]] = {}
+        self._pids_named: set = set()
+
+    # -- sampling / ids ------------------------------------------------------
+
+    @property
+    def sampling(self) -> bool:
+        return self.sample_every > 0
+
+    @property
+    def active(self) -> bool:
+        """Any stage site should bother reading the clock."""
+        return self.attribution or _obs.enabled
+
+    def maybe_trace(self, key: int = 0) -> int:
+        """Return a fresh nonzero u64 trace id for a sampled request, or 0.
+
+        Sampling is a counter (every Nth request), so ``1/1`` traces
+        everything and a pinned request sequence yields a deterministic
+        id stream; the id itself mixes the sequence with ``key`` (e.g.
+        the client id) so concurrent clients cannot collide."""
+        n = self.sample_every
+        if n <= 0:
+            return 0
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        if seq % n:
+            return 0
+        return _mix64((seq << 20) ^ key) or 1  # force nonzero
+
+    # -- flow events (the causal chain in the merged Chrome trace) -----------
+
+    def _pid_tid(self, replica: Optional[int]):
+        pid = (
+            REPLICA_PID_BASE + replica if replica is not None
+            else os.getpid()
+        )
+        tid = threading.get_ident() & 0xFFFF
+        if replica is not None and pid not in self._pids_named:
+            self._pids_named.add(pid)
+            tracer.emit({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"replica r{replica}"},
+            })
+        return pid, tid
+
+    def hop(self, trace: int, name: str, phase: str = "step",
+            replica: Optional[int] = None, **args) -> None:
+        """One hop of a traced request's causal chain.
+
+        Emits a 1 us slice named ``name`` plus the Chrome flow event
+        (``ph s/t/f`` by ``phase`` start/step/end) that links it to the
+        other hops carrying the same trace id.  No-op when the tracer is
+        off or the frame is untraced (trace == 0)."""
+        if not trace or not tracer.enabled:
+            return
+        pid, tid = self._pid_tid(replica)
+        ts = time.perf_counter_ns() / 1e3
+        args["trace"] = f"{trace:#x}"
+        tracer.emit({
+            "name": name, "ph": "X", "cat": "txtrace",
+            "ts": ts, "dur": 1.0, "pid": pid, "tid": tid, "args": args,
+        })
+        flow = {
+            "name": "tx", "cat": "txflow",
+            "ph": {"start": "s", "step": "t", "end": "f"}[phase],
+            "id": trace, "ts": ts + 0.5, "pid": pid, "tid": tid,
+        }
+        if phase == "end":
+            flow["bp"] = "e"
+        tracer.emit(flow)
+
+    @contextlib.contextmanager
+    def span(self, trace: int, name: str, replica: Optional[int] = None,
+             **args):
+        """A timed slice bound into a traced request's flow (a hop with
+        real duration).  No-op when untraced or the tracer is off."""
+        if not trace or not tracer.enabled:
+            yield
+            return
+        pid, tid = self._pid_tid(replica)
+        start = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            end = time.perf_counter_ns()
+            args["trace"] = f"{trace:#x}"
+            ts = start / 1e3
+            tracer.emit({
+                "name": name, "ph": "X", "cat": "txtrace",
+                "ts": ts, "dur": (end - start) / 1e3,
+                "pid": pid, "tid": tid, "args": args,
+            })
+            tracer.emit({
+                "name": "tx", "cat": "txflow", "ph": "t",
+                "id": trace, "ts": ts + (end - start) / 2e3,
+                "pid": pid, "tid": tid,
+            })
+
+    # -- stage ledger (attribution) ------------------------------------------
+
+    def stage_observe(self, name: str, us: float) -> None:
+        """Record one commit stage duration.  Callers guard on
+        ``txtrace.active`` BEFORE reading any clock (cost discipline)."""
+        if _obs.enabled:
+            _obs.histogram(f"txtrace.stage.{name}", "us").observe(us)
+        if self.attribution:
+            with self._lock:
+                slot = self._stages.get(name)
+                if slot is None:
+                    slot = self._stages[name] = [0, 0.0]
+                slot[0] += 1
+                slot[1] += us
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        """Timed stage block; free (no clock read) when inactive."""
+        if not self.active:
+            yield
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.stage_observe(name, (time.perf_counter_ns() - t0) / 1e3)
+
+    def stage_totals(self) -> Dict[str, dict]:
+        """Accumulated {stage: {count, us}} since the last reset."""
+        with self._lock:
+            return {
+                name: {"count": c, "us": round(us, 1)}
+                for name, (c, us) in sorted(self._stages.items())
+            }
+
+    def reset_stages(self) -> None:
+        with self._lock:
+            self._stages.clear()
+
+    @contextlib.contextmanager
+    def attribution_scope(self, reset: bool = True):
+        """Enable the stage ledger for a block, ALWAYS disable on exit
+        (the registry's enabled_scope discipline — txtrace is
+        process-global too)."""
+        if reset:
+            self.reset_stages()
+        self.attribution = True
+        try:
+            yield self
+        finally:
+            self.attribution = False
+
+    @contextlib.contextmanager
+    def sampling_scope(self, every: int = 1):
+        """Force a sample period for a block (tests/tools), restoring the
+        env-derived value on exit."""
+        prev = self.sample_every
+        self.sample_every = max(0, int(every))
+        try:
+            yield self
+        finally:
+            self.sample_every = prev
+
+
+class Blackbox:
+    """Bounded ring of protocol events: the per-replica flight recorder.
+
+    ``record`` is one slot store + one int add (the sim's hot loop calls
+    it per protocol event); the ring overwrites oldest-first past ``cap``
+    and ``seq`` preserves the true event count, so a dump states exactly
+    how much history was lost."""
+
+    __slots__ = ("name", "cap", "seq", "_ring")
+
+    def __init__(self, name: str, cap: int = 512) -> None:
+        assert cap > 0
+        self.name = name
+        self.cap = cap
+        self.seq = 0
+        self._ring: List[Optional[tuple]] = [None] * cap
+
+    def record(self, event: str, **kw) -> None:
+        self._ring[self.seq % self.cap] = (self.seq, event, kw)
+        self.seq += 1
+
+    def snapshot(self) -> List[dict]:
+        """Retained events, oldest first."""
+        start = max(0, self.seq - self.cap)
+        out = []
+        for i in range(start, self.seq):
+            rec = self._ring[i % self.cap]
+            if rec is None:  # pragma: no cover — ring invariant
+                continue
+            seq, event, kw = rec
+            out.append({"seq": seq, "ev": event, **kw})
+        return out
+
+    def dump_text(self) -> str:
+        """One JSON line per retained event, with a provenance header."""
+        import json as _json
+
+        events = self.snapshot()
+        lost = self.seq - len(events)
+        lines = [
+            f"# blackbox {self.name}: {self.seq} events recorded, "
+            f"{len(events)} retained (cap {self.cap}), {lost} lost",
+        ]
+        lines.extend(_json.dumps(e, default=str) for e in events)
+        return "\n".join(lines) + "\n"
+
+
+def dump_blackboxes(boxes, directory: str, prefix: str = "blackbox") -> list:
+    """Write one ``<prefix>_<name>.txt`` per recorder; returns the paths.
+    Best-effort (postmortem paths must never raise over the original
+    failure): an unwritable directory yields an empty list."""
+    paths = []
+    for box in boxes:
+        if box is None:
+            continue
+        path = os.path.join(directory, f"{prefix}_{box.name}.txt")
+        try:
+            with open(path, "w") as f:
+                f.write(box.dump_text())
+        except OSError:
+            continue
+        paths.append(path)
+    return paths
+
+
+# The process-global tracer (the registry/tracer singleton pattern).
+txtrace = TxTracer()

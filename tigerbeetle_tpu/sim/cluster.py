@@ -31,6 +31,9 @@ import numpy as np
 
 from .. import types
 from ..config import ClusterConfig, LedgerConfig, LEDGER_TEST, TEST_MIN
+from ..obs.txtrace import (
+    Blackbox, dump_blackboxes as _dump_blackboxes, txtrace,
+)
 from ..testing.workload import WorkloadGen
 from ..vsr import wire
 from ..vsr.consensus import NORMAL, VsrReplica
@@ -455,6 +458,16 @@ class SimClient:
             session=self.session,
             operation=int(operation),
         )
+        # Causal trace stamp (docs/tracing.md), same discipline as the
+        # network client: a sampled request carries a nonzero id in the
+        # carved header bytes and the replicas' hops chain onto it.  With
+        # sampling off (every pinned seed's default) this is one attribute
+        # read returning 0 — schedules replay bit-identically.
+        trace = txtrace.maybe_trace(int(self.client_id) & 0xFFFF_FFFF)
+        if trace:
+            h["trace"] = trace
+            txtrace.hop(trace, "client.request", phase="start",
+                        request=self.request_number)
         message = wire.encode(h, body)
         request_checksum = wire.header_checksum(wire.decode_header(message)[0])
         self.inflight = {
@@ -499,6 +512,12 @@ class SimClient:
         if command != wire.Command.reply:
             return
         request_n = int(h["request"])
+        trace = int(h["trace"])
+        if trace:
+            # The reply carries the request's trace id back: this hop
+            # closes the causal chain (flow binding ``f``).
+            txtrace.hop(trace, "client.reply", phase="end",
+                        request=request_n)
         # Coherence oracle: one logical outcome per request number, ever.
         # Identity is (op, body checksum) — a post-view-change primary
         # legitimately re-sends the reply with new view/replica header
@@ -808,6 +827,13 @@ class SimCluster:
         from ..testing.auditor import Auditor
 
         self.auditor = Auditor() if audit else None
+        # Flight recorders (obs/txtrace.Blackbox): one per replica SEAT,
+        # surviving restarts like the disk and the hash logs, so a
+        # postmortem dump carries the protocol history from BEFORE a
+        # crash.  Pure ring appends (no clocks, no behavior change) —
+        # pinned seeds replay bit-identically with the recorder on.
+        self.blackboxes = [Blackbox(f"r{i}", cap=2048)
+                          for i in range(self.total)]
         self.replicas: List[Optional[VsrReplica]] = [None] * self.total
         self.alive = [False] * self.total
         for i in range(self.total):
@@ -891,6 +917,8 @@ class SimCluster:
         )
         # Virtual time: device-recovery backoff must never wall-sleep.
         replica.machine.retry_tick_s = 0
+        # The seat's flight recorder rides across restarts.
+        replica.blackbox = self.blackboxes[i]
         if self.merkle:
             # The VOPR merkle kind IS the mirror-off proof: even at the
             # interval-1 cadence, detection must come from root mismatch
@@ -1287,6 +1315,13 @@ class SimCluster:
     def run(self, ticks: int) -> None:
         for _ in range(ticks):
             self.step()
+
+    def dump_blackboxes(self, directory: str,
+                        prefix: str = "blackbox") -> List[str]:
+        """Write every replica seat's flight-recorder history as
+        ``<prefix>_r<i>.txt`` postmortem artifacts (docs/tracing.md); the
+        VOPR calls this for failing seeds, next to the viz grid."""
+        return _dump_blackboxes(self.blackboxes, directory, prefix=prefix)
 
     # -- oracles --------------------------------------------------------------
 

@@ -1534,9 +1534,16 @@ def replay_schedule(source) -> dict:
                 error = f"{type(err).__name__}: {err}"
                 break
         state_key = harness.canonical_key().hex()
+        # Flight-recorder history of the replayed schedule (the CLI writes
+        # one postmortem file per seat next to its JSON verdict).
+        blackboxes = {
+            box.name: box.dump_text()
+            for box in harness.cluster.blackboxes
+        }
     reproduced = error is None and violation == expected
     identical = reproduced and state_key == data.get("state_key")
     return {
+        "blackboxes": blackboxes,
         "reproduced": reproduced,
         "identical": identical,
         "violation": violation,

@@ -41,6 +41,10 @@ class VoprResult:
     # Rendered status grid (obs/vopr_viz) when the run recorded one —
     # requested via run_seed(viz=True) / --vopr-viz / TB_VOPR_VIZ.
     viz: Optional[str] = None
+    # Per-replica flight-recorder dumps ({name: rendered text}), attached
+    # to FAILING runs only (obs/txtrace.Blackbox; docs/tracing.md) — the
+    # CLI writes them next to vopr_viz_<seed>.txt.
+    blackboxes: Optional[dict] = None
 
 
 def run_seed(
@@ -167,6 +171,14 @@ def run_seed(
             run — shared by every exit path."""
             if cluster.viz is not None:
                 result.viz = cluster.viz.render()
+            if result.exit_code != EXIT_PASSED:
+                # Failing seeds carry every seat's flight-recorder history
+                # (protocol events leading into the failure) so the find
+                # is debuggable without a re-run.
+                result.blackboxes = {
+                    box.name: box.dump_text()
+                    for box in cluster.blackboxes
+                }
             if _obs.enabled:
                 _obs.counter("vopr.seeds").inc()
                 outcome = {

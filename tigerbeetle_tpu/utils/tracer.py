@@ -115,6 +115,19 @@ class Tracer:
                 "args": args,
             })
 
+    def emit(self, event: dict) -> None:
+        """Append one pre-built Chrome trace event (bounded like _emit).
+        The cross-process flow events (``ph`` s/t/f) and per-replica
+        process_name metadata of obs/txtrace.py enter the buffer here —
+        shapes the span helpers above cannot express."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._events) >= self.EVENTS_MAX:
+                self.dropped += 1
+                return
+            self._events.append(event)
+
     def _emit(self, name: str, start_ns: int, end_ns: int, args: dict) -> None:
         with self._lock:
             if len(self._events) >= self.EVENTS_MAX:

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..obs.metrics import registry as _obs
+from ..obs.txtrace import txtrace
 from . import checkpoint as checkpoint_mod
 from . import overload
 from . import wire
@@ -826,8 +827,16 @@ class VsrReplica(Replica):
             # history.  Repair/sync must close the gap first.
             return []
 
+        txtrace.hop(int(h["trace"]), "consensus.ingress",
+                    replica=self.replica, request=request_n)
         prepare_h, prepare_body = self._prepare(h, body, operation)
         op = int(prepare_h["op"])
+        if self.blackbox is not None:
+            self.blackbox.record(
+                "prepare_primary", view=self.view, op=op,
+                checksum=f"{wire.header_checksum(prepare_h):#x}"[:18],
+                pipeline=len(self.pipeline),
+            )
         self.headers[op] = prepare_h
         self.pipeline[op] = PipelineEntry(
             op=op,
@@ -1053,6 +1062,14 @@ class VsrReplica(Replica):
 
         if op == self.op + 1 and wire.u128(h, "parent") == self.parent_checksum:
             self._journal_prepare(h, body)
+            txtrace.hop(int(h["trace"]), "consensus.prepare",
+                        replica=self.replica, op=op)
+            if self.blackbox is not None:
+                self.blackbox.record(
+                    "prepare", view=view, op=op,
+                    checksum=f"{checksum:#x}"[:18],
+                    stash=len(self.stash), missing=len(self.missing),
+                )
             self._append_ok(out, h)
             successor = self._ring_successor()
             if successor is not None and successor != int(h["replica"]):
@@ -1112,6 +1129,8 @@ class VsrReplica(Replica):
             out.append(self._send_prepare_ok(prepare_h))
 
     def _send_prepare_ok(self, prepare_h: np.ndarray) -> Msg:
+        txtrace.hop(int(prepare_h["trace"]), "consensus.ack",
+                    replica=self.replica, op=int(prepare_h["op"]))
         ok = self._hdr(
             wire.Command.prepare_ok,
             parent=wire.u128(prepare_h, "parent"),
@@ -1259,6 +1278,9 @@ class VsrReplica(Replica):
         # execute it.  checksum 0 = unanchored (legacy/pruned): skip.
         want = wire.u128(h, "commit_checksum")
         commit_op = int(h["commit"])
+        if self.blackbox is not None:
+            self.blackbox.record("commit_heartbeat", view=view,
+                                 commit=commit_op)
         if want:
             self._note_anchor(commit_op, want)
         if (
@@ -1498,13 +1520,15 @@ class VsrReplica(Replica):
             ):
                 self.missing[op] = wire.header_checksum(h)
                 break
-            if self._debug_file is not None:
+            if self._debug_file is not None or self.blackbox is not None:
                 self._debug(
                     "commit_op", op=op,
                     operation=int(read[0]["operation"]),
                     prep_view=int(read[0]["view"]),
                     ts=int(read[0]["timestamp"]),
                 )
+            txtrace.hop(int(read[0]["trace"]), "consensus.commit",
+                        replica=self.replica, op=op)
             reply = self._commit_prepare(read[0], read[1], replay=False)
             entry = self.pipeline.pop(op, None)
             if self.is_primary and reply is not None:
@@ -1531,6 +1555,15 @@ class VsrReplica(Replica):
     # -- view change ---------------------------------------------------------
 
     def _debug(self, event: str, **kw) -> None:
+        box = self.blackbox
+        if box is not None:
+            # Every debug-channel event also lands in the flight recorder
+            # (obs/txtrace.Blackbox): the recorder is on in the simulator
+            # even when the debug file is not, so postmortem dumps carry
+            # the protocol history leading into a failure.
+            rec = {"view": self.view, "status": self.status}
+            rec.update(kw)
+            box.record(event, **rec)
         if self._debug_file is None:
             return
         import json as _json
@@ -3554,6 +3587,16 @@ class VsrReplica(Replica):
         out: List[Msg] = []
         if self.clock is not None:
             self.clock.tick()
+        if self.blackbox is not None:
+            # One ring append per tick: the recorder's heartbeat row —
+            # op/commit watermarks and queue depths, the numbers a
+            # postmortem reads first.
+            self.blackbox.record(
+                "tick", t=self._ticks, view=self.view, status=self.status,
+                op=self.op, commit=self.commit_min,
+                stash=len(self.stash), missing=len(self.missing),
+                pipeline=len(self.pipeline),
+            )
         if self.replica_count == 1:
             return out
 

@@ -21,6 +21,7 @@ checkpoint (§3.1 of SURVEY).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -30,6 +31,7 @@ from .. import types
 from ..config import ClusterConfig, LedgerConfig
 from ..machine import DeviceStateUnrecoverable, TpuStateMachine
 from ..obs.metrics import registry as _obs
+from ..obs.txtrace import dump_blackboxes, txtrace
 from ..utils.tracer import tracer
 from . import checkpoint as checkpoint_mod
 from . import wire
@@ -179,6 +181,11 @@ class Replica:
         # committed op's (op, operation, timestamp, body, results, replay)
         # — the simulator's op-ordered reply auditor hooks in here.
         self.commit_observer = None
+        # Optional flight recorder (obs/txtrace.Blackbox): attached by the
+        # CLI server (TB_BLACKBOX), the simulator, and the consensus layer;
+        # None = off (zero cost).  Dumped on device recovery, crash-path
+        # exits, and on demand (dump_blackbox).
+        self.blackbox = None
         # Overlapped checkpointing (single-replica TCP server only; see
         # checkpoint()).  _ckpt_thread holds the in-flight background write;
         # _ckpt_result its finished SuperBlockState until adopted.
@@ -534,7 +541,7 @@ class Replica:
             # loses an op no client was ever answered for.
             prepare_h, prepare_body = self._prepare(header, body, operation,
                                                     sync=False)
-            fsync = self._io_pool_submit(self.journal.sync)
+            fsync = self._io_pool_submit(self._journal_sync_staged)
             reply = self._commit_prepare(prepare_h, prepare_body, replay=False)
             fsync.result()
         else:
@@ -667,6 +674,9 @@ class Replica:
             if last is not None and not last.done():
                 return out, last
             return out, None
+        if self.blackbox is not None:
+            self.blackbox.record("group", n=len(admitted), op=self.op,
+                                 depth=self.pipeline_depth)
         if self.pipeline_depth > 1 and self.hash_log is None:
             return self._commit_group_pipelined(admitted, out,
                                                 deferred_replies)
@@ -685,7 +695,7 @@ class Replica:
                 header, body, operation, sync=False
             )
             prepared.append((i, prepare_h, prepare_body))
-        fsync = self._io_pool_submit(self.journal.sync)
+        fsync = self._io_pool_submit(self._journal_sync_staged)
         self._last_group_fsync = fsync
         runs = self._group_device_runs(prepared)
         precomputed: Dict[int, bytes] = {}
@@ -806,7 +816,7 @@ class Replica:
             j = 0
             while j in runs:
                 run = runs[j]
-                handle = self._dispatch_run(run)
+                handle = self._dispatch_run(run, prepared)
                 if handle is None:
                     break  # refused: its ops execute inline in phase A
                 self._pipeline_track(run, handle, result_bodies, skip)
@@ -814,7 +824,7 @@ class Replica:
         finally:
             for message in messages:
                 self.journal.write_prepare(message, sync=False)
-        fsync = self._io_pool_submit(self.journal.sync)
+        fsync = self._io_pool_submit(self._journal_sync_staged)
         self._last_group_fsync = fsync
 
         def drain(reason: str) -> None:
@@ -839,7 +849,7 @@ class Replica:
                 continue
             run = runs.get(j)
             if run is not None and j != 0:
-                handle = self._dispatch_run(run)
+                handle = self._dispatch_run(run, prepared)
                 if handle is not None:
                     self._pipeline_track(run, handle, result_bodies, skip)
                     continue
@@ -1022,15 +1032,30 @@ class Replica:
                 RuntimeError(f"pipelined group commit failed: {err!r}")
             )
 
-    def _dispatch_run(self, run):
+    def _dispatch_run(self, run, prepared=None):
         """Dispatch one device run deferred; returns a DeviceCommitHandle
         or None (not eligible — the engine executes the ops inline)."""
         machine = self.machine
         batches = [b for _jj, b, _t in run]
         timestamps = [t for _jj, _b, t in run]
         if len(run) == 1:
-            return machine.commit_fast_deferred(batches[0], timestamps[0])
-        return machine.commit_group_fast(batches, timestamps, deferred=True)
+            handle = machine.commit_fast_deferred(batches[0], timestamps[0])
+        else:
+            handle = machine.commit_group_fast(
+                batches, timestamps, deferred=True
+            )
+        if handle is not None and prepared is not None and txtrace.active:
+            # Bind traced ops of this run into their causal chains at the
+            # moment the run enters the FIFO dispatch lane — the deferred
+            # engine's twin of the replica.execute span (docs/tracing.md).
+            for jj, _b, _t in run:
+                trace = int(prepared[jj][1]["trace"])
+                if trace:
+                    txtrace.hop(trace, "replica.dispatch_lane",
+                                replica=self.replica,
+                                op=int(prepared[jj][1]["op"]),
+                                run_len=len(run))
+        return handle
 
     def _group_device_runs(
         self, admitted, single_ok: bool = False
@@ -1099,6 +1124,13 @@ class Replica:
             )
         return self._io_pool.submit(fn)
 
+    def _journal_sync_staged(self):
+        """journal.sync under the ``wal_fsync`` attribution stage — the
+        stage times the durability barrier itself (it runs on the IO pool
+        thread), not the serving thread's wait for it."""
+        with txtrace.stage("wal_fsync"):
+            return self.journal.sync()
+
     def _prepare(
         self, request_h: np.ndarray, body: bytes, operation: wire.Operation,
         sync: bool = True, defer_write: Optional[List[bytes]] = None,
@@ -1133,6 +1165,14 @@ class Replica:
             operation=int(operation),
         )
         h["replica"] = self.replica
+        trace = int(request_h["trace"])
+        if trace:
+            # Sampled request: the trace id rides onto the prepare (and
+            # from there onto the reply), inside the header-checksum
+            # domain — one causal chain per request (obs/txtrace.py).
+            h["trace"] = trace
+            txtrace.hop(trace, "replica.prepare", replica=self.replica,
+                        op=op)
         message = wire.encode(h, body)
         if defer_write is None:
             self.journal.write_prepare(message, sync=sync)
@@ -1184,7 +1224,16 @@ class Replica:
                 t0 = time.perf_counter_ns() if _obs.enabled else 0  # tblint: ignore[nondet] metrics
                 with tracer.span("state_machine_commit", op=op,
                                  operation=operation.name):
-                    result_body = self._execute(operation, body, timestamp)
+                    # The kernel slice of a traced request's causal chain
+                    # (docs/tracing.md): a real-duration span bound into
+                    # the flow — the grouped/deferred engine's twin is the
+                    # replica.dispatch_lane hop (_dispatch_run).
+                    with txtrace.span(int(header["trace"]),
+                                      "replica.execute",
+                                      replica=self.replica, op=op):
+                        result_body = self._execute(
+                            operation, body, timestamp
+                        )
                 if _obs.enabled:
                     _obs.histogram("replica.commit_us", "us").observe(
                         (time.perf_counter_ns() - t0) / 1e3  # tblint: ignore[nondet] metrics
@@ -1230,6 +1279,10 @@ class Replica:
             root=self.machine.commitment_root(),
         )
         reply_h["replica"] = self.replica
+        trace = int(header["trace"])
+        if trace:
+            reply_h["trace"] = trace
+            txtrace.hop(trace, "replica.reply", replica=self.replica, op=op)
         reply = wire.encode(reply_h, result_body)
         if self.auth is not None:
             # Stamp at creation, not egress: the MAC is keyed by the reply's
@@ -1809,6 +1862,20 @@ class Replica:
         except DeviceStateUnrecoverable:
             self.recover_device_state()
 
+    def dump_blackbox(self, reason: str = "on_demand") -> Optional[str]:
+        """Write the flight recorder's retained history next to the data
+        file (postmortem artifact, docs/tracing.md); no-op when no
+        recorder is attached.  Best-effort: a dump must never raise over
+        the failure that triggered it.  Returns the path or None."""
+        box = self.blackbox
+        if box is None:
+            return None
+        box.record("dump", reason=reason, op=self.op,
+                   commit_min=self.commit_min)
+        directory = os.path.dirname(self.data_path) or "."
+        paths = dump_blackboxes([box], directory)
+        return paths[0] if paths else None
+
     def recover_device_state(self) -> None:
         """Last-resort device-state recovery: rebuild the machine from the
         durable checkpoint + WAL replay — the restart recovery path, run
@@ -1823,6 +1890,9 @@ class Replica:
         m = self.machine
         if _obs.enabled:
             _obs.counter("device_recovery.wal_replays").inc()
+        # The flight recorder's reason to exist: dump the retained protocol
+        # history BEFORE the rebuild mutates anything further.
+        self.dump_blackbox("device_recovery")
         prepare_timestamp = m.prepare_timestamp
         m.scrub_disarm()
         m.quarantine()

@@ -114,7 +114,14 @@ _FRAME = [
     ("checksum_padding", "V16"),
     ("checksum_body_lo", "<u8"), ("checksum_body_hi", "<u8"),
     ("checksum_body_padding", "V16"),
-    ("nonce_reserved", "V16"),
+    # Carved from the reference's nonce_reserved u128: a u64 causal trace id
+    # (obs/txtrace.py) stamped on sampled requests and copied onto the
+    # prepare/reply they become, so one id follows a request across every
+    # replica.  Zero = untraced (the legacy wire, bit-identical).  Unlike
+    # the MAC below, the trace rides INSIDE the header-checksum domain: it
+    # is set before encode() and never rewritten in flight.
+    ("trace", "<u8"),
+    ("nonce_reserved", "V8"),
     ("cluster_lo", "<u8"), ("cluster_hi", "<u8"),
     ("size", "<u4"),
     ("epoch", "<u4"),
@@ -551,6 +558,11 @@ def header_checksum(h: np.ndarray) -> int:
 def header_mac(h: np.ndarray) -> int:
     """The frame's MAC field (0 = unauthenticated)."""
     return u128(h, "mac")
+
+
+def header_trace(h: np.ndarray) -> int:
+    """The frame's causal trace id (0 = untraced — the legacy wire)."""
+    return int(h["trace"])
 
 
 def stamp_mac(frame: bytes, mac: int) -> bytes:

@@ -225,6 +225,18 @@ TIERS = {
         # Artifact: AUTH_SMOKE.json at the repo root.
         cmd=["tools/auth_smoke.py"],
     ),
+    "trace": dict(
+        # Causal-tracing smoke (docs/tracing.md): one merged Perfetto
+        # flow per sampled request across >= 3 replica pid rows of a
+        # SimCluster (client.request -> consensus -> replica.execute ->
+        # replica.reply -> client.reply), depth-1 attribution stage sums
+        # reconciling within 10% of measured wall, trace-off
+        # replies/digest identity with sampling at 1/1, and a failing
+        # VOPR seed through the real CLI writing per-replica
+        # flight-recorder dumps next to the viz grid.
+        # Artifacts: TRACE_FLOW.json + TRACE_SMOKE.json at the repo root.
+        cmd=["tools/trace_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -336,6 +348,18 @@ TIERS = {
             "test_every_transfer_field",
             "tests/test_scan_builder.py::TestCompositions::"
             "test_nested_depth_two",
+            # Tier-1 budget audit (PR 17): next tranche of slowest tier-1
+            # tests moved to @slow; they run whole here so the full
+            # matrix still covers them.
+            "tests/test_scan_path.py::TestSequentialTransfers::"
+            "test_balance_limits",
+            "tests/test_merkle.py::TestRootOracle::"
+            "test_root_vs_oracle_mixed_stream",
+            "tests/test_waves.py::TestWavesDifferential::"
+            "test_forced_conflict_collapses_to_chain_path",
+            "tests/test_queries.py::TestGetAccountHistory::"
+            "test_two_phase_no_history_on_post",
+            "tests/test_sharded.py::test_sharded_full_kernel_routes_history",
             "tests/test_host_engine.py::TestCrossExecutorParity::"
             "test_digest_parity",
             "tests/test_host_engine.py::TestGrowthAndQueries::"
@@ -357,7 +381,7 @@ TIERS = {
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
     "scrub", "merkle", "overload", "waves", "sharded", "async",
-    "sanitize", "sync", "byzantine", "mc", "auth", "integration",
+    "sanitize", "sync", "byzantine", "mc", "auth", "trace", "integration",
 ]
 
 

@@ -78,7 +78,9 @@ def _self_attr_touches(fn: ast.FunctionDef,
 
 def _threaded_closures(method: ast.FunctionDef) -> List[ast.FunctionDef]:
     """Nested defs of ``method`` that are handed to another thread:
-    ``<anything>.submit(fn)`` or ``Thread(target=fn)``."""
+    ``<anything>.submit(fn)``, ``Thread(target=fn)``, or the machine's
+    staged lane wrapper ``self._lane_dispatch(fn, ...)`` (which submits
+    ``fn`` to the FIFO dispatch lane when deferred)."""
     nested = {n.name: n for n in ast.walk(method)
               if isinstance(n, ast.FunctionDef) and n is not method}
     if not nested:
@@ -88,7 +90,7 @@ def _threaded_closures(method: ast.FunctionDef) -> List[ast.FunctionDef]:
         if not isinstance(node, ast.Call):
             continue
         name = _terminal_name(node.func)
-        if name == "submit":
+        if name in ("submit", "_lane_dispatch"):
             for arg in node.args:
                 if isinstance(arg, ast.Name) and arg.id in nested:
                     picked.append(nested.pop(arg.id))
