@@ -50,3 +50,23 @@ def suppressed_volatile_shape(batches):
     n = len(batches)
     keys = np.zeros(n, np.uint64)
     return _update(keys, keys)  # tblint: ignore[size-class] one-shot tool path
+
+
+def _pad_to(b, lanes):
+    out = np.zeros(lanes, b.dtype)
+    out[: b.shape[0]] = b
+    return out
+
+
+def fused_dispatch(run):
+    fused = np.concatenate([b for b in run])  # fused width = len(run)
+    return _update(fused, fused)  # BAD: one program per fusion plan
+
+
+def fused_dispatch_splat(keys, run):
+    return _update(jnp.vstack([*run]), keys)  # BAD: splat member list
+
+
+def fused_padded_to_class(self, run):
+    fused = np.concatenate([_pad_to(b, self.batch_lanes) for b in run])
+    return _update(fused, fused)  # clean: lands on the lanes size class

@@ -352,6 +352,7 @@ class TestLinkedChainsWithLimits:
                  ledger=1, code=1),
         ])
 
+    @pytest.mark.slow  # ~13s; runs whole in the ci integration tier
     def test_chain_terminator_balancing_member(self):
         """The TERMINATOR of a chain (linked flag clear) is still a chain
         member: a balancing terminator whose clamp depends on the chain's

@@ -395,6 +395,7 @@ class TestOpenLoopGen:
                     has_resolve = True
         assert has_resolve
 
+    @pytest.mark.slow  # ~13s; runs whole in the ci integration tier
     def test_attach_drives_real_cluster(self, tmp_path):
         cluster = make_cluster(tmp_path, seed=31, clients=1, requests=2)
         gen = OpenLoopGen(31, n_clients=4, hot_accounts=16, rate=0.3,

@@ -171,11 +171,13 @@ class TestPrefixScans:
             value = sorted(values)[len(values) // 2]
             check(m, t_rows, sb.scan_prefix(field, value))
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_absent_value_empty(self, populated):
         m, t_rows, _ = populated
         got = m.scan_transfers(sb.scan_prefix("ledger", 77))
         assert len(got) == 0
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_descending(self, populated):
         m, t_rows, _ = populated
         check(m, t_rows, sb.scan_prefix("code", 20), reversed_=True)
@@ -293,6 +295,7 @@ class TestCompositions:
 
 
 class TestExhaustedFrontier:
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_exhausted_node_does_not_truncate_siblings(self):
         """A merge node whose result set completes early (small exhausted
         leg) must not export its finite window frontier: a parent union
@@ -411,6 +414,7 @@ class TestMaintenance:
         rows = m.lookup_transfers(list(range(100, 190)) + list(range(500, 510)))
         check(m, rows, sb.scan_prefix("code", 20))
 
+    @pytest.mark.slow  # tier-1 budget: runs whole in the ci integration tier
     def test_account_scans(self, populated):
         m, _, stale = populated
         # Re-fetch: the fixture's transfers mutated balances since creation.

@@ -131,6 +131,7 @@ class TestSequentialTransfers:
         run_transfers(dev, ref, types.transfers_array(rows))
         check_parity(dev, ref)
 
+    @pytest.mark.slow  # ~33s; runs whole in the ci integration tier
     def test_balancing_transfers(self):
         dev, ref = make_pair()
         seed(dev, ref)

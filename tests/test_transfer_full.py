@@ -210,6 +210,7 @@ class TestTwoPhaseVectorized:
 
 
 class TestRandomizedDifferential:
+    @pytest.mark.slow  # ~17s/seed; runs whole in the ci integration tier
     @pytest.mark.parametrize("seed", range(8))
     def test_random_two_phase_stream(self, seed):
         rng = np.random.default_rng(seed)
@@ -298,6 +299,7 @@ class TestRandomizedDifferential:
 
 
 class TestGrowth:
+    @pytest.mark.slow  # ~15s; runs whole in the ci integration tier
     def test_table_growth_under_insert_pressure(self):
         """4x the initial capacity inserts complete with zero spurious codes
         (VERDICT.md next-round #5)."""

@@ -207,6 +207,7 @@ class TestWavesDifferential:
         run_batch(dev, ref, chain)
         assert calls, "forced-conflict batch did not take the chain path"
 
+    @pytest.mark.slow  # ~22s; runs whole in the ci integration tier
     def test_waves_on_off_digest_identity(self):
         """Same seeded workload, waves on vs off: identical digests,
         results, and balances (bit-identity, not just code equality)."""
@@ -255,6 +256,7 @@ class TestWaveBound:
             ctx, soa, jnp.uint64(count), jnp.uint64(ts), use_waves=True
         )
 
+    @pytest.mark.slow  # ~25s; runs whole in the ci integration tier
     def test_conflict_free_batch_certifies_bound_one(self):
         led, n = self._setup()
         b = np.zeros(64, dtype=types.TRANSFER_DTYPE)

@@ -550,6 +550,17 @@ class TpuStateMachine:
         self._merkle_dirty = False
         self._merkle_steps_cache = None
         self._canon_tree = None  # (canon ledger ref, {pad name: np heap})
+        # Deferred commitment lane (TB_MERKLE_ASYNC; docs/commitments.md):
+        # touched-row records of committed batches whose leaf->root path
+        # refresh has not run yet.  Drained by merkle_settle() at every
+        # point a maintained root is observed; leaves recompute from
+        # CURRENT table content, so one fused settle is bit-identical to
+        # the per-commit update sequence.  Empty unless the knob is on.
+        self._merkle_async: Optional[bool] = None  # lazy (TB_MERKLE_ASYNC)
+        self._merkle_pending: List[Tuple[str, np.ndarray]] = []
+        # Cross-batch conflict fusion (TB_FUSE; vsr/overload.py): read by
+        # the replica's dispatch lane, lazy like the knobs above.
+        self._fuse_batches: Optional[bool] = None  # lazy (TB_FUSE)
         # Plain-int event counters (read by obs/vopr_viz and tests without
         # the global metrics registry).
         self.scrub_checks = 0
@@ -557,6 +568,7 @@ class TpuStateMachine:
         self.merkle_updates = 0
         self.merkle_rebuilds = 0
         self.merkle_mismatches = 0
+        self.merkle_settles = 0  # commitment-lane drains (TB_MERKLE_ASYNC)
         self.device_recoveries = 0
         self.degraded_to_host_engine = False
         if self._tiering:
@@ -1108,6 +1120,10 @@ class TpuStateMachine:
         else:
             self._merkle_forest = merkle_ops.build_forest(self.ledger)
         self._merkle_dirty = False
+        # A rebuild reads the whole ledger, so it subsumes every queued
+        # deferred-lane touch (TB_MERKLE_ASYNC); stale records would only
+        # re-touch rows idempotently, but dropping them keeps lag honest.
+        self._merkle_pending.clear()
         self.merkle_rebuilds += 1
         if _obs.enabled:
             _obs.counter("merkle.rebuilds").inc()
@@ -1127,6 +1143,7 @@ class TpuStateMachine:
         readback through the commit-barrier funnel ((2, 3) single-device;
         per-shard (n, 2, 3) lanes under TB_SHARDS, which also localize a
         mismatch to one shard)."""
+        self.merkle_settle()  # the scrub oracle observes settled roots only
         self._merkle_rebuild_if_dirty()
         if self._ledger_is_sharded:
             lanes = np.asarray(self._d2h_codes(
@@ -1169,31 +1186,84 @@ class TpuStateMachine:
         device update rides the ledger chain)."""
         if self._merkle_forest is None or len(batch) == 0:
             return
+        if self.merkle_async:
+            # Deferred commitment lane: record the touched rows and let a
+            # settle barrier pay the leaf->root refresh (merkle_settle).
+            self._merkle_lane_enqueue(operation, batch)
+            return
         if self._merkle_rebuild_if_dirty():
             return  # the rebuild already reflects this batch
         if operation == "create_accounts":
-            with txtrace.stage("merkle_refresh"):
-                lo, hi = self._merkle_pad(
-                    batch["id_lo"].astype(np.uint64),
-                    batch["id_hi"].astype(np.uint64),
-                    self._MERKLE_MIN_LANES,
-                )
-                if self._ledger_is_sharded:
-                    self._merkle_forest = (
-                        self._merkle_steps()["update_accounts"](
-                            self._merkle_forest, self._ledger, lo, hi
-                        )
-                    )
-                else:
-                    self._merkle_forest = merkle_ops.update_accounts(
-                        self._merkle_forest, self.ledger, lo, hi,
-                        max_probe=sm.MAX_PROBE,
-                    )
-            self.merkle_updates += 1
-            if _obs.enabled:
-                _obs.counter("merkle.updates").inc()
+            self._merkle_apply_accounts(batch)
         else:
             self._merkle_update_transfers_batches([batch])
+
+    def _merkle_apply_accounts(self, batch: np.ndarray) -> None:
+        with txtrace.stage("merkle_refresh"):
+            lo, hi = self._merkle_pad(
+                batch["id_lo"].astype(np.uint64),
+                batch["id_hi"].astype(np.uint64),
+                self._MERKLE_MIN_LANES,
+            )
+            if self._ledger_is_sharded:
+                self._merkle_forest = (
+                    self._merkle_steps()["update_accounts"](
+                        self._merkle_forest, self._ledger, lo, hi
+                    )
+                )
+            else:
+                self._merkle_forest = merkle_ops.update_accounts(
+                    self._merkle_forest, self.ledger, lo, hi,
+                    max_probe=sm.MAX_PROBE,
+                )
+        self.merkle_updates += 1
+        if _obs.enabled:
+            _obs.counter("merkle.updates").inc()
+
+    def _merkle_lane_enqueue(self, operation: str, batch: np.ndarray) -> None:
+        """Queue one committed batch's touched-row record on the deferred
+        commitment lane (TB_MERKLE_ASYNC).  Batches are immutable after
+        commit, so holding the reference is safe; the queue is
+        serving-thread-only, like _deferred_inflight."""
+        self._merkle_pending.append((operation, batch))
+        if _obs.enabled:
+            _obs.counter("merkle.lane.deferred_updates").inc()
+
+    def merkle_settle(self) -> None:
+        """Settle barrier for the deferred commitment lane: replay every
+        queued touched-row record into the maintained forest, restoring
+        exactly the per-batch refresh sequence the synchronous path would
+        have produced (leaves recompute from current table content, so
+        one coalesced update == the batch-at-a-time sequence).  Runs at
+        every point a maintained root is observed — scrub check,
+        get_proof, reply-root stamping, merkle_roots, checkpoint capture
+        (docs/commitments.md) — and MUST run with the dispatch lane idle:
+        the touched-path update reads self.ledger, which in-flight lane
+        closures swap and donate."""
+        if not self._merkle_pending:
+            return
+        assert self._deferred_inflight == 0, (
+            "merkle_settle with the dispatch lane busy — settle barriers "
+            "run only at drained points"
+        )
+        pending, self._merkle_pending = self._merkle_pending, []
+        if self._merkle_forest is None:
+            return  # disarmed while records were queued: nothing to anchor
+        if _obs.enabled:
+            _obs.counter("merkle.lane.settle_waits").inc()
+            _obs.histogram("merkle.lane.lag_batches", "batches").observe(
+                len(pending)
+            )
+        self.merkle_settles += 1
+        if self._merkle_rebuild_if_dirty():
+            return  # the O(capacity) rebuild subsumes every queued touch
+        for op, batches in merkle_ops.coalesce_touch_records(
+            pending, max_rows=self.batch_lanes
+        ):
+            if op == "create_accounts":
+                self._merkle_apply_accounts(batches[0])
+            else:
+                self._merkle_update_transfers_batches(batches)
 
     def _merkle_update_transfers_batches(self, batches) -> None:
         """ONE touched-path update covering a run of committed
@@ -1268,6 +1338,7 @@ class TpuStateMachine:
         (the replica settles before checks/checkpoints/queries)."""
         if self._merkle_forest is None:
             return None
+        self.merkle_settle()
         self._merkle_rebuild_if_dirty()
         if self._ledger_is_sharded:
             lanes = np.asarray(self._d2h_codes(
@@ -1288,6 +1359,12 @@ class TpuStateMachine:
         forest is clean)."""
         if self._merkle_forest is None:
             return None
+        # Canonical roots derive from the LEDGER, not the maintained
+        # forest, so deferred-lane staleness cannot skew them — but
+        # checkpoint capture is a root-observation point, so settle the
+        # lane here too (when idle) to bound commitment-lane lag.
+        if self._merkle_pending and self._deferred_inflight == 0:
+            self.merkle_settle()
         return merkle_ops.np_ledger_roots(self._query_ledger())
 
     def commitment_root(self) -> int:
@@ -1310,9 +1387,22 @@ class TpuStateMachine:
         a commit point slightly AFTER the op being replied to (the lane
         holds the whole wave): the contract is at-or-after, which a
         get_proof reply — always a group boundary, served from settled
-        state — meets exactly."""
+        state — meets exactly.
+
+        Under TB_MERKLE_ASYNC the same skippable-0 contract covers a
+        backlogged commitment lane: when deferred touch records are
+        queued the reply stamps 0 (clients skip it) rather than a stale
+        root — per-reply stamping must never pull the lane's work onto
+        the serving thread (that would serialize exactly the refresh the
+        deferred lane exists to move off the commit stream).  The HARD
+        settle barriers — scrub check, checkpoint capture, get_proof,
+        state-sync summary — bound the lag and are the points real roots
+        are certified; a get_proof reply (the one clients cross-check)
+        is always served from settled state."""
         if self._merkle_forest is None or self._engine is not None:
             return 0
+        if self._merkle_pending:
+            return 0  # lane backlogged: stamp the skippable sentinel
         self._merkle_rebuild_if_dirty()
         if self._ledger_is_sharded:
             # Cache-fresh check WITHOUT touching _query_ledger() (that
@@ -1367,6 +1457,7 @@ class TpuStateMachine:
             return None
         if kind not in merkle_ops.PROOF_KINDS:
             raise ValueError(f"unknown proof kind {kind!r}")
+        self.merkle_settle()  # proofs anchor to settled roots only
         lo = np.uint64(ident & U64_MAX)
         hi = np.uint64(ident >> 64)
         row_bytes = None
@@ -2438,6 +2529,50 @@ class TpuStateMachine:
         self._waves_enabled = bool(value)
 
     @property
+    def fuse_batches(self) -> bool:
+        """Cross-batch conflict fusion (TB_FUSE env, default OFF; the CLI's
+        --fuse-batches overrides).  Read by the replica's dispatch lane:
+        runs of non-conflicting client batches (vsr/overload.plan_fusion's
+        admission-time conflict index) fuse into one wider padded dispatch
+        on the EXISTING jit size classes.  Off is bit-identical — no
+        signature is computed, every run dispatches exactly as before."""
+        if self._fuse_batches is None:
+            from .vsr import overload
+
+            self._fuse_batches = overload.fusion_enabled()
+        return self._fuse_batches
+
+    @fuse_batches.setter
+    def fuse_batches(self, value: bool) -> None:
+        self._fuse_batches = bool(value)
+
+    @property
+    def merkle_async(self) -> bool:
+        """Deferred commitment lane (TB_MERKLE_ASYNC env, default OFF; the
+        CLI's --merkle-async overrides).  On, committed batches enqueue
+        touched-row records instead of paying the O(batch * log cap)
+        leaf->root refresh inside the dispatch closure; merkle_settle()
+        drains the lane at every point a maintained root is observed
+        (scrub check, get_proof, reply-root stamping, merkle_roots), so
+        roots remain exactly as certified today — they just no longer
+        serialize the commit stream.  Off is bit-identical pre-lane
+        behavior.  No-op unless TB_MERKLE is armed."""
+        if self._merkle_async is None:
+            import os
+
+            self._merkle_async = os.environ.get("TB_MERKLE_ASYNC", "") == "1"
+        return self._merkle_async
+
+    @merkle_async.setter
+    def merkle_async(self, value: bool) -> None:
+        value = bool(value)
+        if not value and self._merkle_async and self._merkle_pending:
+            # Turning the lane off must not strand queued records (callers
+            # toggle at quiescent points: setup, tests, bench arms).
+            self.merkle_settle()
+        self._merkle_async = value
+
+    @property
     def pipeline_depth(self) -> int:
         """Deferred-readback depth (TB_PIPELINE env, default 2; the CLI's
         --pipeline-depth overrides).  Depth 1 disables deferral — every
@@ -2621,6 +2756,11 @@ class TpuStateMachine:
         need = self._transfers_bound + sum(counts)
         for c in counts:
             self._transfers_bound += c
+        # TB_MERKLE_ASYNC: the knob is read ONCE here on the serving
+        # thread — the closure must not re-read it at execute time (a
+        # toggle racing an in-flight lane would split one run's updates
+        # across modes).
+        merkle_closure = self._merkle_forest is not None and not self.merkle_async
 
         def dispatch():
             # Growth + dispatch + index maintenance stay ONE unit so the
@@ -2638,7 +2778,7 @@ class TpuStateMachine:
                 self._index_append_device(
                     id_lo[j], id_hi[j], codes[j], counts[j],
                 )
-            if self._merkle_forest is not None:
+            if merkle_closure:
                 # Commitment updates ride the ledger chain on the lane,
                 # PER BATCH: one key-size class per workload shape, so
                 # variable run lengths never hit fresh jit variants
@@ -2659,6 +2799,11 @@ class TpuStateMachine:
             batches=list(batches) if armed_mirror else None,
             deferred=deferred,
         )
+        if self._merkle_forest is not None and not merkle_closure:
+            # Deferred commitment lane: queue the run's touch records on
+            # the serving thread; settle barriers replay them in order.
+            for b in batches:
+                self._merkle_lane_enqueue("create_transfers", b)
         if deferred:
             self._deferred_submitted(sum(counts))
         if armed:
@@ -2699,6 +2844,8 @@ class TpuStateMachine:
         snap = {name: v.copy()
                 for name, v in self._shard_insert_bounds.items()}
         step = self._shard_steps["fast_probed"]
+        # Knob read once at submit (see commit_group_fast).
+        merkle_closure = self._merkle_forest is not None and not self.merkle_async
 
         def dispatch():
             self._grow_if_needed(transfers_need=need, shard_bounds=snap)
@@ -2712,7 +2859,7 @@ class TpuStateMachine:
                 self._index_append_device(
                     soas[j]["id_lo"], soas[j]["id_hi"], codes, counts[j]
                 )
-                if self._merkle_forest is not None:
+                if merkle_closure:
                     self._merkle_update_transfers_batches([batches[j]])
                 codes_out.append(codes)
                 ovf_out.append(overflow)
@@ -2728,6 +2875,9 @@ class TpuStateMachine:
             batches=list(batches) if armed_mirror else None,
             deferred=deferred,
         )
+        if self._merkle_forest is not None and not merkle_closure:
+            for b in batches:
+                self._merkle_lane_enqueue("create_transfers", b)
         if deferred:
             self._deferred_submitted(total, owner_sum)
         if armed:
@@ -2806,6 +2956,8 @@ class TpuStateMachine:
         # Snapshot the growth target pre-submit (see _grow_if_needed).
         need = self._transfers_bound + count
         self._transfers_bound += count
+        # Knob read once at submit (see commit_group_fast).
+        merkle_closure = self._merkle_forest is not None and not self.merkle_async
         if self._ledger_is_sharded:
             snap = {name: v.copy()
                     for name, v in self._shard_insert_bounds.items()}
@@ -2820,7 +2972,7 @@ class TpuStateMachine:
                 self._index_append_device(
                     soa["id_lo"], soa["id_hi"], codes, count
                 )
-                if self._merkle_forest is not None:
+                if merkle_closure:
                     self._merkle_update_transfers_batches([batch])
                 if _obs.enabled:
                     _obs.counter("sharding.batches").inc()
@@ -2838,7 +2990,7 @@ class TpuStateMachine:
                     sm.create_transfers_fast_probed(self.ledger, soa, cnt, ts)
                 )
                 self._index_append_device(id_lo, id_hi, codes, count)
-                if self._merkle_forest is not None:
+                if merkle_closure:
                     # Commitment update rides the ledger chain; keys come
                     # from the retained HOST batch (the staged SoA was
                     # donated above).
@@ -2852,6 +3004,8 @@ class TpuStateMachine:
             self, fut, [count], [timestamp], stacked=False,
             batches=[batch] if armed_mirror else None, deferred=True,
         )
+        if self._merkle_forest is not None and not merkle_closure:
+            self._merkle_lane_enqueue("create_transfers", batch)
         self._deferred_submitted(count, owners)
         if armed:
             self._inflight_handles.append(handle)

@@ -560,3 +560,41 @@ def _np_combine(left, right) -> np.uint64:
     return mix64_np(
         np.asarray([left], np.uint64), np.asarray([right], np.uint64)
     )[0]
+
+
+# -- deferred commitment lane (TB_MERKLE_ASYNC; machine.merkle_settle) --------
+
+def coalesce_touch_records(records, max_rows: int):
+    """Chunk a deferred-commitment-lane queue into update-sized groups.
+
+    ``records`` is an ordered list of ``(operation, batch)`` touch records
+    queued by committed batches while the lane was deferring the
+    leaf->root refresh.  Yields ``(operation, batches)`` groups where
+    consecutive ``create_transfers`` records coalesce until their summed
+    row count would exceed ``max_rows`` (the machine's batch_lanes — so a
+    settle's padded key classes never exceed the classes the synchronous
+    per-batch path already compiled), and every other operation (account
+    creation) stays a singleton at its original position.
+
+    Order is preserved end to end: an accounts record splits the
+    transfer runs around it exactly where it committed, so replaying the
+    groups reproduces the synchronous refresh sequence (leaves recompute
+    from current table content, making each group idempotent and the
+    coalescing an over-approximation-safe fusion, not a reordering)."""
+    group: list = []
+    rows = 0
+    for op, batch in records:
+        if op == "create_transfers":
+            n = len(batch)
+            if group and rows + n > max_rows:
+                yield ("create_transfers", group)
+                group, rows = [], 0
+            group.append(batch)
+            rows += n
+            continue
+        if group:
+            yield ("create_transfers", group)
+            group, rows = [], 0
+        yield (op, [batch])
+    if group:
+        yield ("create_transfers", group)

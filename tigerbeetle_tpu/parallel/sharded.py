@@ -608,6 +608,15 @@ def sharded_scrub_digest(mesh: Mesh):
 # fold uses.  Pending references resolve through the _ShardGather psum
 # (the pending transfer's row lives on ONE shard; its posted key and
 # account sides must reach THEIR owners).
+#
+# Under TB_MERKLE_ASYNC (docs/commitments.md deferred lane) the update
+# steps below run from machine.merkle_settle() instead of inside each
+# commit closure: the settle drains COALESCED touch records (up to
+# batch_lanes rows per step call) through these same jitted programs —
+# same size classes, same owner-local probe semantics — so the deferred
+# lane composes with sharding with no sharded-specific state.  Settle
+# runs only on a drained dispatch lane (the closures swap/donate the
+# sharded ledger buffers), which the hard barriers guarantee.
 
 
 def merkle_steps(mesh: Mesh) -> Dict[str, object]:

@@ -2889,6 +2889,13 @@ class VsrReplica(Replica):
         want = int(h["checkpoint_op"])
         if want and want != self.op_checkpoint:
             return []
+        # The state-sync summary is checkpoint-derived: capture already ran
+        # behind the settle barrier (machine.merkle_canonical_roots drains
+        # the TB_MERKLE_ASYNC commitment lane before the roots are read),
+        # so a deferred-lane backlog on THIS replica can never skew the
+        # roots a rejoining peer descends against.  Consensus commits are
+        # per-op besides (TB_FUSE never engages here), keeping peer forests
+        # byte-identical — docs/commitments.md composition sections.
         pack = self._sync_pack_for(self.op_checkpoint)
         if pack is None:
             return []

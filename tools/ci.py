@@ -237,6 +237,17 @@ TIERS = {
         # Artifacts: TRACE_FLOW.json + TRACE_SMOKE.json at the repo root.
         cmd=["tools/trace_smoke.py"],
     ),
+    "fusion": dict(
+        # Cross-batch conflict fusion + deferred commitment lane smoke
+        # (docs/commit_pipeline.md fusion section, docs/commitments.md
+        # deferred-lane section): runs bench.py with all four knob arms
+        # (off/fuse/async/both) and asserts every arm is byte-identical
+        # to off, the knob-off pipeline sweep still matches the
+        # PIPELINE_SMOKE pin, a dispatch actually fused wider than one
+        # batch, and the fuse.* / merkle.lane.* series landed in
+        # METRICS.json.  Artifact: FUSION_SMOKE.json at the repo root.
+        cmd=["tools/fusion_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -273,6 +284,39 @@ TIERS = {
             "tests/test_async_sharded.py::test_pipeline_shard_metrics_recorded",
             "tests/test_async_sharded.py::TestReplicaComposition",
             "tests/test_async_sharded.py::TestVoprComposed",
+            # PR 18 tier-1 budget tranche: the next ~150s of slowest
+            # tier-1 tests moved to @slow (scan-path balancing parity,
+            # the waves on/off differential + bound certification, the
+            # randomized two-phase stream, table growth, the open-loop
+            # cluster drive, the linked-chain balancing terminator) —
+            # they run whole here so the full matrix still covers them.
+            "tests/test_scan_path.py::TestSequentialTransfers::"
+            "test_balancing_transfers",
+            "tests/test_waves.py::TestWavesDifferential::"
+            "test_waves_on_off_digest_identity",
+            "tests/test_waves.py::TestWaveBound::"
+            "test_conflict_free_batch_certifies_bound_one",
+            "tests/test_transfer_full.py::TestRandomizedDifferential",
+            "tests/test_transfer_full.py::TestGrowth::"
+            "test_table_growth_under_insert_pressure",
+            "tests/test_byzantine.py::TestOpenLoopGen::"
+            "test_attach_drives_real_cluster",
+            "tests/test_balancing_vector.py::TestLinkedChainsWithLimits::"
+            "test_chain_terminator_balancing_member",
+            "tests/test_scan_builder.py::TestPrefixScans::"
+            "test_absent_value_empty",
+            "tests/test_scan_builder.py::TestPrefixScans::test_descending",
+            "tests/test_scan_builder.py::TestExhaustedFrontier::"
+            "test_exhausted_node_does_not_truncate_siblings",
+            "tests/test_scan_builder.py::TestMaintenance::"
+            "test_account_scans",
+            # Cross-batch fusion + deferred commitment lane (PR 18): the
+            # sharded differential cells (mesh compiles) and the pinned
+            # VOPR seed under TB_FUSE=1 x TB_MERKLE_ASYNC=1 — @slow, so
+            # they run whole here.
+            "tests/test_fusion.py::TestFusionDifferential::"
+            "test_vs_model_and_off_path_sharded",
+            "tests/test_fusion.py::TestVoprFused",
             "tests/test_merkle.py::TestMerkleProofs::test_proof_kinds_sharded",
             "tests/test_block_repair.py::"
             "test_missing_cold_run_repaired_from_peer",
@@ -381,7 +425,8 @@ TIERS = {
 ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
     "scrub", "merkle", "overload", "waves", "sharded", "async",
-    "sanitize", "sync", "byzantine", "mc", "auth", "trace", "integration",
+    "sanitize", "sync", "byzantine", "mc", "auth", "trace", "fusion",
+    "integration",
 ]
 
 

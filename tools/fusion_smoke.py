@@ -1,0 +1,135 @@
+"""CI fusion smoke: prove cross-batch fusion + the deferred commitment
+lane end to end, cheaply (ISSUE 18; docs/commit_pipeline.md fusion
+section, docs/commitments.md deferred-lane section).
+
+Runs ``bench.py`` (subprocess, CPU-pinned) with the pipeline-smoke
+flagship workload PLUS ``--fuse-batches --merkle-async``, then asserts
+the ARTIFACTS, not just the exit code:
+
+1. knob-identity — the fusion sweep's off / fuse / async / both arms
+   must report byte-identical ``replies_sha`` AND ledger digests
+   (``payload.fusion.identity_vs_off``): both knobs are perf-only by
+   contract, and this is the cheap cross-process check that stays true.
+2. off-path pin vs PIPELINE_SMOKE — the same bench process also runs the
+   plain ``--pipeline-depth 1,2`` sweep with the knobs OFF; its depth-1
+   ``replies_sha``/``digest`` must equal the values PIPELINE_SMOKE.json
+   pinned, so merely LOADING the fusion machinery cannot perturb the
+   default path (skipped with a note if the pipeline tier hasn't run).
+3. the fused path actually engaged — the ``both`` arm's ``fuse`` block
+   and METRICS.json must carry ``fuse.fused_runs`` > 0 with
+   ``fuse.fused_width`` max > 1, and the lane series
+   (``merkle.lane.deferred_updates`` / ``merkle.lane.settle_waits`` and
+   the ``merkle.lane.lag_batches`` histogram) must be present — a smoke
+   that never fuses or never defers proves nothing.
+
+Artifacts land at the repo root: METRICS.json (fresh series from this
+run) and FUSION_SMOKE.json (the summary; the fusion tier in tools/ci.py
+records pass/fail in CI_LAST.json).
+
+Usage: python tools/fusion_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+EXPECTED_COUNTERS = (
+    "fuse.fused_runs", "merkle.lane.deferred_updates",
+    "merkle.lane.settle_waits",
+)
+
+
+def main() -> int:
+    summary: dict = {}
+    metrics_path = os.path.join(REPO, "METRICS.json")
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--force-cpu", "--skip-e2e", "--skip-kernel-profile",
+            "--skip-parity",
+            "--transfers", "30000", "--accounts", "256", "--count", "1024",
+            "--pipeline-depth", "1,2",
+            "--fuse-batches", "--merkle-async",
+            "--metrics-json", metrics_path,
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=2400,
+    )
+    sys.stderr.write(proc.stderr[-2000:])
+    assert proc.returncode == 0, f"bench rc={proc.returncode}"
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # 1. knob-identity: every arm byte-identical to off.
+    fusion = payload.get("fusion") or {}
+    arms = fusion.get("arms") or {}
+    assert set(arms) == {"off", "fuse", "async", "both"}, sorted(arms)
+    assert fusion.get("identity_vs_off") is True, (
+        "fusion arms diverge from the off arm (replies_sha/digest)"
+    )
+    summary["identity_vs_off"] = True
+    summary["speedup_vs_off"] = fusion.get("speedup_vs_off")
+    summary["per_batch_us"] = {
+        name: arm.get("per_batch_us") for name, arm in arms.items()
+    }
+
+    # 2. off-path pin: the knob-off pipeline sweep in this same process
+    # must reproduce what the pipeline tier pinned.
+    sweep = (payload.get("reps") or {}).get("pipeline_sweep") or {}
+    d1 = sweep.get("1") or {}
+    pin_path = os.path.join(REPO, "PIPELINE_SMOKE.json")
+    if os.path.exists(pin_path):
+        with open(pin_path) as f:
+            pinned = (json.load(f).get("identity") or {})
+        assert d1.get("replies_sha") == pinned.get("replies_sha"), (
+            "knob-off pipeline replies diverge from PIPELINE_SMOKE pin"
+        )
+        assert d1.get("digest") == pinned.get("digest"), (
+            "knob-off ledger digest diverges from PIPELINE_SMOKE pin"
+        )
+        summary["off_path_pin"] = "matched"
+    else:
+        summary["off_path_pin"] = "pipeline tier not run; pin skipped"
+
+    # 3. the fused path engaged, and the series landed in METRICS.json.
+    both = arms.get("both") or {}
+    fuse_ctrs = both.get("fuse") or {}
+    assert fuse_ctrs.get("fused_runs", 0) > 0, fuse_ctrs
+    assert fuse_ctrs.get("width_max", 0) > 1, fuse_ctrs
+    lane_ctrs = both.get("merkle_lane") or {}
+    assert lane_ctrs.get("deferred_updates", 0) > 0, lane_ctrs
+    with open(metrics_path) as f:
+        metrics = json.load(f)
+    counters = metrics.get("counters", {})
+    for name in EXPECTED_COUNTERS:
+        assert counters.get(name, 0) > 0, (
+            f"{name} missing from METRICS.json: "
+            f"{sorted(k for k in counters if '.' in k)[:40]}"
+        )
+    hists = metrics.get("histograms", {})
+    assert hists.get("fuse.fused_width", {}).get("max", 0) > 1, (
+        "no dispatch ever fused wider than one batch"
+    )
+    assert "merkle.lane.lag_batches" in hists, sorted(hists)
+    summary["counters"] = {
+        name: counters[name] for name in EXPECTED_COUNTERS
+    }
+    summary["counters"]["fuse.conflict_rejects"] = counters.get(
+        "fuse.conflict_rejects", 0
+    )
+    summary["fused_width_max"] = hists["fuse.fused_width"]["max"]
+    summary["lag_batches_max"] = hists["merkle.lane.lag_batches"].get("max")
+
+    out = os.path.join(REPO, "FUSION_SMOKE.json")
+    with open(out, "w") as f:
+        json.dump({"green": True, **summary}, f, indent=1)
+    print(json.dumps({"green": True, **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

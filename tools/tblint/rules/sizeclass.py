@@ -20,7 +20,13 @@ rounding (the ``machine._merkle_pad`` idiom).  The rule fires when a
 module-local jitted callable receives (a) an array built by a
 constructor whose shape argument is volatile un-stabilized, or (b) a
 volatile value on a ``static_argnames`` parameter (every distinct value
-is a recompile)."""
+is a recompile), or (c) an array built by JOINING a dynamic member list
+(``np.concatenate``/``hstack``/``vstack`` over a comprehension, a
+volatile slice, or a ``*splat``) — the PR 18 fused-run case: the joined
+width is the fused width, ``len()`` of the fused list, so an un-padded
+fused dispatch compiles one program per distinct fusion plan.  Fused-run
+padding must land on the EXISTING jit size classes (``batch_lanes`` /
+``GROUP_K`` attribute pads or ``bit_length()`` rounding)."""
 
 from __future__ import annotations
 
@@ -32,6 +38,9 @@ from ..jitgraph import _root_name, _terminal_name, module_wrappers
 
 _CONSTRUCTORS = {"zeros", "ones", "empty", "full", "arange", "asarray",
                  "array", "stack", "tile", "repeat"}
+#: member-list joiners: the result's leading dim is the SUM of member
+#: lengths — the fused-run width (PR 18 cross-batch fusion)
+_JOINERS = {"concatenate", "concat", "hstack", "vstack"}
 _ARRAY_MODULES = {"np", "jnp", "numpy"}
 _STABILIZERS = {"bit_length"}
 
@@ -52,24 +61,32 @@ class _Volatility:
         self.volatile_arrays: Set[str] = set()
         self._walk(fn.body)
 
-    def expr_volatile(self, expr: ast.AST) -> bool:
-        """Volatile and NOT stabilized: mentions len()/a volatile name,
-        with no attribute constant / bit_length rounding in sight."""
-        has_volatile = False
+    @staticmethod
+    def _stabilized(expr: ast.AST) -> bool:
+        """An attribute constant (self.batch_lanes / cfg.GROUP_K) or a
+        bit_length() rounding anywhere in the expression: the shape is
+        padded to configuration, not keyed on data."""
         for sub in ast.walk(expr):
-            if _is_len_call(sub):
-                has_volatile = True
-            elif isinstance(sub, ast.Name) and sub.id in self.volatile:
-                has_volatile = True
-            elif isinstance(sub, ast.Attribute):
+            if isinstance(sub, ast.Attribute):
                 if sub.attr in _STABILIZERS:
-                    return False
+                    return True
                 if isinstance(sub.ctx, ast.Load) and not isinstance(
                     sub.value, ast.Call
                 ):
-                    # self.batch_lanes / cfg.GROUP_K: padded to config.
-                    return False
-        return has_volatile
+                    return True
+        return False
+
+    def expr_volatile(self, expr: ast.AST) -> bool:
+        """Volatile and NOT stabilized: mentions len()/a volatile name,
+        with no attribute constant / bit_length rounding in sight."""
+        if self._stabilized(expr):
+            return False
+        for sub in ast.walk(expr):
+            if _is_len_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in self.volatile:
+                return True
+        return False
 
     def _constructor_shape_volatile(self, call: ast.Call) -> bool:
         name = _terminal_name(call.func)
@@ -80,10 +97,38 @@ class _Volatility:
             return False
         return self.expr_volatile(call.args[0])
 
+    def _joiner_width_volatile(self, call: ast.Call) -> bool:
+        """np.concatenate/hstack/vstack over a dynamic member list: the
+        joined leading dim is the fused width — len() of the fused list —
+        unless the operand is padded to a config constant / bit_length
+        size class (the fused-run discipline, PR 18)."""
+        name = _terminal_name(call.func)
+        root = _root_name(call.func)
+        if name not in _JOINERS or root not in _ARRAY_MODULES:
+            return False
+        if not call.args:
+            return False
+        op = call.args[0]
+        if self._stabilized(op):
+            return False
+        if self.expr_volatile(op):
+            return True
+        for sub in ast.walk(op):
+            # A comprehension / *splat member list, or a member drawn from
+            # an already-volatile array: width is data-dependent by
+            # construction.
+            if isinstance(sub, (ast.ListComp, ast.GeneratorExp,
+                                ast.Starred)):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in self.volatile_arrays:
+                return True
+        return False
+
     def value_builds_volatile_array(self, value: ast.AST) -> bool:
         for sub in ast.walk(value):
-            if isinstance(sub, ast.Call) and \
-                    self._constructor_shape_volatile(sub):
+            if isinstance(sub, ast.Call) and (
+                    self._constructor_shape_volatile(sub)
+                    or self._joiner_width_volatile(sub)):
                 return True
             if isinstance(sub, ast.Name) and sub.id in self.volatile_arrays:
                 return True
