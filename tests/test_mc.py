@@ -464,6 +464,9 @@ def test_mutations_are_frozen_set_of_known_names(tmp_path):
         "not_primary", "anchor_certify", "vc_quorum",
         # PR 16 auth-layer knockouts (docs/tbmc.md mutation table):
         "mac_skip", "key_confusion", "cert_downgrade", "equiv_dedup",
+        # Reconfiguration knockout (docs/reconfiguration.md): the
+        # view-change quorum sized from boot-time membership.
+        "reconfig_stale_quorum",
     }
     with pytest.raises(AssertionError):
         McCluster(McScope(), str(tmp_path), ("no_such_mutation",))

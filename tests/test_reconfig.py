@@ -1,0 +1,464 @@
+"""Live reshaping under fire (docs/reconfiguration.md): online shard
+splits, standby promotion via a committed ``reconfigure`` op, and the
+VOPR reconfiguration fault domain.
+
+Layers covered, bottom-up:
+
+- wire + superblock: the 16-byte ``reconfigure`` body, the v3 superblock
+  roundtrip carrying (replica_count, standby_count, primary_offset);
+- execution: ``_apply_reconfigure`` status codes — single-step
+  voter<->standby transitions only, bounds, primary-demotion refusal,
+  idempotent crash-replay;
+- machine: the online 2 -> 4 split — serving between chunks, Merkle
+  chunk verification rejecting a corrupted shipment, live-split digest
+  identity vs a cold boot at the target shard count;
+- cluster: promotion e2e (the flipped membership survives a primary
+  kill), promotion persistence across crash+restart, and the 2-voter
+  wedge negative control;
+- tbmc: the promotion scope exhaustively clean; the seeded
+  ``reconfig_stale_quorum`` knockout caught by a guided hunt whose
+  counterexample dies with the defense restored;
+- VOPR: the pinned reconfiguration seed (split + crash mid-migration +
+  corrupt chunk + promotion + primary kill) green and byte-identical to
+  its no-reshard oracle, with the verify-off negative control failing
+  loudly; cold tiering under TB_SHARDS (the long-excluded scenario,
+  re-admitted by the canonical single-layout eviction window); and the
+  diurnal/multi-ledger open-loop arrivals.
+
+The VOPR seeds and the exhaustive tbmc sweep are @slow and ride the ci
+``integration``/``reconfig`` tiers (tier-1 budget discipline, ROADMAP
+standing constraint); everything else is tier-1."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.config import LedgerConfig
+from tigerbeetle_tpu.machine import TpuStateMachine
+from tigerbeetle_tpu.sim.cluster import SimCluster
+from tigerbeetle_tpu.sim.network import PacketSimulator
+from tigerbeetle_tpu.vsr import wire
+
+RECONFIG_SEED = 830001  # the pinned fault-domain seed (tools/reconfig_smoke)
+CID = 1009              # tbmc's single scripted client id
+
+LANES = 128
+
+
+def small_cfg():
+    return LedgerConfig(
+        accounts_capacity_log2=10, transfers_capacity_log2=12,
+        posted_capacity_log2=10,
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire + superblock
+
+
+def test_reconfigure_body_layout():
+    body = wire.reconfigure_body(4, 0)
+    assert len(body) == 16
+    lanes = np.frombuffer(body[:8], "<u4")
+    assert (int(lanes[0]), int(lanes[1])) == (4, 0)
+    assert body[8:] == b"\x00" * 8
+
+
+def test_superblock_v3_membership_roundtrip(tmp_path):
+    from tigerbeetle_tpu.vsr.storage import Storage
+    from tigerbeetle_tpu.vsr.superblock import SuperBlock, SuperBlockState
+
+    path = str(tmp_path / "sb.tigerbeetle")
+    storage = Storage.format(path)
+    sb = SuperBlock(storage)
+    sb.format(cluster=7, replica=3, replica_count=3, standby_count=1)
+    state = sb.open()
+    assert (state.replica_count, state.standby_count) == (3, 1)
+    # A committed promotion checkpoints the flipped membership + the
+    # primary-offset continuity term; reopen must restore all three.
+    sb.checkpoint(SuperBlockState(
+        cluster=7, replica=3, replica_count=4, standby_count=0,
+        primary_offset=2, view=5, commit_min=9,
+    ))
+    state2 = SuperBlock(Storage(path)).open()
+    assert (state2.replica_count, state2.standby_count) == (4, 0)
+    assert state2.primary_offset == 2
+
+
+def test_superblock_membership_validation():
+    from tigerbeetle_tpu.vsr.superblock import validate_membership
+
+    validate_membership(3, 3, 1)       # the promotable standby seat
+    with pytest.raises(ValueError):
+        validate_membership(0, 0, 0)   # no voters
+    with pytest.raises(ValueError):
+        validate_membership(4, 3, 1)   # index past the member range
+    with pytest.raises(ValueError):
+        validate_membership(0, 1, 1)   # solo cluster cannot have standbys
+
+
+# ---------------------------------------------------------------------------
+# _apply_reconfigure status codes (executed on a live cluster so the op
+# travels the real commit path, not a unit-call shortcut)
+
+
+def _reconfig_status(cl, cid):
+    res = cl.clients[cid].results  # [(request_n, reply_body), ...]
+    assert res, "reconfigure client never got a reply"
+    return int.from_bytes(res[-1][1][:8], "little")
+
+
+def test_reconfigure_rejects_multi_step_and_bounds(tmp_path):
+    from tigerbeetle_tpu.vsr.consensus import VsrReplica
+
+    with tempfile.TemporaryDirectory() as wd:
+        cl = SimCluster(wd, n_replicas=2, n_clients=1, seed=5,
+                        requests_per_client=2, n_standbys=2)
+        # 2+2 -> 4+0 jumps two seats: not a single-step transition.
+        bad = cl.add_reconfigure_client(at_tick=40, new_rc=4, new_sc=0,
+                                        seed=5)
+        cl.run_until(lambda: cl.clients[bad].done, max_ticks=4_000)
+        assert _reconfig_status(cl, bad) == VsrReplica.RECONFIGURE_BAD_TRANSITION
+        # Conservation: 2+2 -> 3+0 drops a member entirely.
+        gone = cl.add_reconfigure_client(at_tick=cl.t + 20, new_rc=3,
+                                         new_sc=0, seed=6)
+        cl.run_until(lambda: cl.clients[gone].done, max_ticks=4_000)
+        assert _reconfig_status(cl, gone) == VsrReplica.RECONFIGURE_BAD_TRANSITION
+        # Membership never flipped on any seat.
+        assert all(r.replica_count == 2 for r in cl.replicas)
+
+
+def test_reconfigure_idempotent_reapply(tmp_path):
+    from tigerbeetle_tpu.vsr.consensus import VsrReplica
+
+    with tempfile.TemporaryDirectory() as wd:
+        cl = SimCluster(wd, n_replicas=2, n_clients=1, seed=9,
+                        requests_per_client=2, n_standbys=1)
+        first = cl.add_reconfigure_client(at_tick=40, new_rc=3, new_sc=0,
+                                          seed=9)
+        cl.run_until(lambda: cl.clients[first].done, max_ticks=4_000)
+        assert _reconfig_status(cl, first) == VsrReplica.RECONFIGURE_OK
+        # Re-applying the now-current membership is a success no-op
+        # (crash-replay safety — WAL replay re-executes the op).
+        again = cl.add_reconfigure_client(at_tick=cl.t + 20, new_rc=3,
+                                          new_sc=0, seed=10)
+        cl.run_until(lambda: cl.clients[again].done, max_ticks=4_000)
+        assert _reconfig_status(cl, again) == VsrReplica.RECONFIGURE_OK
+        assert all(
+            (r.replica_count, r.standby_count) == (3, 0)
+            for i, r in enumerate(cl.replicas) if cl.alive[i]
+        )
+
+
+# ---------------------------------------------------------------------------
+# machine: the online split
+
+
+def _accounts(n=64):
+    return types.accounts_array([
+        types.account(id=i, ledger=1, code=10) for i in range(1, n + 1)
+    ])
+
+
+def _batch(base, n=16, accounts=64):
+    return types.transfers_array([
+        types.transfer(id=base + i, debit_account_id=1 + (base + i) % accounts,
+                       credit_account_id=1 + (base + i * 7 + 3) % accounts,
+                       amount=1 + i, ledger=1, code=10)
+        for i in range(n)
+    ])
+
+
+def test_reshard_split_identity_vs_cold_boot():
+    live = TpuStateMachine(small_cfg(), batch_lanes=LANES, shards=2)
+    cold = TpuStateMachine(small_cfg(), batch_lanes=LANES, shards=4)
+    for m in (live, cold):
+        m.create_accounts(_accounts())
+    for b in range(4):
+        assert live.create_transfers(_batch(100 + 16 * b)) == \
+            cold.create_transfers(_batch(100 + 16 * b))
+    assert live.reshard_begin(4, verify=True, chunk_rows=16)
+    # Serving between chunk shipments never wedges — and each commit
+    # dirties migrated rows, so cutover takes catch-up rounds.
+    for b in range(6):
+        if not live.reshard_active:
+            break
+        live.reshard_step(1)
+        assert live.create_transfers(_batch(300 + 16 * b)) == \
+            cold.create_transfers(_batch(300 + 16 * b))
+    pumps = 0
+    while live.reshard_active:
+        live.reshard_step(1)
+        pumps += 1
+        assert pumps < 10_000, "split did not cut over after the drain"
+    stats = live.reshard_stats
+    assert live.shards == 4 and stats["splits_completed"] == 1
+    assert stats["catchup_rounds"] >= 1
+    assert int(live.digest()) == int(cold.digest())
+    # Post-cutover serving stays byte-identical on the new layout.
+    assert live.create_transfers(_batch(900)) == \
+        cold.create_transfers(_batch(900))
+    assert int(live.digest()) == int(cold.digest())
+
+
+def test_reshard_verify_rejects_corrupt_chunk():
+    m = TpuStateMachine(small_cfg(), batch_lanes=LANES, shards=2)
+    m.create_accounts(_accounts())
+    m.create_transfers(_batch(100))
+    oracle = TpuStateMachine(small_cfg(), batch_lanes=LANES, shards=4)
+    oracle.create_accounts(_accounts())
+    oracle.create_transfers(_batch(100))
+    assert m.reshard_begin(4, verify=True, chunk_rows=16,
+                           corrupt_chunks={0})
+    pumps = 0
+    while m.reshard_active:
+        m.reshard_step(1)
+        pumps += 1
+        assert pumps < 10_000
+    stats = m.reshard_stats
+    assert stats["chunk_retries"] >= 1, (
+        "corrupted chunk 0 was not rejected + re-shipped"
+    )
+    assert stats["splits_completed"] == 1
+    assert int(m.digest()) == int(oracle.digest())
+
+
+def test_reshard_begin_refusals_and_idempotence():
+    m = TpuStateMachine(small_cfg(), batch_lanes=LANES, shards=2)
+    m.create_accounts(_accounts())
+    # A non-doubling target is refused — counted, warned, never a wedge.
+    with pytest.warns(RuntimeWarning, match="not a doubling"):
+        assert not m.reshard_begin(8, verify=True, chunk_rows=16)
+    assert m.reshard_stats["abandons"] == 1
+    assert m.reshard_begin(4, verify=True, chunk_rows=16)
+    # Re-arming mid-flight is an idempotent True, not a second split.
+    assert m.reshard_begin(4, verify=True, chunk_rows=16)
+    assert m.reshard_stats["splits_started"] == 1
+    pumps = 0
+    while m.reshard_active:
+        m.reshard_step(4)
+        pumps += 1
+        assert pumps < 10_000
+    assert m.shards == 4
+
+
+# ---------------------------------------------------------------------------
+# cluster: promotion
+
+
+def test_promotion_survives_primary_kill(tmp_path):
+    with tempfile.TemporaryDirectory() as wd:
+        cl = SimCluster(wd, n_replicas=2, n_clients=2, seed=11,
+                        requests_per_client=5, n_standbys=1)
+        cl.add_reconfigure_client(at_tick=60, new_rc=3, new_sc=0, seed=11)
+        for _ in range(400):
+            cl.step()
+        live = [i for i in range(cl.total) if cl.alive[i]]
+        assert all(
+            (cl.replicas[i].replica_count, cl.replicas[i].standby_count)
+            == (3, 0) for i in live
+        )
+        assert not cl.replicas[2].is_standby
+        prim = next(i for i in live if cl.replicas[i].is_primary)
+        cl.crash(prim)
+        cl.add_flood_clients(2, seed=77, n_requests=3, start_tick=cl.t + 5)
+        for _ in range(1_500):
+            cl.step()
+        alive = [i for i in range(3) if cl.alive[i]]
+        assert any(cl.replicas[i].is_primary for i in alive), (
+            "no primary elected after the kill — promotion not load-bearing"
+        )
+        assert all(c.done for c in cl.clients.values()), (
+            "commits wedged after the post-promotion primary kill"
+        )
+
+
+def test_promotion_persists_across_restart(tmp_path):
+    with tempfile.TemporaryDirectory() as wd:
+        cl = SimCluster(wd, n_replicas=2, n_clients=2, seed=11,
+                        requests_per_client=5, n_standbys=1)
+        cl.add_reconfigure_client(at_tick=60, new_rc=3, new_sc=0, seed=11)
+        for _ in range(400):
+            cl.step()
+        assert cl.replicas[1].replica_count == 3
+        cl.crash(1)
+        cl.restart(1)
+        # The flip was checkpointed (superblock v3): the reopened seat
+        # boots at the new membership, not the formatted one.
+        assert (cl.replicas[1].replica_count,
+                cl.replicas[1].standby_count) == (3, 0)
+        for _ in range(200):
+            cl.step()
+        assert cl.replicas[1].commit_min >= 1
+
+
+def test_two_voter_wedge_negative_control(tmp_path):
+    # The promotion e2e's control: WITHOUT the promotion, losing one of
+    # two voters wedges the cluster (no view-change quorum) — proving
+    # the committed membership op is what keeps the lights on above.
+    with tempfile.TemporaryDirectory() as wd:
+        cl = SimCluster(wd, n_replicas=2, n_clients=1, seed=11,
+                        requests_per_client=3)
+        for _ in range(200):
+            cl.step()
+        prim = next(i for i in range(2) if cl.replicas[i].is_primary)
+        cl.crash(prim)
+        cl.add_flood_clients(1, seed=3, n_requests=2, start_tick=cl.t + 5)
+        for _ in range(1_500):
+            cl.step()
+        assert not cl.replicas[1 - prim].is_primary, (
+            "2-voter cluster elected a primary after losing one voter"
+        )
+
+
+# ---------------------------------------------------------------------------
+# tbmc: the reconfiguration fault domain
+
+
+def test_mc_reconfig_stale_quorum_guided_hunt_and_defense(tmp_path):
+    from tigerbeetle_tpu.sim.mc import McScope, check, replay_schedule
+
+    # Guided hunt: op 2 committed by the post-flip 4-voter ring with the
+    # 1 -> 2 hop dropped (seats 2 and 3 starved), then seat 2's
+    # suspect -> escalate view change.  Under the stale boot-membership
+    # quorum (2 of the OLD 3 voters) the view change stops intersecting
+    # the 4-voter replication quorum and re-commits a different op at
+    # the same number.
+    prefix = (
+        ("client", CID, 0), ("deliver", "client", CID, "replica", 0),
+        ("deliver", "replica", 0, "replica", 1),
+        ("deliver", "replica", 1, "replica", 2),
+        ("deliver", "replica", 1, "replica", 0),
+        ("deliver", "replica", 2, "replica", 3),
+        ("deliver", "replica", 2, "replica", 0),
+        ("deliver", "replica", 0, "client", CID),
+        ("timeout", 0, "commit_hb"),
+        ("deliver", "replica", 0, "replica", 1),
+        ("deliver", "replica", 0, "replica", 2),
+        ("deliver", "replica", 0, "replica", 3),
+        ("client", CID, 0), ("deliver", "client", CID, "replica", 0),
+        ("deliver", "replica", 0, "replica", 1),
+        ("drop", "replica", 1, "replica", 2),
+        ("deliver", "replica", 1, "replica", 0),
+        ("deliver", "replica", 0, "client", CID),
+        ("timeout", 2, "suspect"), ("timeout", 2, "vc_escalate"),
+        ("deliver", "replica", 2, "replica", 3),
+        ("deliver", "replica", 2, "replica", 3),
+        ("deliver", "replica", 3, "replica", 2),
+        ("deliver", "replica", 3, "replica", 2),
+        ("deliver", "replica", 3, "replica", 2),
+        ("deliver", "replica", 2, "replica", 3),
+        ("client", CID, 2), ("deliver", "client", CID, "replica", 2),
+    )
+    scope = McScope(
+        n_replicas=3, n_standbys=1, reconfig=True, ops_per_client=2,
+        crash_budget=0, drop_budget=1, timeout_budget=3,
+        timeout_quiescent_only=False, max_view=2, depth_max=6,
+        max_states=50_000,
+    )
+    report = check(scope, ("reconfig_stale_quorum",), prefix=prefix)
+    assert report.violation is not None
+    assert report.violation["kind"] == "agreement", report.violation
+    ce = report.counterexample()
+    # Replay identity: the recorded schedule reproduces the recorded
+    # violation with a bit-identical canonical state key.
+    replay = replay_schedule(ce)
+    assert replay["reproduced"] and replay["identical"], replay
+    # Defense replay: with the mutation stripped the schedule must NOT
+    # reproduce — the defended protocol emits different frames.
+    defended = replay_schedule(dict(ce, mutations=[]))
+    assert defended["reproduced"] is False, (
+        "stale-quorum counterexample reproduced without the mutation — "
+        "a real protocol bug, not a mutation proof"
+    )
+
+
+@pytest.mark.slow
+def test_mc_reconfig_scope_exhaustively_clean(tmp_path):
+    from tigerbeetle_tpu.sim.mc import McScope, check
+
+    # The unmutated 3+1 -> 4+0 promotion under every crash + timeout
+    # interleaving at depth 8 (~25k states): no safety violation, scope
+    # exhausted.  Deeper pins (depth 10/12: 100k/300k states) ride
+    # tools/reconfig_smoke.py history.
+    clean = check(McScope(
+        n_replicas=3, n_standbys=1, reconfig=True, ops_per_client=1,
+        crash_budget=1, timeout_budget=2, max_view=1, depth_max=8,
+        max_states=400_000,
+    ))
+    assert clean.violation is None, (clean.violation, clean.schedule)
+    assert clean.exhaustive, clean.states
+
+
+# ---------------------------------------------------------------------------
+# VOPR: the reconfiguration fault kind + re-admitted scenarios (@slow)
+
+
+@pytest.mark.slow
+def test_vopr_reconfig_pinned_seed_and_negative_control():
+    from tigerbeetle_tpu.sim.vopr import run_reconfig_seed
+
+    r = run_reconfig_seed(RECONFIG_SEED)
+    assert r.exit_code == 0, (r.reason, r.reshard_stats)
+    assert r.promoted and r.crash_source >= 0 and r.killed_primary >= 0
+    assert r.shards_final and all(s == 4 for s in r.shards_final)
+    assert r.reshard_stats["chunk_retries"] >= 1, (
+        "the corrupted chunk was not rejected + re-shipped"
+    )
+    assert r.digest_final == r.digest_oracle, (
+        "healed split diverged from the no-reshard oracle"
+    )
+    # Scrub-off discipline: the SAME schedule with chunk verification
+    # off must fail the convergence/audit oracles loudly.
+    neg = run_reconfig_seed(RECONFIG_SEED, verify=False)
+    assert neg.exit_code == 129, (neg.exit_code, neg.reason)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [910007, 910033])
+def test_vopr_cold_tiering_under_shards(seed):
+    # The long-excluded scenario (forced-untiered under TB_SHARDS since
+    # PR 8), re-admitted: evictions open a canonical single-layout
+    # window and mesh commits route through the sequential fallback
+    # while any row is cold.  These seeds draw hot_cap=128 (tiered) from
+    # the 0xC01D stream.
+    from tigerbeetle_tpu.sim.vopr import run_seed
+
+    old = os.environ.get("TB_SHARDS")
+    os.environ["TB_SHARDS"] = "2"
+    try:
+        r = run_seed(seed, ticks=3_000, settle_ticks=40_000)
+    finally:
+        if old is None:
+            os.environ.pop("TB_SHARDS", None)
+        else:
+            os.environ["TB_SHARDS"] = old
+    assert r.exit_code == 0, (seed, r.reason)
+    assert r.commits > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,kw", [
+    ("diurnal", dict(arrival="diurnal", rate=0.25, horizon=900)),
+    ("multiledger", dict(ledgers=3, rate=0.25, horizon=900)),
+])
+def test_openloop_diurnal_and_multiledger(name, kw):
+    from tigerbeetle_tpu.sim.openloop import OpenLoopGen
+
+    gen = OpenLoopGen(900100, n_clients=6, hot_accounts=48, start_tick=40,
+                      batch=4, **kw)
+    with tempfile.TemporaryDirectory() as wd:
+        cl = SimCluster(wd, n_replicas=3, n_clients=1, seed=900100,
+                        requests_per_client=3,
+                        net=PacketSimulator(seed=900101, delay_mean=2,
+                                            delay_max=8))
+        gen.attach(cl)
+        ok = cl.run_until(lambda: cl.clients_done() and cl.converged(),
+                          max_ticks=30_000)
+        assert ok, f"{name}: no convergence"
+        cl.check_converged()
+        cl.check_conservation()
+        assert gen.total_requests > 0 and cl.auditor.audited > 0

@@ -267,6 +267,15 @@ def main(argv=None) -> int:
                              "must reject + rotate (add --no-verify for "
                              "the install-divergent-state negative "
                              "control)")
+    p_vopr.add_argument("--reconfig", action="store_true",
+                        help="run the RECONFIGURATION fault kind: online "
+                             "2->4 shard split mid-open-loop-flood with a "
+                             "crash of one migration source and a corrupt "
+                             "chunk, plus a committed membership op "
+                             "promoting the standby and a primary kill "
+                             "(docs/reconfiguration.md; add --no-verify "
+                             "for the install-divergent-state negative "
+                             "control)")
     p_vopr.add_argument("--replay-schedule", default=None, metavar="FILE",
                         help="re-execute a tbmc counterexample schedule "
                              "(sim/mc.py, docs/tbmc.md) bit-identically "
@@ -294,7 +303,9 @@ def main(argv=None) -> int:
     if args.subcommand in ("format", "promote", "repl") or (
         args.subcommand == "vopr" and not args.tpu
     ):
-        jaxenv.force_cpu()
+        # The reconfiguration kind's 2 -> 4 online split shards across
+        # 4 devices; every other CPU-pinned path is fine with one.
+        jaxenv.force_cpu(8 if getattr(args, "reconfig", False) else None)
     elif (
         args.subcommand in ("start", "benchmark")
         or (args.subcommand == "vopr" and args.tpu)
@@ -329,6 +340,7 @@ def _cmd_vopr(args) -> int:
             or args.overload or args.no_priority
             or args.byzantine or args.no_verify
             or args.catchup or args.force_full or args.lying_responder
+            or args.reconfig
             or args.device_faults or args.scrub_interval is not None
             or args.merkle or args.vopr_viz or args.bug is not None
             or args.clusters != 4096 or args.steps != 400
@@ -373,12 +385,14 @@ def _cmd_vopr(args) -> int:
         args.overload or args.no_priority
         or args.byzantine or args.no_verify or args.merkle
         or args.catchup or args.force_full or args.lying_responder
+        or args.reconfig
     ):
         # Same loud-reject discipline as the non-TPU knob checks below:
         # the TPU vopr runs its own random schedule, so silently dropping
         # --overload would report a scenario that never ran.
         print("error: --overload/--no-priority/--byzantine/--no-verify/"
-              "--merkle/--catchup do not apply with --tpu", file=sys.stderr)
+              "--merkle/--catchup/--reconfig do not apply with --tpu",
+              file=sys.stderr)
         return 2
     if args.tpu:
         from .sim import vopr_tpu
@@ -415,7 +429,8 @@ def _cmd_vopr(args) -> int:
         return EXIT_CORRECTNESS if n > 0 else 0
 
     from .sim.vopr import (
-        run_byzantine_seed, run_catchup_seed, run_overload_seed, run_seed,
+        run_byzantine_seed, run_catchup_seed, run_overload_seed,
+        run_reconfig_seed, run_seed,
     )
 
     if args.bug is not None or args.clusters != 4096 or args.steps != 400:
@@ -426,9 +441,11 @@ def _cmd_vopr(args) -> int:
         print("error: --no-priority applies only with --overload",
               file=sys.stderr)
         return 2
-    if args.no_verify and not (args.byzantine or args.catchup):
-        print("error: --no-verify applies only with --byzantine or "
-              "--catchup", file=sys.stderr)
+    if args.no_verify and not (
+        args.byzantine or args.catchup or args.reconfig
+    ):
+        print("error: --no-verify applies only with --byzantine, "
+              "--catchup or --reconfig", file=sys.stderr)
         return 2
     if (args.primary_seat or args.auth) and not args.byzantine:
         print("error: --primary-seat/--auth apply only with --byzantine",
@@ -449,6 +466,17 @@ def _cmd_vopr(args) -> int:
         print("error: --overload/--byzantine/--device-faults/"
               "--scrub-interval/--merkle/--vopr-viz/--ticks do not apply "
               "with --catchup", file=sys.stderr)
+        return 2
+    if args.reconfig and (
+        args.overload or args.byzantine or args.catchup
+        or args.device_faults or args.scrub_interval is not None
+        or args.merkle or args.vopr_viz or args.ticks is not None
+    ):
+        # The reconfiguration scenario owns its schedule (fixed reshard/
+        # promotion/kill ticks); loudly reject knobs it does not take.
+        print("error: --overload/--byzantine/--catchup/--device-faults/"
+              "--scrub-interval/--merkle/--vopr-viz/--ticks do not apply "
+              "with --reconfig", file=sys.stderr)
         return 2
     if args.merkle and not args.scrub_interval:
         print("error: --merkle needs --scrub-interval >= 1 (the commitment "
@@ -480,6 +508,18 @@ def _cmd_vopr(args) -> int:
     first = args.seed if args.seed is not None else secrets.randbits(31)
     worst = 0
     for seed in range(first, first + args.count):
+        if args.reconfig:
+            result = run_reconfig_seed(seed, verify=not args.no_verify)
+            print(
+                f"seed={result.seed} exit={result.exit_code} "
+                f"verify={result.verify} promoted={result.promoted} "
+                f"crash_source={result.crash_source} "
+                f"killed_primary={result.killed_primary} "
+                f"shards={result.shards_final} "
+                f"stats={result.reshard_stats}: {result.reason}"
+            )
+            worst = max(worst, result.exit_code)
+            continue
         if args.catchup:
             result = run_catchup_seed(
                 seed,

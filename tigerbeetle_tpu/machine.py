@@ -330,17 +330,28 @@ class TpuStateMachine:
         # (rows already dropped).  Owners are host-computable (one mix64
         # pass per batch), so growth sizes off the peak shard too.
         self._shard_insert_bounds: dict = {}
-        if shards >= 2 and (host_engine or hot_transfers_capacity_max is not None):
-            # Sharding runs on the device path and excludes cold tiering
-            # (no bloom on the mesh path).  A process-wide TB_SHARDS env
-            # must not take down a host-engine solo server or a tiered
-            # replica: degrade to the proven single-device path loudly
-            # (the DEGRADED_DEVICE_COUNT discipline).
+        # Online shard split (docs/reconfiguration.md): volatile migration
+        # state (None = no split in flight).  Deliberately NOT part of any
+        # checkpoint — a crash mid-migration rolls back to serving the old
+        # layout and the operator (or VOPR's reconfig fault kind) re-arms.
+        self._reshard: Optional[dict] = None
+        self.reshard_stats = {
+            "splits_started": 0, "splits_completed": 0, "abandons": 0,
+            "restarts": 0, "catchup_rounds": 0, "chunks": 0,
+            "chunk_retries": 0, "bytes_migrated": 0, "bytes_full": 0,
+        }
+        if shards >= 2 and host_engine:
+            # Sharding runs on the device path.  A process-wide TB_SHARDS
+            # env must not take down a host-engine solo server: degrade to
+            # the proven single-device path loudly (the
+            # DEGRADED_DEVICE_COUNT discipline).  Cold tiering now
+            # COMPOSES with sharding (PR 20): the mesh kernels still have
+            # no bloom, so tiered transfer commits route through the
+            # sequential fallback's canonical window, where the existing
+            # host-exact cold resolution applies unchanged.
             warnings.warn(
                 f"TB_SHARDS={shards} ignored: "
-                + ("the host engine is the commit authority here"
-                   if host_engine else
-                   "cold tiering is a single-device concern"),
+                "the host engine is the commit authority here",
                 RuntimeWarning, stacklevel=2,
             )
             shards = 0
@@ -2326,6 +2337,15 @@ class TpuStateMachine:
         exactly like the wave scheduler's unschedulable exit."""
         from .ops import transfer_full as tf
 
+        if self._tiering or self.cold.count:
+            # The mesh kernels carry no bloom, so a cold (evicted) id
+            # would silently read as not-found there.  Tiered transfer
+            # commits route through the sequential fallback's canonical
+            # window, where the existing host-exact cold resolution
+            # (_resolve_cold) applies unchanged — correctness over
+            # throughput while the tier is active.
+            return self._sequential("create_transfers", batch, timestamp)
+
         self._note_cross_shard(batch, count)
         self._note_shard_inserts("transfers", batch, count)
         cnt, ts = jnp.uint64(count), jnp.uint64(timestamp)
@@ -2442,6 +2462,313 @@ class TpuStateMachine:
             _obs.histogram("sharding.cross_shard_pct", "%").observe(
                 100 * cross // max(count, 1)
             )
+
+    # -- online shard split (docs/reconfiguration.md) ------------------------
+    #
+    # An N -> 2N split executed WHILE SERVING: the old layout keeps
+    # committing; between batches the engine ships the owner-changed row
+    # subset through the vsr/statesync codec (per-chunk Merkle
+    # verification against the source tree), catches up changed slots in
+    # delta rounds, and cuts over only after the staged full state passes
+    # the whole-state checksum gate AND the new layout's per-shard scrub
+    # lanes fold to the canonical digest.  Any verification failure
+    # abandons the split and keeps serving the old layout — graceful
+    # degradation, never a wedge.  Migration state is volatile by design:
+    # a crash mid-migration restarts on the old layout (clean rollback)
+    # and the split is simply re-armed.
+
+    @property
+    def reshard_active(self) -> bool:
+        return self._reshard is not None
+
+    def reshard_begin(
+        self, target_shards: int, *, verify: bool = True,
+        chunk_rows: int = 512, corrupt_chunks=(), corrupt_persistent=False,
+    ) -> bool:
+        """Arm an online N -> 2N shard split.  Returns True when the
+        migration is armed (idempotent while one is in flight); False —
+        counted, logged, never a wedge — when this machine cannot split.
+        ``corrupt_chunks``/``corrupt_persistent`` are fault-injection
+        hooks (VOPR reconfig kind): flip a byte in the numbered migration
+        chunks, transiently or on every retry."""
+        if self._reshard is not None:
+            return True
+        reason = None
+        if self.shards < 2 or self._shard_mesh is None:
+            reason = "machine is not in sharded mode"
+        elif target_shards != self.shards * 2:
+            reason = f"{self.shards} -> {target_shards} is not a doubling"
+        elif self._engine is not None:
+            reason = "host engine is the commit authority"
+        elif self._tiering or self.cold.count:
+            reason = "cold tier active (evicted rows have no leaves)"
+        elif len(jax.devices()) < target_shards:
+            reason = (
+                f"{target_shards} shards need {target_shards} devices, "
+                f"have {len(jax.devices())}"
+            )
+        else:
+            for cap in (self.config.accounts_capacity,
+                        self.config.transfers_capacity,
+                        self.config.posted_capacity):
+                if cap % target_shards:
+                    reason = "capacity not divisible by the target shards"
+        if reason is not None:
+            self.reshard_stats["abandons"] += 1
+            if _obs.enabled:
+                _obs.counter("reconfig.reshard_abandoned").inc()
+            warnings.warn(
+                f"shard split refused: {reason} (serving continues on the "
+                f"current layout)", RuntimeWarning, stacklevel=2,
+            )
+            return False
+        self.reshard_stats["splits_started"] += 1
+        self._reshard = {
+            "target": int(target_shards), "verify": bool(verify),
+            "chunk_rows": int(chunk_rows), "round": 0, "queue": [],
+            "src": None, "trees": None, "wire": None,
+            "shipped_leaves": None, "shipped_mask": None, "chunks_sent": 0,
+            "corrupt_chunks": set(int(c) for c in corrupt_chunks),
+            "corrupt_persistent": bool(corrupt_persistent),
+        }
+        if _obs.enabled:
+            _obs.counter("reconfig.reshard_started").inc()
+            _obs.gauge("reconfig.reshard_active").set(1)
+        return True
+
+    def reshard_abort(self) -> None:
+        """Operator abort: drop the migration, keep serving the old
+        layout untouched."""
+        if self._reshard is not None:
+            self._reshard_abandon("operator abort")
+
+    def reshard_step(self, max_chunks: int = 8) -> str:
+        """Advance an active split by up to ``max_chunks`` verified
+        migration chunks; call between commit batches (the replica tick /
+        VOPR driver seam).  Returns 'idle' (no split), 'migrating',
+        'done' (cutover installed this step) or 'abandoned'."""
+        rs = self._reshard
+        if rs is None:
+            return "idle"
+        from .vsr import statesync as _ss  # lazy: machine sits below vsr
+
+        for _ in range(max_chunks):
+            rs = self._reshard
+            if rs is None:
+                return "abandoned"
+            if not rs["queue"]:
+                status = self._reshard_advance()
+                if status != "migrating":
+                    return status
+                continue
+            pad, slots = rs["queue"].pop(0)
+            tree = rs["trees"][pad]
+            cap = _ss.pad_capacity(rs["src"], pad)
+            chunk_id = rs["chunks_sent"]
+            rows = None
+            for attempt in (0, 1):
+                corrupt = chunk_id in rs["corrupt_chunks"] and (
+                    attempt == 0 or rs["corrupt_persistent"]
+                )
+                body = _ss.ship_chunk(
+                    rs["src"], tree, pad, slots, corrupt=corrupt
+                )
+                if not rs["verify"]:
+                    # Scrub-off negative control: install unaudited.
+                    rows = _ss.unpack_rows(rs["src"], pad, slots, body)
+                    break
+                rows = _ss.verify_chunk(rs["src"], tree, pad, slots, body)
+                if rows is not None:
+                    break
+                self.reshard_stats["chunk_retries"] += 1
+                if _obs.enabled:
+                    _obs.counter("reconfig.chunk_retries").inc()
+            if rows is None:
+                return self._reshard_abandon(
+                    f"chunk {chunk_id} ({pad}) failed verification twice"
+                )
+            for k in _ss.per_slot_keys(rs["src"], pad):
+                rs["wire"][pad][k][slots] = rows[k]
+            # Record the SOURCE leaf as shipped even unaudited: with
+            # verification off a corrupted chunk must stay divergent all
+            # the way to cutover (the auditor's job to catch), not be
+            # silently re-shipped clean next round.
+            rs["shipped_leaves"][pad][slots] = tree[cap + slots]
+            rs["shipped_mask"][pad][slots] = True
+            rs["chunks_sent"] += 1
+            self.reshard_stats["chunks"] += 1
+            self.reshard_stats["bytes_migrated"] += len(body)
+            if _obs.enabled:
+                _obs.counter("reconfig.bytes_migrated").inc(len(body))
+        return "migrating"
+
+    def _reshard_snapshot(self):
+        """Fresh canonical flat-array snapshot + trees (the statesync
+        responder's view of THIS machine's live state)."""
+        from .vsr import checkpoint as _ckpt
+        from .vsr import statesync as _ss
+
+        arrays = {
+            k: np.asarray(v)
+            for k, v in _ckpt.ledger_to_arrays(self.checkpoint_ledger()).items()
+        }
+        return arrays, _ss.build_trees(arrays)
+
+    def _reshard_advance(self) -> str:
+        """Queue drained: take a fresh snapshot, enqueue the moved slots
+        whose leaves changed since their last ship (delta round), or cut
+        over when a round comes back empty."""
+        from .parallel import sharded as shard_mod
+        from .vsr import statesync as _ss
+
+        rs = self._reshard
+        arrays, trees = self._reshard_snapshot()
+        if rs["src"] is not None and any(
+            _ss.pad_capacity(arrays, pad) != _ss.pad_capacity(rs["src"], pad)
+            for pad in _ss.PADS
+        ):
+            # A table grew mid-migration: leaf indexes are incomparable
+            # across capacities — restart the split from scratch (counted;
+            # the old layout served throughout).
+            self.reshard_stats["restarts"] += 1
+            if _obs.enabled:
+                _obs.counter("reconfig.reshard_restarts").inc()
+            rs["wire"] = None
+        if rs["wire"] is None:
+            rs["wire"] = {
+                pad: {
+                    k: np.zeros_like(arrays[k])
+                    for k in _ss.per_slot_keys(arrays, pad)
+                }
+                for pad in _ss.PADS
+            }
+            rs["shipped_leaves"] = {
+                pad: np.zeros(_ss.pad_capacity(arrays, pad), np.uint64)
+                for pad in _ss.PADS
+            }
+            rs["shipped_mask"] = {
+                pad: np.zeros(_ss.pad_capacity(arrays, pad), bool)
+                for pad in _ss.PADS
+            }
+            rs["round"] = 0
+            # Full-transfer baseline the differential protocol is judged
+            # against: every live row of every pad.
+            self.reshard_stats["bytes_full"] = sum(
+                int((
+                    (arrays[f"{pad}/key_lo"] | arrays[f"{pad}/key_hi"]) != 0
+                ).sum()) * _ss.row_bytes(arrays, pad)
+                for pad in _ss.PADS
+            )
+        queue = []
+        for pad in _ss.PADS:
+            cap = _ss.pad_capacity(arrays, pad)
+            moved = shard_mod.split_moved_mask(
+                arrays[f"{pad}/key_lo"], arrays[f"{pad}/key_hi"], self.shards
+            )
+            leaves = trees[pad][cap:]
+            need = moved & (
+                ~rs["shipped_mask"][pad]
+                | (leaves != rs["shipped_leaves"][pad])
+            )
+            for piece in _ss.chunk_slots(
+                np.nonzero(need)[0], rs["chunk_rows"]
+            ):
+                queue.append((pad, piece))
+        rs["src"], rs["trees"] = arrays, trees
+        if not queue:
+            return self._reshard_cutover(arrays, trees)
+        rs["queue"] = queue
+        if rs["round"] > 0:
+            self.reshard_stats["catchup_rounds"] += 1
+        rs["round"] += 1
+        return "migrating"
+
+    def _reshard_cutover(self, arrays, trees) -> str:
+        """The cutover rule (docs/reconfiguration.md): staged state =
+        stayed rows (never left their device) + wire rows (each chunk
+        Merkle-verified); it must pass the whole-state checksum gate, and
+        the NEW layout's per-shard scrub lanes must fold to the canonical
+        digest, before the swap.  Any gate failure abandons — the old
+        layout was never touched."""
+        from jax.sharding import Mesh
+
+        from .parallel import sharded as shard_mod
+        from .vsr import checkpoint as _ckpt
+        from .vsr import statesync as _ss
+
+        rs = self._reshard
+        staged = {k: v.copy() for k, v in arrays.items()}
+        for pad in _ss.PADS:
+            moved = shard_mod.split_moved_mask(
+                arrays[f"{pad}/key_lo"], arrays[f"{pad}/key_hi"], self.shards
+            )
+            slots = np.nonzero(moved)[0]
+            for k in _ss.per_slot_keys(arrays, pad):
+                staged[k][slots] = rs["wire"][pad][k][slots]
+        if rs["verify"] and (
+            _ss.arrays_checksum(staged) != _ss.arrays_checksum(arrays)
+        ):
+            return self._reshard_abandon("whole-state checksum gate failed")
+        digest_want = _ss.np_digest(arrays)
+        devs = jax.devices()
+        new_mesh = Mesh(np.array(devs[: rs["target"]]), (shard_mod.AXIS,))
+        new_steps = shard_mod.machine_steps(
+            new_mesh, self.config.jacobi_max_passes
+        )
+        sharded_led = shard_mod.shard_ledger(
+            _ckpt.arrays_to_ledger(staged), new_mesh
+        )
+        # Per-shard commitment gate: the 2N scrub lanes (wrap-add partial
+        # folds, one per shard) must sum to the canonical accounts digest.
+        lanes = np.asarray(new_steps["scrub"](sharded_led)).astype(np.uint64)
+        with np.errstate(over="ignore"):
+            got = int(lanes[:, 0].sum(dtype=np.uint64))
+        if rs["verify"] and got != digest_want:
+            return self._reshard_abandon(
+                "per-shard commitment roots do not fold to the canonical "
+                "digest"
+            )
+        old_shards = self.shards
+        self.shards = rs["target"]
+        self._shard_mesh = new_mesh
+        self._shard_steps = new_steps
+        self._ledger = sharded_led  # already placed on the new mesh
+        self._ledger_is_sharded = True
+        self._canon = None
+        self._refresh_shard_bounds(sharded_led)
+        self._merkle_mark_dirty()
+        # First dispatches on the 2N mesh legitimately jit-compile: the
+        # TB_SANITIZE recompile tripwire gets the same grace as growth.
+        self._sanitize_grace = True
+        self._sanitize_soft = True
+        self.reshard_stats["splits_completed"] += 1
+        audited = rs["verify"]
+        self._reshard = None
+        if _obs.enabled:
+            _obs.counter("reconfig.reshard_completed").inc()
+            _obs.gauge("reconfig.reshard_active").set(0)
+            _obs.gauge("sharding.shards").set(self.shards)
+        if audited:
+            # Converter sanity on the audited path only: with verification
+            # disabled (the scrub-off negative control) an installed
+            # divergence is the AUDITOR's to catch downstream.
+            assert int(self.digest()) == digest_want, (
+                f"post-cutover digest diverged after {old_shards} -> "
+                f"{self.shards} split"
+            )
+        return "done"
+
+    def _reshard_abandon(self, reason: str) -> str:
+        self.reshard_stats["abandons"] += 1
+        self._reshard = None
+        if _obs.enabled:
+            _obs.counter("reconfig.reshard_abandoned").inc()
+            _obs.gauge("reconfig.reshard_active").set(0)
+        warnings.warn(
+            f"shard split abandoned: {reason} (serving continues on the "
+            f"{self.shards}-shard layout)", RuntimeWarning, stacklevel=3,
+        )
+        return "abandoned"
 
     def _note_balance_bound(self, batch: np.ndarray) -> None:
         """Over-approximate the largest possible single balance field after
@@ -3080,13 +3407,36 @@ class TpuStateMachine:
         Deterministic given the ledger state; called at checkpoint
         boundaries by the replica, or directly under memory pressure.
         Returns the number of rows evicted."""
+        assert self._engine is None, "tiering runs on the device path"
+        if self._shard_mesh is not None and self._ledger_is_sharded:
+            # Tiering under TB_SHARDS (the long-excluded VOPR scenario,
+            # folded back in PR 20): eviction is a canonical-layout
+            # concern — pull the ledger single-layout (the _sequential
+            # window discipline), run the EXISTING exact eviction
+            # unchanged, re-place onto the mesh.  Determinism: both
+            # converters and the threshold selection are deterministic,
+            # so replicas evicting at the same op boundary stay
+            # byte-identical.
+            from .parallel import sharded as shard_mod
+
+            self._ledger = shard_mod.unshard_ledger(
+                self._ledger, self._shard_mesh
+            )
+            self._ledger_is_sharded = False
+            try:
+                return self._evict_cold_impl(frac)
+            finally:
+                self._ledger = shard_mod.shard_ledger(
+                    self._ledger, self._shard_mesh
+                )
+                self._ledger_is_sharded = True
+                self._canon = None
+                self._refresh_shard_bounds(self._ledger)
+        return self._evict_cold_impl(frac)
+
+    def _evict_cold_impl(self, frac: Optional[float] = None) -> int:
         from .ops import cold as cold_mod
 
-        assert self._engine is None, "tiering runs on the device path"
-        assert self._shard_mesh is None, (
-            "cold tiering is a single-device concern (machine init enforces "
-            "the exclusion; this guards direct calls)"
-        )
         if not self._tiering:
             self._tiering = True
             self._bloom_np = np.zeros(((1 << self._bloom_log2) // 32,), np.uint32)
@@ -3792,13 +4142,11 @@ class TpuStateMachine:
         )
         self._balance_bound = int(state.get("balance_bound", _BOUND_CLAMP))
         manifest = state.get("cold_manifest", [])
-        if manifest and self._shard_mesh is not None:
-            # The mesh path has no bloom/cold resolution: a checkpoint whose
-            # durable manifest says evictions happened cannot serve sharded.
-            raise DeviceStateUnrecoverable(
-                "cold tier active in checkpoint: unsupported under TB_SHARDS"
-            )
         if manifest:
+            # Cold tier under TB_SHARDS is served by the sequential
+            # fallback (mesh kernels carry no bloom): commits route
+            # through the canonical single-layout window while any row is
+            # cold, so a tiered checkpoint restores sharded just fine.
             self._tiering = True
             self.cold.load_manifest(manifest)
             self._bloom_log2 = int(state.get("bloom_log2", self._bloom_log2))

@@ -1006,3 +1006,28 @@ def machine_steps(mesh: Mesh, max_passes: int) -> dict:
         }
         _STEP_CACHE[key] = steps
     return steps
+
+
+# ---------------------------------------------------------------------------
+# Online shard split (docs/reconfiguration.md)
+# ---------------------------------------------------------------------------
+
+
+def split_moved_mask(key_lo: np.ndarray, key_hi: np.ndarray,
+                     old_shards: int) -> np.ndarray:
+    """Boolean mask of canonical slots whose OWNER changes on an
+    old_shards -> 2*old_shards split.  Owners are the low hash bits, so
+    doubling adds exactly one bit: a live row moves iff
+    ``mix64(key) & old_shards != 0`` (it lands on shard s + old_shards),
+    and stays resident otherwise.  Empty slots (key == 0) never move —
+    only the moved subset crosses the verified migration channel
+    (vsr/statesync.ship_chunk / verify_chunk); the stayed subset never
+    leaves its device."""
+    from ..ops.scrub import mix64_np
+
+    assert old_shards >= 1 and old_shards & (old_shards - 1) == 0
+    lo = np.asarray(key_lo, dtype=np.uint64)
+    hi = np.asarray(key_hi, dtype=np.uint64)
+    live = (lo | hi) != 0
+    owners = mix64_np(lo, hi)
+    return live & ((owners & np.uint64(old_shards)) != 0)

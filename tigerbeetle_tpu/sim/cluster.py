@@ -980,6 +980,29 @@ class SimCluster:
     def restart(self, i: int) -> None:
         self.start(i)
 
+    def add_reconfigure_client(
+        self, at_tick: int, new_rc: int, new_sc: int, seed: int = 0,
+    ) -> int:
+        """Attach a one-shot scripted client that submits a committed
+        ``reconfigure`` op at ``at_tick`` — the LIVE membership-change
+        path (docs/reconfiguration.md), as opposed to promote_standby's
+        stopped-file surgery.  Id stream is distinct (seed ^ 0x2ECF) so
+        base-client schedules stay untouched."""
+        cid = ((seed ^ 0x2ECF) * 1000 + 29) | 1
+        self.clients[cid] = OpenLoopClient(
+            client_id=cid,
+            cluster_id=self.cluster_id,
+            n_replicas=self.n,
+            seed=seed ^ 0x2ECF,
+            script=[(
+                at_tick,
+                wire.Operation.reconfigure,
+                wire.reconfigure_body(new_rc, new_sc),
+            )],
+        )
+        self._wire_client(self.clients[cid])
+        return cid
+
     def promote_standby(self, standby: int, voter_slot: int) -> None:
         """Promote a (stopped) standby's data file into a (stopped) voting
         slot — the in-sim twin of VsrReplica.promote + the operator moving

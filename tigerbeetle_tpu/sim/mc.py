@@ -105,6 +105,11 @@ MUTATIONS = (
     "cert_downgrade", # backup execution skips the ack-certificate gate
     "equiv_dedup",    # conflicting prepares adopted + re-acked; one-vote-
                       # per-op certificate dedup removed
+    # Reconfiguration knockout (docs/reconfiguration.md): view-change
+    # quorum sized from the membership the process booted with, ignoring
+    # committed reconfigure ops — after a 3+1 -> 4+0 promotion the stale
+    # VC quorum (2 of 4) stops intersecting replication quorums.
+    "reconfig_stale_quorum",
 )
 
 Event = Tuple  # flat tuples of str/int — JSON round-trippable
@@ -160,6 +165,14 @@ class McScope:
     # kinds its scenario needs — the unmutated control runs the SAME
     # restricted scope, so the passes/fails discipline is preserved.
     timeout_kinds: Optional[Tuple[str, ...]] = None
+    # Reconfiguration scope (docs/reconfiguration.md): ``n_standbys``
+    # non-voting stream consumers at indexes [n_replicas, n_replicas +
+    # n_standbys); ``reconfig`` prepends a promote-everything membership
+    # op (reconfigure to n_replicas + n_standbys voters, 0 standbys) to
+    # client 0's script, so the flip interleaves with the scope's crash /
+    # timeout / drop alphabet during exploration.
+    n_standbys: int = 0
+    reconfig: bool = False
     client_sends: int = 1       # sends per request (1 = no resends)
     max_view: int = 2           # states beyond are bound-pruned
     depth_max: int = 24
@@ -486,6 +499,7 @@ class McCluster:
         self.cluster = _McSimCluster(
             workdir,
             n_replicas=scope.n_replicas,
+            n_standbys=scope.n_standbys,
             n_clients=0,
             seed=scope.seed,
             config=MC_CONFIG,
@@ -502,6 +516,16 @@ class McCluster:
         for j in range(scope.n_clients):
             cid = (1009 * (j + 1)) | 1
             ops = []
+            if scope.reconfig and j == 0:
+                # The membership op rides client 0 FIRST: the promotion
+                # commits early, and every later op / fault event
+                # exercises the post-flip quorums.
+                ops.append((
+                    wire.Operation.reconfigure,
+                    wire.reconfigure_body(
+                        scope.n_replicas + scope.n_standbys, 0
+                    ),
+                ))
             for k in range(scope.ops_per_client):
                 acc = np.zeros(1, dtype=types.ACCOUNT_DTYPE)
                 acc["id_lo"] = 1000 * (j + 1) + k + 1

@@ -30,6 +30,7 @@ byzantine and overload VOPR kinds (sim/vopr.py) and drives the
 
 from __future__ import annotations
 
+import math
 import random
 from typing import List, Optional, Tuple
 
@@ -44,7 +45,7 @@ from ..vsr import wire
 ACCOUNT_BASE = 1 << 32
 TRANSFER_BASE = 1 << 40
 
-ARRIVALS = ("poisson", "uniform", "burst")
+ARRIVALS = ("poisson", "uniform", "burst", "diurnal")
 
 
 class OpenLoopGen:
@@ -65,8 +66,13 @@ class OpenLoopGen:
         query_rate: float = 0.15,
         ledger: int = 1,
         code: int = 10,
+        ledgers: int = 1,
+        ledger_skew: float = 1.2,
     ) -> None:
         assert arrival in ARRIVALS, arrival
+        assert ledgers >= 1 and hot_accounts >= 2 * ledgers, (
+            "every ledger needs >= 2 accounts for transfer pairs"
+        )
         self.seed = seed
         self.n_clients = n_clients
         self.hot_accounts = hot_accounts
@@ -81,10 +87,41 @@ class OpenLoopGen:
         # Zipf weights over the shared hot-account universe (rank 1 is the
         # hottest; shuffled so hotness is not correlated with id order).
         self.account_ids = [ACCOUNT_BASE + k for k in range(1, hot_accounts + 1)]
-        ranks = np.arange(1, hot_accounts + 1, dtype=np.float64)
-        weights = 1.0 / np.power(ranks, zipf_s)
-        perm = rng.permutation(hot_accounts)
-        self._zipf_p = (weights / weights.sum())[perm]
+        self.ledgers = ledgers
+        if ledgers == 1:
+            # Single-ledger path: draw sequence byte-identical to the
+            # pre-multi-ledger generator (pinned byzantine/overload/
+            # catch-up seeds replay their exact traffic).
+            ranks = np.arange(1, hot_accounts + 1, dtype=np.float64)
+            weights = 1.0 / np.power(ranks, zipf_s)
+            perm = rng.permutation(hot_accounts)
+            self._zipf_p = (weights / weights.sum())[perm]
+            self._groups = None
+        else:
+            # Multi-ledger/multi-currency skew: accounts split into one
+            # contiguous group per ledger; ledgers themselves are Zipf
+            # over ``ledger_skew`` (one dominant currency, a long tail),
+            # and transfers stay WITHIN a ledger — cross-currency rows
+            # would just be rejected noise.  Ledger numbers ride
+            # ``ledger + g``, currency codes ``code + g``.
+            lranks = np.arange(1, ledgers + 1, dtype=np.float64)
+            lw = 1.0 / np.power(lranks, ledger_skew)
+            self._ledger_p = lw / lw.sum()
+            bounds = np.linspace(0, hot_accounts, ledgers + 1).astype(int)
+            self._groups = []
+            self._group_p = []
+            global_p = np.zeros(hot_accounts, dtype=np.float64)
+            for g in range(ledgers):
+                lo, hi = int(bounds[g]), int(bounds[g + 1])
+                ids = self.account_ids[lo:hi]
+                ranks = np.arange(1, len(ids) + 1, dtype=np.float64)
+                weights = 1.0 / np.power(ranks, zipf_s)
+                perm = rng.permutation(len(ids))
+                gp = (weights / weights.sum())[perm]
+                self._groups.append(ids)
+                self._group_p.append(gp)
+                global_p[lo:hi] = gp * self._ledger_p[g]
+            self._zipf_p = global_p  # zipf_skew()'s global view
 
         # Arrival schedule: (tick, client_index) pairs over the horizon.
         ticks = self._arrival_ticks(rng)
@@ -130,12 +167,33 @@ class OpenLoopGen:
             while t < self.horizon:
                 t += step * (0.5 + rng.random())
                 out.append(t)
-        else:  # burst: groups of ~4 arrivals at 4x spacing
+        elif self.arrival == "burst":  # groups of ~4 arrivals at 4x spacing
             while t < self.horizon:
                 t += 4.0 / self.rate
                 for _ in range(int(rng.integers(2, 7))):
                     out.append(t + float(rng.random()))
-        return [int(x) for x in out if x < self.horizon]
+        else:  # diurnal: two day-cycles with midday burst clusters
+            # Poisson thinning against a raised-cosine intensity (trough
+            # ~= 10% of the mean rate, peak ~= 2.5x), plus a burst group
+            # at each peak — the daily shape of production payment
+            # traffic, which uniform arrival processes never stress.
+            peak = 2.5 * self.rate
+            span = max(1.0, (self.horizon - self.start_tick) / 2.0)
+            while t < self.horizon:
+                t += rng.exponential(1.0 / peak)
+                phase = 2.0 * math.pi * (t - self.start_tick) / span
+                lam = self.rate * (
+                    0.1 + 2.4 * (0.5 - 0.5 * math.cos(phase)) ** 2
+                )
+                if rng.random() < lam / peak:
+                    out.append(t)
+            for day in range(2):
+                mid = self.start_tick + span * (day + 0.5)
+                for _ in range(int(rng.integers(6, 14))):
+                    out.append(mid + float(rng.normal(0.0, span * 0.02)))
+        return [
+            int(x) for x in out if self.start_tick <= x < self.horizon
+        ]
 
     # -- batch builders -------------------------------------------------------
 
@@ -150,7 +208,7 @@ class OpenLoopGen:
         for i, chunk in enumerate(chunks):
             rows = [
                 types.account(
-                    id=a, ledger=self.ledger, code=self.code,
+                    id=a, ledger=self._ledger_of(a), code=self._code_of(a),
                     user_data_64=int(rng.integers(0, 1 << 32)),
                 )
                 for a in chunk
@@ -162,11 +220,35 @@ class OpenLoopGen:
                 types.accounts_array(rows).tobytes(),
             ))
 
-    def _pick_pair(self, rng) -> Tuple[int, int]:
+    def _ledger_of(self, account_id: int) -> int:
+        if self._groups is None:
+            return self.ledger
+        for g, ids in enumerate(self._groups):
+            if account_id in ids:
+                return self.ledger + g
+        raise KeyError(account_id)
+
+    def _code_of(self, account_id: int) -> int:
+        return self.code + (self._ledger_of(account_id) - self.ledger)
+
+    def _pick_pair(self, rng) -> Tuple[int, int, int, int]:
+        """(debit, credit, ledger, code) — single-ledger keeps the legacy
+        one-draw sequence; multi-ledger draws the ledger first so pairs
+        stay within one currency."""
+        if self._groups is None:
+            dr, cr = rng.choice(
+                len(self.account_ids), size=2, replace=False, p=self._zipf_p
+            )
+            return (
+                self.account_ids[int(dr)], self.account_ids[int(cr)],
+                self.ledger, self.code,
+            )
+        g = int(rng.choice(self.ledgers, p=self._ledger_p))
+        ids = self._groups[g]
         dr, cr = rng.choice(
-            len(self.account_ids), size=2, replace=False, p=self._zipf_p
+            len(ids), size=2, replace=False, p=self._group_p[g]
         )
-        return self.account_ids[int(dr)], self.account_ids[int(cr)]
+        return ids[int(dr)], ids[int(cr)], self.ledger + g, self.code + g
 
     def _transfer_batch(
         self, rng, ci, batch, pending_by_client, seq_by_client,
@@ -176,7 +258,7 @@ class OpenLoopGen:
         for _ in range(batch):
             seq_by_client[ci] += 1
             tid = TRANSFER_BASE + ci * 1_000_000 + seq_by_client[ci]
-            dr, cr = self._pick_pair(rng)
+            dr, cr, ledger, code = self._pick_pair(rng)
             flags = 0
             timeout = 0
             if rng.random() < two_phase_rate:
@@ -187,7 +269,7 @@ class OpenLoopGen:
             rows.append(types.transfer(
                 id=tid, debit_account_id=dr, credit_account_id=cr,
                 amount=int(rng.integers(1, 1 << 24)), timeout=timeout,
-                ledger=self.ledger, code=self.code, flags=flags,
+                ledger=ledger, code=code, flags=flags,
                 user_data_64=int(rng.integers(0, 1 << 16)),
             ))
         return (
@@ -211,10 +293,10 @@ class OpenLoopGen:
             if rng.random() < 0.7
             else TransferFlags.VOID_PENDING_TRANSFER
         )
-        dr, cr = self._pick_pair(rng)
+        dr, cr, ledger, code = self._pick_pair(rng)
         rows = [types.transfer(
             id=tid, debit_account_id=dr, credit_account_id=cr,
-            amount=0, pending_id=pid, ledger=self.ledger, code=self.code,
+            amount=0, pending_id=pid, ledger=ledger, code=code,
             flags=int(flag),
         )]
         return (

@@ -116,15 +116,13 @@ def run_seed(
     # draw from the schedule rng would shift every pinned regression
     # seed's fault schedule.
     hot_cap = random.Random(seed ^ 0xC01D).choice([None, None, None, 128])
-    if os.environ.get("TB_SHARDS", "").isdigit() and int(
-        os.environ["TB_SHARDS"]
-    ) >= 2:
-        # Sharded serving (TB_SHARDS x VOPR): cold tiering is a
-        # single-device concern (no bloom on the mesh path; machine init
-        # enforces the exclusion).  The draw above still consumed its
-        # stream, so arming shards never shifts a pinned seed's schedule —
-        # tiered schedules simply run untiered, like device_faults does.
-        hot_cap = None
+    # Sharded serving (TB_SHARDS x VOPR): tiered schedules run TIERED
+    # since the reconfiguration PR — evictions open a canonical
+    # single-layout window and mesh commits route through the sequential
+    # fallback while any row is cold (machine.evict_cold /
+    # _sharded_commit_transfers), so the long-excluded cold x shards
+    # scenarios are back under the fuzz net (pinned seed:
+    # tests/test_reconfig.py::test_vopr_cold_tiering_under_shards).
     partition_modes = ["isolate_single", "uniform_size", "uniform_partition"]
     # Device fault kind (opt-in; docs/fault_domains.md): schedule drawn
     # from a SEPARATE stream so arming it cannot shift the base schedule,
@@ -1110,3 +1108,300 @@ def run_overload_seed(
         return go(workdir)
     with tempfile.TemporaryDirectory() as d:
         return go(d)
+
+
+@dataclasses.dataclass
+class ReconfigResult(VoprResult):
+    """VoprResult + the reconfiguration fault kind's accounting."""
+
+    verify: bool = True
+    reshard: bool = True
+    promotion: bool = True
+    crash_source: int = -1        # migration source crashed mid-transfer
+    killed_primary: int = -1      # primary killed after the promotion op
+    promoted: bool = False        # membership flip observed on every seat
+    shards_final: Optional[list] = None   # per-live-replica shard count
+    reshard_stats: Optional[dict] = None  # summed over every seat
+    digest_oracle: int = -1       # no-reshard oracle run's final digest
+    digest_final: int = -1
+
+
+def run_reconfig_seed(
+    seed: int,
+    workdir: Optional[str] = None,
+    verify: bool = True,
+    reshard: bool = True,
+    promotion: bool = True,
+    oracle: Optional[bool] = None,
+    ticks: int = 2_400,
+    settle_ticks: int = 30_000,
+) -> ReconfigResult:
+    """The RECONFIGURATION fault kind (docs/reconfiguration.md): cluster
+    shape changes under fire.
+
+    Schedule (one seed, replayed bit-identically): an open-loop flood; at
+    RESHARD_AT every seat arms an online 2 -> 4 shard split pumped one
+    Merkle-verified chunk per tick while serving continues; one migration
+    SOURCE is crashed mid-transfer (its split rolls back with the machine
+    rebuild, and it re-arms after restart — resume-by-rollback); one seat's
+    chunk 0 (an ACCOUNTS chunk) is corrupted in flight; a committed
+    ``reconfigure`` op promotes the standby into the voter set; then the
+    primary is killed, so the view change that follows needs the promoted
+    seat in its quorum.  After healing, every surviving split is pumped to
+    completion and the cluster must converge with every oracle green.
+
+    - ``verify=True``: the corrupt chunk is rejected by its leaf check and
+      re-shipped (chunk_retries > 0); the run passes and the final digest
+      is byte-identical to the no-reshard ORACLE run of the same schedule.
+    - ``verify=False`` is the NEGATIVE CONTROL, the scrub-off discipline:
+      the same corrupt chunk installs unaudited, the cutover digest gate is
+      off, and the run must demonstrably fail the convergence/audit
+      oracles (exit 129) — proving chunk verification is load-bearing.
+
+    Needs >= 4 devices (tests run under jaxenv.force_cpu(8)).  Reshard
+    events live on fixed ticks + dedicated streams, so arming the kind
+    never shifts run_seed schedules."""
+    import jax as _jax
+
+    if len(_jax.devices()) < 4:
+        raise RuntimeError(
+            "reconfig kind needs >= 4 devices for the 2 -> 4 split "
+            "(jaxenv.force_cpu(8) before importing jax)"
+        )
+    if oracle is None:
+        oracle = verify and reshard
+    from .openloop import OpenLoopGen
+
+    RESHARD_AT = 300
+    RESTART_AT = 900
+    PROMOTE_AT = 1_200
+    KILL_PRIMARY_AT = 1_700
+    gen = OpenLoopGen(
+        seed ^ 0x2ECF,
+        n_clients=6,
+        hot_accounts=32,
+        arrival="poisson",
+        rate=0.05,
+        start_tick=40,
+        horizon=1_400,
+        batch=4,
+    )
+
+    def go(workdir: str, with_reshard: bool) -> ReconfigResult:
+        cluster = SimCluster(
+            workdir,
+            n_replicas=3,
+            n_clients=1,
+            seed=seed,
+            requests_per_client=4,
+            net=PacketSimulator(seed=seed + 1, delay_mean=2, delay_max=8),
+            n_standbys=1,
+        )
+        gen.attach(cluster)
+        if promotion:
+            cluster.add_reconfigure_client(
+                at_tick=PROMOTE_AT, new_rc=4, new_sc=0, seed=seed,
+            )
+        crash_source = -1
+        killed_primary = -1
+        faults = 0
+        # Per-seat split state: 'armed' seats pump one chunk per tick;
+        # an abandon stops re-arming (graceful degradation, not a retry
+        # storm).  Corruption rides ONE seat's chunk 0 — the first
+        # ACCOUNTS chunk, so a verify-off install is digest-visible — and
+        # that seat is NEVER the crash victim: a crashed seat falls far
+        # enough behind to resync wholesale from a clean peer, which
+        # would heal the very divergence the negative control must
+        # demonstrate (state sync repairing divergence is correct, but it
+        # is not this seed's proof).
+        dead_splits: set = set()
+        corrupt_seat = 2
+
+        def arm(i: int) -> None:
+            m = cluster.replicas[i].machine
+            if (
+                not with_reshard or i in dead_splits or m.reshard_active
+                or m.shards != 2
+            ):
+                return
+            kw = {"verify": verify, "chunk_rows": 16}
+            if i == corrupt_seat and m.reshard_stats["splits_started"] == 0:
+                kw["corrupt_chunks"] = {0}
+            if not m.reshard_begin(4, **kw):
+                dead_splits.add(i)
+
+        def pump(i: int) -> None:
+            m = cluster.replicas[i].machine
+            if m.reshard_active and m.reshard_step(1) == "abandoned":
+                dead_splits.add(i)
+
+        def result(code: int, reason: str) -> ReconfigResult:
+            live = [
+                (i, r) for i, (r, a) in
+                enumerate(zip(cluster.replicas, cluster.alive)) if a
+            ]
+            stats: dict = {}
+            for _i, r in live:
+                for k, v in r.machine.reshard_stats.items():
+                    stats[k] = stats.get(k, 0) + v
+            commits = max((r.commit_min for _i, r in live), default=0)
+            res = ReconfigResult(
+                seed, code, reason, cluster.t, commits, faults,
+                verify=verify, reshard=with_reshard, promotion=promotion,
+                crash_source=crash_source, killed_primary=killed_primary,
+                promoted=bool(live) and all(
+                    r.replica_count == 4 for _i, r in live
+                ),
+                shards_final=[r.machine.shards for _i, r in live],
+                reshard_stats=stats,
+                digest_final=(
+                    int(live[0][1].machine.digest()) if live else -1
+                ),
+            )
+            if code != EXIT_PASSED:
+                res.blackboxes = {
+                    box.name: box.dump_text() for box in cluster.blackboxes
+                }
+            if _obs.enabled:
+                _obs.counter("vopr.seeds").inc()
+                outcome = {
+                    EXIT_PASSED: "passed",
+                    EXIT_LIVENESS: "liveness",
+                    EXIT_CORRECTNESS: "correctness",
+                }[code]
+                _obs.counter(f"vopr.{outcome}").inc()
+                _obs.counter("vopr.faults").inc(faults)
+            return res
+
+        try:
+            for t in range(ticks):
+                cluster.step()
+                if t >= RESHARD_AT:
+                    for i in range(cluster.total):
+                        if cluster.alive[i]:
+                            arm(i)
+                            pump(i)
+                if (
+                    with_reshard and crash_source == -1
+                    and t > RESHARD_AT
+                ):
+                    # Crash the first NON-PRIMARY voter caught genuinely
+                    # mid-transfer (chunks shipped, cutover not reached).
+                    for i in range(cluster.n):
+                        r = cluster.replicas[i]
+                        if (
+                            cluster.alive[i] and not r.is_primary
+                            and i != corrupt_seat
+                            and r.machine.reshard_active
+                            and r.machine.reshard_stats["chunks"] > 0
+                        ):
+                            cluster.crash(i)
+                            crash_source = i
+                            faults += 1
+                            if _obs.enabled:
+                                _obs.counter(
+                                    "vopr.faults.reshard_crash"
+                                ).inc()
+                            break
+                if t == RESTART_AT and crash_source >= 0:
+                    if not cluster.alive[crash_source]:
+                        cluster.restart(crash_source)
+                if t == KILL_PRIMARY_AT:
+                    live_voters = [
+                        i for i in range(cluster.total)
+                        if cluster.alive[i]
+                        and cluster.replicas[i].is_primary
+                    ]
+                    if live_voters:
+                        killed_primary = live_voters[0]
+                        cluster.crash(killed_primary)
+                        faults += 1
+                        if _obs.enabled:
+                            _obs.counter("vopr.faults.primary_kill").inc()
+            # Heal: everyone restarts; surviving splits pump to DONE (the
+            # crashed source's split rolled back with the machine rebuild
+            # and re-arms here — resume-by-rollback, never a wedge).
+            for i in range(cluster.total):
+                if not cluster.alive[i]:
+                    cluster.restart(i)
+            for i in range(cluster.total):
+                arm(i)
+                guard = 0
+                while cluster.replicas[i].machine.reshard_active:
+                    pump(i)
+                    guard += 1
+                    assert guard < 10_000, "split failed to terminate"
+            ok = cluster.run_until(
+                lambda: cluster.clients_done() and cluster.converged(),
+                max_ticks=settle_ticks,
+            )
+            if not ok:
+                # Distinguish a stalled cluster (liveness) from replicas
+                # that SETTLED on different state (correctness): with
+                # verification off the corrupt chunk's install diverges
+                # forever — that must exit 129, not 128.
+                cluster.check_converged()
+                states = [
+                    (r.status, r.view, r.commit_min) if r else None
+                    for r in cluster.replicas
+                ]
+                return result(
+                    EXIT_LIVENESS,
+                    f"no convergence after {settle_ticks} settle ticks: "
+                    f"{states}",
+                )
+            cluster.check_converged()
+            cluster.check_conservation()
+            return result(EXIT_PASSED, "passed")
+        except AssertionError as err:
+            return result(EXIT_CORRECTNESS, f"oracle violation: {err}")
+        except Exception as err:  # noqa: BLE001 — a crash IS a find
+            import traceback
+
+            tb = traceback.format_exc().strip().splitlines()
+            return result(
+                EXIT_CORRECTNESS,
+                f"crash: {type(err).__name__}: {err} @ {tb[-3:]}",
+            )
+
+    def both(workdir: str) -> ReconfigResult:
+        # Sharded serving for every machine in this scenario (the env twin
+        # the CLI sets; restored so the kind never leaks into run_seed).
+        prev = os.environ.get("TB_SHARDS")
+        os.environ["TB_SHARDS"] = "2"
+        try:
+            digest_oracle = -1
+            if oracle:
+                odir = os.path.join(workdir, "oracle")
+                os.makedirs(odir, exist_ok=True)
+                oracle_res = go(odir, with_reshard=False)
+                if oracle_res.exit_code != EXIT_PASSED:
+                    oracle_res.reason = (
+                        f"no-reshard ORACLE run failed: {oracle_res.reason}"
+                    )
+                    return oracle_res
+                digest_oracle = oracle_res.digest_final
+            mdir = os.path.join(workdir, "main")
+            os.makedirs(mdir, exist_ok=True)
+            res = go(mdir, with_reshard=reshard)
+            res.digest_oracle = digest_oracle
+            if (
+                res.exit_code == EXIT_PASSED and oracle
+                and res.digest_final != digest_oracle
+            ):
+                res.exit_code = EXIT_CORRECTNESS
+                res.reason = (
+                    f"resharded digest {res.digest_final:#x} diverges from "
+                    f"the no-reshard oracle {digest_oracle:#x}"
+                )
+            return res
+        finally:
+            if prev is None:
+                os.environ.pop("TB_SHARDS", None)
+            else:
+                os.environ["TB_SHARDS"] = prev
+
+    if workdir is not None:
+        return both(workdir)
+    with tempfile.TemporaryDirectory() as d:
+        return both(d)

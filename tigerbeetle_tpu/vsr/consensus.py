@@ -357,7 +357,11 @@ class VsrReplica(Replica):
     # -- identity ------------------------------------------------------------
 
     def primary_index(self, view: Optional[int] = None) -> int:
-        return (self.view if view is None else view) % self.replica_count
+        v = self.view if view is None else view
+        # primary_offset: committed reconfiguration keeps the serving
+        # primary fixed across a quorum-membership flip; 0 forever on a
+        # never-reconfigured cluster (docs/reconfiguration.md).
+        return (v + self._primary_offset) % self.replica_count
 
     @property
     def is_standby(self) -> bool:
@@ -381,6 +385,49 @@ class VsrReplica(Replica):
     def is_primary(self) -> bool:
         return self.status == NORMAL and self.primary_index() == self.replica
 
+    def _membership_changed(self, old_rc: int, old_sc: int,
+                            view: int) -> None:
+        """A reconfigure op committed: fix the primary mapping so THIS
+        prepare's view keeps its primary under the new modulus (quorum
+        flips never move the primary without a view change), rebuild the
+        clock over the new voter set, and persist — all pure functions of
+        committed state, so every replica (and every replay) lands on the
+        same offset."""
+        old_primary = (view + self._primary_offset) % old_rc
+        self._primary_offset = (old_primary - view) % self.replica_count
+        if self.clock is not None:
+            # Rebuild the sample quorum over the new voter set WITHOUT
+            # re-drawing jitter or resetting time_ns (determinism: the
+            # prng stream must not depend on membership history), and
+            # CARRY the learned samples AND the current sync estimate
+            # over: dropping them would un-synchronize the clock and make
+            # the primary shed every request (BUSY_CLOCK) until a full
+            # ping round under the NEW quorum — a needless availability
+            # dip on every membership flip (pre-flip samples exclude
+            # standbys by design, replica.zig:1274, so a 3+1 -> 4+0
+            # promotion can never meet quorum 3 from carried samples
+            # alone), and a permanent wedge in the frozen-time model
+            # checker.  The wall-clock estimate is not invalidated by a
+            # membership flip; its confidence basis is merely stale, and
+            # the next pong re-runs Marzullo under the new quorum.
+            old_clock = self.clock
+            self.clock = Clock(
+                self.replica_count, self.replica, self._monotonic,
+                self._realtime,
+            )
+            self.clock.samples = dict(old_clock.samples)
+            self.clock.epoch_start_monotonic = (
+                old_clock.epoch_start_monotonic
+            )
+            self.clock.offset_ns = old_clock.offset_ns
+            self.clock._synchronized = old_clock._synchronized
+        self._persist_view()
+        if _obs.enabled:
+            _obs.counter(
+                "reconfig.promotions" if self.replica_count > old_rc
+                else "reconfig.demotions"
+            ).inc()
+
     @property
     def commit_backlog(self) -> bool:
         """Journaled ops known-committed but not yet executed (the bus
@@ -393,7 +440,19 @@ class VsrReplica(Replica):
 
     @property
     def quorum_view_change(self) -> int:
-        q = quorums(self.replica_count)[1]
+        rc = self.replica_count
+        if "reconfig_stale_quorum" in self.mc_mutations:
+            # Seeded mutation (tools/tbmc): the view-change quorum is
+            # sized from the membership this process OPENED with,
+            # ignoring committed reconfigure ops.  After a 3+1 -> 4+0
+            # promotion the stale quorum (2 of 4) no longer intersects
+            # every replication quorum (2 + 2 = 4, not > 4), so a view
+            # change can canonicalize a history that misses a committed
+            # op (mc.py exhibits a machine-checked counterexample at the
+            # pinned reconfig scope; replication quorums are unaffected
+            # because quorums(3)[0] == quorums(4)[0]).
+            rc = self._boot_replica_count
+        q = quorums(rc)[1]
         if "vc_quorum" in self.mc_mutations:
             # Seeded mutation (tools/tbmc): the classic off-by-one — view
             # changes complete one vote short, so canonical selection can
@@ -556,6 +615,12 @@ class VsrReplica(Replica):
             commit_min=max(self._sb_state.commit_min, self.commit_min),
             commit_max=max(self._sb_state.commit_max, self.commit_max),
             log_adopted_op=getattr(self, "_log_adopted_op", 0),
+            # Membership + primary mapping ride every view write: a
+            # committed reconfiguration must never be forgotten by a
+            # crash between its commit and the next checkpoint.
+            replica_count=self.replica_count,
+            standby_count=self.standby_count,
+            primary_offset=self._primary_offset,
         )
         # Through the single merge-point: a concurrent background
         # checkpoint (async_checkpoint) must not be reverted or raced.
@@ -2150,7 +2215,7 @@ class VsrReplica(Replica):
             nonce=self._rsv_nonce,
         )
         req["replica"] = self.replica
-        return [(("replica", view % self.replica_count), wire.encode(req))]
+        return [(("replica", self.primary_index(view)), wire.encode(req))]
 
     def on_request_start_view(self, h: np.ndarray, body: bytes) -> List[Msg]:
         if not self._ingress_auth(h):
@@ -3512,6 +3577,7 @@ class VsrReplica(Replica):
             replica=self.replica,
             replica_count=self.replica_count,
             standby_count=self.standby_count,  # membership rides every write
+            primary_offset=self._primary_offset,
             view=self.view,
             log_view=self.log_view,
             commit_min=self.commit_min,
@@ -3993,6 +4059,7 @@ class VsrReplica(Replica):
 
     _MC_SCALARS = (
         "cluster", "replica", "replica_count", "standby_count",
+        "_primary_offset", "_boot_replica_count",
         "view", "log_view", "status", "op", "commit_min", "commit_max",
         "op_checkpoint", "parent_checksum", "_verify_floor", "_log_suspect",
         "_log_adopted_op", "byzantine_detections", "_dvc_sent_for",

@@ -173,6 +173,16 @@ class Replica:
         self.replica = 0
         self.replica_count = 1
         self.standby_count = 0
+        # Primary rotation offset (docs/reconfiguration.md): a committed
+        # membership change keeps the current view's primary fixed under
+        # the new modulus by adjusting this offset; persisted in the
+        # superblock so restarts agree.
+        self._primary_offset = 0
+        # Membership this process OPENED with (refreshed from the
+        # superblock on every open): read only by the tbmc
+        # ``reconfig_stale_quorum`` knockout, which models a node sizing
+        # its view-change quorum from the pre-reconfigure membership.
+        self._boot_replica_count = 1
         # Wire authentication (vsr/auth.Keychain); None = zero-MAC legacy
         # wire.  The consensus layer (VsrReplica) adds the strict-mode
         # policy knobs; the base replica only needs the keychain to stamp
@@ -317,6 +327,8 @@ class Replica:
         self.replica = sb.replica
         self.replica_count = sb.replica_count
         self.standby_count = sb.standby_count
+        self._primary_offset = getattr(sb, "primary_offset", 0)
+        self._boot_replica_count = self.replica_count
         self.view = sb.view
         self.op_checkpoint = sb.op_checkpoint
         self.commit_min = sb.op_checkpoint
@@ -1382,6 +1394,11 @@ class Replica:
                 client=client, session=op, request=0, reply_bytes=b""
             )
             self._admit_session(session)
+        elif operation == wire.Operation.reconfigure:
+            result_body = self._apply_reconfigure(header, body)
+            self.commit_min = op
+            if _obs.enabled:
+                _obs.counter("replica.commits").inc()
         else:
             if result_body is None:
                 t0 = time.perf_counter_ns() if _obs.enabled else 0  # tblint: ignore[nondet] metrics
@@ -1555,6 +1572,17 @@ class Replica:
             # yields an empty reply (parse_filter_from_input,
             # state_machine.zig:810-820).
             return
+        if operation == wire.Operation.reconfigure:
+            # <u4 new_replica_count, <u4 new_standby_count, 8 B reserved.
+            # Shape only — semantic checks happen at APPLY under the
+            # membership current at that op (deterministic across replicas
+            # and replay; an invalid transition commits a reject status).
+            if len(body) != 16:
+                raise InvalidRequest(
+                    "reconfigure body must be 16 bytes "
+                    "(u32 replica_count, u32 standby_count, 8 reserved)"
+                )
+            return
         if operation == wire.Operation.get_proof:
             # 16 B: one u128 id (accounts — PR 10 shape); 24 B: id + u64
             # kind selector.  Every journaled prepare must replay, so the
@@ -1569,6 +1597,71 @@ class Replica:
                     raise InvalidRequest(f"unknown proof kind {kind}")
             return
         raise InvalidRequest(f"operation {operation!r} not accepted")
+
+    # -- membership reconfiguration (docs/reconfiguration.md) ----------------
+
+    # Reply status codes (u64 LE result body) for operation reconfigure.
+    RECONFIGURE_OK = 0
+    RECONFIGURE_BAD_TRANSITION = 1   # not a single-step promote/demote
+    RECONFIGURE_BOUNDS = 2           # outside REPLICAS_MAX/STANDBYS_MAX/solo
+    RECONFIGURE_PRIMARY_DEMOTION = 3  # would demote the serving primary
+
+    def _apply_reconfigure(self, header, body: bytes) -> bytes:
+        """Execute a committed membership-change op.  Runs at the SAME op
+        on every replica (and on WAL replay), so every input is taken from
+        deterministic state: the membership current at this op and the
+        prepare header's view — never the local wall clock or the
+        replica's own (possibly lagging) view.  Idempotent: re-applying
+        the current membership is a success no-op, which makes
+        crash-replay safe without any dedup bookkeeping."""
+        import numpy as np
+
+        from .superblock import REPLICAS_MAX, STANDBYS_MAX
+
+        lanes = np.frombuffer(body[:8], "<u4")
+        new_rc, new_sc = int(lanes[0]), int(lanes[1])
+        old_rc, old_sc = self.replica_count, self.standby_count
+        status = self.RECONFIGURE_OK
+        if (new_rc, new_sc) == (old_rc, old_sc):
+            pass  # idempotent re-apply (crash replay)
+        elif new_rc + new_sc != old_rc + old_sc or (
+            abs(new_rc - old_rc) != 1
+        ):
+            # One step at a time, voters <-> standbys only: promotion
+            # makes standby index old_rc a voter; demotion makes voter
+            # index old_rc - 1 the first standby.  Indexes never move.
+            status = self.RECONFIGURE_BAD_TRANSITION
+        elif not (
+            1 <= new_rc <= REPLICAS_MAX and 0 <= new_sc <= STANDBYS_MAX
+        ) or (new_rc == 1 and new_sc > 0):
+            status = self.RECONFIGURE_BOUNDS
+        elif new_rc < old_rc and self._reconfigure_primary(
+            int(header["view"]), old_rc
+        ) == old_rc - 1:
+            # Demoting the replica that is primary at this prepare's view
+            # would drop the cluster's serving head without a view change.
+            status = self.RECONFIGURE_PRIMARY_DEMOTION
+        else:
+            self.replica_count, self.standby_count = new_rc, new_sc
+            self._membership_changed(old_rc, old_sc, int(header["view"]))
+            if _obs.enabled:
+                _obs.counter("reconfig.membership_ops").inc()
+                _obs.gauge("reconfig.replica_count").set(new_rc)
+                _obs.gauge("reconfig.standby_count").set(new_sc)
+        if status != self.RECONFIGURE_OK and _obs.enabled:
+            _obs.counter("reconfig.membership_rejected").inc()
+        return int(status).to_bytes(8, "little")
+
+    def _reconfigure_primary(self, view: int, replica_count: int) -> int:
+        """Primary index at ``view`` under an explicit membership (the
+        deterministic pre-transition mapping)."""
+        return (view + self._primary_offset) % replica_count
+
+    def _membership_changed(self, old_rc: int, old_sc: int,
+                            view: int) -> None:
+        """Post-transition hook.  The base replica only records the new
+        shape (solo replicas can only no-op); VsrReplica overrides to fix
+        the primary mapping, rebuild the clock quorum, and persist."""
 
     def _event_count(self, operation: wire.Operation, body: bytes) -> int:
         if operation in (
@@ -1820,6 +1913,7 @@ class Replica:
             # checkpoint erase standby_count, so restarted voters stopped
             # broadcasting to standbys forever.
             standby_count=self.standby_count,
+            primary_offset=self._primary_offset,
             view=fields["view"],
             log_view=fields["log_view"],
             commit_min=op,

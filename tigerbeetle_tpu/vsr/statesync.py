@@ -403,3 +403,66 @@ class SyncPack:
         self.digest = np_digest(self.arrays)
         self.state_checksum = arrays_checksum(self.arrays)
         self.roots_body = pack_roots(self.arrays, self.trees, self.meta)
+
+
+# -- online reshard migration (docs/reconfiguration.md) -----------------------
+#
+# An N -> 2N shard split reuses this codec verbatim: the split adds one
+# owner bit, so a canonical slot either stays on its shard or moves to
+# shard s+N.  Only the MOVED subset crosses the wire; every chunk is a
+# pack_rows payload re-hashed against the already-built source tree
+# (verify_rows), and the staged full state must pass arrays_checksum
+# before the new layout may take over.  The helpers below carve the
+# moved subset into bounded chunks and audit each one — the machine-side
+# engine (machine.TpuStateMachine.reshard_*) drives them between
+# commits.
+
+
+def chunk_slots(slots: np.ndarray, chunk: int) -> List[np.ndarray]:
+    """Split a slot vector into <= chunk-sized contiguous pieces (wire
+    bound: one migration message per piece)."""
+    slots = np.asarray(slots, dtype=np.int64)
+    if len(slots) == 0:
+        return []
+    return [slots[i:i + chunk] for i in range(0, len(slots), max(1, chunk))]
+
+
+def ship_chunk(
+    arrays: Dict[str, np.ndarray], tree: np.ndarray, pad: str,
+    slots: np.ndarray, corrupt: bool = False,
+) -> bytes:
+    """Responder side of one migration chunk: a pack_rows payload for
+    ``slots``.  ``corrupt`` flips one byte of the key_lo segment (fault
+    injection: a lying or bit-flipped migration source) — keys are
+    leaf-covered for every pad, so the receiver's verify_chunk must
+    catch it, or, with verification disabled, install the divergence the
+    auditor then catches (the scrub-off discipline)."""
+    body = bytearray(pack_rows(arrays, pad, slots))
+    if corrupt and body:
+        off = 0
+        for k in per_slot_keys(arrays, pad):
+            size = arrays[k].dtype.itemsize * len(slots)
+            if k.endswith("/key_lo"):
+                body[off + size // 2] ^= 0x40
+                break
+            off += size
+        else:  # pragma: no cover - every pad has a key_lo
+            body[len(body) // 2] ^= 0x40
+    return bytes(body)
+
+
+def verify_chunk(
+    arrays: Dict[str, np.ndarray], tree: np.ndarray, pad: str,
+    slots: np.ndarray, body: bytes,
+) -> Optional[Dict[str, np.ndarray]]:
+    """Receiver side: unpack against the receiver's own schema and
+    re-hash every row against the verified source tree leaf for its
+    slot.  None => reject the chunk (retry, then abandon the split)."""
+    rows = unpack_rows(arrays, pad, slots, body)
+    if rows is None:
+        return None
+    cap = pad_capacity(arrays, pad)
+    want = {cap + int(s): int(tree[cap + int(s)]) for s in slots}
+    if not verify_rows(rows, pad, slots, want, cap):
+        return None
+    return rows

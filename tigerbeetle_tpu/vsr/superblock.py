@@ -23,7 +23,8 @@ from .checksum import checksum
 from .storage import SUPERBLOCK_COPIES, SUPERBLOCK_COPY_SIZE, Storage
 
 MAGIC = 0x7462_7470_7573_6201  # "tbtpusb\x01"
-VERSION = 2  # v2: +log_adopted_op amputation watermark (round 5)
+VERSION = 3  # v3: +primary_offset (committed reconfiguration, PR 20);
+             # v2: +log_adopted_op amputation watermark (round 5)
 
 # log_adopted_op sentinel written by VsrReplica.promote: a promoted data
 # file opens log_suspect and can only be certified by installing a
@@ -46,7 +47,13 @@ SUPERBLOCK_DTYPE = np.dtype(
         ("replica", "u1"),
         ("replica_count", "u1"),
         ("standby_count", "u1"),
-        ("_pad2", "V5"),
+        # Primary rotation offset: primary(view) = (view + primary_offset)
+        # % replica_count.  A committed membership change (operation
+        # reconfigure) picks the offset that keeps the CURRENT view's
+        # primary fixed under the new modulus, so quorum flips never move
+        # the primary without a view change (docs/reconfiguration.md).
+        ("primary_offset", "u1"),
+        ("_pad2", "V4"),
         ("sequence", "<u8"),
         # -- VSRState (superblock.zig CheckpointState analogue) --
         ("view", "<u4"),
@@ -85,6 +92,7 @@ class SuperBlockState:
     # standby_count) — they consume the prepare stream but never ack or
     # vote (constants.zig:31-35).
     standby_count: int = 0
+    primary_offset: int = 0
     sequence: int = 0
     view: int = 0
     log_view: int = 0
@@ -109,6 +117,7 @@ def _encode_copy(state: SuperBlockState, copy: int) -> bytes:
     rec["replica"] = state.replica
     rec["replica_count"] = state.replica_count
     rec["standby_count"] = state.standby_count
+    rec["primary_offset"] = state.primary_offset
     rec["sequence"] = state.sequence
     rec["view"] = state.view
     rec["log_view"] = state.log_view
@@ -154,6 +163,7 @@ def _decode_copy(buf: bytes) -> Optional[Tuple[SuperBlockState, int]]:
         replica=int(rec["replica"]),
         replica_count=int(rec["replica_count"]),
         standby_count=int(rec["standby_count"]),
+        primary_offset=int(rec["primary_offset"]),
         sequence=int(rec["sequence"]),
         view=int(rec["view"]),
         log_view=int(rec["log_view"]),

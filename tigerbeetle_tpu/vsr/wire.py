@@ -107,6 +107,17 @@ class Operation(enum.IntEnum):
     get_proof = VSR_OPERATIONS_RESERVED + 6
 
 
+def reconfigure_body(replica_count: int, standby_count: int) -> bytes:
+    """Body of an ``Operation.reconfigure`` request: the TARGET membership
+    (vsr.zig ReconfigurationRequest, narrowed to the counts — node
+    identity is positional here, see docs/reconfiguration.md).  16 bytes:
+    <u4 replica_count, <u4 standby_count, 8 reserved>."""
+    return (
+        np.array([replica_count, standby_count], dtype="<u4").tobytes()
+        + b"\x00" * 8
+    )
+
+
 # The shared 128-byte frame prefix (message_header.zig:17-66); per-command
 # tails fill the remaining 128 bytes.
 _FRAME = [

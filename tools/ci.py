@@ -248,6 +248,20 @@ TIERS = {
         # METRICS.json.  Artifact: FUSION_SMOKE.json at the repo root.
         cmd=["tools/fusion_smoke.py"],
     ),
+    "reconfig": dict(
+        # Live-reshaping fault domain smoke (docs/reconfiguration.md):
+        # standby promotion load-bearing through a post-flip primary
+        # kill, a live 2->4 shard split byte-identical to a cold boot at
+        # 4 shards with commits landing between chunks, the pinned
+        # `vopr --reconfig` seed (crash mid-migration + corrupt chunk)
+        # green and byte-identical to its no-reshard oracle with the
+        # --no-verify negative control failing loudly (exit 129), the
+        # tbmc promotion scope exhaustively clean with the seeded
+        # reconfig_stale_quorum knockout caught + defense-replayed, and
+        # the reconfig.* series asserted in METRICS.json.
+        # Artifact: RECONFIG_SMOKE.json at the repo root.
+        cmd=["tools/reconfig_smoke.py"],
+    ),
     "integration": dict(
         # No marker filter: these subprocess/black-box files run whole,
         # INCLUDING their @slow tests — plus the slow stragglers that the
@@ -364,6 +378,19 @@ TIERS = {
             # replay (@slow: a full guided state-space walk + two
             # schedule replays through fresh McClusters).
             "tests/test_mc.py::test_vc_quorum_guided_hunt_and_defense_replay",
+            # Reconfiguration fault domain (PR 20), @slow from day one
+            # (tier-1 budget discipline): the pinned vopr --reconfig
+            # seed + verify-off negative control (two full reshard sim
+            # runs), the exhaustive tbmc promotion-scope sweep (~25k
+            # states), the cold-tiering-under-TB_SHARDS re-admitted seed
+            # pair (full tiered sharded sim runs), and the diurnal/
+            # multi-ledger open-loop arrival pair.
+            "tests/test_reconfig.py::"
+            "test_vopr_reconfig_pinned_seed_and_negative_control",
+            "tests/test_reconfig.py::"
+            "test_mc_reconfig_scope_exhaustively_clean",
+            "tests/test_reconfig.py::test_vopr_cold_tiering_under_shards",
+            "tests/test_reconfig.py::test_openloop_diurnal_and_multiledger",
             # Tier-1 budget audit (PR 5): the 5 slowest tier-1 tests moved
             # to @slow; they run whole here so the full matrix still
             # covers them.
@@ -426,7 +453,7 @@ ORDER = [
     "tidy", "lint", "unit", "kernel", "consensus", "obs", "pipeline",
     "scrub", "merkle", "overload", "waves", "sharded", "async",
     "sanitize", "sync", "byzantine", "mc", "auth", "trace", "fusion",
-    "integration",
+    "reconfig", "integration",
 ]
 
 
